@@ -1,0 +1,61 @@
+"""Single-device MNIST training CLI — the TPU-native counterpart of the
+reference's ``mnist.py`` (reference mnist.py:73-137; SURVEY.md §3.4).
+
+Same flag surface and printed output; runs on one TPU chip (or CPU with
+``--no-accel``/``--no-cuda``).  Training always shuffles — adopting the
+``mnist_ddp.py`` semantics over the reference mnist.py quirk where CPU runs
+never shuffled (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="TPU-native MNIST example")
+    p.add_argument("--batch-size", type=int, default=64, metavar="N",
+                   help="training batch size (default: 64)")
+    p.add_argument("--test-batch-size", type=int, default=1000, metavar="N",
+                   help="eval batch size (default: 1000)")
+    p.add_argument("--epochs", type=int, default=14, metavar="N",
+                   help="number of epochs (default: 14)")
+    p.add_argument("--lr", type=float, default=1.0, metavar="LR",
+                   help="learning rate (default: 1.0)")
+    p.add_argument("--gamma", type=float, default=0.7, metavar="M",
+                   help="lr decay factor per epoch (default: 0.7)")
+    p.add_argument("--no-cuda", "--no-accel", dest="no_accel",
+                   action="store_true", default=False,
+                   help="force CPU (accepts the reference's --no-cuda)")
+    p.add_argument("--dry-run", action="store_true", default=False,
+                   help="run a single batch per epoch")
+    p.add_argument("--seed", type=int, default=1, metavar="S",
+                   help="random seed (default: 1)")
+    p.add_argument("--log-interval", type=int, default=10, metavar="N",
+                   help="batches between train log lines (default: 10)")
+    p.add_argument("--save-model", action="store_true", default=False,
+                   help="save the final model checkpoint")
+    p.add_argument("--data-root", type=str, default="./data",
+                   help="MNIST IDX directory")
+    return p
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+
+    import jax
+
+    if args.no_accel:
+        jax.config.update("jax_platforms", "cpu")
+
+    from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
+    from pytorch_mnist_ddp_tpu.trainer import fit
+
+    # Single-device semantics, like the reference mnist.py (one device, no
+    # collectives); the reference saves to mnist_cnn.pt (mnist.py:133).
+    dist = DistState(devices=jax.devices()[:1])
+    fit(args, dist, save_path="mnist_cnn.pt")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Distributed MNIST training CLI — the TPU-native counterpart of the
+reference's ``mnist_ddp.py`` (reference mnist_ddp.py:108-203; SURVEY.md §3.1).
+
+Launch surface preserved (SURVEY.md N4):
+
+- ``python -m pytorch_mnist_ddp_tpu.parallel.launch --nproc_per_node=4 \\
+  mnist_ddp.py --batch-size 200 --epochs 20`` — the
+  ``torch.distributed.launch`` analogue (reference README.md:42); on TPU
+  this selects 4 local chips in ONE SPMD process.
+- ``RANK``/``WORLD_SIZE`` (+``MASTER_ADDR``/``MASTER_PORT``) or
+  ``SLURM_PROCID`` env: multi-host via ``jax.distributed.initialize``.
+- Bare ``python mnist_ddp.py ...``: prints "Not using distributed mode"
+  and degrades to single-device (reference mnist_ddp.py:25-28).
+
+End of run prints the reference's wall-clock line (its label says "ms",
+the value is seconds — preserved, it is the benchmark surface; reference
+mnist_ddp.py:200-203).
+"""
+
+from __future__ import annotations
+
+import time
+
+from mnist import build_parser
+
+
+def main() -> None:
+    p = build_parser()
+    # DDP-only flags (reference mnist_ddp.py:132-134).  --local_rank is
+    # accepted for launcher compatibility but env vars win, exactly like
+    # the reference (declared :132, never read).
+    p.add_argument("--local_rank", type=int, default=0,
+                   help="accepted for launcher compatibility; env wins")
+    p.add_argument("--world-size", type=int, default=1,
+                   help="number of processes (env WORLD_SIZE wins)")
+    p.add_argument("--dist-url", type=str, default="env://",
+                   help="rendezvous URL for multi-host init")
+    args = p.parse_args()
+
+    import jax
+
+    if args.no_accel:
+        jax.config.update("jax_platforms", "cpu")
+
+    from pytorch_mnist_ddp_tpu.parallel.distributed import init_distributed_mode
+    from pytorch_mnist_ddp_tpu.trainer import fit
+
+    dist = init_distributed_mode(dist_url=args.dist_url)
+    # Checkpoint filename quirk preserved: distributed saves mnist_cnn.pt,
+    # the non-distributed fallback saves mnist_cnn_.pt (trailing
+    # underscore; reference mnist_ddp.py:193-197, SURVEY.md §3.5).
+    save_path = "mnist_cnn.pt" if dist.distributed else "mnist_cnn_.pt"
+    fit(args, dist, save_path=save_path)
+
+
+if __name__ == "__main__":
+    from pytorch_mnist_ddp_tpu.utils.logging import total_time_line
+
+    start = time.time()
+    main()
+    print(total_time_line(time.time() - start))

@@ -1,0 +1,24 @@
+"""tpu-mnist-ddp: a TPU-native training framework with the capabilities of
+``FlyingAnt2018/pytorch_mnist_ddp``.
+
+The reference (mounted at /root/reference) is a canonical PyTorch MNIST
+example trained single-device (mnist.py) or data-parallel with
+DistributedDataParallel + NCCL (mnist_ddp.py).  This package provides the
+same capability surface built TPU-first on JAX/XLA:
+
+- ``data``      — MNIST IDX pipeline + host-sharded loaders
+                  (replaces torchvision.datasets.MNIST / DataLoader /
+                  DistributedSampler; SURVEY.md N5-N8)
+- ``models``    — the 2-conv CNN as a Flax module with PyTorch-parity init
+                  (replaces Net + ATen kernels; SURVEY.md #3, N9)
+- ``ops``       — optimizer (Adadelta), LR schedule (StepLR), losses, and
+                  Pallas TPU kernels (replaces torch.optim / N11, N12)
+- ``parallel``  — device-mesh construction, the jitted data-parallel train
+                  step (psum gradient allreduce over ICI/DCN), distributed
+                  init from env, and a launch-compatible CLI
+                  (replaces torch.distributed / DDP / NCCL; N1-N4)
+- ``utils``     — checkpointing, logging formats, RNG threading, timing
+                  (replaces torch.save / print surface; N13, N15)
+"""
+
+__version__ = "0.1.0"

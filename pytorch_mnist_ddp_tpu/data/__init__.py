@@ -1,0 +1,3 @@
+from .mnist import MNIST, load_mnist_arrays
+from .transforms import normalize, MNIST_MEAN, MNIST_STD
+from .loader import DataLoader

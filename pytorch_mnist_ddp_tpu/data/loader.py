@@ -1,0 +1,199 @@
+"""Host-side input pipeline (replaces ``DataLoader`` + samplers +
+pin-memory workers; SURVEY.md N5-N7).
+
+The reference's loader stack is per-sample Python transforms inside worker
+subprocesses feeding pinned staging buffers (reference mnist_ddp.py:146-151,
+167-168).  The TPU-native pipeline is different in kind:
+
+- Batches are assembled **vectorized** on the host: one fancy-index gather
+  of uint8 images + one fused affine normalize (data/transforms.py) per
+  batch — no per-sample Python, no worker processes needed at MNIST scale.
+- A background prefetch thread stays ``prefetch_depth`` batches ahead and
+  *starts the host->device transfer early* (``device_put`` is async), so
+  the device never waits on the host — the role pin-memory + workers play
+  in the reference, and the real risk to the wall-clock target
+  (SURVEY.md §7 'hard parts': ~12 ms/step budget).
+- Per-host sharding is folded in: each process materializes only its
+  sampler shard (parallel/sampler.py) and placement produces a *global*
+  jax.Array sharded over the mesh ``data`` axis
+  (``jax.make_array_from_process_local_data`` — single- and multi-host).
+- Final partial batches are padded to the static batch shape with a 0/1
+  weight mask so jit never sees a new shape (SURVEY.md §7 'non-divisible
+  eval batches').
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.sampler import epoch_indices, per_rank_count
+from .transforms import normalize
+from ..parallel.mesh import DATA_AXIS
+
+Batch = tuple[jax.Array, jax.Array, jax.Array]  # (x, y, weight-mask)
+
+
+class DataLoader:
+    """Epoch-based batched loader over in-memory uint8 arrays.
+
+    ``global_batch`` is the whole-mesh batch size; each process assembles
+    ``global_batch / process_count`` samples and each device receives
+    ``global_batch / world_size``.  ``epoch(e)`` yields device-placed
+    ``(x, y, w)`` with static shapes.
+    """
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        global_batch: int,
+        mesh: Mesh | None = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        process_rank: int = 0,
+        process_count: int = 1,
+        drop_last: bool = False,
+        prefetch_depth: int = 2,
+        device_place: bool = True,
+        mask_padding: bool = False,
+    ) -> None:
+        if global_batch % process_count:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by "
+                f"{process_count} processes"
+            )
+        self.images = images
+        self.labels = labels.astype(np.int32)
+        self.global_batch = global_batch
+        self.host_batch = global_batch // process_count
+        self.mesh = mesh
+        self.shuffle = shuffle
+        self.seed = seed
+        self.process_rank = process_rank
+        self.process_count = process_count
+        self.drop_last = drop_last
+        # mask_padding: zero-weight the sampler's pad-to-divisible duplicate
+        # samples (eval wants each test sample counted exactly once; train
+        # keeps duplicates live like torch's DistributedSampler).
+        self.mask_padding = mask_padding
+        self.prefetch_depth = prefetch_depth
+        self.device_place = device_place and mesh is not None
+        if self.device_place:
+            n_shards = mesh.shape[DATA_AXIS]
+            if self.global_batch % n_shards:
+                raise ValueError(
+                    f"global batch {global_batch} not divisible by the "
+                    f"{n_shards}-way data axis"
+                )
+            self._shardings = tuple(
+                NamedSharding(mesh, spec) for spec in (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+            )
+
+    def __len__(self) -> int:
+        """Batches per epoch (matches ``len(train_loader)`` in the log-line
+        percentage, reference mnist_ddp.py:79)."""
+        n = per_rank_count(len(self.labels), self.process_count)
+        if self.drop_last:
+            return n // self.host_batch
+        return -(-n // self.host_batch)
+
+    @property
+    def dataset_len(self) -> int:
+        """Global dataset size (the log lines' denominator)."""
+        return len(self.labels)
+
+    # -- host-side assembly --------------------------------------------------
+
+    def _host_batches(self, epoch: int) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        idx, valid = epoch_indices(
+            len(self.labels),
+            self.process_count,
+            self.process_rank,
+            epoch,
+            self.seed,
+            self.shuffle,
+            return_valid=True,
+        )
+        hb = self.host_batch
+        n_full, rem = divmod(len(idx), hb)
+        for b in range(n_full + (0 if (self.drop_last or not rem) else 1)):
+            take = idx[b * hb : (b + 1) * hb]
+            x = normalize(self.images[take])
+            y = self.labels[take]
+            if self.mask_padding:
+                w = valid[b * hb : (b + 1) * hb].astype(np.float32)
+            else:
+                w = np.ones(len(take), np.float32)
+            if len(take) < hb:  # pad the final partial batch, mask it out
+                pad = hb - len(take)
+                x = np.concatenate([x, np.zeros((pad, *x.shape[1:]), x.dtype)])
+                y = np.concatenate([y, np.zeros(pad, y.dtype)])
+                w = np.concatenate([w, np.zeros(pad, np.float32)])
+            yield x, y, w
+
+    def _place(self, host_batch: tuple[np.ndarray, ...]) -> Batch:
+        if not self.device_place:
+            return tuple(map(jax.numpy.asarray, host_batch))  # type: ignore[return-value]
+        return tuple(
+            jax.make_array_from_process_local_data(s, a)
+            for s, a in zip(self._shardings, host_batch)
+        )  # type: ignore[return-value]
+
+    # -- prefetching epoch iterator ------------------------------------------
+
+    def epoch(self, epoch: int) -> Iterator[Batch]:
+        """Yield device-placed batches for one epoch, assembling and
+        transferring ahead of consumption on a background thread."""
+        if self.prefetch_depth <= 0:
+            for hb in self._host_batches(epoch):
+                yield self._place(hb)
+            return
+
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch_depth)
+        stop = threading.Event()
+        _END, _ERR = object(), object()
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer() -> None:
+            try:
+                for hb in self._host_batches(epoch):
+                    if not _put(self._place(hb)):  # device_put = early transfer
+                        return  # consumer abandoned the epoch (e.g. --dry-run)
+                _put(_END)
+            except BaseException as e:  # surfaced on the consumer side
+                _put((_ERR, e))
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+                    raise item[1]
+                yield item
+        finally:
+            # Unblock and reap the producer even if the consumer bailed
+            # mid-epoch (dry-run break, exception in the train loop).
+            stop.set()
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join()

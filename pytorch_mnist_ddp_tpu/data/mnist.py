@@ -1,0 +1,187 @@
+"""MNIST dataset: IDX download + parse, with a deterministic synthetic
+fallback (replaces ``torchvision.datasets.MNIST``; SURVEY.md N8).
+
+The reference downloads the IDX files to ``./data`` on first use
+(``download=True`` for the train split, reference mnist_ddp.py:157).  TPU
+hosts have no torchvision, so this module is self-contained:
+
+1. If the four IDX files exist under ``root`` (or ``$MNIST_DATA_DIR``),
+   parse them.  Both raw and gzip files are accepted.
+2. Else, if downloading is allowed, fetch them from the canonical mirrors.
+3. Else (air-gapped hosts), generate a deterministic *synthetic* MNIST-like
+   dataset — same shapes/dtypes/cardinality (60k/10k uint8 28x28, 10
+   classes), learnable by the reference CNN — so every pipeline, test, and
+   benchmark path runs without network access.  A notice is printed once.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import urllib.request
+
+import numpy as np
+
+_MIRRORS = [
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    "http://yann.lecun.com/exdb/mnist/",
+]
+
+_FILES = {
+    ("train", "images"): "train-images-idx3-ubyte",
+    ("train", "labels"): "train-labels-idx1-ubyte",
+    ("test", "images"): "t10k-images-idx3-ubyte",
+    ("test", "labels"): "t10k-labels-idx1-ubyte",
+}
+
+_IMAGE_MAGIC = 2051
+_LABEL_MAGIC = 2049
+
+
+def parse_idx(raw: bytes) -> np.ndarray:
+    """Parse an IDX-format buffer (big-endian header) into a numpy array."""
+    magic, = struct.unpack(">i", raw[:4])
+    if magic == _IMAGE_MAGIC:
+        n, rows, cols = struct.unpack(">iii", raw[4:16])
+        data = np.frombuffer(raw, dtype=np.uint8, offset=16)
+        return data.reshape(n, rows, cols)
+    if magic == _LABEL_MAGIC:
+        n, = struct.unpack(">i", raw[4:8])
+        return np.frombuffer(raw, dtype=np.uint8, offset=8)[:n]
+    raise ValueError(f"not an MNIST IDX buffer (magic={magic})")
+
+
+def _read_maybe_gz(path: str) -> bytes | None:
+    for candidate, opener in ((path, open), (path + ".gz", gzip.open)):
+        if os.path.exists(candidate):
+            with opener(candidate, "rb") as f:
+                return f.read()
+    return None
+
+
+def _try_download(root: str, filename: str) -> bytes | None:
+    os.makedirs(root, exist_ok=True)
+    for mirror in _MIRRORS:
+        url = mirror + filename + ".gz"
+        try:
+            with urllib.request.urlopen(url, timeout=20) as resp:
+                gz = resp.read()
+            raw = gzip.decompress(gz)
+            with open(os.path.join(root, filename), "wb") as f:
+                f.write(raw)
+            return raw
+        except Exception:
+            continue
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Synthetic fallback
+
+
+def synthetic_mnist(
+    split: str, n: int | None = None, seed: int = 1234
+) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic MNIST-shaped dataset for air-gapped hosts.
+
+    Each class k is a fixed smooth random template (per-class blob pattern);
+    a sample is its template under a random ±2px shift plus pixel noise.
+    The task is learnable to >99% by the reference CNN while remaining
+    non-trivial (shift invariance matters, which exercises the convs).
+    Train and test are drawn from the same distribution with disjoint RNG
+    streams.
+    """
+    if n is None:
+        n = 60000 if split == "train" else 10000
+    rng = np.random.RandomState(seed)  # template stream: shared across splits
+    # 10 class templates: low-frequency random fields, rendered at 36x36 so
+    # shifted 28x28 crops stay fully inside the canvas.
+    freq = rng.normal(size=(10, 6, 6))
+    templates = np.zeros((10, 36, 36), dtype=np.float32)
+    for k in range(10):
+        t = np.kron(freq[k], np.ones((6, 6)))  # 36x36 blocky field
+        # cheap smoothing: two passes of a box blur
+        for _ in range(2):
+            t = (
+                t
+                + np.roll(t, 1, 0) + np.roll(t, -1, 0)
+                + np.roll(t, 1, 1) + np.roll(t, -1, 1)
+            ) / 5.0
+        t = (t - t.min()) / (np.ptp(t) + 1e-8)
+        templates[k] = t
+
+    sample_rng = np.random.RandomState(seed + (1 if split == "train" else 2))
+    labels = sample_rng.randint(0, 10, size=n).astype(np.uint8)
+    shifts = sample_rng.randint(-2, 3, size=(n, 2))
+    noise = sample_rng.normal(0.0, 0.08, size=(n, 28, 28)).astype(np.float32)
+    images = np.empty((n, 28, 28), dtype=np.uint8)
+    base = 4  # crop origin for zero shift
+    for i in range(n):
+        dy, dx = shifts[i]
+        crop = templates[labels[i], base + dy : base + dy + 28, base + dx : base + dx + 28]
+        img = np.clip(crop + noise[i], 0.0, 1.0)
+        images[i] = (img * 255).astype(np.uint8)
+    return images, labels
+
+
+# ---------------------------------------------------------------------------
+
+_synthetic_notice_printed = False
+
+
+def load_mnist_arrays(
+    root: str = "./data",
+    split: str = "train",
+    download: bool = True,
+    allow_synthetic: bool = True,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Return ``(images uint8 [N,28,28], labels uint8 [N])`` for a split.
+
+    Resolution order: ``$MNIST_DATA_DIR`` / ``root`` IDX files -> download
+    (when allowed) -> deterministic synthetic fallback.
+    """
+    root = os.environ.get("MNIST_DATA_DIR", root)
+    arrays = {}
+    for kind in ("images", "labels"):
+        filename = _FILES[(split, kind)]
+        raw = _read_maybe_gz(os.path.join(root, filename))
+        if raw is None and download:
+            raw = _try_download(root, filename)
+        if raw is None:
+            if not allow_synthetic:
+                raise FileNotFoundError(
+                    f"MNIST file {filename} not found in {root} and download failed"
+                )
+            global _synthetic_notice_printed
+            if not _synthetic_notice_printed:
+                print(
+                    "MNIST IDX files unavailable (no local copy, download "
+                    "failed); using deterministic synthetic MNIST-like data"
+                )
+                _synthetic_notice_printed = True
+            return synthetic_mnist(split)
+        arrays[kind] = parse_idx(raw)
+    images, labels = arrays["images"], arrays["labels"]
+    if len(images) != len(labels):
+        raise ValueError("image/label count mismatch")
+    return images, labels
+
+
+class MNIST:
+    """Dataset object: raw uint8 arrays + length; transforms happen at batch
+    time in the loader (vectorized, not per-sample like torchvision)."""
+
+    def __init__(
+        self,
+        root: str = "./data",
+        train: bool = True,
+        download: bool = True,
+        allow_synthetic: bool = True,
+    ) -> None:
+        self.images, self.labels = load_mnist_arrays(
+            root, "train" if train else "test", download, allow_synthetic
+        )
+
+    def __len__(self) -> int:
+        return len(self.images)

@@ -1,0 +1,23 @@
+"""Input transforms (replaces ``transforms.Compose([ToTensor, Normalize])``).
+
+The reference composes ``ToTensor()`` (uint8 HWC -> float32 CHW in [0,1])
+with ``Normalize((0.1307,), (0.3081,))`` (reference mnist.py:112-115,
+mnist_ddp.py:153-156; SURVEY.md §2a #10).  On TPU we keep images in NHWC
+(the TPU-idiomatic layout — SURVEY.md §7 step 2) and fold both steps into
+one vectorized affine transform applied at batch time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MNIST_MEAN = 0.1307
+MNIST_STD = 0.3081
+
+
+def normalize(images_u8: np.ndarray) -> np.ndarray:
+    """uint8 [N,28,28] -> float32 [N,28,28,1], scaled to [0,1] then
+    standardized with the MNIST mean/std, exactly ToTensor∘Normalize."""
+    x = images_u8.astype(np.float32) * (1.0 / 255.0)
+    x = (x - MNIST_MEAN) / MNIST_STD
+    return x[..., None]

@@ -1,0 +1,1 @@
+from .net import Net, init_params, torch_reset_uniform

@@ -1,0 +1,101 @@
+"""The reference CNN as a Flax module (replaces ``Net``; SURVEY.md §2a #3).
+
+Architecture (reference mnist.py:11-34, duplicated at mnist_ddp.py:39-62):
+``Conv(1->32, 3x3) -> relu -> Conv(32->64, 3x3) -> relu -> maxpool(2) ->
+dropout(.25) -> flatten -> Dense(9216->128) -> relu -> dropout(.5) ->
+Dense(128->10) -> log_softmax``.  28x28 input -> 26 -> 24 -> pool -> 12, so
+the flatten width is 64*12*12 = 9216 (~1.2M params).
+
+TPU-first decisions (SURVEY.md §7 step 2):
+
+- **NHWC layout** (TPU-idiomatic; the reference is NCHW).  The flatten
+  therefore orders features H*W*C instead of torch's C*H*W — behaviorally
+  identical, but fc1's weight rows are permuted relative to a torch
+  checkpoint.  ``utils/checkpoint.py`` keeps our native layout;
+  cross-framework interchange would need that permutation.
+- **PyTorch-parity init**: torch's Conv2d/Linear reset is kaiming-uniform
+  with a=sqrt(5), which reduces to U(-1/sqrt(fan_in), +1/sqrt(fan_in)) for
+  both weight and bias.  Flax's default (lecun-normal, zero bias) differs,
+  so we install the torch scheme explicitly (SURVEY.md §7 'hard parts').
+- Optional bfloat16 compute (params stay fp32) to feed the MXU at its
+  native width; log_softmax is always computed in fp32 for stable NLL.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def torch_reset_uniform(gain: float = 1.0) -> nn.initializers.Initializer:
+    """torch's Conv2d/Linear ``reset_parameters`` distribution.
+
+    kaiming_uniform(a=sqrt(5)) over fan_in gives bound
+    ``sqrt(6 / ((1 + 5) * fan_in)) = 1/sqrt(fan_in)``; biases use the same
+    bound.  For Flax HWIO conv kernels and (in, out) dense kernels, fan_in
+    is the product of every dim but the last.
+    """
+
+    def init(key, shape, dtype=jnp.float32):
+        fan_in = 1
+        for d in shape[:-1]:
+            fan_in *= d
+        bound = gain / jnp.sqrt(jnp.asarray(fan_in, jnp.float32))
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+def _bias_init_like(fan_in: int) -> nn.initializers.Initializer:
+    def init(key, shape, dtype=jnp.float32):
+        bound = 1.0 / jnp.sqrt(jnp.asarray(fan_in, jnp.float32))
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+class Net(nn.Module):
+    """2-conv MNIST CNN.  Input: ``[N, 28, 28, 1]`` float32/bfloat16.
+    Output: ``[N, 10]`` float32 log-probabilities."""
+
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(
+            32, (3, 3), padding="VALID", name="conv1", dtype=self.compute_dtype,
+            kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(1 * 9),
+        )(x)
+        x = nn.relu(x)
+        x = nn.Conv(
+            64, (3, 3), padding="VALID", name="conv2", dtype=self.compute_dtype,
+            kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(32 * 9),
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train, name="dropout1")(x)
+        x = x.reshape(x.shape[0], -1)  # [N, 9216] (H*W*C ordering; see module docstring)
+        x = nn.Dense(
+            128, name="fc1", dtype=self.compute_dtype,
+            kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(9216),
+        )(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train, name="dropout2")(x)
+        x = nn.Dense(
+            10, name="fc2", dtype=self.compute_dtype,
+            kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(128),
+        )(x)
+        # fp32 log_softmax regardless of compute dtype: NLL accuracy matters.
+        return jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+
+
+def init_params(key: jax.Array, compute_dtype: jnp.dtype = jnp.float32):
+    """Initialize params from one key.  Every data-parallel replica calls
+    this with the SAME key, which replaces DDP's rank-0 parameter broadcast
+    (reference mnist_ddp.py:172-174; SURVEY.md N3) — replicas are identical
+    by construction rather than by collective."""
+    model = Net(compute_dtype=compute_dtype)
+    dummy = jnp.zeros((1, 28, 28, 1), jnp.float32)
+    return model.init({"params": key}, dummy, train=False)["params"]

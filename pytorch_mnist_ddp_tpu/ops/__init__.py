@@ -1,0 +1,3 @@
+from .adadelta import adadelta_init, adadelta_update, AdadeltaState
+from .schedule import step_lr
+from .loss import nll_loss

@@ -1,0 +1,63 @@
+"""Adadelta with exact torch-update parity (replaces ``optim.Adadelta``;
+SURVEY.md N11).
+
+The reference constructs ``optim.Adadelta(params, lr=1.0)`` with defaults
+``rho=0.9, eps=1e-6, weight_decay=0`` (reference mnist.py:124,
+mnist_ddp.py:176).  torch's update, reproduced exactly (eps placement
+*inside* both square roots):
+
+    square_avg <- rho * square_avg + (1-rho) * g^2
+    delta      <- sqrt(acc_delta + eps) / sqrt(square_avg + eps) * g
+    acc_delta  <- rho * acc_delta + (1-rho) * delta^2
+    p          <- p - lr * delta
+
+State is two accumulators per parameter (``square_avg``, ``acc_delta``),
+initialized to zeros like torch.  ``lr`` is a traced scalar so the
+epoch-stepped StepLR schedule (``ops/schedule.py``) never retriggers
+compilation.  Implemented as a pure pytree transform (jit/shard_map
+friendly) rather than a stateful class; parity is pinned by
+``tests/test_adadelta.py`` against ``torch.optim.Adadelta``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AdadeltaState(NamedTuple):
+    square_avg: Any  # pytree like params
+    acc_delta: Any   # pytree like params
+
+
+def adadelta_init(params: Any) -> AdadeltaState:
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    return AdadeltaState(square_avg=zeros, acc_delta=jax.tree.map(jnp.zeros_like, params))
+
+
+def adadelta_update(
+    params: Any,
+    grads: Any,
+    state: AdadeltaState,
+    lr: jax.Array | float,
+    rho: float = 0.9,
+    eps: float = 1e-6,
+    weight_decay: float = 0.0,
+) -> tuple[Any, AdadeltaState]:
+    """One Adadelta step over a whole parameter pytree."""
+
+    def leaf(p, g, sq, ac):
+        if weight_decay:
+            g = g + weight_decay * p
+        sq = rho * sq + (1.0 - rho) * g * g
+        delta = jnp.sqrt(ac + eps) / jnp.sqrt(sq + eps) * g
+        ac = rho * ac + (1.0 - rho) * delta * delta
+        return p - lr * delta, sq, ac
+
+    flat = jax.tree.map(leaf, params, grads, state.square_avg, state.acc_delta)
+    new_params = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
+    new_sq = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+    new_ac = jax.tree.map(lambda t: t[2], flat, is_leaf=lambda t: isinstance(t, tuple))
+    return new_params, AdadeltaState(new_sq, new_ac)

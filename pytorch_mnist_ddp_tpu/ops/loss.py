@@ -1,0 +1,42 @@
+"""Losses (replaces ``F.nll_loss``; SURVEY.md N9).
+
+The reference computes ``F.nll_loss(log_probs, target)`` with mean
+reduction in training (reference mnist_ddp.py:71) and sum reduction in eval
+(mnist_ddp.py:97).  Because jit needs static shapes, partial final batches
+are padded and carried with a 0/1 weight vector; the weighted forms below
+reduce to the reference's exact numbers on unpadded data.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def nll_loss(
+    log_probs: jax.Array,
+    targets: jax.Array,
+    weights: jax.Array | None = None,
+    reduction: str = "mean",
+) -> jax.Array:
+    """Negative log likelihood from log-probabilities.
+
+    ``weights`` (0/1 per sample) masks padding: 'mean' divides by the real
+    sample count, 'sum' adds only real samples — matching torch on unpadded
+    input.
+    """
+    per_sample = -jnp.take_along_axis(
+        log_probs, targets[:, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    if weights is not None:
+        per_sample = per_sample * weights
+        denom = jnp.maximum(weights.sum(), 1.0)
+    else:
+        denom = per_sample.shape[0]
+    if reduction == "mean":
+        return per_sample.sum() / denom
+    if reduction == "sum":
+        return per_sample.sum()
+    if reduction == "none":
+        return per_sample
+    raise ValueError(f"unknown reduction {reduction!r}")

@@ -1,0 +1,25 @@
+"""Learning-rate schedules (replaces ``StepLR``; SURVEY.md N12).
+
+The reference steps ``StepLR(optimizer, step_size=1, gamma=0.7)`` once per
+epoch (reference mnist.py:126-130, mnist_ddp.py:178,189), i.e. the lr for
+epoch e (1-based) is ``lr * gamma**((e-1)//step_size)``.  Here the schedule
+is a pure function of the epoch index; the epoch driver feeds the resulting
+scalar into the jitted train step as a traced argument (no recompilation
+per epoch).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+def step_lr(base_lr: float, gamma: float = 0.7, step_size: int = 1) -> Callable[[int], float]:
+    """Return ``epoch (1-based) -> lr`` with StepLR semantics: the lr decays
+    by ``gamma`` after every ``step_size`` epochs (so epoch 1 uses
+    ``base_lr``, matching torch where ``scheduler.step()`` runs at epoch
+    end)."""
+
+    def lr_for_epoch(epoch: int) -> float:
+        return base_lr * gamma ** ((epoch - 1) // step_size)
+
+    return lr_for_epoch

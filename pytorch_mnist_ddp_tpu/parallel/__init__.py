@@ -1,0 +1,4 @@
+from .sampler import epoch_indices, per_rank_count
+from .mesh import make_mesh, data_sharding, replicated_sharding
+from .distributed import init_distributed_mode, DistState
+from .ddp import make_train_step, make_eval_step, replicate_params

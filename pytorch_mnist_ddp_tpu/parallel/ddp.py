@@ -1,0 +1,130 @@
+"""The data-parallel train/eval steps (replaces ``DistributedDataParallel``
++ NCCL allreduce + the autograd engine surface; SURVEY.md N2/N3/N10).
+
+Where the reference reaches gradient sync through autograd hooks firing
+bucketed NCCL allreduces overlapped with backward (reference
+mnist_ddp.py:172-174; SURVEY.md §3.2), the TPU-native shape is ONE function:
+the whole hot loop — forward, loss, backward, gradient ``pmean`` over the
+``data`` mesh axis, Adadelta update — is traced once and compiled by
+XLA:TPU, which schedules the ICI collectives overlapped with the remaining
+backward computation itself (latency-hiding scheduler).  ``lax.pmean`` is
+exactly DDP's sum-divided-by-world semantics.
+
+Reference-quirk decisions, deliberate (SURVEY.md §3.2-3.3):
+
+- The returned per-step loss is the stack of *per-replica local* losses;
+  callers log element 0, reproducing the reference's "rank-0 local loss,
+  not allreduced" logging — and since it is returned as a device array, no
+  ``loss.item()``-style sync stall exists unless the caller forces one.
+- Eval is fully data-parallel with a ``psum`` of (loss_sum, correct_count)
+  — same printed numbers as the reference's rank-0-only eval but without
+  idling N-1 replicas (fixes the bubble noted in SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.net import Net
+from ..ops.adadelta import AdadeltaState, adadelta_init, adadelta_update
+from ..ops.loss import nll_loss
+from .mesh import DATA_AXIS
+
+
+class TrainState(NamedTuple):
+    """Replicated training state: params + Adadelta accumulators + step."""
+
+    params: Any
+    opt: AdadeltaState
+    step: jax.Array  # int32 global step counter (drives per-step dropout keys)
+
+
+def make_train_state(params: Any) -> TrainState:
+    return TrainState(params=params, opt=adadelta_init(params), step=jnp.int32(0))
+
+
+def replicate_params(tree: Any, mesh: Mesh) -> Any:
+    """Place a pytree fully-replicated on the mesh.  Together with same-key
+    init (models/net.py:init_params) this replaces DDP's rank-0 broadcast."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+def make_train_step(
+    mesh: Mesh,
+    compute_dtype: jnp.dtype = jnp.float32,
+    rho: float = 0.9,
+    eps: float = 1e-6,
+    dropout: bool = True,
+):
+    """Build the jitted DP train step.
+
+    Returns ``step_fn(state, x, y, w, dropout_key, lr) -> (state, losses)``
+    where ``x`` is the *global* batch (sharded over the ``data`` axis by the
+    input pipeline), ``w`` the 0/1 padding mask, and ``losses`` a
+    ``[num_data_shards]`` array of per-replica local losses.
+    """
+    model = Net(compute_dtype=compute_dtype)
+
+    def local_step(state: TrainState, x, y, w, dropout_key, lr):
+        # Per-step, per-replica dropout stream folded from the single root
+        # seed (reference semantics: one global seed; SURVEY.md N15).
+        key = jax.random.fold_in(dropout_key, state.step)
+        key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+
+        def loss_fn(params):
+            log_probs = model.apply(
+                {"params": params}, x, train=dropout, rngs={"dropout": key}
+            )
+            return nll_loss(log_probs, y, w, reduction="mean")
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        # The DDP allreduce: mean over replicas == bucketed NCCL sum / world.
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        params, opt = adadelta_update(state.params, grads, state.opt, lr, rho, eps)
+        new_state = TrainState(params=params, opt=opt, step=state.step + 1)
+        return new_state, loss[None]  # keep a per-shard loss axis
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        out_specs=(P(), P(DATA_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_eval_step(mesh: Mesh, compute_dtype: jnp.dtype = jnp.float32):
+    """Build the jitted distributed eval step.
+
+    Returns ``eval_fn(params, x, y, w) -> (loss_sum, correct)`` — the
+    sum-reduced NLL (reference mnist_ddp.py:97) and the argmax-match count
+    (mnist_ddp.py:98-99) over the REAL (unpadded) samples of the global
+    batch, psum'd over the mesh so every process holds the totals.
+    """
+    model = Net(compute_dtype=compute_dtype)
+
+    def local_eval(params, x, y, w):
+        log_probs = model.apply({"params": params}, x, train=False)
+        loss_sum = nll_loss(log_probs, y, w, reduction="sum")
+        pred = jnp.argmax(log_probs, axis=1)
+        correct = ((pred == y) * w).sum()
+        # Distributed eval: one psum replaces the reference's rank-0-only
+        # eval bubble (SURVEY.md §3.3), printed numbers unchanged.
+        totals = jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
+        return totals
+
+    sharded = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

@@ -1,0 +1,137 @@
+"""World formation from the environment (replaces ``init_distributed_mode``,
+reference mnist_ddp.py:13-37; SURVEY.md N1/N4).
+
+The reference's contract, preserved here:
+
+- ``RANK`` / ``WORLD_SIZE`` / ``LOCAL_RANK`` env vars select distributed
+  mode (mnist_ddp.py:16-19); ``SLURM_PROCID`` is the fallback
+  (mnist_ddp.py:20-22); with neither, the script prints
+  "Not using distributed mode" and degrades to single-device
+  (mnist_ddp.py:25-28).
+- ``MASTER_ADDR``/``MASTER_PORT`` (the ``env://`` init method,
+  mnist_ddp.py:134) provide the rendezvous address.
+
+The JAX mapping differs in one structural way: a torch process drives ONE
+GPU, while a JAX process drives EVERY local chip (SPMD).  So:
+
+- ``RANK``/``WORLD_SIZE`` count *processes* (= hosts); multi-host world
+  formation is ``jax.distributed.initialize`` (the DCN rendezvous that
+  replaces TCPStore+NCCL bootstrap).
+- The launcher's ``--nproc_per_node=N`` (reference README.md:42) maps to
+  "N local devices in one process" and is conveyed by ``NPROC_PER_NODE``
+  (see ``parallel/launch.py``).
+- The *data-parallel world size* (the reference's GPU count, used for the
+  global sample counter at mnist_ddp.py:78) is the total device count.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import jax
+
+from ..utils.logging import NOT_DISTRIBUTED_NOTICE, distributed_init_banner
+
+
+@dataclass
+class DistState:
+    """Resolved distributed topology for this process."""
+
+    distributed: bool = False
+    process_rank: int = 0      # sampler-sharding rank (one shard per host)
+    process_count: int = 1
+    world_size: int = 1        # total devices = data-parallel degree
+    local_rank: int = 0
+    devices: list = field(default_factory=list)
+    dist_url: str = "env://"
+
+    @property
+    def is_chief(self) -> bool:
+        """Rank-0 gate for logging/eval/checkpointing (mnist_ddp.py:75)."""
+        return self.process_rank == 0
+
+    @property
+    def local_device_count(self) -> int:
+        return len(self.devices)
+
+
+def _coordinator_address(dist_url: str) -> str | None:
+    if dist_url and dist_url != "env://":
+        return dist_url.removeprefix("tcp://")
+    addr = os.environ.get("MASTER_ADDR")
+    port = os.environ.get("MASTER_PORT")
+    if addr and port:
+        return f"{addr}:{port}"
+    return None
+
+
+def init_distributed_mode(
+    dist_url: str = "env://",
+    devices_per_process: int | None = None,
+    quiet: bool = False,
+) -> DistState:
+    """Resolve the world from the environment, mirroring the reference's
+    decision tree (mnist_ddp.py:13-37), and return a ``DistState``.
+
+    ``devices_per_process`` caps how many local devices join the mesh
+    (the ``--nproc_per_node`` request); ``None`` uses all of them.
+    """
+    env = os.environ
+    # --nproc_per_node caps local devices in every mode (the launcher sets
+    # NPROC_PER_NODE for both single- and multi-node runs).
+    if devices_per_process is None and "NPROC_PER_NODE" in env:
+        devices_per_process = int(env["NPROC_PER_NODE"])
+    if "RANK" in env and "WORLD_SIZE" in env:
+        process_rank = int(env["RANK"])
+        process_count = int(env["WORLD_SIZE"])
+        local_rank = int(env.get("LOCAL_RANK", 0))
+    elif "SLURM_PROCID" in env:
+        process_rank = int(env["SLURM_PROCID"])
+        process_count = int(env.get("SLURM_NTASKS", 1))
+        local_rank = 0
+    elif devices_per_process is not None:
+        # Single-host SPMD: one process drives N local devices.
+        process_rank, process_count, local_rank = 0, 1, 0
+    else:
+        if not quiet:
+            print(NOT_DISTRIBUTED_NOTICE)
+        return DistState(devices=jax.local_devices()[:1], dist_url=dist_url)
+
+    if process_count > 1 and not jax.distributed.is_initialized():
+        # Multi-host rendezvous (replaces TCPStore + NCCL bootstrap).
+        # NOTE: must run before anything touches the XLA backend — even
+        # jax.process_count() would initialize it and make this raise.
+        jax.distributed.initialize(
+            coordinator_address=_coordinator_address(dist_url),
+            num_processes=process_count,
+            process_id=process_rank,
+        )
+
+    local = jax.local_devices()
+    if devices_per_process is not None:
+        if devices_per_process > len(local):
+            raise RuntimeError(
+                f"--nproc_per_node={devices_per_process} requested but only "
+                f"{len(local)} local device(s) are available"
+            )
+        local = local[:devices_per_process]
+
+    world_size = len(local) * process_count
+    state = DistState(
+        distributed=True,
+        process_rank=process_rank,
+        process_count=process_count,
+        world_size=world_size,
+        local_rank=local_rank,
+        devices=local,
+        dist_url=dist_url,
+    )
+    if not quiet:
+        print(
+            distributed_init_banner(
+                state.process_rank, dist_url, state.local_rank, state.world_size
+            ),
+            flush=True,
+        )
+    return state

@@ -1,0 +1,70 @@
+"""Launcher CLI (replaces ``torch.distributed.launch``; SURVEY.md N4).
+
+The reference is launched as ``python -m torch.distributed.launch
+--nproc_per_node=4 mnist_ddp.py --batch-size 200 --epochs 20`` (reference
+README.md:42), which forks one process per GPU and sets
+``RANK``/``WORLD_SIZE``/``LOCAL_RANK``.  On TPU the idiomatic topology is
+ONE process per host driving all local chips (SPMD), so this launcher:
+
+- single host: sets ``NPROC_PER_NODE=N`` and runs the script in one child
+  process; ``init_distributed_mode`` builds an N-device mesh.  On the CPU
+  backend it forces N virtual host devices via
+  ``--xla_force_host_platform_device_count`` so the same command line
+  exercises real sharding on a laptop/CI (SURVEY.md §4).
+- multi host (``--nnodes``/``--node_rank``/``--master_addr``/
+  ``--master_port``): exports the reference's env contract
+  (``RANK``/``WORLD_SIZE``/``MASTER_ADDR``/``MASTER_PORT``) with
+  rank = node_rank — one process per node.
+
+Usage: ``python -m pytorch_mnist_ddp_tpu.parallel.launch
+--nproc_per_node=4 [--backend cpu] mnist_ddp.py ...script args...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description="TPU-native distributed launcher")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="devices to use on this host")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--master_addr", type=str, default="127.0.0.1")
+    p.add_argument("--master_port", type=str, default="29500")
+    p.add_argument("--backend", type=str, default=None,
+                   help="force a JAX platform (e.g. cpu for virtual devices)")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+
+    env = dict(os.environ)
+    env["NPROC_PER_NODE"] = str(args.nproc_per_node)
+    if args.nnodes > 1:
+        env["RANK"] = str(args.node_rank)
+        env["WORLD_SIZE"] = str(args.nnodes)
+        env["LOCAL_RANK"] = "0"
+        env["MASTER_ADDR"] = args.master_addr
+        env["MASTER_PORT"] = args.master_port
+    if args.backend:
+        env["JAX_PLATFORMS"] = args.backend
+        if args.backend == "cpu":
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.nproc_per_node}"
+            ).strip()
+            # Keep the axon sitecustomize from re-registering the TPU in
+            # the child when a CPU run was explicitly requested.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    cmd = [sys.executable, args.script, *args.script_args]
+    return subprocess.call(cmd, env=env)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,66 @@
+"""Device mesh construction and sharding helpers.
+
+This is the TPU-native replacement for the reference's device-binding +
+backend plumbing (``torch.cuda.set_device`` + NCCL process group, reference
+mnist_ddp.py:32-37; SURVEY.md N1/N2/N14).  Instead of one process per GPU
+with rank-indexed device pinning, a JAX process addresses every local chip
+and parallelism is expressed as shardings over a named
+``jax.sharding.Mesh``:
+
+- axis ``'data'``  — data parallelism (the reference's whole capability)
+- axis ``'model'`` — tensor/model parallelism (kept available so the mesh
+  design doesn't paint us into a DP-only corner; SURVEY.md §2c)
+
+Collectives over these axes lower to XLA ICI/DCN collectives; there is no
+user-visible comm backend to select (the ``"nccl"`` hard-coding at
+mnist_ddp.py:33 has no TPU analogue — SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    num_data: int | None = None,
+    num_model: int = 1,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a ``(data, model)`` mesh over the given (default: all) devices.
+
+    ``num_data=None`` uses every remaining device on the data axis.  The
+    data axis is outermost so neighboring devices (fastest ICI links) form
+    the model groups and gradient allreduce rides the longer rings.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if num_data is None:
+        if len(devices) % num_model:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by model={num_model}"
+            )
+        num_data = len(devices) // num_model
+    need = num_data * num_model
+    if need > len(devices):
+        raise ValueError(
+            f"requested {num_data}x{num_model} mesh but only "
+            f"{len(devices)} devices are available"
+        )
+    grid = np.asarray(devices[:need]).reshape(num_data, num_model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-leading sharding for input arrays: split dim 0 over 'data'."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (params/opt state under pure DP)."""
+    return NamedSharding(mesh, P())

@@ -1,0 +1,69 @@
+"""Index sampling with DistributedSampler-parity semantics (SURVEY.md N5/N6).
+
+The reference uses three torch samplers (reference mnist_ddp.py:161-165):
+
+- ``DistributedSampler(train set)`` in distributed mode: pads the dataset to
+  ``ceil(N/world) * world`` samples by repeating leading indices so every
+  rank draws an equal count, shards by ``indices[rank::world]``, and
+  reshuffles each epoch from an epoch-seeded generator activated by
+  ``set_epoch(epoch)`` (mnist_ddp.py:180-181).
+- ``RandomSampler`` for non-distributed train shuffle (mnist_ddp.py:164).
+- ``SequentialSampler`` for deterministic eval order (mnist_ddp.py:165).
+
+This module reproduces those *semantics* (equal per-rank counts, disjoint
+cover modulo padding, epoch-seeded reshuffle, deterministic eval) with
+numpy PRNG.  The exact permutation values differ from torch's Mersenne
+generator — the contract preserved is the semantic one (SURVEY.md §4
+'Sampler contract tests').
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def epoch_indices(
+    n: int,
+    world_size: int = 1,
+    rank: int = 0,
+    epoch: int = 0,
+    seed: int = 0,
+    shuffle: bool = True,
+    return_valid: bool = False,
+) -> np.ndarray | tuple[np.ndarray, np.ndarray]:
+    """Per-rank sample indices for one epoch.
+
+    With ``world_size == 1`` and ``shuffle`` this is RandomSampler; with
+    ``shuffle=False`` it is SequentialSampler; otherwise it implements the
+    DistributedSampler contract: pad to divisible, epoch-seeded permutation,
+    strided rank slice.
+
+    ``return_valid=True`` additionally returns a bool mask marking entries
+    that are real samples rather than padding duplicates.  Training keeps
+    the duplicates live (torch's DistributedSampler trains on them too);
+    eval masks them so global loss/accuracy totals count every test sample
+    exactly once (see data/loader.py ``mask_padding``).
+    """
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    if shuffle:
+        # seed + epoch mirrors torch's DistributedSampler generator seeding;
+        # a fresh permutation per epoch is the set_epoch(...) behavior.
+        indices = np.random.RandomState(seed + epoch).permutation(n)
+    else:
+        indices = np.arange(n)
+    if world_size == 1:
+        return (indices, np.ones(n, bool)) if return_valid else indices
+    num_samples = -(-n // world_size)  # ceil
+    total = num_samples * world_size
+    if total > n:
+        indices = np.concatenate([indices, indices[: total - n]])
+    positions = np.arange(rank, total, world_size)
+    if return_valid:
+        return indices[positions], positions < n
+    return indices[positions]
+
+
+def per_rank_count(n: int, world_size: int) -> int:
+    """Samples each rank draws per epoch (after padding)."""
+    return -(-n // world_size)

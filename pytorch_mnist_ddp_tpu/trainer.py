@@ -1,0 +1,172 @@
+"""Epoch driver: train loop, eval loop, checkpoint tail (replaces the
+``train()``/``test()``/``main()`` bodies the reference duplicates across
+mnist.py and mnist_ddp.py; SURVEY.md §2a #5-#8).
+
+One driver serves both CLIs — single-device is simply a 1-device mesh, the
+exact analogue of the reference's "Not using distributed mode" degradation
+(reference mnist_ddp.py:25-28).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .data.loader import DataLoader
+from .data.mnist import MNIST
+from .models.net import init_params
+from .ops.schedule import step_lr
+from .parallel.ddp import (
+    TrainState,
+    make_eval_step,
+    make_train_state,
+    make_train_step,
+    replicate_params,
+)
+from .parallel.distributed import DistState
+from .parallel.mesh import DATA_AXIS, make_mesh
+from .utils.checkpoint import model_state_dict, save_state_dict
+from .utils.logging import test_summary_lines, train_log_line
+from .utils.rng import root_key, split_streams
+
+
+def train_one_epoch(
+    step_fn,
+    state: TrainState,
+    loader: DataLoader,
+    epoch: int,
+    dropout_key: jax.Array,
+    lr: float,
+    dist: DistState,
+    log_interval: int = 10,
+    dry_run: bool = False,
+    per_rank_batch: int | None = None,
+) -> TrainState:
+    """One training epoch (reference train(), mnist_ddp.py:65-86).
+
+    Logging preserves the reference's exact semantics: chief-only, every
+    ``log_interval`` batches, global sample counter
+    ``world_size * batch_idx * per_rank_batch`` (mnist_ddp.py:78), and the
+    logged loss is the FIRST replica's local loss — fetched from device
+    only on log steps, so there is no per-step sync stall (SURVEY.md §3.2).
+    """
+    lr_arr = jnp.float32(lr)
+    num_batches = len(loader)
+    if per_rank_batch is None:
+        per_rank_batch = loader.global_batch // max(dist.world_size, 1)
+    for batch_idx, (x, y, w) in enumerate(loader.epoch(epoch)):
+        state, losses = step_fn(state, x, y, w, dropout_key, lr_arr)
+        if dist.is_chief and batch_idx % log_interval == 0:
+            samples = dist.world_size * batch_idx * per_rank_batch
+            if not dist.distributed:
+                samples = batch_idx * per_rank_batch
+            print(
+                train_log_line(
+                    epoch,
+                    samples,
+                    loader.dataset_len,
+                    batch_idx,
+                    num_batches,
+                    float(losses[0]),
+                )
+            )
+        if dry_run:
+            break
+    return state
+
+
+def evaluate(
+    eval_fn,
+    params,
+    loader: DataLoader,
+    dist: DistState,
+) -> tuple[float, int]:
+    """Distributed eval (reference test(), mnist_ddp.py:89-105): sums NLL
+    and correct counts over the full test set, psum'd across the mesh, and
+    prints the reference's summary on the chief.  Returns (avg_loss,
+    correct)."""
+    loss_sum = 0.0
+    correct = 0.0
+    for x, y, w in loader.epoch(0):
+        totals = eval_fn(params, x, y, w)
+        loss_sum += float(totals[0])
+        correct += float(totals[1])
+    n = loader.dataset_len
+    avg = loss_sum / n
+    if dist.is_chief:
+        print(test_summary_lines(avg, int(correct), n))
+    return avg, int(correct)
+
+
+def fit(args, dist: DistState, save_path: str | None = None) -> TrainState:
+    """Full training run: data, model, optimizer, epoch loop, final save —
+    the body of the reference's main() (mnist_ddp.py:108-197)."""
+    if dist.distributed:
+        # Multi-host: the mesh spans every device in the world (JAX's global
+        # view); single-host: the (possibly --nproc_per_node-capped) locals.
+        devs = jax.devices() if dist.process_count > 1 else dist.devices
+        mesh = make_mesh(devices=devs)
+    else:
+        mesh = make_mesh(num_data=1, devices=dist.devices or jax.devices()[:1])
+    n_shards = mesh.shape[DATA_AXIS]
+
+    train_set = MNIST(root=getattr(args, "data_root", "./data"), train=True)
+    test_set = MNIST(root=getattr(args, "data_root", "./data"), train=False)
+
+    keys = split_streams(root_key(args.seed))
+    params = init_params(keys["init"])
+    state = replicate_params(make_train_state(params), mesh)
+
+    global_batch = args.batch_size * n_shards
+    train_loader = DataLoader(
+        train_set.images,
+        train_set.labels,
+        global_batch,
+        mesh=mesh,
+        shuffle=True,
+        seed=args.seed,
+        process_rank=dist.process_rank,
+        process_count=dist.process_count,
+    )
+    eval_batch = -(-args.test_batch_size // n_shards) * n_shards
+    test_loader = DataLoader(
+        test_set.images,
+        test_set.labels,
+        eval_batch,
+        mesh=mesh,
+        shuffle=False,
+        process_rank=dist.process_rank,
+        process_count=dist.process_count,
+        # Count every test sample exactly once in the psum'd totals, even
+        # when the sampler pads ranks to equal length (multi-host).
+        mask_padding=True,
+    )
+
+    step_fn = make_train_step(mesh)
+    eval_fn = make_eval_step(mesh)
+    lr_fn = step_lr(args.lr, args.gamma, step_size=1)
+
+    for epoch in range(1, args.epochs + 1):
+        state = train_one_epoch(
+            step_fn,
+            state,
+            train_loader,
+            epoch,
+            keys["dropout"],
+            lr_fn(epoch),
+            dist,
+            log_interval=args.log_interval,
+            dry_run=args.dry_run,
+            per_rank_batch=args.batch_size,
+        )
+        evaluate(eval_fn, state.params, test_loader, dist)
+        # scheduler.step() is implicit: lr_fn(epoch+1) next iteration.
+
+    if getattr(args, "save_model", False) and save_path and dist.is_chief:
+        # DDP-mode checkpoints carry the module. key prefix quirk
+        # (reference mnist_ddp.py:195; SURVEY.md §3.5).
+        sd = model_state_dict(
+            jax.device_get(state.params), ddp_prefix=dist.distributed
+        )
+        save_state_dict(sd, save_path)
+    return state

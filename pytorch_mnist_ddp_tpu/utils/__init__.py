@@ -1,0 +1,9 @@
+from .rng import root_key, split_streams, fold_step
+from .logging import (
+    train_log_line,
+    test_summary_lines,
+    distributed_init_banner,
+    total_time_line,
+)
+from .timer import WallClock
+from .checkpoint import save_state_dict, load_state_dict, model_state_dict

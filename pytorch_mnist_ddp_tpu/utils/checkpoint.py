@@ -1,0 +1,87 @@
+"""Checkpointing (replaces ``torch.save(model.state_dict())``; SURVEY.md N13).
+
+The reference saves a final-only, rank-0-gated checkpoint behind
+``--save-model`` (reference mnist_ddp.py:191-197, mnist.py:132-133), with
+two quirks preserved here because they are part of the observable surface:
+
+- In distributed mode the saved keys carry a ``module.`` prefix (the DDP
+  wrapper's state dict, mnist_ddp.py:195).
+- The non-distributed ``mnist_ddp`` path writes ``mnist_cnn_.pt`` (trailing
+  underscore, mnist_ddp.py:197) while distributed and ``mnist.py`` write
+  ``mnist_cnn.pt``.
+
+Format: a ``numpy.savez`` archive of flat ``name -> array`` entries
+(``conv1.weight``-style dotted keys).  Unlike the reference, a load path is
+provided (the reference has no ``torch.load`` anywhere; SURVEY.md §5
+'Checkpoint / resume').
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+# Flax param-name → torch state-dict-name translation for the Net module:
+# flax uses {'kernel','bias'}, torch uses {'weight','bias'}.
+_LEAF_RENAME = {"kernel": "weight", "bias": "bias"}
+_LEAF_RENAME_INV = {"weight": "kernel", "bias": "bias"}
+
+
+def _flatten(tree: Mapping[str, Any], prefix: str = "") -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    for name, value in tree.items():
+        if isinstance(value, Mapping):
+            out.update(_flatten(value, prefix + name + "."))
+        else:
+            leaf = _LEAF_RENAME.get(name, name)
+            out[prefix + leaf] = np.asarray(value)
+    return out
+
+
+def model_state_dict(params: Mapping[str, Any], ddp_prefix: bool = False) -> dict[str, np.ndarray]:
+    """Flatten a Flax param tree into a torch-style flat state dict.
+
+    ``ddp_prefix=True`` reproduces the reference's distributed-mode quirk of
+    saving the wrapped module's keys (``module.conv1.weight`` etc.,
+    mnist_ddp.py:195).
+    """
+    flat = _flatten(params)
+    if ddp_prefix:
+        flat = {"module." + k: v for k, v in flat.items()}
+    return flat
+
+
+def save_state_dict(state: Mapping[str, np.ndarray], path: str) -> None:
+    """Atomic write of a flat state dict (np.savez archive)."""
+    state = {k: np.asarray(jax.device_get(v)) for k, v in state.items()}
+    buf = io.BytesIO()
+    np.savez(buf, **state)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)
+
+
+def load_state_dict(path: str) -> dict[str, np.ndarray]:
+    with np.load(path) as archive:
+        return {k: archive[k] for k in archive.files}
+
+
+def params_from_state_dict(state: Mapping[str, np.ndarray]) -> dict[str, Any]:
+    """Rebuild a nested Flax param tree from a flat torch-style state dict,
+    accepting (and stripping) the ``module.`` prefix quirk."""
+    tree: dict[str, Any] = {}
+    for key, value in state.items():
+        parts = key.split(".")
+        if parts[0] == "module":
+            parts = parts[1:]
+        parts[-1] = _LEAF_RENAME_INV.get(parts[-1], parts[-1])
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return tree

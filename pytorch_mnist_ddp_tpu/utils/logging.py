@@ -1,0 +1,65 @@
+"""stdout observability surface, byte-matched to the reference.
+
+The reference's only observability is ``print()`` (SURVEY.md §5): a train
+progress line (reference mnist_ddp.py:77-79), a test summary
+(mnist_ddp.py:103-105), a distributed-init banner (mnist_ddp.py:34), the
+"Not using distributed mode" fallback notice (mnist_ddp.py:26), and the
+end-of-run wall-clock line (mnist_ddp.py:203 — whose label says "ms" while
+the value is seconds; that quirk is part of the published benchmark surface
+and is preserved verbatim).
+
+These helpers return strings; callers decide rank-gating (process 0 only in
+distributed mode, mnist_ddp.py:75).
+"""
+
+from __future__ import annotations
+
+
+def train_log_line(
+    epoch: int,
+    samples_seen: int,
+    dataset_len: int,
+    batch_idx: int,
+    num_batches: int,
+    loss: float,
+) -> str:
+    """Train progress line (reference mnist_ddp.py:77-79 / mnist.py:46-48).
+
+    In distributed mode the caller passes the *global* sample counter
+    ``world_size * batch_idx * batch_size`` (mnist_ddp.py:78); ``loss`` is
+    the process-0-local (first-replica) loss, not an allreduced mean —
+    preserving the reference's logging semantics (SURVEY.md §3.2).
+    """
+    pct = 100.0 * batch_idx / num_batches
+    return "Train Epoch: {} [{}/{} ({:.0f}%)]\tLoss: {:.6f}".format(
+        epoch, samples_seen, dataset_len, pct, loss
+    )
+
+
+def test_summary_lines(avg_loss: float, correct: int, dataset_len: int) -> str:
+    """Test summary (reference mnist_ddp.py:103-105): leading and trailing
+    newline included, accuracy over the full test set."""
+    pct = 100.0 * correct / dataset_len
+    return "\nTest set: Average loss: {:.4f}, Accuracy: {}/{} ({:.0f}%)\n".format(
+        avg_loss, correct, dataset_len, pct
+    )
+
+
+def distributed_init_banner(
+    rank: int, dist_url: str, local_rank: int, world_size: int
+) -> str:
+    """Distributed init banner (reference mnist_ddp.py:34)."""
+    return (
+        f"| distributed init (rank {rank}): {dist_url}, "
+        f"local rank:{local_rank}, world size:{world_size}"
+    )
+
+
+NOT_DISTRIBUTED_NOTICE = "Not using distributed mode"
+
+
+def total_time_line(elapsed_seconds: float) -> str:
+    """End-of-run wall clock (reference mnist_ddp.py:203).  The label reads
+    "ms" but the value is seconds — the README speed table was produced by
+    this exact line, so it is preserved byte-for-byte (SURVEY.md §2a #9)."""
+    return f"Total cost time:{elapsed_seconds} ms"

@@ -1,0 +1,36 @@
+"""PRNG threading for the framework.
+
+The reference uses one global seed (``torch.manual_seed(args.seed)``,
+reference mnist_ddp.py:140) that implicitly drives parameter init, dropout,
+and data shuffling.  JAX's explicit PRNG maps that single seed onto named
+streams split from one root key; per-step dropout keys are folded in from
+the step counter so a jitted train step stays reproducible from ``--seed``
+alone (SURVEY.md N15).
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Stable stream indices: order must never change or seeds stop reproducing.
+_STREAMS = ("init", "dropout", "shuffle")
+
+
+def root_key(seed: int) -> jax.Array:
+    """The single root key — the analogue of ``torch.manual_seed(seed)``."""
+    return jax.random.PRNGKey(seed)
+
+
+def split_streams(key: jax.Array) -> dict[str, jax.Array]:
+    """Split the root key into the framework's named streams."""
+    keys = jax.random.split(key, len(_STREAMS))
+    return dict(zip(_STREAMS, keys))
+
+
+def fold_step(key: jax.Array, step: jax.Array | int) -> jax.Array:
+    """Derive a per-step key (e.g. dropout at global step ``step``).
+
+    ``fold_in`` is cheap and trace-friendly, so this can live inside a
+    jitted train step with the step counter as a traced scalar.
+    """
+    return jax.random.fold_in(key, step)

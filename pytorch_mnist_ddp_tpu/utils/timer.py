@@ -1,0 +1,38 @@
+"""Wall-clock + optional per-step timing.
+
+The reference's whole benchmark harness is ``start = time.time()`` around
+``main()`` (reference mnist_ddp.py:200-203).  ``WallClock`` reproduces that
+and adds opt-in per-step timing / simple stats that the reference lacks
+(SURVEY.md §5 'Tracing / profiling')."""
+
+from __future__ import annotations
+
+import time
+
+
+class WallClock:
+    """Whole-run timer plus optional per-step sampling."""
+
+    def __init__(self) -> None:
+        self.start = time.time()
+        self._step_times: list[float] = []
+        self._last_mark: float | None = None
+
+    def elapsed(self) -> float:
+        return time.time() - self.start
+
+    def mark_step(self) -> None:
+        """Record the interval since the previous ``mark_step`` call."""
+        now = time.perf_counter()
+        if self._last_mark is not None:
+            self._step_times.append(now - self._last_mark)
+        self._last_mark = now
+
+    @property
+    def step_times(self) -> list[float]:
+        return self._step_times
+
+    def steps_per_second(self) -> float:
+        if not self._step_times:
+            return 0.0
+        return len(self._step_times) / sum(self._step_times)

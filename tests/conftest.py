@@ -1,0 +1,30 @@
+"""Test harness: force an 8-virtual-device CPU mesh (SURVEY.md §4).
+
+Multi-device behavior is unit-tested without TPU hardware by forcing the
+host platform to expose 8 devices (``--xla_force_host_platform_device_count``)
+and selecting the CPU backend.  The platform override goes through
+``jax.config`` because this machine's sitecustomize may pre-register an
+accelerator plugin that outranks the ``JAX_PLATFORMS`` env var.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+# Keep any accelerator tunnel out of test subprocesses too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

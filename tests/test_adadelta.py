@@ -1,0 +1,69 @@
+"""Optimizer + schedule parity tests (SURVEY.md §4 'numerics tests'):
+ops/adadelta.py against torch.optim.Adadelta, ops/schedule.py against
+torch.optim.lr_scheduler.StepLR."""
+
+import numpy as np
+import pytest
+
+from pytorch_mnist_ddp_tpu.ops.adadelta import adadelta_init, adadelta_update
+from pytorch_mnist_ddp_tpu.ops.schedule import step_lr
+
+torch = pytest.importorskip("torch")
+
+
+def test_adadelta_matches_torch_exactly():
+    """Bit-level update parity with optim.Adadelta(lr=1.0) — the
+    reference's optimizer config (reference mnist.py:124)."""
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(4, 3).astype(np.float32)
+    grads = [rng.randn(4, 3).astype(np.float32) for _ in range(5)]
+
+    tw = torch.nn.Parameter(torch.tensor(w0))
+    opt = torch.optim.Adadelta([tw], lr=1.0)
+
+    params = {"w": np.array(w0)}
+    state = adadelta_init(params)
+    for g in grads:
+        tw.grad = torch.tensor(g)
+        opt.step()
+        params, state = adadelta_update(params, {"w": g}, state, lr=1.0)
+        np.testing.assert_allclose(
+            np.asarray(params["w"]), tw.detach().numpy(), rtol=2e-6, atol=2e-7
+        )
+
+
+def test_adadelta_custom_hypers_match_torch():
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(10).astype(np.float32)
+    tw = torch.nn.Parameter(torch.tensor(w0))
+    opt = torch.optim.Adadelta([tw], lr=0.5, rho=0.8, eps=1e-5, weight_decay=0.01)
+    params = {"w": np.array(w0)}
+    state = adadelta_init(params)
+    for _ in range(3):
+        g = rng.randn(10).astype(np.float32)
+        tw.grad = torch.tensor(g)
+        opt.step()
+        params, state = adadelta_update(
+            params, {"w": g}, state, lr=0.5, rho=0.8, eps=1e-5, weight_decay=0.01
+        )
+        np.testing.assert_allclose(
+            np.asarray(params["w"]), tw.detach().numpy(), rtol=2e-6, atol=2e-7
+        )
+
+
+def test_step_lr_matches_torch_schedule():
+    """StepLR(step_size=1, gamma=0.7) epoch-lr sequence parity
+    (reference mnist.py:126-130)."""
+    tw = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.Adadelta([tw], lr=1.0)
+    sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1, gamma=0.7)
+    lr_fn = step_lr(1.0, gamma=0.7, step_size=1)
+    for epoch in range(1, 15):
+        assert lr_fn(epoch) == pytest.approx(opt.param_groups[0]["lr"], rel=1e-9)
+        sched.step()
+
+
+def test_step_lr_step_size():
+    lr_fn = step_lr(2.0, gamma=0.5, step_size=3)
+    assert lr_fn(1) == lr_fn(2) == lr_fn(3) == 2.0
+    assert lr_fn(4) == 1.0

@@ -1,0 +1,54 @@
+"""Checkpoint tests: save/load roundtrip, the module.-prefix quirk, and
+state-dict <-> param-tree inversion (SURVEY.md N13, §3.5)."""
+
+import numpy as np
+
+import jax
+
+from pytorch_mnist_ddp_tpu.models.net import init_params
+from pytorch_mnist_ddp_tpu.utils.checkpoint import (
+    load_state_dict,
+    model_state_dict,
+    params_from_state_dict,
+    save_state_dict,
+)
+
+
+def test_state_dict_keys_torch_style():
+    params = init_params(jax.random.PRNGKey(0))
+    sd = model_state_dict(params)
+    assert set(sd) == {
+        "conv1.weight", "conv1.bias", "conv2.weight", "conv2.bias",
+        "fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias",
+    }
+
+
+def test_ddp_prefix_quirk():
+    """Distributed-mode saves carry the module. prefix like the reference's
+    wrapped state dict (reference mnist_ddp.py:195)."""
+    params = init_params(jax.random.PRNGKey(0))
+    sd = model_state_dict(params, ddp_prefix=True)
+    assert all(k.startswith("module.") for k in sd)
+
+
+def test_save_load_roundtrip(tmp_path):
+    params = init_params(jax.random.PRNGKey(1))
+    sd = model_state_dict(params)
+    path = str(tmp_path / "mnist_cnn.pt")
+    save_state_dict(sd, path)
+    loaded = load_state_dict(path)
+    assert set(loaded) == set(sd)
+    for k in sd:
+        np.testing.assert_array_equal(loaded[k], np.asarray(sd[k]))
+
+
+def test_params_from_state_dict_inverts(tmp_path):
+    params = init_params(jax.random.PRNGKey(2))
+    for prefix in (False, True):
+        sd = model_state_dict(params, ddp_prefix=prefix)
+        tree = params_from_state_dict(sd)
+        flat_a = jax.tree.leaves(params)
+        flat_b = jax.tree.leaves(tree)
+        assert len(flat_a) == len(flat_b)
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

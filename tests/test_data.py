@@ -1,0 +1,147 @@
+"""Data layer tests: IDX parsing, synthetic fallback, transforms, loader
+batching/padding/coverage (SURVEY.md N5-N8)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from pytorch_mnist_ddp_tpu.data.loader import DataLoader
+from pytorch_mnist_ddp_tpu.data.mnist import parse_idx, synthetic_mnist
+from pytorch_mnist_ddp_tpu.data.transforms import MNIST_MEAN, MNIST_STD, normalize
+
+
+def _idx_images(arr: np.ndarray) -> bytes:
+    n, r, c = arr.shape
+    return struct.pack(">iiii", 2051, n, r, c) + arr.tobytes()
+
+
+def _idx_labels(arr: np.ndarray) -> bytes:
+    return struct.pack(">ii", 2049, len(arr)) + arr.tobytes()
+
+
+def test_parse_idx_roundtrip():
+    imgs = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28)
+    labels = np.array([3, 7], np.uint8)
+    assert np.array_equal(parse_idx(_idx_images(imgs)), imgs)
+    assert np.array_equal(parse_idx(_idx_labels(labels)), labels)
+
+
+def test_parse_idx_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_idx(struct.pack(">i", 1234) + b"\x00" * 100)
+
+
+def test_synthetic_shapes_and_determinism():
+    x1, y1 = synthetic_mnist("train", n=64)
+    x2, y2 = synthetic_mnist("train", n=64)
+    assert x1.shape == (64, 28, 28) and x1.dtype == np.uint8
+    assert y1.shape == (64,) and set(np.unique(y1)) <= set(range(10))
+    assert np.array_equal(x1, x2) and np.array_equal(y1, y2)
+    xt, _ = synthetic_mnist("test", n=64)
+    assert not np.array_equal(x1, xt)  # disjoint RNG streams per split
+
+
+def test_normalize_matches_totensor_normalize():
+    """Matches ToTensor + Normalize((0.1307,),(0.3081,)) exactly
+    (reference mnist.py:112-115)."""
+    img = np.random.RandomState(0).randint(0, 256, (5, 28, 28), np.uint8)
+    out = normalize(img)
+    assert out.shape == (5, 28, 28, 1) and out.dtype == np.float32
+    expected = (img.astype(np.float32) / 255.0 - MNIST_MEAN) / MNIST_STD
+    np.testing.assert_allclose(out[..., 0], expected, rtol=1e-6)
+
+
+def _tiny_dataset(n=37):
+    rng = np.random.RandomState(0)
+    return rng.randint(0, 256, (n, 28, 28), np.uint8), rng.randint(0, 10, n).astype(np.uint8)
+
+
+def test_loader_shapes_padding_and_coverage():
+    imgs, labels = _tiny_dataset(37)
+    loader = DataLoader(imgs, labels, global_batch=8, shuffle=False,
+                        device_place=False, prefetch_depth=0)
+    batches = list(loader.epoch(0))
+    assert len(batches) == len(loader) == 5  # ceil(37/8)
+    for x, y, w in batches[:-1]:
+        assert x.shape == (8, 28, 28, 1) and y.shape == (8,) and w.shape == (8,)
+        assert float(np.sum(np.asarray(w))) == 8
+    # last batch: 5 real + 3 padded
+    x, y, w = batches[-1]
+    assert x.shape == (8, 28, 28, 1)
+    assert float(np.sum(np.asarray(w))) == 5
+    assert np.array_equal(np.asarray(w), [1, 1, 1, 1, 1, 0, 0, 0])
+    real = int(sum(float(np.sum(np.asarray(w))) for _, _, w in batches))
+    assert real == 37
+
+
+def test_loader_prefetch_equals_sync():
+    imgs, labels = _tiny_dataset(40)
+    a = DataLoader(imgs, labels, 8, shuffle=True, seed=3,
+                   device_place=False, prefetch_depth=0)
+    b = DataLoader(imgs, labels, 8, shuffle=True, seed=3,
+                   device_place=False, prefetch_depth=2)
+    for (xa, ya, wa), (xb, yb, wb) in zip(a.epoch(1), b.epoch(1), strict=True):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+        np.testing.assert_array_equal(np.asarray(ya), np.asarray(yb))
+
+
+def test_loader_process_sharding():
+    imgs, labels = _tiny_dataset(40)
+    seen = []
+    for rank in range(2):
+        loader = DataLoader(imgs, labels, global_batch=8, shuffle=False,
+                            process_rank=rank, process_count=2,
+                            device_place=False, prefetch_depth=0)
+        assert loader.host_batch == 4
+        for _, y, w in loader.epoch(0):
+            seen.extend(np.asarray(y)[np.asarray(w) > 0].tolist())
+    # Both ranks together see every label (sequential order, disjoint).
+    assert len(seen) == 40
+
+
+def test_loader_device_placement_sharded(devices):
+    import jax
+    from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh, DATA_AXIS
+
+    mesh = make_mesh()
+    assert mesh.shape[DATA_AXIS] == 8
+    imgs, labels = _tiny_dataset(64)
+    loader = DataLoader(imgs, labels, global_batch=16, mesh=mesh,
+                        shuffle=False, prefetch_depth=0)
+    x, y, w = next(iter(loader.epoch(0)))
+    assert isinstance(x, jax.Array) and x.shape == (16, 28, 28, 1)
+    # sharded over the data axis: each device holds 2 samples
+    assert len(x.sharding.device_set) == 8
+
+
+def test_loader_mask_padding_zero_weights_duplicates():
+    """Eval loaders mask sampler pad-duplicates so psum totals count each
+    sample once (3 ranks over 10 samples -> 2 pads get weight 0)."""
+    imgs, labels = _tiny_dataset(10)
+    total_weight = 0.0
+    for rank in range(3):
+        loader = DataLoader(imgs, labels, global_batch=6, shuffle=False,
+                            process_rank=rank, process_count=3,
+                            device_place=False, prefetch_depth=0,
+                            mask_padding=True)
+        for _, _, w in loader.epoch(0):
+            total_weight += float(np.sum(np.asarray(w)))
+    assert total_weight == 10.0
+
+
+def test_loader_abandoned_epoch_reaps_prefetch_thread():
+    """Breaking out of an epoch early (--dry-run) must not leak the
+    producer thread."""
+    import threading
+    imgs, labels = _tiny_dataset(64)
+    loader = DataLoader(imgs, labels, global_batch=4, shuffle=False,
+                        device_place=False, prefetch_depth=2)
+    before = threading.active_count()
+    for _ in loader.epoch(0):
+        break  # abandon immediately
+    import time
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before

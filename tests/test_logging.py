@@ -1,0 +1,42 @@
+"""Byte-exactness tests for the printed output surface (SURVEY.md §5
+'Metrics / logging': three formats to preserve byte-for-byte)."""
+
+from pytorch_mnist_ddp_tpu.utils.logging import (
+    NOT_DISTRIBUTED_NOTICE,
+    distributed_init_banner,
+    total_time_line,
+    train_log_line,
+)
+from pytorch_mnist_ddp_tpu.utils.logging import test_summary_lines as summary_lines
+
+
+def test_train_line_format():
+    # world_size=4, batch_idx=10, per-rank batch 200 -> counter 8000/60000
+    line = train_log_line(3, 4 * 10 * 200, 60000, 10, 75, 0.1234567)
+    assert line == "Train Epoch: 3 [8000/60000 (13%)]\tLoss: 0.123457"
+
+
+def test_train_line_zero_batch():
+    line = train_log_line(1, 0, 60000, 0, 300, 2.3)
+    assert line == "Train Epoch: 1 [0/60000 (0%)]\tLoss: 2.300000"
+
+
+def test_test_summary_format():
+    s = summary_lines(0.0512, 9873, 10000)
+    assert s == "\nTest set: Average loss: 0.0512, Accuracy: 9873/10000 (99%)\n"
+
+
+def test_banner_format():
+    b = distributed_init_banner(0, "env://", 0, 4)
+    assert b == "| distributed init (rank 0): env://, local rank:0, world size:4"
+
+
+def test_not_distributed_notice():
+    assert NOT_DISTRIBUTED_NOTICE == "Not using distributed mode"
+
+
+def test_total_time_line_preserves_ms_label_quirk():
+    """The reference prints seconds under an 'ms' label
+    (mnist_ddp.py:203) — the README benchmark was made with this exact
+    line, so it stays."""
+    assert total_time_line(73.6) == "Total cost time:73.6 ms"

@@ -1,0 +1,48 @@
+"""NLL loss parity against torch.nn.functional.nll_loss (SURVEY.md N9)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+
+def _fixture(n=16, c=10, seed=0):
+    rng = np.random.RandomState(seed)
+    logits = rng.randn(n, c).astype(np.float32)
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    targets = rng.randint(0, c, n)
+    return logp, targets
+
+
+def test_mean_reduction_matches_torch():
+    logp, t = _fixture()
+    ours = float(nll_loss(jnp.asarray(logp), jnp.asarray(t)))
+    theirs = float(F.nll_loss(torch.tensor(logp), torch.tensor(t)))
+    assert ours == pytest.approx(theirs, rel=1e-6)
+
+
+def test_sum_reduction_matches_torch():
+    logp, t = _fixture(seed=1)
+    ours = float(nll_loss(jnp.asarray(logp), jnp.asarray(t), reduction="sum"))
+    theirs = float(F.nll_loss(torch.tensor(logp), torch.tensor(t), reduction="sum"))
+    assert ours == pytest.approx(theirs, rel=1e-6)
+
+
+def test_masked_mean_ignores_padding():
+    logp, t = _fixture(n=8)
+    w = np.array([1, 1, 1, 1, 1, 0, 0, 0], np.float32)
+    ours = float(nll_loss(jnp.asarray(logp), jnp.asarray(t), jnp.asarray(w)))
+    theirs = float(F.nll_loss(torch.tensor(logp[:5]), torch.tensor(t[:5])))
+    assert ours == pytest.approx(theirs, rel=1e-6)
+
+
+def test_none_reduction():
+    logp, t = _fixture(n=4)
+    per = np.asarray(nll_loss(jnp.asarray(logp), jnp.asarray(t), reduction="none"))
+    assert per.shape == (4,)
+    np.testing.assert_allclose(per, [-logp[i, t[i]] for i in range(4)], rtol=1e-6)

@@ -1,0 +1,123 @@
+"""Model tests: architecture, init distribution, and full forward parity
+against a PyTorch build of the reference CNN (SURVEY.md §2a #3, §7 step 2)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.models.net import Net, init_params
+
+
+def test_output_shape_and_log_softmax():
+    params = init_params(jax.random.PRNGKey(0))
+    x = jnp.zeros((4, 28, 28, 1))
+    out = Net().apply({"params": params}, x, train=False)
+    assert out.shape == (4, 10)
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_param_count():
+    """320 + 18,496 + 1,179,776 + 1,290 = 1,199,882 params — the ~1.2M of
+    the reference Net (SURVEY.md §2a #3)."""
+    params = init_params(jax.random.PRNGKey(0))
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert n == 1_199_882
+    assert params["fc1"]["kernel"].shape == (9216, 128)
+
+
+def test_torch_style_init_bounds():
+    """Weights/biases are U(-1/sqrt(fan_in), +1/sqrt(fan_in)) like torch's
+    Conv2d/Linear reset_parameters (SURVEY.md §7 'hard parts')."""
+    params = init_params(jax.random.PRNGKey(0))
+    checks = {
+        ("conv1", "kernel"): 1 * 9,
+        ("conv2", "kernel"): 32 * 9,
+        ("fc1", "kernel"): 9216,
+        ("fc2", "kernel"): 128,
+        ("conv1", "bias"): 1 * 9,
+        ("fc1", "bias"): 9216,
+    }
+    for (mod, leaf), fan_in in checks.items():
+        v = np.asarray(params[mod][leaf])
+        bound = 1.0 / np.sqrt(fan_in)
+        assert np.abs(v).max() <= bound
+        if v.size > 100:  # spread sanity: roughly uniform, not collapsed
+            assert np.abs(v).max() > 0.9 * bound
+            assert abs(v.mean()) < 0.1 * bound
+
+
+def test_dropout_active_in_train_mode():
+    params = init_params(jax.random.PRNGKey(0))
+    x = jnp.ones((2, 28, 28, 1))
+    net = Net()
+    a = net.apply({"params": params}, x, train=True,
+                  rngs={"dropout": jax.random.PRNGKey(1)})
+    b = net.apply({"params": params}, x, train=True,
+                  rngs={"dropout": jax.random.PRNGKey(2)})
+    c = net.apply({"params": params}, x, train=False)
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+    assert not np.allclose(np.asarray(a), np.asarray(c))
+    # eval mode is deterministic
+    d = net.apply({"params": params}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(c), np.asarray(d))
+
+
+@pytest.fixture(scope="module")
+def torch_net():
+    """The reference architecture rebuilt in torch (from SURVEY.md §2a #3)
+    as an independent parity fixture."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    class TorchNet(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(1, 32, 3, 1)
+            self.conv2 = nn.Conv2d(32, 64, 3, 1)
+            self.fc1 = nn.Linear(9216, 128)
+            self.fc2 = nn.Linear(128, 10)
+
+        def forward(self, x):
+            x = F.relu(self.conv1(x))
+            x = F.relu(self.conv2(x))
+            x = F.max_pool2d(x, 2)
+            x = torch.flatten(x, 1)
+            x = F.relu(self.fc1(x))
+            x = self.fc2(x)
+            return F.log_softmax(x, dim=1)
+
+    return TorchNet()
+
+
+def test_forward_parity_with_torch(torch_net):
+    """Copy our params into the torch build (with the documented
+    NHWC<->NCHW layout permutations) and require identical logits."""
+    torch = pytest.importorskip("torch")
+    params = init_params(jax.random.PRNGKey(42))
+
+    with torch.no_grad():
+        for name in ("conv1", "conv2"):
+            k = np.asarray(params[name]["kernel"])  # HWIO
+            getattr(torch_net, name).weight.copy_(
+                torch.tensor(k.transpose(3, 2, 0, 1))  # OIHW
+            )
+            getattr(torch_net, name).bias.copy_(
+                torch.tensor(np.asarray(params[name]["bias"]))
+            )
+        # fc1: our flatten is H*W*C (12,12,64), torch's is C*H*W (64,12,12).
+        k = np.asarray(params["fc1"]["kernel"])  # (9216, 128), rows h*768+w*64+c
+        k_hwc = k.reshape(12, 12, 64, 128)
+        k_chw = k_hwc.transpose(2, 0, 1, 3).reshape(9216, 128)
+        torch_net.fc1.weight.copy_(torch.tensor(k_chw.T))
+        torch_net.fc1.bias.copy_(torch.tensor(np.asarray(params["fc1"]["bias"])))
+        torch_net.fc2.weight.copy_(torch.tensor(np.asarray(params["fc2"]["kernel"]).T))
+        torch_net.fc2.bias.copy_(torch.tensor(np.asarray(params["fc2"]["bias"])))
+
+    torch_net.eval()
+    x = np.random.RandomState(0).rand(4, 28, 28, 1).astype(np.float32)
+    ours = np.asarray(Net().apply({"params": params}, jnp.asarray(x), train=False))
+    theirs = torch_net(torch.tensor(x.transpose(0, 3, 1, 2))).detach().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,129 @@
+"""Parallelism tests on the 8-virtual-device CPU mesh (SURVEY.md §4):
+mesh construction, the DP train step, single-vs-sharded parity, and
+distributed eval."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.models.net import init_params
+from pytorch_mnist_ddp_tpu.parallel.ddp import (
+    make_eval_step,
+    make_train_state,
+    make_train_step,
+    replicate_params,
+)
+from pytorch_mnist_ddp_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+
+def _batch(n, seed=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.rand(n, 28, 28, 1).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 10, n).astype(np.int32))
+    w = jnp.ones((n,), jnp.float32)
+    return x, y, w
+
+
+def test_make_mesh_shapes(devices):
+    mesh = make_mesh()
+    assert mesh.shape == {DATA_AXIS: 8, MODEL_AXIS: 1}
+    mesh2 = make_mesh(num_data=4, num_model=2)
+    assert mesh2.shape == {DATA_AXIS: 4, MODEL_AXIS: 2}
+
+
+def test_train_step_runs_and_counts(devices):
+    mesh = make_mesh()
+    params = init_params(jax.random.PRNGKey(0))
+    state = replicate_params(make_train_state(params), mesh)
+    step = make_train_step(mesh)
+    x, y, w = _batch(16)
+    state, losses = step(state, x, y, w, jax.random.PRNGKey(1), jnp.float32(1.0))
+    assert losses.shape == (8,)  # one local loss per data shard
+    assert int(state.step) == 1
+
+
+def test_single_vs_sharded_parity(devices):
+    """DDP's defining property: k sharded steps == k single-device steps on
+    the same global batches (grads are a global mean either way;
+    SURVEY.md §4 'deterministic-parity tests').  Dropout off — per-replica
+    dropout streams are intentionally different (SURVEY.md N15)."""
+    # init twice from the same key (identical values, distinct buffers —
+    # the donating step consumes its own state's buffers).
+    mesh1 = make_mesh(num_data=1, devices=jax.devices()[:1])
+    mesh8 = make_mesh()
+    s1 = replicate_params(make_train_state(init_params(jax.random.PRNGKey(0))), mesh1)
+    s8 = replicate_params(make_train_state(init_params(jax.random.PRNGKey(0))), mesh8)
+    step1 = make_train_step(mesh1, dropout=False)
+    step8 = make_train_step(mesh8, dropout=False)
+
+    key = jax.random.PRNGKey(9)
+    lr = jnp.float32(1.0)
+    for i in range(3):
+        x, y, w = _batch(16, seed=i)
+        s1, _ = step1(s1, x, y, w, key, lr)
+        s8, _ = step8(s8, x, y, w, key, lr)
+
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s8.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6
+        )
+
+
+def test_grad_pmean_matches_manual_average(devices):
+    """The pmean allreduce reproduces DDP's sum/world exactly: per-shard
+    local-mean grads averaged by hand == the sharded step's update."""
+    from pytorch_mnist_ddp_tpu.ops.adadelta import adadelta_init, adadelta_update
+    from pytorch_mnist_ddp_tpu.models.net import Net
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+
+    params = init_params(jax.random.PRNGKey(3))
+    x, y, w = _batch(16, seed=5)
+
+    # manual: 8 local grads (batch slices of 2), then mean
+    model = Net()
+    def local_grad(xs, ys, ws):
+        def loss_fn(p):
+            return nll_loss(model.apply({"params": p}, xs, train=False), ys, ws)
+        return jax.grad(loss_fn)(params)
+    grads = [local_grad(x[i * 2:(i + 1) * 2], y[i * 2:(i + 1) * 2], w[i * 2:(i + 1) * 2])
+             for i in range(8)]
+    mean_grads = jax.tree.map(lambda *g: sum(g) / 8.0, *grads)
+    manual_params, _ = adadelta_update(params, mean_grads, adadelta_init(params), lr=1.0)
+
+    mesh = make_mesh()
+    state = replicate_params(make_train_state(params), mesh)
+    step = make_train_step(mesh, dropout=False)
+    state, _ = step(state, x, y, w, jax.random.PRNGKey(0), jnp.float32(1.0))
+
+    for a, b in zip(jax.tree.leaves(manual_params), jax.tree.leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6)
+
+
+def test_distributed_eval_totals(devices):
+    """psum'd (loss_sum, correct) equals a single-device full-batch eval
+    (the reference's rank-0 numbers, without the bubble; SURVEY.md §3.3)."""
+    from pytorch_mnist_ddp_tpu.models.net import Net
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+
+    params = init_params(jax.random.PRNGKey(7))
+    x, y, w = _batch(32, seed=11)
+    w = w.at[-3:].set(0.0)  # padding must be excluded
+
+    mesh = make_mesh()
+    eval_fn = make_eval_step(mesh)
+    totals = eval_fn(params, x, y, w)
+
+    logp = Net().apply({"params": params}, x, train=False)
+    expect_loss = float(nll_loss(logp, y, w, reduction="sum"))
+    expect_correct = float(((jnp.argmax(logp, 1) == y) * w).sum())
+    np.testing.assert_allclose(float(totals[0]), expect_loss, rtol=1e-5)
+    assert float(totals[1]) == expect_correct
+
+
+def test_replicated_state_is_fully_addressable(devices):
+    mesh = make_mesh()
+    params = init_params(jax.random.PRNGKey(0))
+    state = replicate_params(make_train_state(params), mesh)
+    leaf = jax.tree.leaves(state.params)[0]
+    assert len(leaf.sharding.device_set) == 8

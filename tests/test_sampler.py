@@ -1,0 +1,93 @@
+"""Sampler contract tests (SURVEY.md §4 'Sampler contract tests'):
+DistributedSampler-parity semantics for parallel/sampler.py."""
+
+import numpy as np
+import pytest
+
+from pytorch_mnist_ddp_tpu.parallel.sampler import epoch_indices, per_rank_count
+
+
+def test_equal_counts_and_padding():
+    # 10 samples over 4 ranks -> ceil = 3 each, 12 total (2 repeats).
+    shards = [epoch_indices(10, 4, r, epoch=0, seed=0) for r in range(4)]
+    assert all(len(s) == 3 for s in shards)
+    assert per_rank_count(10, 4) == 3
+
+
+def test_disjoint_cover_when_divisible():
+    shards = [epoch_indices(60000, 4, r, epoch=1, seed=0) for r in range(4)]
+    allidx = np.concatenate(shards)
+    assert len(allidx) == 60000
+    assert np.array_equal(np.sort(allidx), np.arange(60000))
+
+
+def test_cover_with_padding():
+    # Padded union covers every index; exactly total-n repeats.
+    shards = [epoch_indices(10, 4, r, epoch=0, seed=0) for r in range(4)]
+    allidx = np.concatenate(shards)
+    assert set(allidx.tolist()) == set(range(10))
+    assert len(allidx) == 12
+
+
+def test_epoch_reshuffle_and_determinism():
+    a = epoch_indices(1000, 4, 2, epoch=0, seed=7)
+    b = epoch_indices(1000, 4, 2, epoch=1, seed=7)
+    c = epoch_indices(1000, 4, 2, epoch=0, seed=7)
+    assert not np.array_equal(a, b)  # set_epoch reshuffles
+    assert np.array_equal(a, c)      # same epoch+seed reproduces
+
+
+def test_sequential_eval_order():
+    idx = epoch_indices(100, 1, 0, shuffle=False)
+    assert np.array_equal(idx, np.arange(100))
+
+
+def test_random_sampler_single_rank():
+    idx = epoch_indices(100, 1, 0, epoch=0, seed=1, shuffle=True)
+    assert len(idx) == 100
+    assert set(idx.tolist()) == set(range(100))
+    assert not np.array_equal(idx, np.arange(100))
+
+
+def test_rank_validation():
+    with pytest.raises(ValueError):
+        epoch_indices(10, 4, 5)
+
+
+def test_matches_torch_distributed_sampler_semantics():
+    """Same per-rank counts and padded-union multiset as torch's
+    DistributedSampler (the reference's sampler, mnist_ddp.py:161-162)."""
+    torch = pytest.importorskip("torch")
+    from torch.utils.data.distributed import DistributedSampler
+
+    n, world = 103, 4
+    ours = [epoch_indices(n, world, r, epoch=3, seed=0) for r in range(world)]
+    ds = [
+        DistributedSampler(range(n), num_replicas=world, rank=r, seed=0)
+        for r in range(world)
+    ]
+    for s in ds:
+        s.set_epoch(3)
+    theirs = [list(iter(s)) for s in ds]
+    assert [len(o) for o in ours] == [len(t) for t in theirs]
+    # Union as a multiset matches: every index at least once, repeats equal.
+    ours_all = sorted(np.concatenate(ours).tolist())
+    theirs_all = sorted(np.concatenate(theirs).tolist())
+    assert len(ours_all) == len(theirs_all)
+    assert set(ours_all) == set(theirs_all) == set(range(n))
+
+
+def test_return_valid_marks_padding():
+    # 10 samples / 4 ranks: positions 10,11 are pads (ranks 2 and 3).
+    for rank in range(4):
+        idx, valid = epoch_indices(10, 4, rank, epoch=0, seed=0, return_valid=True)
+        assert len(idx) == len(valid) == 3
+    total_valid = sum(
+        epoch_indices(10, 4, r, 0, 0, return_valid=True)[1].sum() for r in range(4)
+    )
+    assert total_valid == 10  # every real sample counted exactly once
+
+
+def test_return_valid_all_true_when_divisible():
+    _, valid = epoch_indices(60000, 4, 1, 0, 0, return_valid=True)
+    assert valid.all()
