@@ -59,6 +59,7 @@ def main() -> None:
         log_interval=10_000_000,  # silence train lines; epoch evals remain
         dry_run=False,
         save_model=False,
+        fused=True,
         data_root="./data",
     )
     if len(devices) > 1:

@@ -1,0 +1,200 @@
+"""Fused device-resident epoch execution — the TPU-first fast path.
+
+The reference's hot loop pays a host round trip per batch: worker-process
+batch assembly, pinned-buffer H2D copy, kernel launches, and a
+``loss.item()`` sync (reference mnist_ddp.py:67-79; SURVEY.md §3.2).  At
+MNIST scale that host traffic, not compute, dominates wall clock — the
+~12 ms/step budget of the README table (SURVEY.md §7 'hard parts').
+
+The TPU-native shape eliminates the per-step host boundary entirely:
+
+- The raw uint8 dataset lives in HBM, replicated (60k x 28 x 28 = 47 MB).
+- Each epoch is ONE jitted call: ``lax.scan`` over the steps; each step
+  gathers its batch by index, normalizes on-device (VPU), and runs the
+  full train step (forward, loss, backward, gradient ``pmean`` over the
+  ``data`` axis, Adadelta update) without leaving the chip.
+- The epoch permutation is computed on-device from the shuffle key folded
+  with the epoch number — same semantics as the host sampler
+  (fresh epoch-seeded permutation, pad-to-divisible by repeating leading
+  indices; parallel/sampler.py), different generator.
+- Per-step first-replica losses come back as ONE array per epoch, so the
+  reference's train log lines can still be printed verbatim (from host,
+  after the epoch) with zero mid-epoch syncs.
+
+Eval is fused the same way: scan over test batches accumulating
+(loss_sum, correct) with a padding mask, one psum at the end.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data.transforms import MNIST_MEAN, MNIST_STD
+from ..models.net import Net
+from ..ops.adadelta import adadelta_update
+from ..ops.loss import nll_loss
+from .ddp import TrainState
+from .mesh import DATA_AXIS
+
+
+def _normalize_dev(x_u8: jax.Array, compute_dtype) -> jax.Array:
+    """On-device ToTensor + Normalize (uint8 NHW -> float NHWC 1-channel),
+    identical math to data/transforms.py:normalize."""
+    x = x_u8.astype(jnp.float32) * (1.0 / 255.0)
+    x = (x - MNIST_MEAN) / (MNIST_STD)
+    return x[..., None].astype(compute_dtype)
+
+
+def device_put_dataset(images, labels, mesh: Mesh):
+    """Stage the raw uint8 dataset replicated in HBM (one transfer per
+    run, amortized over every epoch)."""
+    import numpy as np
+
+    sharding = NamedSharding(mesh, P())
+    # make_array_from_process_local_data handles both single- and
+    # multi-host replication (device_put can't target non-addressable
+    # devices in a multi-controller world).
+    x = jax.make_array_from_process_local_data(sharding, np.asarray(images))
+    y = jax.make_array_from_process_local_data(
+        sharding, np.asarray(labels, dtype=np.int32)
+    )
+    return x, y
+
+
+def make_fused_train_epoch(
+    mesh: Mesh,
+    dataset_size: int,
+    global_batch: int,
+    compute_dtype=jnp.float32,
+    rho: float = 0.9,
+    eps: float = 1e-6,
+    dropout: bool = True,
+):
+    """Build ``epoch_fn(state, images, labels, epoch, shuffle_key,
+    dropout_key, lr) -> (state, losses[num_batches, n_shards])``.
+
+    ``num_batches = ceil(dataset_size / global_batch)``; a non-divisible
+    final batch is filled by wrapping the permutation and the filler
+    samples carry weight 0 — exactly the host loader's final-batch padding
+    (data/loader.py), so both paths train on the same effective samples.
+    """
+    model = Net(compute_dtype=compute_dtype)
+    n_shards = mesh.shape[DATA_AXIS]
+    if global_batch % n_shards:
+        raise ValueError(f"global batch {global_batch} not divisible by mesh")
+    shard_batch = global_batch // n_shards
+    num_batches = -(-dataset_size // global_batch)
+    padded = num_batches * global_batch
+
+    def local_epoch(state: TrainState, images, labels, epoch, shuffle_key, dropout_key, lr):
+        # Epoch-seeded permutation; wrap to fill the final batch, with the
+        # wrapped filler masked out (weight 0) like the host loader's
+        # final-batch padding.
+        perm = jax.random.permutation(
+            jax.random.fold_in(shuffle_key, epoch), dataset_size
+        )
+        positions = jnp.arange(padded)
+        perm = jnp.take(perm, positions % dataset_size)
+        valid = (positions < dataset_size).astype(jnp.float32)
+        shard = jax.lax.axis_index(DATA_AXIS)
+
+        def one_step(state: TrainState, batch):
+            step_perm, step_valid = batch  # [global_batch] each
+            idx = jax.lax.dynamic_slice_in_dim(
+                step_perm, shard * shard_batch, shard_batch
+            )
+            w = jax.lax.dynamic_slice_in_dim(
+                step_valid, shard * shard_batch, shard_batch
+            )
+            x = _normalize_dev(jnp.take(images, idx, axis=0), compute_dtype)
+            y = jnp.take(labels, idx, axis=0)
+            key = jax.random.fold_in(dropout_key, state.step)
+            key = jax.random.fold_in(key, shard)
+
+            def loss_fn(params):
+                logp = model.apply(
+                    {"params": params}, x, train=dropout, rngs={"dropout": key}
+                )
+                return nll_loss(logp, y, w, reduction="mean")
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            grads = jax.lax.pmean(grads, DATA_AXIS)
+            params, opt = adadelta_update(state.params, grads, state.opt, lr, rho, eps)
+            return TrainState(params, opt, state.step + 1), loss
+
+        state, losses = jax.lax.scan(
+            one_step,
+            state,
+            (
+                perm.reshape(num_batches, global_batch),
+                valid.reshape(num_batches, global_batch),
+            ),
+        )
+        return state, losses[:, None]  # per-shard loss column
+
+    sharded = jax.shard_map(
+        local_epoch,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P(None, DATA_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,)), num_batches
+
+
+def make_fused_eval(
+    mesh: Mesh,
+    dataset_size: int,
+    global_batch: int,
+    compute_dtype=jnp.float32,
+):
+    """Build ``eval_fn(params, images, labels) -> (loss_sum, correct)``
+    over the whole test set in one device call (scan over batches, padding
+    masked, single psum) — the fused form of parallel/ddp.py:make_eval_step."""
+    model = Net(compute_dtype=compute_dtype)
+    n_shards = mesh.shape[DATA_AXIS]
+    if global_batch % n_shards:
+        raise ValueError(f"global batch {global_batch} not divisible by mesh")
+    shard_batch = global_batch // n_shards
+    num_batches = -(-dataset_size // global_batch)
+    padded = num_batches * global_batch
+
+    def local_eval(params, images, labels):
+        idx = jnp.arange(padded) % dataset_size  # wrap; wrapped tail masked below
+        valid = (jnp.arange(padded) < dataset_size).astype(jnp.float32)
+        shard = jax.lax.axis_index(DATA_AXIS)
+
+        def one_batch(carry, batch):
+            loss_sum, correct = carry
+            b_idx, b_valid = batch
+            i = jax.lax.dynamic_slice_in_dim(b_idx, shard * shard_batch, shard_batch)
+            v = jax.lax.dynamic_slice_in_dim(b_valid, shard * shard_batch, shard_batch)
+            x = _normalize_dev(jnp.take(images, i, axis=0), compute_dtype)
+            y = jnp.take(labels, i, axis=0)
+            logp = model.apply({"params": params}, x, train=False)
+            loss_sum += nll_loss(logp, y, v, reduction="sum")
+            correct += ((jnp.argmax(logp, axis=1) == y) * v).sum()
+            return (loss_sum, correct), None
+
+        (loss_sum, correct), _ = jax.lax.scan(
+            one_batch,
+            (jnp.float32(0.0), jnp.float32(0.0)),
+            (
+                idx.reshape(num_batches, global_batch),
+                valid.reshape(num_batches, global_batch),
+            ),
+        )
+        return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
+
+    sharded = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

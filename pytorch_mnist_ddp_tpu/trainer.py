@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .data.loader import DataLoader
 from .data.mnist import MNIST
@@ -118,49 +119,94 @@ def fit(args, dist: DistState, save_path: str | None = None) -> TrainState:
     state = replicate_params(make_train_state(params), mesh)
 
     global_batch = args.batch_size * n_shards
-    train_loader = DataLoader(
-        train_set.images,
-        train_set.labels,
-        global_batch,
-        mesh=mesh,
-        shuffle=True,
-        seed=args.seed,
-        process_rank=dist.process_rank,
-        process_count=dist.process_count,
-    )
     eval_batch = -(-args.test_batch_size // n_shards) * n_shards
-    test_loader = DataLoader(
-        test_set.images,
-        test_set.labels,
-        eval_batch,
-        mesh=mesh,
-        shuffle=False,
-        process_rank=dist.process_rank,
-        process_count=dist.process_count,
-        # Count every test sample exactly once in the psum'd totals, even
-        # when the sampler pads ranks to equal length (multi-host).
-        mask_padding=True,
-    )
-
-    step_fn = make_train_step(mesh)
-    eval_fn = make_eval_step(mesh)
     lr_fn = step_lr(args.lr, args.gamma, step_size=1)
+    # Fused path: whole epochs as single device calls over an HBM-resident
+    # dataset (parallel/fused.py).  Identical printed output; the train
+    # lines are emitted after each epoch instead of during it.  dry-run
+    # stays on the per-batch loop (it IS the per-batch smoke test).
+    fused = bool(getattr(args, "fused", False)) and not args.dry_run
 
-    for epoch in range(1, args.epochs + 1):
-        state = train_one_epoch(
-            step_fn,
-            state,
-            train_loader,
-            epoch,
-            keys["dropout"],
-            lr_fn(epoch),
-            dist,
-            log_interval=args.log_interval,
-            dry_run=args.dry_run,
-            per_rank_batch=args.batch_size,
+    if fused:
+        from .parallel.fused import (
+            device_put_dataset,
+            make_fused_eval,
+            make_fused_train_epoch,
         )
-        evaluate(eval_fn, state.params, test_loader, dist)
-        # scheduler.step() is implicit: lr_fn(epoch+1) next iteration.
+
+        tr_x, tr_y = device_put_dataset(train_set.images, train_set.labels, mesh)
+        te_x, te_y = device_put_dataset(test_set.images, test_set.labels, mesh)
+        epoch_fn, num_batches = make_fused_train_epoch(
+            mesh, len(train_set), global_batch
+        )
+        fused_eval_fn = make_fused_eval(mesh, len(test_set), eval_batch)
+
+        for epoch in range(1, args.epochs + 1):
+            state, losses = epoch_fn(
+                state, tr_x, tr_y, jnp.int32(epoch), keys["shuffle"],
+                keys["dropout"], jnp.float32(lr_fn(epoch)),
+            )
+            if dist.is_chief:
+                losses_host = np.asarray(losses[:, 0])
+                for batch_idx in range(0, num_batches, args.log_interval):
+                    samples = dist.world_size * batch_idx * args.batch_size
+                    if not dist.distributed:
+                        samples = batch_idx * args.batch_size
+                    print(
+                        train_log_line(
+                            epoch, samples, len(train_set), batch_idx,
+                            num_batches, float(losses_host[batch_idx]),
+                        )
+                    )
+            totals = fused_eval_fn(state.params, te_x, te_y)
+            if dist.is_chief:
+                print(
+                    test_summary_lines(
+                        float(totals[0]) / len(test_set),
+                        int(totals[1]),
+                        len(test_set),
+                    )
+                )
+    else:
+        train_loader = DataLoader(
+            train_set.images,
+            train_set.labels,
+            global_batch,
+            mesh=mesh,
+            shuffle=True,
+            seed=args.seed,
+            process_rank=dist.process_rank,
+            process_count=dist.process_count,
+        )
+        test_loader = DataLoader(
+            test_set.images,
+            test_set.labels,
+            eval_batch,
+            mesh=mesh,
+            shuffle=False,
+            process_rank=dist.process_rank,
+            process_count=dist.process_count,
+            # Count every test sample exactly once in the psum'd totals,
+            # even when the sampler pads ranks to equal length (multi-host).
+            mask_padding=True,
+        )
+        step_fn = make_train_step(mesh)
+        eval_fn = make_eval_step(mesh)
+        for epoch in range(1, args.epochs + 1):
+            state = train_one_epoch(
+                step_fn,
+                state,
+                train_loader,
+                epoch,
+                keys["dropout"],
+                lr_fn(epoch),
+                dist,
+                log_interval=args.log_interval,
+                dry_run=args.dry_run,
+                per_rank_batch=args.batch_size,
+            )
+            evaluate(eval_fn, state.params, test_loader, dist)
+            # scheduler.step() is implicit: lr_fn(epoch+1) next iteration.
 
     if getattr(args, "save_model", False) and save_path and dist.is_chief:
         # DDP-mode checkpoints carry the module. key prefix quirk

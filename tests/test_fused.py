@@ -1,0 +1,170 @@
+"""Fused-epoch path tests (parallel/fused.py): one-device-call epochs must
+reproduce the per-batch path's math and the eval totals exactly."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.models.net import Net, init_params
+from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+from pytorch_mnist_ddp_tpu.parallel.ddp import (
+    make_train_state,
+    make_train_step,
+    replicate_params,
+)
+from pytorch_mnist_ddp_tpu.parallel.fused import (
+    device_put_dataset,
+    make_fused_eval,
+    make_fused_train_epoch,
+)
+from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+
+
+def _dataset(n=96, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        rng.randint(0, 256, (n, 28, 28), np.uint8),
+        rng.randint(0, 10, n).astype(np.uint8),
+    )
+
+
+def test_fused_epoch_runs_and_counts(devices):
+    mesh = make_mesh()
+    images, labels = _dataset(96)
+    x, y = device_put_dataset(images, labels, mesh)
+    epoch_fn, num_batches = make_fused_train_epoch(mesh, 96, global_batch=32)
+    assert num_batches == 3
+    state = replicate_params(make_train_state(init_params(jax.random.PRNGKey(0))), mesh)
+    state, losses = epoch_fn(
+        state, x, y, jnp.int32(1), jax.random.PRNGKey(5), jax.random.PRNGKey(6),
+        jnp.float32(1.0),
+    )
+    assert losses.shape == (3, 8)
+    assert int(state.step) == 3
+
+
+def test_fused_pads_non_divisible_dataset(devices):
+    mesh = make_mesh()
+    images, labels = _dataset(100)  # 100 % 32 != 0 -> 4 batches, wrap-padded
+    x, y = device_put_dataset(images, labels, mesh)
+    epoch_fn, num_batches = make_fused_train_epoch(mesh, 100, global_batch=32)
+    assert num_batches == 4
+    state = replicate_params(make_train_state(init_params(jax.random.PRNGKey(0))), mesh)
+    state, losses = epoch_fn(
+        state, x, y, jnp.int32(1), jax.random.PRNGKey(5), jax.random.PRNGKey(6),
+        jnp.float32(1.0),
+    )
+    assert losses.shape == (4, 8) and np.isfinite(np.asarray(losses)).all()
+
+
+def test_fused_matches_per_batch_path(devices):
+    """Same permutation fed to both paths (dropout off) -> identical params
+    after one epoch, to float tolerance."""
+    from pytorch_mnist_ddp_tpu.data.transforms import normalize
+
+    mesh = make_mesh()
+    images, labels = _dataset(64)
+    x, y = device_put_dataset(images, labels, mesh)
+
+    # fused epoch (2 batches of 32), dropout off on both paths
+    epoch_fn, _ = make_fused_train_epoch(mesh, 64, global_batch=32, dropout=False)
+    sf = replicate_params(make_train_state(init_params(jax.random.PRNGKey(0))), mesh)
+    shuffle_key, epoch = jax.random.PRNGKey(5), 1
+    sf, fused_losses = epoch_fn(
+        sf, x, y, jnp.int32(epoch), shuffle_key, jax.random.PRNGKey(6),
+        jnp.float32(1.0),
+    )
+    # reproduce the device-side permutation on host, drive the per-batch step
+    perm = np.asarray(
+        jax.random.permutation(jax.random.fold_in(shuffle_key, epoch), 64)
+    )
+    step = make_train_step(mesh, dropout=False)
+    sp = replicate_params(make_train_state(init_params(jax.random.PRNGKey(0))), mesh)
+    loop_losses = []
+    for b in range(2):
+        take = perm[b * 32 : (b + 1) * 32]
+        xb = jnp.asarray(normalize(images[take]))
+        yb = jnp.asarray(labels[take].astype(np.int32))
+        wb = jnp.ones((32,), jnp.float32)
+        sp, l = step(sp, xb, yb, wb, jax.random.PRNGKey(6), jnp.float32(1.0))
+        loop_losses.append(float(l[0]))
+
+    np.testing.assert_allclose(
+        np.asarray(fused_losses[:, 0]), loop_losses, rtol=1e-4
+    )
+    for a, b in zip(jax.tree.leaves(sf.params), jax.tree.leaves(sp.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-5
+        )
+
+
+def test_fused_eval_matches_unfused(devices):
+    mesh = make_mesh()
+    images, labels = _dataset(80, seed=3)
+    x, y = device_put_dataset(images, labels, mesh)
+    params = init_params(jax.random.PRNGKey(7))
+    eval_fn = make_fused_eval(mesh, 80, global_batch=32)  # 3 batches, 16 pad
+    totals = eval_fn(params, x, y)
+
+    from pytorch_mnist_ddp_tpu.data.transforms import normalize
+
+    logp = Net().apply({"params": params}, jnp.asarray(normalize(images)), train=False)
+    yv = jnp.asarray(labels.astype(np.int32))
+    expect_loss = float(nll_loss(logp, yv, reduction="sum"))
+    expect_correct = float((jnp.argmax(logp, 1) == yv).sum())
+    np.testing.assert_allclose(float(totals[0]), expect_loss, rtol=1e-4)
+    assert float(totals[1]) == expect_correct
+
+
+def test_fused_tiny_dataset_large_batch(devices):
+    """global_batch > 2*dataset_size must not crash (modulo wrap)."""
+    mesh = make_mesh()
+    images, labels = _dataset(24)
+    x, y = device_put_dataset(images, labels, mesh)
+    epoch_fn, num_batches = make_fused_train_epoch(mesh, 24, global_batch=64)
+    assert num_batches == 1
+    state = replicate_params(make_train_state(init_params(jax.random.PRNGKey(0))), mesh)
+    state, losses = epoch_fn(
+        state, x, y, jnp.int32(1), jax.random.PRNGKey(5), jax.random.PRNGKey(6),
+        jnp.float32(1.0),
+    )
+    assert np.isfinite(np.asarray(losses)).all()
+
+
+def test_fused_masks_final_partial_batch(devices):
+    """Non-divisible dataset: fused path must zero-weight wrap filler like
+    the host loader, so it matches the per-batch path exactly."""
+    from pytorch_mnist_ddp_tpu.data.transforms import normalize
+
+    mesh = make_mesh()
+    n, gb = 48, 32  # 2 batches, second has 16 real + 16 filler
+    images, labels = _dataset(n, seed=9)
+    x, y = device_put_dataset(images, labels, mesh)
+    epoch_fn, num_batches = make_fused_train_epoch(mesh, n, global_batch=gb, dropout=False)
+    assert num_batches == 2
+    sf = replicate_params(make_train_state(init_params(jax.random.PRNGKey(0))), mesh)
+    shuffle_key, epoch = jax.random.PRNGKey(5), 1
+    sf, fused_losses = epoch_fn(
+        sf, x, y, jnp.int32(epoch), shuffle_key, jax.random.PRNGKey(6),
+        jnp.float32(1.0),
+    )
+
+    perm = np.asarray(jax.random.permutation(jax.random.fold_in(shuffle_key, epoch), n))
+    perm_padded = perm[np.arange(2 * gb) % n]
+    valid = (np.arange(2 * gb) < n).astype(np.float32)
+    step = make_train_step(mesh, dropout=False)
+    sp = replicate_params(make_train_state(init_params(jax.random.PRNGKey(0))), mesh)
+    loop_losses = []
+    for b in range(2):
+        take = perm_padded[b * gb : (b + 1) * gb]
+        xb = jnp.asarray(normalize(images[take]))
+        yb = jnp.asarray(labels[take].astype(np.int32))
+        wb = jnp.asarray(valid[b * gb : (b + 1) * gb])
+        sp, l = step(sp, xb, yb, wb, jax.random.PRNGKey(6), jnp.float32(1.0))
+        loop_losses.append(float(l[0]))
+
+    np.testing.assert_allclose(np.asarray(fused_losses[:, 0]), loop_losses, rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(sf.params), jax.tree.leaves(sp.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-5)
