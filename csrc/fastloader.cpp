@@ -1,0 +1,89 @@
+// Native data-loader core (replaces the C++ machinery torch's DataLoader
+// delegates to — pin-memory staging + worker-side batch collation;
+// SURVEY.md N7, reference mnist_ddp.py:146-151).
+//
+// The hot path of host-side batch assembly is gather + normalize:
+//     out[i] = (images[idx[i]] / 255 - mean) / std
+// done here in one multithreaded pass into a caller-owned staging buffer
+// (written once, handed straight to the device transfer — the role pinned
+// memory plays in the reference).  Also provides an IDX header parser so
+// dataset loading never round-trips through Python byte-twiddling.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Parse an MNIST IDX header. Returns 0 on success.
+//   buf/len:   raw file bytes
+//   out_dims:  int64[4] -> {count, rows, cols, payload_offset}
+// Images (magic 2051) give rows/cols; labels (magic 2049) give rows=cols=0.
+int idx_parse_header(const uint8_t* buf, int64_t len, int64_t* out_dims) {
+    if (len < 8) return -1;
+    uint32_t magic = (uint32_t(buf[0]) << 24) | (uint32_t(buf[1]) << 16) |
+                     (uint32_t(buf[2]) << 8) | uint32_t(buf[3]);
+    auto be32 = [&](int64_t off) {
+        return (int64_t(buf[off]) << 24) | (int64_t(buf[off + 1]) << 16) |
+               (int64_t(buf[off + 2]) << 8) | int64_t(buf[off + 3]);
+    };
+    if (magic == 2051) {  // images
+        if (len < 16) return -1;
+        int64_t n = be32(4), rows = be32(8), cols = be32(12);
+        if (len < 16 + n * rows * cols) return -2;
+        out_dims[0] = n; out_dims[1] = rows; out_dims[2] = cols; out_dims[3] = 16;
+        return 0;
+    }
+    if (magic == 2049) {  // labels
+        int64_t n = be32(4);
+        if (len < 8 + n) return -2;
+        out_dims[0] = n; out_dims[1] = 0; out_dims[2] = 0; out_dims[3] = 8;
+        return 0;
+    }
+    return -3;
+}
+
+// Gather + normalize a batch: for each of n indices, read one pixel_count
+// uint8 image and write float32 (x/255 - mean)/std into out (contiguous
+// [n, pixel_count]).  Threaded over samples.
+void gather_normalize(const uint8_t* images, const int32_t* indices,
+                      int64_t n, int64_t pixel_count, float mean, float stddev,
+                      float* out) {
+    const float scale = 1.0f / (255.0f * stddev);
+    const float shift = -mean / stddev;
+    auto worker = [&](int64_t begin, int64_t end) {
+        for (int64_t i = begin; i < end; ++i) {
+            const uint8_t* src = images + int64_t(indices[i]) * pixel_count;
+            float* dst = out + i * pixel_count;
+            for (int64_t p = 0; p < pixel_count; ++p) {
+                dst[p] = float(src[p]) * scale + shift;
+            }
+        }
+    };
+    int64_t hw = std::thread::hardware_concurrency();
+    int64_t nthreads = hw < 1 ? 1 : (hw > 8 ? 8 : hw);
+    if (n < 256 || nthreads == 1) {  // small batches: threading overhead loses
+        worker(0, n);
+        return;
+    }
+    std::vector<std::thread> threads;
+    int64_t chunk = (n + nthreads - 1) / nthreads;
+    for (int64_t t = 0; t < nthreads; ++t) {
+        int64_t begin = t * chunk;
+        int64_t end = begin + chunk > n ? n : begin + chunk;
+        if (begin >= end) break;
+        threads.emplace_back(worker, begin, end);
+    }
+    for (auto& th : threads) th.join();
+}
+
+// Gather labels (uint8 -> int32) for a batch of indices.
+void gather_labels(const uint8_t* labels, const int32_t* indices, int64_t n,
+                   int32_t* out) {
+    for (int64_t i = 0; i < n; ++i) out[i] = int32_t(labels[indices[i]]);
+}
+
+}  // extern "C"

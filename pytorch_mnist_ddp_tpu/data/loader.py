@@ -33,7 +33,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.sampler import epoch_indices, per_rank_count
-from .transforms import normalize
+from . import native
+from .transforms import MNIST_MEAN, MNIST_STD, normalize
 from ..parallel.mesh import DATA_AXIS
 
 Batch = tuple[jax.Array, jax.Array, jax.Array]  # (x, y, weight-mask)
@@ -69,6 +70,7 @@ class DataLoader:
                 f"{process_count} processes"
             )
         self.images = images
+        self._labels_raw = labels  # uint8 view for the native gather
         self.labels = labels.astype(np.int32)
         self.global_batch = global_batch
         self.host_batch = global_batch // process_count
@@ -124,8 +126,14 @@ class DataLoader:
         n_full, rem = divmod(len(idx), hb)
         for b in range(n_full + (0 if (self.drop_last or not rem) else 1)):
             take = idx[b * hb : (b + 1) * hb]
-            x = normalize(self.images[take])
-            y = self.labels[take]
+            # Native multithreaded gather+normalize when the C++ core is
+            # available (data/native.py); identical numpy math otherwise.
+            x = native.gather_normalize(self.images, take, MNIST_MEAN, MNIST_STD)
+            if x is None:
+                x = normalize(self.images[take])
+            y = native.gather_labels(self._labels_raw, take)
+            if y is None:
+                y = self.labels[take]
             if self.mask_padding:
                 w = valid[b * hb : (b + 1) * hb].astype(np.float32)
             else:

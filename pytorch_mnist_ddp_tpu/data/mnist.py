@@ -40,15 +40,30 @@ _LABEL_MAGIC = 2049
 
 
 def parse_idx(raw: bytes) -> np.ndarray:
-    """Parse an IDX-format buffer (big-endian header) into a numpy array."""
+    """Parse an IDX-format buffer (big-endian header) into a numpy array.
+
+    Uses the native parser (csrc/fastloader.cpp via data/native.py) when
+    built; pure-Python otherwise."""
+    from . import native
+
+    # Validation errors from the native parser (bad magic, truncated
+    # payload) propagate — its stricter checks are part of the contract.
+    parsed = native.parse_idx_native(raw)
+    if parsed is not None:
+        return parsed
     magic, = struct.unpack(">i", raw[:4])
     if magic == _IMAGE_MAGIC:
         n, rows, cols = struct.unpack(">iii", raw[4:16])
         data = np.frombuffer(raw, dtype=np.uint8, offset=16)
-        return data.reshape(n, rows, cols)
+        if len(data) < n * rows * cols:
+            raise ValueError("truncated IDX image payload")
+        return data[: n * rows * cols].reshape(n, rows, cols)
     if magic == _LABEL_MAGIC:
         n, = struct.unpack(">i", raw[4:8])
-        return np.frombuffer(raw, dtype=np.uint8, offset=8)[:n]
+        data = np.frombuffer(raw, dtype=np.uint8, offset=8)
+        if len(data) < n:
+            raise ValueError("truncated IDX label payload")
+        return data[:n]
     raise ValueError(f"not an MNIST IDX buffer (magic={magic})")
 
 

@@ -1,0 +1,125 @@
+"""ctypes bindings for the native data-loader core (csrc/fastloader.cpp).
+
+The shared library is built on first use with the system g++ (no pybind11
+in the image; plain C ABI + ctypes).  Every entry point has a pure-numpy
+fallback, so the framework works identically — just slower on the host
+path — when no compiler is available.  ``DataLoader`` picks these up
+automatically (data/loader.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc", "fastloader.cpp")
+_LIB_ENV = "TPU_MNIST_NATIVE_LIB"
+
+_lib = None
+_tried = False
+
+
+def _build_lib() -> str | None:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return None
+    cache_dir = os.path.join(tempfile.gettempdir(), "tpu_mnist_native")
+    os.makedirs(cache_dir, exist_ok=True)
+    out = os.path.join(cache_dir, "libfastloader.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    tmp = out + f".build{os.getpid()}"
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           "-o", tmp, src, "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+        return out
+    except Exception:
+        return None
+
+
+def get_lib():
+    """Load (building if needed) the native library, or None."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    path = os.environ.get(_LIB_ENV) or _build_lib()
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.gather_normalize.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_void_p,
+        ]
+        lib.gather_labels.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        lib.idx_parse_header.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        lib.idx_parse_header.restype = ctypes.c_int
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def gather_normalize(
+    images: np.ndarray, indices: np.ndarray, mean: float, std: float
+) -> np.ndarray | None:
+    """Native gather+normalize: uint8 [N,H,W] + int32 [B] ->
+    float32 [B,H,W,1].  Returns None if the native lib is unavailable or
+    the images aren't a contiguous uint8 buffer (caller falls back to
+    numpy, which handles any dtype/stride — and copying a whole
+    non-contiguous dataset per batch would defeat the point)."""
+    lib = get_lib()
+    if lib is None or images.dtype != np.uint8 or not images.flags["C_CONTIGUOUS"]:
+        return None
+    idx = np.ascontiguousarray(indices, dtype=np.int32)
+    b = len(idx)
+    h, w = images.shape[1], images.shape[2]
+    out = np.empty((b, h, w, 1), np.float32)
+    lib.gather_normalize(
+        images.ctypes.data, idx.ctypes.data, b, h * w,
+        ctypes.c_float(mean), ctypes.c_float(std), out.ctypes.data,
+    )
+    return out
+
+
+def gather_labels(labels: np.ndarray, indices: np.ndarray) -> np.ndarray | None:
+    lib = get_lib()
+    # The native kernel reads raw uint8 labels; any other dtype takes the
+    # numpy fallback (fancy indexing is already cheap there).
+    if (
+        lib is None
+        or labels.dtype != np.uint8
+        or not labels.flags["C_CONTIGUOUS"]
+    ):
+        return None
+    idx = np.ascontiguousarray(indices, dtype=np.int32)
+    out = np.empty(len(idx), np.int32)
+    lib.gather_labels(labels.ctypes.data, idx.ctypes.data, len(idx), out.ctypes.data)
+    return out
+
+
+def parse_idx_native(raw: bytes) -> np.ndarray | None:
+    """Native IDX parse; returns None when the lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    dims = np.zeros(4, np.int64)
+    rc = lib.idx_parse_header(buf.ctypes.data, len(buf), dims.ctypes.data)
+    if rc != 0:
+        raise ValueError(f"not an MNIST IDX buffer (native parser rc={rc})")
+    n, rows, cols, offset = (int(d) for d in dims)
+    if rows:  # images
+        return buf[offset : offset + n * rows * cols].reshape(n, rows, cols).copy()
+    return buf[offset : offset + n].copy()

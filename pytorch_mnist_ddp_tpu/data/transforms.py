@@ -17,7 +17,10 @@ MNIST_STD = 0.3081
 
 def normalize(images_u8: np.ndarray) -> np.ndarray:
     """uint8 [N,28,28] -> float32 [N,28,28,1], scaled to [0,1] then
-    standardized with the MNIST mean/std, exactly ToTensor∘Normalize."""
-    x = images_u8.astype(np.float32) * (1.0 / 255.0)
-    x = (x - MNIST_MEAN) / MNIST_STD
+    standardized with the MNIST mean/std — ToTensor∘Normalize folded into
+    one affine pass (same scale/shift form as the native core,
+    csrc/fastloader.cpp)."""
+    scale = np.float32(1.0 / (255.0 * MNIST_STD))
+    shift = np.float32(-MNIST_MEAN / MNIST_STD)
+    x = images_u8.astype(np.float32) * scale + shift
     return x[..., None]

@@ -43,9 +43,10 @@ from .mesh import DATA_AXIS
 
 def _normalize_dev(x_u8: jax.Array, compute_dtype) -> jax.Array:
     """On-device ToTensor + Normalize (uint8 NHW -> float NHWC 1-channel),
-    identical math to data/transforms.py:normalize."""
-    x = x_u8.astype(jnp.float32) * (1.0 / 255.0)
-    x = (x - MNIST_MEAN) / (MNIST_STD)
+    same affine scale/shift form as data/transforms.py:normalize."""
+    scale = jnp.float32(1.0 / (255.0 * MNIST_STD))
+    shift = jnp.float32(-MNIST_MEAN / MNIST_STD)
+    x = x_u8.astype(jnp.float32) * scale + shift
     return x[..., None].astype(compute_dtype)
 
 

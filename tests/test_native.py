@@ -1,0 +1,136 @@
+"""Native (C++/ctypes) data-loader core tests: build, math parity with the
+numpy path, and graceful fallback (csrc/fastloader.cpp, data/native.py)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from pytorch_mnist_ddp_tpu.data import native
+from pytorch_mnist_ddp_tpu.data.transforms import MNIST_MEAN, MNIST_STD, normalize
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.get_lib()
+    if lib is None:
+        pytest.skip("native library unavailable (no compiler?)")
+    return lib
+
+
+def test_gather_normalize_matches_numpy(lib):
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, (100, 28, 28), np.uint8)
+    idx = rng.randint(0, 100, 32).astype(np.int32)
+    ours = native.gather_normalize(images, idx, MNIST_MEAN, MNIST_STD)
+    expect = normalize(images[idx])
+    assert ours.shape == (32, 28, 28, 1) and ours.dtype == np.float32
+    np.testing.assert_allclose(ours, expect, rtol=1e-6, atol=1e-7)
+
+
+def test_gather_normalize_large_batch_threads(lib):
+    """>256 samples takes the multithreaded path; results identical."""
+    rng = np.random.RandomState(1)
+    images = rng.randint(0, 256, (2000, 28, 28), np.uint8)
+    idx = rng.randint(0, 2000, 1024).astype(np.int32)
+    ours = native.gather_normalize(images, idx, MNIST_MEAN, MNIST_STD)
+    np.testing.assert_allclose(ours, normalize(images[idx]), rtol=1e-6, atol=1e-7)
+
+
+def test_gather_labels(lib):
+    labels = np.arange(50, dtype=np.uint8) % 10
+    idx = np.array([0, 49, 13, 13], np.int32)
+    out = native.gather_labels(labels, idx)
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, [0, 9, 3, 3])
+
+
+def test_native_idx_parse_matches_python(lib):
+    imgs = np.random.RandomState(2).randint(0, 256, (7, 28, 28), np.uint8)
+    raw = struct.pack(">iiii", 2051, 7, 28, 28) + imgs.tobytes()
+    parsed = native.parse_idx_native(raw)
+    np.testing.assert_array_equal(parsed, imgs)
+    labels = np.array([1, 2, 3], np.uint8)
+    raw_l = struct.pack(">ii", 2049, 3) + labels.tobytes()
+    np.testing.assert_array_equal(native.parse_idx_native(raw_l), labels)
+
+
+def test_native_idx_parse_rejects_garbage(lib):
+    with pytest.raises(ValueError):
+        native.parse_idx_native(struct.pack(">i", 99) + b"\0" * 64)
+    with pytest.raises(ValueError):
+        # truncated payload (header says 10 images, body has 1)
+        native.parse_idx_native(
+            struct.pack(">iiii", 2051, 10, 28, 28) + b"\0" * 784
+        )
+
+
+def test_loader_uses_native_and_matches_fallback(monkeypatch):
+    """DataLoader output must be byte-identical with and without the
+    native core."""
+    from pytorch_mnist_ddp_tpu.data.loader import DataLoader
+
+    rng = np.random.RandomState(3)
+    images = rng.randint(0, 256, (64, 28, 28), np.uint8)
+    labels = rng.randint(0, 10, 64).astype(np.uint8)
+
+    def batches():
+        loader = DataLoader(images, labels, 16, shuffle=True, seed=5,
+                            device_place=False, prefetch_depth=0)
+        return [(np.asarray(x), np.asarray(y)) for x, y, _ in loader.epoch(0)]
+
+    with_native = batches()
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    without = batches()
+    for (xa, ya), (xb, yb) in zip(with_native, without, strict=True):
+        # same affine formula on both paths; allow last-bit FMA differences
+        np.testing.assert_allclose(xa, xb, rtol=0, atol=1e-6)
+        np.testing.assert_array_equal(ya, yb)
+
+
+def test_gather_normalize_rejects_non_uint8(lib):
+    images = np.zeros((4, 28, 28), np.float32)
+    idx = np.zeros(2, np.int32)
+    assert native.gather_normalize(images, idx, MNIST_MEAN, MNIST_STD) is None
+
+
+def test_gather_normalize_rejects_non_contiguous(lib):
+    images = np.zeros((8, 28, 28), np.uint8)[::2]
+    idx = np.zeros(2, np.int32)
+    assert native.gather_normalize(images, idx, MNIST_MEAN, MNIST_STD) is None
+
+
+def test_loader_actually_uses_native_label_gather(lib, monkeypatch):
+    """The native label gather must run on the loader's hot path (uint8
+    source labels), not silently fall back."""
+    from pytorch_mnist_ddp_tpu.data.loader import DataLoader
+
+    calls = []
+    orig = native.gather_labels
+
+    def spy(labels, idx):
+        out = orig(labels, idx)
+        calls.append(out is not None)
+        return out
+
+    monkeypatch.setattr(
+        "pytorch_mnist_ddp_tpu.data.loader.native.gather_labels", spy
+    )
+    images = np.zeros((32, 28, 28), np.uint8)
+    labels = np.arange(32, dtype=np.uint8) % 10
+    loader = DataLoader(images, labels, 8, shuffle=False,
+                        device_place=False, prefetch_depth=0)
+    ys = [np.asarray(y) for _, y, _ in loader.epoch(0)]
+    assert calls and all(calls)  # native path taken every batch
+    np.testing.assert_array_equal(np.concatenate(ys), labels.astype(np.int32))
+
+
+def test_truncated_idx_raises_everywhere():
+    """Both parsers (native and Python) must reject truncated payloads."""
+    from pytorch_mnist_ddp_tpu.data.mnist import parse_idx
+
+    bad_labels = struct.pack(">ii", 2049, 100) + b"\0" * 10
+    bad_images = struct.pack(">iiii", 2051, 10, 28, 28) + b"\0" * 784
+    for raw in (bad_labels, bad_images):
+        with pytest.raises(ValueError):
+            parse_idx(raw)
