@@ -33,13 +33,18 @@ int idx_parse_header(const uint8_t* buf, int64_t len, int64_t* out_dims) {
     if (magic == 2051) {  // images
         if (len < 16) return -1;
         int64_t n = be32(4), rows = be32(8), cols = be32(12);
-        if (len < 16 + n * rows * cols) return -2;
+        if (n < 0 || rows <= 0 || cols <= 0) return -2;
+        // Overflow-safe truncation check: n*rows*cols (and even rows*cols)
+        // can exceed int64 for hostile headers, so divide instead of
+        // multiplying — floor(floor(a/b)/c) == floor(a/(b*c)) for
+        // positive b, c.
+        if ((len - 16) / rows / cols < n) return -2;
         out_dims[0] = n; out_dims[1] = rows; out_dims[2] = cols; out_dims[3] = 16;
         return 0;
     }
     if (magic == 2049) {  // labels
         int64_t n = be32(4);
-        if (len < 8 + n) return -2;
+        if (n < 0 || len < 8 + n) return -2;
         out_dims[0] = n; out_dims[1] = 0; out_dims[2] = 0; out_dims[3] = 8;
         return 0;
     }

@@ -54,6 +54,9 @@ def main() -> None:
 
     from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
     from pytorch_mnist_ddp_tpu.trainer import fit
+    from pytorch_mnist_ddp_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
 
     # Single-device semantics, like the reference mnist.py (one device, no
     # collectives); the reference saves to mnist_cnn.pt (mnist.py:133).

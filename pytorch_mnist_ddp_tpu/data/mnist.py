@@ -51,15 +51,23 @@ def parse_idx(raw: bytes) -> np.ndarray:
     parsed = native.parse_idx_native(raw)
     if parsed is not None:
         return parsed
+    if len(raw) < 8:
+        raise ValueError("truncated IDX header")
     magic, = struct.unpack(">i", raw[:4])
     if magic == _IMAGE_MAGIC:
+        if len(raw) < 16:
+            raise ValueError("truncated IDX image header")
         n, rows, cols = struct.unpack(">iii", raw[4:16])
+        if n < 0 or rows <= 0 or cols <= 0:
+            raise ValueError(f"invalid IDX image dims ({n}, {rows}, {cols})")
         data = np.frombuffer(raw, dtype=np.uint8, offset=16)
         if len(data) < n * rows * cols:
             raise ValueError("truncated IDX image payload")
         return data[: n * rows * cols].reshape(n, rows, cols)
     if magic == _LABEL_MAGIC:
         n, = struct.unpack(">i", raw[4:8])
+        if n < 0:
+            raise ValueError(f"invalid IDX label count ({n})")
         data = np.frombuffer(raw, dtype=np.uint8, offset=8)
         if len(data) < n:
             raise ValueError("truncated IDX label payload")

@@ -10,14 +10,34 @@ automatically (data/loader.py).
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
+import platform
 import subprocess
-import tempfile
 
 import numpy as np
 
+from ..utils.cache_dir import cache_root
+
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc", "fastloader.cpp")
 _LIB_ENV = "TPU_MNIST_NATIVE_LIB"
+_CFLAGS = ["-O3", "-march=native", "-shared", "-fPIC", "-std=c++17"]
+
+
+def _cpu_tag() -> str:
+    """Discriminator for the -march=native binary: arch + ISA feature set,
+    so a cache shared across heterogeneous hosts (NFS home) never serves a
+    binary with unsupported instructions (SIGILL)."""
+    feats = b""
+    try:
+        with open("/proc/cpuinfo", "rb") as f:
+            for line in f:
+                if line.startswith((b"flags", b"Features")):
+                    feats = b" ".join(sorted(line.split(b":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    return platform.machine() + "-" + hashlib.sha256(feats).hexdigest()[:8]
 
 _lib = None
 _tried = False
@@ -27,14 +47,22 @@ def _build_lib() -> str | None:
     src = os.path.abspath(_SRC)
     if not os.path.exists(src):
         return None
-    cache_dir = os.path.join(tempfile.gettempdir(), "tpu_mnist_native")
-    os.makedirs(cache_dir, exist_ok=True)
-    out = os.path.join(cache_dir, "libfastloader.so")
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+    # Per-user cache dir (never a shared /tmp path — a world-writable
+    # location would let another local user plant the .so we CDLL), keyed
+    # on the source+flags hash plus a CPU tag so edits, flag changes, and
+    # host ISA differences all rebuild rather than reuse.
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read() + " ".join(_CFLAGS).encode()).hexdigest()
+    cache_dir = cache_root("native")
+    out = os.path.join(cache_dir, f"libfastloader-{digest[:16]}-{_cpu_tag()}.so")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        return None
+    if os.path.exists(out):
         return out
     tmp = out + f".build{os.getpid()}"
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           "-o", tmp, src, "-lpthread"]
+    cmd = ["g++", *_CFLAGS, "-o", tmp, src, "-lpthread"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)
@@ -71,6 +99,24 @@ def get_lib():
     return _lib
 
 
+def _checked_indices(indices: np.ndarray, n: int) -> np.ndarray:
+    """Validate gather indices with numpy's semantics before handing them
+    to the native kernels (which, like any C gather, do no bounds checks):
+    negatives wrap from the end, anything out of range raises IndexError —
+    so native and numpy-fallback paths fail identically."""
+    idx = np.ascontiguousarray(indices, dtype=np.int32)
+    if idx.size:
+        lo, hi = int(idx.min()), int(idx.max())
+        if lo < -n or hi >= n:
+            bad = lo if lo < -n else hi
+            raise IndexError(
+                f"index {bad} is out of bounds for axis 0 with size {n}"
+            )
+        if lo < 0:
+            idx = np.where(idx < 0, idx + n, idx).astype(np.int32)
+    return idx
+
+
 def gather_normalize(
     images: np.ndarray, indices: np.ndarray, mean: float, std: float
 ) -> np.ndarray | None:
@@ -82,7 +128,7 @@ def gather_normalize(
     lib = get_lib()
     if lib is None or images.dtype != np.uint8 or not images.flags["C_CONTIGUOUS"]:
         return None
-    idx = np.ascontiguousarray(indices, dtype=np.int32)
+    idx = _checked_indices(indices, len(images))
     b = len(idx)
     h, w = images.shape[1], images.shape[2]
     out = np.empty((b, h, w, 1), np.float32)
@@ -103,7 +149,7 @@ def gather_labels(labels: np.ndarray, indices: np.ndarray) -> np.ndarray | None:
         or not labels.flags["C_CONTIGUOUS"]
     ):
         return None
-    idx = np.ascontiguousarray(indices, dtype=np.int32)
+    idx = _checked_indices(indices, len(labels))
     out = np.empty(len(idx), np.int32)
     lib.gather_labels(labels.ctypes.data, idx.ctypes.data, len(idx), out.ctypes.data)
     return out

@@ -1,0 +1,38 @@
+"""Persistent XLA compilation cache control.
+
+The reference pays no compile cost (eager CUDA kernels are pre-built); the
+XLA analogue is the persistent compilation cache, which makes every run
+after the first start from compiled executables.  The ``JAX_COMPILATION_
+CACHE_DIR`` env var alone is not reliably honored on all backends, so this
+enables the cache explicitly through ``jax.config`` with thresholds that
+cache every entry (min size/compile-time gates off).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .cache_dir import cache_root
+
+
+def enable_persistent_cache(path: str | None = None) -> str | None:
+    """Turn on the persistent compilation cache (idempotent).  Returns the
+    cache directory in use, or None when the cache can't be set up (e.g.
+    read-only home) — the cache is an optimization, never a startup
+    requirement.  Must be called before the first jit compile to benefit
+    that compile; safe to call any time."""
+    import jax
+
+    cache_dir = (
+        path
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or cache_root("xla")
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:
+        return None
+    return cache_dir

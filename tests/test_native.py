@@ -125,12 +125,51 @@ def test_loader_actually_uses_native_label_gather(lib, monkeypatch):
     np.testing.assert_array_equal(np.concatenate(ys), labels.astype(np.int32))
 
 
-def test_truncated_idx_raises_everywhere():
-    """Both parsers (native and Python) must reject truncated payloads."""
+@pytest.mark.parametrize("use_native", [True, False])
+def test_truncated_idx_raises_everywhere(use_native, monkeypatch):
+    """BOTH parsers (native and pure-Python fallback) must reject truncated
+    or nonsense headers — forcing the fallback path so its guards are
+    exercised even on machines where the native lib builds."""
     from pytorch_mnist_ddp_tpu.data.mnist import parse_idx
 
-    bad_labels = struct.pack(">ii", 2049, 100) + b"\0" * 10
-    bad_images = struct.pack(">iiii", 2051, 10, 28, 28) + b"\0" * 784
-    for raw in (bad_labels, bad_images):
+    if use_native and native.get_lib() is None:
+        pytest.skip("native library unavailable (no compiler?)")
+    if not use_native:
+        monkeypatch.setattr(native, "parse_idx_native", lambda raw: None)
+
+    bad = [
+        struct.pack(">ii", 2049, 100) + b"\0" * 10,          # truncated labels
+        struct.pack(">iiii", 2051, 10, 28, 28) + b"\0" * 784,  # truncated images
+        struct.pack(">ii", 2049, -1) + b"\0" * 10,           # negative count
+        struct.pack(">iiii", 2051, -1, 28, 28) + b"\0" * 784,  # negative n
+        struct.pack(">iiii", 2051, 5, 0, 28) + b"\0" * 784,  # zero rows
+        # overflow bait: huge dims whose int64 product would wrap
+        struct.pack(">IIII", 2051, 0xFFFFFFFF, 0xFFFFFFFF, 0xFFFFFFFF),
+        struct.pack(">i", 2051) + b"\0" * 6,                 # short image header
+        b"\0\0",                                             # shorter than magic
+    ]
+    for raw in bad:
         with pytest.raises(ValueError):
             parse_idx(raw)
+
+
+def test_native_gather_bounds_checked(lib):
+    """Out-of-range indices must raise IndexError (numpy semantics), never
+    read out of bounds; in-range negatives wrap from the end like numpy."""
+    images = np.arange(4 * 28 * 28, dtype=np.uint8).reshape(4, 28, 28)
+    labels = np.array([7, 8, 9, 5], np.uint8)
+    from pytorch_mnist_ddp_tpu.data.transforms import normalize
+
+    with pytest.raises(IndexError):
+        native.gather_normalize(images, np.array([0, 4], np.int32),
+                                MNIST_MEAN, MNIST_STD)
+    with pytest.raises(IndexError):
+        native.gather_labels(labels, np.array([-5], np.int32))
+    # negative wrap matches numpy fancy indexing
+    out = native.gather_normalize(images, np.array([-1, 0], np.int32),
+                                  MNIST_MEAN, MNIST_STD)
+    np.testing.assert_allclose(out, normalize(images[[-1, 0]]),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_array_equal(
+        native.gather_labels(labels, np.array([-1, -4], np.int32)), [5, 7]
+    )
