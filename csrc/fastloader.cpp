@@ -26,9 +26,13 @@ int idx_parse_header(const uint8_t* buf, int64_t len, int64_t* out_dims) {
     if (len < 8) return -1;
     uint32_t magic = (uint32_t(buf[0]) << 24) | (uint32_t(buf[1]) << 16) |
                      (uint32_t(buf[2]) << 8) | uint32_t(buf[3]);
+    // Signed 32-bit read (then widened), matching Python's struct ">i":
+    // a sign-bit-set count must parse as negative and be rejected by the
+    // n < 0 guards below on BOTH parsers, not accepted here as 2^31+.
     auto be32 = [&](int64_t off) {
-        return (int64_t(buf[off]) << 24) | (int64_t(buf[off + 1]) << 16) |
-               (int64_t(buf[off + 2]) << 8) | int64_t(buf[off + 3]);
+        uint32_t u = (uint32_t(buf[off]) << 24) | (uint32_t(buf[off + 1]) << 16) |
+                     (uint32_t(buf[off + 2]) << 8) | uint32_t(buf[off + 3]);
+        return int64_t(int32_t(u));
     };
     if (magic == 2051) {  // images
         if (len < 16) return -1;

@@ -39,6 +39,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run each epoch as one device call over an "
                         "HBM-resident dataset (fastest; same printed "
                         "output, train lines emitted at epoch end)")
+    p.add_argument("--pallas-opt", action="store_true", default=False,
+                   help="use the fused Pallas Adadelta kernel for the "
+                        "optimizer update (ops/pallas_adadelta.py)")
     p.add_argument("--data-root", type=str, default="./data",
                    help="MNIST IDX directory")
     return p

@@ -104,16 +104,19 @@ def _checked_indices(indices: np.ndarray, n: int) -> np.ndarray:
     to the native kernels (which, like any C gather, do no bounds checks):
     negatives wrap from the end, anything out of range raises IndexError —
     so native and numpy-fallback paths fail identically."""
-    idx = np.ascontiguousarray(indices, dtype=np.int32)
-    if idx.size:
-        lo, hi = int(idx.min()), int(idx.max())
+    # Bounds-check in the ORIGINAL dtype: narrowing int64 -> int32 first
+    # would wrap out-of-range values into range and gather the wrong row.
+    orig = np.asarray(indices)
+    if orig.size:
+        lo, hi = int(orig.min()), int(orig.max())
         if lo < -n or hi >= n:
             bad = lo if lo < -n else hi
             raise IndexError(
                 f"index {bad} is out of bounds for axis 0 with size {n}"
             )
-        if lo < 0:
-            idx = np.where(idx < 0, idx + n, idx).astype(np.int32)
+    idx = np.ascontiguousarray(orig, dtype=np.int32)
+    if orig.size and lo < 0:
+        idx = np.where(idx < 0, idx + n, idx).astype(np.int32)
     return idx
 
 

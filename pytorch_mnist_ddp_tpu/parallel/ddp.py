@@ -31,8 +31,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.net import Net
-from ..ops.adadelta import AdadeltaState, adadelta_init, adadelta_update
+from ..ops.adadelta import AdadeltaState, adadelta_init
 from ..ops.loss import nll_loss
+from ..ops.pallas_adadelta import adadelta_update_best
 from .mesh import DATA_AXIS
 
 
@@ -61,6 +62,7 @@ def make_train_step(
     rho: float = 0.9,
     eps: float = 1e-6,
     dropout: bool = True,
+    use_pallas: bool | None = None,
 ):
     """Build the jitted DP train step.
 
@@ -86,7 +88,9 @@ def make_train_step(
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         # The DDP allreduce: mean over replicas == bucketed NCCL sum / world.
         grads = jax.lax.pmean(grads, DATA_AXIS)
-        params, opt = adadelta_update(state.params, grads, state.opt, lr, rho, eps)
+        params, opt = adadelta_update_best(
+            state.params, grads, state.opt, lr, rho, eps, use_pallas=use_pallas
+        )
         new_state = TrainState(params=params, opt=opt, step=state.step + 1)
         return new_state, loss[None]  # keep a per-shard loss axis
 

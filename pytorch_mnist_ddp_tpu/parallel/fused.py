@@ -35,8 +35,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..data.transforms import MNIST_MEAN, MNIST_STD
 from ..models.net import Net
-from ..ops.adadelta import adadelta_update
 from ..ops.loss import nll_loss
+from ..ops.pallas_adadelta import adadelta_update_best
 from .ddp import TrainState
 from .mesh import DATA_AXIS
 
@@ -74,6 +74,7 @@ def make_fused_train_epoch(
     rho: float = 0.9,
     eps: float = 1e-6,
     dropout: bool = True,
+    use_pallas: bool | None = None,
 ):
     """Build ``epoch_fn(state, images, labels, epoch, shuffle_key,
     dropout_key, lr) -> (state, losses[num_batches, n_shards])``.
@@ -124,7 +125,9 @@ def make_fused_train_epoch(
 
             loss, grads = jax.value_and_grad(loss_fn)(state.params)
             grads = jax.lax.pmean(grads, DATA_AXIS)
-            params, opt = adadelta_update(state.params, grads, state.opt, lr, rho, eps)
+            params, opt = adadelta_update_best(
+                state.params, grads, state.opt, lr, rho, eps, use_pallas=use_pallas
+            )
             return TrainState(params, opt, state.step + 1), loss
 
         state, losses = jax.lax.scan(

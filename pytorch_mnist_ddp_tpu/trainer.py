@@ -126,6 +126,7 @@ def fit(args, dist: DistState, save_path: str | None = None) -> TrainState:
     # lines are emitted after each epoch instead of during it.  dry-run
     # stays on the per-batch loop (it IS the per-batch smoke test).
     fused = bool(getattr(args, "fused", False)) and not args.dry_run
+    use_pallas = bool(getattr(args, "pallas_opt", False))
 
     if fused:
         from .parallel.fused import (
@@ -137,7 +138,7 @@ def fit(args, dist: DistState, save_path: str | None = None) -> TrainState:
         tr_x, tr_y = device_put_dataset(train_set.images, train_set.labels, mesh)
         te_x, te_y = device_put_dataset(test_set.images, test_set.labels, mesh)
         epoch_fn, num_batches = make_fused_train_epoch(
-            mesh, len(train_set), global_batch
+            mesh, len(train_set), global_batch, use_pallas=use_pallas
         )
         fused_eval_fn = make_fused_eval(mesh, len(test_set), eval_batch)
 
@@ -190,7 +191,7 @@ def fit(args, dist: DistState, save_path: str | None = None) -> TrainState:
             # even when the sampler pads ranks to equal length (multi-host).
             mask_padding=True,
         )
-        step_fn = make_train_step(mesh)
+        step_fn = make_train_step(mesh, use_pallas=use_pallas)
         eval_fn = make_eval_step(mesh)
         for epoch in range(1, args.epochs + 1):
             state = train_one_epoch(

@@ -173,3 +173,25 @@ def test_native_gather_bounds_checked(lib):
     np.testing.assert_array_equal(
         native.gather_labels(labels, np.array([-1, -4], np.int32)), [5, 7]
     )
+    # int64 indices that would wrap into range under an int32 narrowing
+    # must still raise, not silently gather the wrong row
+    with pytest.raises(IndexError):
+        native.gather_normalize(images, np.array([2**32], np.int64),
+                                MNIST_MEAN, MNIST_STD)
+    with pytest.raises(IndexError):
+        native.gather_labels(labels, np.array([2**32 + 1], np.int64))
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_sign_bit_header_count_rejected(use_native, monkeypatch):
+    """A header count with the sign bit set (0x80000000) must parse as
+    negative and be rejected by BOTH parsers (struct '>i' semantics)."""
+    from pytorch_mnist_ddp_tpu.data.mnist import parse_idx
+
+    if use_native and native.get_lib() is None:
+        pytest.skip("native library unavailable (no compiler?)")
+    if not use_native:
+        monkeypatch.setattr(native, "parse_idx_native", lambda raw: None)
+    raw = struct.pack(">iI", 2049, 0x80000000) + b"\0" * 64
+    with pytest.raises(ValueError):
+        parse_idx(raw)
