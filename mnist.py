@@ -36,9 +36,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--save-model", action="store_true", default=False,
                    help="save the final model checkpoint")
     p.add_argument("--fused", action="store_true", default=False,
-                   help="run each epoch as one device call over an "
-                        "HBM-resident dataset (fastest; same printed "
-                        "output, train lines emitted at epoch end)")
+                   help="run the whole multi-epoch training as one device "
+                        "call over an HBM-resident dataset (fastest; same "
+                        "printed output, emitted after the run completes)")
     p.add_argument("--pallas-opt", action="store_true", default=False,
                    help="use the fused Pallas Adadelta kernel for the "
                         "optimizer update (ops/pallas_adadelta.py)")

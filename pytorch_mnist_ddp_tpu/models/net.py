@@ -23,6 +23,8 @@ TPU-first decisions (SURVEY.md §7 step 2):
 
 from __future__ import annotations
 
+import functools
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -95,7 +97,20 @@ def init_params(key: jax.Array, compute_dtype: jnp.dtype = jnp.float32):
     """Initialize params from one key.  Every data-parallel replica calls
     this with the SAME key, which replaces DDP's rank-0 parameter broadcast
     (reference mnist_ddp.py:172-174; SURVEY.md N3) — replicas are identical
-    by construction rather than by collective."""
+    by construction rather than by collective.
+
+    Jitted: eager flax init dispatches one device call per tensor, which is
+    costly when dispatch crosses a network tunnel; one fused call also
+    lands in the persistent compile cache."""
+    return _init_params_jit(compute_dtype)(key)
+
+
+@functools.lru_cache(maxsize=None)
+def _init_params_jit(compute_dtype):
     model = Net(compute_dtype=compute_dtype)
     dummy = jnp.zeros((1, 28, 28, 1), jnp.float32)
-    return model.init({"params": key}, dummy, train=False)["params"]
+
+    def init(key):
+        return model.init({"params": key}, dummy, train=False)["params"]
+
+    return jax.jit(init)

@@ -27,6 +27,7 @@ measured tradeoff at this model's scale).
 
 from __future__ import annotations
 
+import os
 from typing import Any
 
 import jax
@@ -152,18 +153,26 @@ def adadelta_update_best(
     ``use_pallas=True`` (CLI ``--pallas-opt``).
 
     Opting in on a backend with no real Pallas TPU lowering falls back to
-    the plain update with a warning — except CPU, where interpret mode is
-    the documented test path."""
+    the plain update with a warning: interpret mode is orders of magnitude
+    slower and must never be reachable from the CLI by accident.  Tests
+    exercise the interpreted kernel on CPU by setting
+    ``TPU_MNIST_PALLAS_INTERPRET=1`` (or calling adadelta_update_pallas
+    with ``interpret=True`` directly)."""
     if use_pallas:
         backend = jax.default_backend()
-        if backend in ("tpu", "cpu"):
+        if backend == "tpu":
             return adadelta_update_pallas(params, grads, state, lr, rho, eps)
+        if os.environ.get("TPU_MNIST_PALLAS_INTERPRET") == "1":
+            return adadelta_update_pallas(
+                params, grads, state, lr, rho, eps, interpret=True
+            )
         import warnings
 
         warnings.warn(
             f"--pallas-opt requested on backend {backend!r}, which would "
             "run the kernel in slow interpret mode; using the plain "
-            "Adadelta update instead",
+            "Adadelta update instead (set TPU_MNIST_PALLAS_INTERPRET=1 "
+            "to force interpret mode for testing)",
             stacklevel=2,
         )
     return adadelta_update(params, grads, state, lr, rho, eps)

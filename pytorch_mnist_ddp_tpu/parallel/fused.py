@@ -66,26 +66,21 @@ def device_put_dataset(images, labels, mesh: Mesh):
     return x, y
 
 
-def make_fused_train_epoch(
-    mesh: Mesh,
+def _local_epoch_builder(
+    model: Net,
     dataset_size: int,
     global_batch: int,
-    compute_dtype=jnp.float32,
-    rho: float = 0.9,
-    eps: float = 1e-6,
-    dropout: bool = True,
-    use_pallas: bool | None = None,
+    n_shards: int,
+    compute_dtype,
+    rho: float,
+    eps: float,
+    dropout: bool,
+    use_pallas: bool | None,
 ):
-    """Build ``epoch_fn(state, images, labels, epoch, shuffle_key,
-    dropout_key, lr) -> (state, losses[num_batches, n_shards])``.
-
-    ``num_batches = ceil(dataset_size / global_batch)``; a non-divisible
-    final batch is filled by wrapping the permutation and the filler
-    samples carry weight 0 — exactly the host loader's final-batch padding
-    (data/loader.py), so both paths train on the same effective samples.
-    """
-    model = Net(compute_dtype=compute_dtype)
-    n_shards = mesh.shape[DATA_AXIS]
+    """Shared body for the per-epoch and whole-run fusions: returns
+    ``local_epoch(state, images, labels, epoch, shuffle_key, dropout_key,
+    lr) -> (state, losses[num_batches])`` (per-shard, to be run inside
+    ``shard_map``) plus ``num_batches``."""
     if global_batch % n_shards:
         raise ValueError(f"global batch {global_batch} not divisible by mesh")
     shard_batch = global_batch // n_shards
@@ -138,10 +133,42 @@ def make_fused_train_epoch(
                 valid.reshape(num_batches, global_batch),
             ),
         )
+        return state, losses
+
+    return local_epoch, num_batches
+
+
+def make_fused_train_epoch(
+    mesh: Mesh,
+    dataset_size: int,
+    global_batch: int,
+    compute_dtype=jnp.float32,
+    rho: float = 0.9,
+    eps: float = 1e-6,
+    dropout: bool = True,
+    use_pallas: bool | None = None,
+):
+    """Build ``epoch_fn(state, images, labels, epoch, shuffle_key,
+    dropout_key, lr) -> (state, losses[num_batches, n_shards])``.
+
+    ``num_batches = ceil(dataset_size / global_batch)``; a non-divisible
+    final batch is filled by wrapping the permutation and the filler
+    samples carry weight 0 — exactly the host loader's final-batch padding
+    (data/loader.py), so both paths train on the same effective samples.
+    """
+    model = Net(compute_dtype=compute_dtype)
+    n_shards = mesh.shape[DATA_AXIS]
+    local_epoch, num_batches = _local_epoch_builder(
+        model, dataset_size, global_batch, n_shards,
+        compute_dtype, rho, eps, dropout, use_pallas,
+    )
+
+    def local_epoch_col(*a):
+        state, losses = local_epoch(*a)
         return state, losses[:, None]  # per-shard loss column
 
     sharded = jax.shard_map(
-        local_epoch,
+        local_epoch_col,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P(), P()),
         out_specs=(P(), P(None, DATA_AXIS)),
@@ -150,17 +177,15 @@ def make_fused_train_epoch(
     return jax.jit(sharded, donate_argnums=(0,)), num_batches
 
 
-def make_fused_eval(
-    mesh: Mesh,
+def _local_eval_builder(
+    model: Net,
     dataset_size: int,
     global_batch: int,
-    compute_dtype=jnp.float32,
+    n_shards: int,
+    compute_dtype,
 ):
-    """Build ``eval_fn(params, images, labels) -> (loss_sum, correct)``
-    over the whole test set in one device call (scan over batches, padding
-    masked, single psum) — the fused form of parallel/ddp.py:make_eval_step."""
-    model = Net(compute_dtype=compute_dtype)
-    n_shards = mesh.shape[DATA_AXIS]
+    """Shared eval body: returns ``local_eval(params, images, labels) ->
+    psum'd [loss_sum, correct]`` to be run inside ``shard_map``."""
     if global_batch % n_shards:
         raise ValueError(f"global batch {global_batch} not divisible by mesh")
     shard_batch = global_batch // n_shards
@@ -194,6 +219,24 @@ def make_fused_eval(
         )
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
 
+    return local_eval
+
+
+def make_fused_eval(
+    mesh: Mesh,
+    dataset_size: int,
+    global_batch: int,
+    compute_dtype=jnp.float32,
+):
+    """Build ``eval_fn(params, images, labels) -> (loss_sum, correct)``
+    over the whole test set in one device call (scan over batches, padding
+    masked, single psum) — the fused form of parallel/ddp.py:make_eval_step."""
+    model = Net(compute_dtype=compute_dtype)
+    n_shards = mesh.shape[DATA_AXIS]
+    local_eval = _local_eval_builder(
+        model, dataset_size, global_batch, n_shards, compute_dtype
+    )
+
     sharded = jax.shard_map(
         local_eval,
         mesh=mesh,
@@ -202,3 +245,65 @@ def make_fused_eval(
         check_vma=False,
     )
     return jax.jit(sharded)
+
+
+def make_fused_run(
+    mesh: Mesh,
+    train_size: int,
+    test_size: int,
+    global_batch: int,
+    eval_batch: int,
+    epochs: int,
+    compute_dtype=jnp.float32,
+    rho: float = 0.9,
+    eps: float = 1e-6,
+    dropout: bool = True,
+    use_pallas: bool | None = None,
+):
+    """Whole-run fusion: EVERY epoch's training scan plus its full-test-set
+    eval as ONE jitted device call.
+
+    The reference pays a host round trip per *batch* (mnist_ddp.py:67-79);
+    the per-epoch fusion above cuts that to one per epoch; this cuts it to
+    one per *run* — a single trace/compile and a single dispatch+sync,
+    which matters when device dispatch crosses a network tunnel.
+
+    Returns ``(run_fn, num_batches)`` where ``run_fn(state, tr_x, tr_y,
+    te_x, te_y, shuffle_key, dropout_key, lrs) -> (state,
+    losses[epochs, num_batches, n_shards], evals[epochs, 2])``; ``lrs`` is
+    the per-epoch learning-rate array (host-computed StepLR values, so the
+    schedule is bit-identical to the per-epoch paths) and ``evals`` rows
+    are the psum'd ``[loss_sum, correct]`` test totals after each epoch.
+    """
+    model = Net(compute_dtype=compute_dtype)
+    n_shards = mesh.shape[DATA_AXIS]
+    local_epoch, num_batches = _local_epoch_builder(
+        model, train_size, global_batch, n_shards,
+        compute_dtype, rho, eps, dropout, use_pallas,
+    )
+    local_eval = _local_eval_builder(
+        model, test_size, eval_batch, n_shards, compute_dtype
+    )
+
+    def local_run(state, tr_x, tr_y, te_x, te_y, shuffle_key, dropout_key, lrs):
+        def one_epoch(state, epoch_and_lr):
+            epoch, lr = epoch_and_lr
+            state, losses = local_epoch(
+                state, tr_x, tr_y, epoch, shuffle_key, dropout_key, lr
+            )
+            totals = local_eval(state.params, te_x, te_y)
+            return state, (losses, totals)
+
+        state, (losses, evals) = jax.lax.scan(
+            one_epoch, state, (jnp.arange(1, epochs + 1), lrs)
+        )
+        return state, losses[..., None], evals
+
+    sharded = jax.shard_map(
+        local_run,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P(None, None, DATA_AXIS), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,)), num_batches

@@ -121,34 +121,36 @@ def fit(args, dist: DistState, save_path: str | None = None) -> TrainState:
     global_batch = args.batch_size * n_shards
     eval_batch = -(-args.test_batch_size // n_shards) * n_shards
     lr_fn = step_lr(args.lr, args.gamma, step_size=1)
-    # Fused path: whole epochs as single device calls over an HBM-resident
-    # dataset (parallel/fused.py).  Identical printed output; the train
-    # lines are emitted after each epoch instead of during it.  dry-run
-    # stays on the per-batch loop (it IS the per-batch smoke test).
+    # Fused path: the ENTIRE multi-epoch run as one device call over an
+    # HBM-resident dataset (parallel/fused.py:make_fused_run).  Identical
+    # printed output, emitted after the run completes rather than live.
+    # dry-run stays on the per-batch loop (it IS the per-batch smoke test).
     fused = bool(getattr(args, "fused", False)) and not args.dry_run
     use_pallas = bool(getattr(args, "pallas_opt", False))
 
     if fused:
-        from .parallel.fused import (
-            device_put_dataset,
-            make_fused_eval,
-            make_fused_train_epoch,
-        )
+        from .parallel.fused import device_put_dataset, make_fused_run
 
         tr_x, tr_y = device_put_dataset(train_set.images, train_set.labels, mesh)
         te_x, te_y = device_put_dataset(test_set.images, test_set.labels, mesh)
-        epoch_fn, num_batches = make_fused_train_epoch(
-            mesh, len(train_set), global_batch, use_pallas=use_pallas
+        run_fn, num_batches = make_fused_run(
+            mesh, len(train_set), len(test_set), global_batch, eval_batch,
+            args.epochs, use_pallas=use_pallas,
         )
-        fused_eval_fn = make_fused_eval(mesh, len(test_set), eval_batch)
-
-        for epoch in range(1, args.epochs + 1):
-            state, losses = epoch_fn(
-                state, tr_x, tr_y, jnp.int32(epoch), keys["shuffle"],
-                keys["dropout"], jnp.float32(lr_fn(epoch)),
-            )
-            if dist.is_chief:
-                losses_host = np.asarray(losses[:, 0])
+        # Host-computed StepLR values: bit-identical to the per-epoch paths.
+        lrs = jnp.asarray(
+            [lr_fn(e) for e in range(1, args.epochs + 1)], jnp.float32
+        )
+        state, losses, evals = run_fn(
+            state, tr_x, tr_y, te_x, te_y,
+            keys["shuffle"], keys["dropout"], lrs,
+        )
+        if dist.is_chief:
+            # One transfer for the whole run, then the reference's exact
+            # interleaved output — train lines + test summary per epoch.
+            losses_host = np.asarray(losses[:, :, 0])
+            evals_host = np.asarray(evals)
+            for epoch in range(1, args.epochs + 1):
                 for batch_idx in range(0, num_batches, args.log_interval):
                     samples = dist.world_size * batch_idx * args.batch_size
                     if not dist.distributed:
@@ -156,15 +158,13 @@ def fit(args, dist: DistState, save_path: str | None = None) -> TrainState:
                     print(
                         train_log_line(
                             epoch, samples, len(train_set), batch_idx,
-                            num_batches, float(losses_host[batch_idx]),
+                            num_batches, float(losses_host[epoch - 1, batch_idx]),
                         )
                     )
-            totals = fused_eval_fn(state.params, te_x, te_y)
-            if dist.is_chief:
                 print(
                     test_summary_lines(
-                        float(totals[0]) / len(test_set),
-                        int(totals[1]),
+                        float(evals_host[epoch - 1, 0]) / len(test_set),
+                        int(evals_host[epoch - 1, 1]),
                         len(test_set),
                     )
                 )

@@ -29,6 +29,20 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
         or cache_root("xla")
     )
     try:
+        # CPU executables are AOT-compiled against the build host's exact
+        # machine features; reusing them on a different host risks SIGILL
+        # (observed: cpu_aot_loader feature-mismatch errors), so skip the
+        # on-disk cache when the CPU platform is selected.  Detection uses
+        # the env var / config value only — jax.default_backend() would
+        # initialize the backend here, and that breaks a later
+        # jax.distributed.initialize() in multi-process launches.
+        platforms = (
+            os.environ.get("JAX_PLATFORMS")
+            or getattr(jax.config, "jax_platforms", None)
+            or ""
+        )
+        if platforms.split(",")[0].strip().lower() == "cpu":
+            return None
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -17,6 +17,7 @@ from pytorch_mnist_ddp_tpu.parallel.ddp import (
 from pytorch_mnist_ddp_tpu.parallel.fused import (
     device_put_dataset,
     make_fused_eval,
+    make_fused_run,
     make_fused_train_epoch,
 )
 from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
@@ -131,6 +132,42 @@ def test_fused_tiny_dataset_large_batch(devices):
         jnp.float32(1.0),
     )
     assert np.isfinite(np.asarray(losses)).all()
+
+
+def test_fused_run_matches_per_epoch_fusion(devices):
+    """Whole-run fusion (make_fused_run) must reproduce the per-epoch fused
+    loop exactly: same per-step losses, same eval totals, same final params."""
+    mesh = make_mesh()
+    tr_images, tr_labels = _dataset(96, seed=11)
+    te_images, te_labels = _dataset(40, seed=12)
+    tx, ty = device_put_dataset(tr_images, tr_labels, mesh)
+    ex, ey = device_put_dataset(te_images, te_labels, mesh)
+    epochs, gb, eb = 3, 32, 16
+    shuffle_key, dropout_key = jax.random.PRNGKey(5), jax.random.PRNGKey(6)
+    lrs = jnp.asarray([1.0 * 0.7 ** (e - 1) for e in range(1, epochs + 1)], jnp.float32)
+
+    run_fn, num_batches = make_fused_run(mesh, 96, 40, gb, eb, epochs)
+    sr = replicate_params(make_train_state(init_params(jax.random.PRNGKey(0))), mesh)
+    sr, run_losses, run_evals = run_fn(sr, tx, ty, ex, ey, shuffle_key, dropout_key, lrs)
+    assert run_losses.shape == (epochs, num_batches, 8)
+    assert run_evals.shape == (epochs, 2)
+
+    epoch_fn, _ = make_fused_train_epoch(mesh, 96, gb)
+    eval_fn = make_fused_eval(mesh, 40, eb)
+    se = replicate_params(make_train_state(init_params(jax.random.PRNGKey(0))), mesh)
+    for epoch in range(1, epochs + 1):
+        se, losses = epoch_fn(
+            se, tx, ty, jnp.int32(epoch), shuffle_key, dropout_key, lrs[epoch - 1]
+        )
+        totals = eval_fn(se.params, ex, ey)
+        np.testing.assert_allclose(
+            np.asarray(run_losses[epoch - 1]), np.asarray(losses), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(run_evals[epoch - 1]), np.asarray(totals), rtol=1e-5
+        )
+    for a, b in zip(jax.tree.leaves(sr.params), jax.tree.leaves(se.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
 
 
 def test_fused_masks_final_partial_batch(devices):
