@@ -11,8 +11,10 @@ TPU-first decisions (SURVEY.md §7 step 2):
 - **NHWC layout** (TPU-idiomatic; the reference is NCHW).  The flatten
   therefore orders features H*W*C instead of torch's C*H*W — behaviorally
   identical, but fc1's weight rows are permuted relative to a torch
-  checkpoint.  ``utils/checkpoint.py`` keeps our native layout;
-  cross-framework interchange would need that permutation.
+  checkpoint.  ``utils/torch_interop.py`` applies that permutation (plus
+  the conv/dense transposes) whenever checkpoints cross the torch
+  boundary, which ``utils/checkpoint.py`` does by default when torch is
+  importable.
 - **PyTorch-parity init**: torch's Conv2d/Linear reset is kaiming-uniform
   with a=sqrt(5), which reduces to U(-1/sqrt(fan_in), +1/sqrt(fan_in)) for
   both weight and bias.  Flax's default (lecun-normal, zero bias) differs,

@@ -10,10 +10,13 @@ two quirks preserved here because they are part of the observable surface:
   underscore, mnist_ddp.py:197) while distributed and ``mnist.py`` write
   ``mnist_cnn.pt``.
 
-Format: a ``numpy.savez`` archive of flat ``name -> array`` entries
-(``conv1.weight``-style dotted keys).  Unlike the reference, a load path is
-provided (the reference has no ``torch.load`` anywhere; SURVEY.md §5
-'Checkpoint / resume').
+Format: when the host has torch (CPU build), saves are genuine
+``torch.save`` state-dict files — ``torch.load``-able by the reference's
+downstream consumers, tensor layouts converted by utils/torch_interop.py —
+and otherwise a ``numpy.savez`` archive of flat ``name -> array`` entries
+(``conv1.weight``-style dotted keys).  ``load_state_dict`` sniffs either
+format.  Unlike the reference, a load path is provided (the reference has
+no ``torch.load`` anywhere; SURVEY.md §5 'Checkpoint / resume').
 """
 
 from __future__ import annotations
@@ -55,20 +58,60 @@ def model_state_dict(params: Mapping[str, Any], ddp_prefix: bool = False) -> dic
     return flat
 
 
-def save_state_dict(state: Mapping[str, np.ndarray], path: str) -> None:
-    """Atomic write of a flat state dict (np.savez archive)."""
+def save_state_dict(
+    state: Mapping[str, np.ndarray], path: str, format: str = "auto"
+) -> None:
+    """Atomic write of a flat state dict.
+
+    ``format``: ``"torch"`` = real ``torch.save`` file (reference-consumer
+    compatible), ``"npz"`` = native numpy archive, ``"auto"`` = torch when
+    importable else npz.
+    """
+    from .torch_interop import have_torch, save_torch_checkpoint
+
     state = {k: np.asarray(jax.device_get(v)) for k, v in state.items()}
-    buf = io.BytesIO()
-    np.savez(buf, **state)
+    if format == "auto":
+        format = "torch" if have_torch() else "npz"
     tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(buf.getvalue())
+    if format == "torch":
+        save_torch_checkpoint(state, tmp)
+    elif format == "npz":
+        buf = io.BytesIO()
+        np.savez(buf, **state)
+        with open(tmp, "wb") as f:
+            f.write(buf.getvalue())
+    else:
+        raise ValueError(f"unknown checkpoint format {format!r}")
     os.replace(tmp, path)
 
 
+def _is_torch_zip(path: str) -> bool:
+    """Both formats are zip archives; torch's contains a ``data.pkl``
+    member (the pickled state-dict skeleton), npz does not."""
+    import zipfile
+
+    try:
+        with zipfile.ZipFile(path) as z:
+            return any(n.split("/")[-1] == "data.pkl" for n in z.namelist())
+    except zipfile.BadZipFile:
+        return False
+
+
 def load_state_dict(path: str) -> dict[str, np.ndarray]:
-    with np.load(path) as archive:
-        return {k: archive[k] for k in archive.files}
+    """Read either checkpoint format back into OUR tensor layouts."""
+    from .torch_interop import have_torch, load_torch_checkpoint
+
+    if _is_torch_zip(path):
+        return load_torch_checkpoint(path)
+    try:
+        with np.load(path) as archive:
+            return {k: archive[k] for k in archive.files}
+    except Exception:
+        # Legacy (pre-zip) torch.save pickles are neither npz nor torch-zip;
+        # torch.load still reads them.
+        if have_torch():
+            return load_torch_checkpoint(path)
+        raise
 
 
 def params_from_state_dict(state: Mapping[str, np.ndarray]) -> dict[str, Any]:

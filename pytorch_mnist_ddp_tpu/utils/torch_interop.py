@@ -1,0 +1,135 @@
+"""PyTorch checkpoint interchange (completes SURVEY.md N13 / §3.5 parity).
+
+The reference saves ``torch.save(model.state_dict(), "mnist_cnn.pt")``
+(reference mnist_ddp.py:195, mnist.py:133) — a zip-of-pickle archive that
+``torch.load`` reads.  A user migrating from the reference owns such files,
+and code downstream of the reference expects to ``torch.load`` ours.  This
+module makes both directions work, converting between our TPU-native layout
+and torch's:
+
+- conv kernels: Flax HWIO ``[kh, kw, in, out]`` <-> torch OIHW
+  ``[out, in, kh, kw]``
+- dense kernels: Flax ``[in, out]`` <-> torch ``[out, in]``
+- **fc1 flatten-order permutation**: our model flattens NHWC activations
+  (``[N,12,12,64]`` -> feature ``h*768 + w*64 + c``) while the reference
+  flattens NCHW (feature ``c*144 + h*12 + w``; reference mnist_ddp.py:57).
+  fc1's 9216 input features are therefore permuted between the two, and a
+  checkpoint is only interchangeable if its fc1 weight columns are
+  re-ordered to the consumer's convention (SURVEY.md §7 step 2).
+
+Serialization uses ``torch`` (CPU build) when importable; the framework
+itself never requires torch — ``have_torch()`` gates every entry point and
+callers fall back to the native npz format (utils/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+# Post-pool activation geometry of the reference CNN: 12x12 spatial, 64
+# channels, 9216 flattened features (reference mnist_ddp.py:46,57).
+_POOL_H = _POOL_W = 12
+_POOL_C = 64
+_FLAT = _POOL_H * _POOL_W * _POOL_C
+
+
+def have_torch() -> bool:
+    try:
+        import torch  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def _nchw_to_nhwc_feature_perm() -> np.ndarray:
+    """``perm[nchw_feature]`` = the NHWC flat index of the same (c, h, w)
+    activation: maps a torch flatten position to ours."""
+    nhwc = np.arange(_FLAT).reshape(_POOL_H, _POOL_W, _POOL_C)
+    return nhwc.transpose(2, 0, 1).reshape(-1)  # index by (c, h, w)
+
+
+def _split_prefix(key: str) -> tuple[str, str]:
+    if key.startswith("module."):
+        return "module.", key[len("module.") :]
+    return "", key
+
+
+def state_dict_to_torch_layout(
+    state: Mapping[str, np.ndarray],
+) -> dict[str, np.ndarray]:
+    """Convert a flat state dict (torch-style dotted keys, OUR tensor
+    layouts — the output of utils/checkpoint.model_state_dict) into torch
+    tensor layouts, fc1 permutation included."""
+    perm = _nchw_to_nhwc_feature_perm()
+    out: dict[str, np.ndarray] = {}
+    for key, value in state.items():
+        _, bare = _split_prefix(key)
+        v = np.asarray(value)
+        if bare.endswith(".weight") and v.ndim == 4:  # conv HWIO -> OIHW
+            v = v.transpose(3, 2, 0, 1)
+        elif bare.endswith(".weight") and v.ndim == 2:  # dense -> [out, in]
+            v = v.T
+            if bare == "fc1.weight":
+                v = v[:, perm]  # columns now indexed by NCHW feature order
+        out[key] = np.ascontiguousarray(v)
+    return out
+
+
+def state_dict_from_torch_layout(
+    state: Mapping[str, np.ndarray],
+) -> dict[str, np.ndarray]:
+    """Inverse of :func:`state_dict_to_torch_layout`: torch tensor layouts
+    -> ours (HWIO convs, ``[in, out]`` dense, NHWC-ordered fc1 rows)."""
+    perm = _nchw_to_nhwc_feature_perm()
+    inv = np.argsort(perm)
+    out: dict[str, np.ndarray] = {}
+    for key, value in state.items():
+        _, bare = _split_prefix(key)
+        v = np.asarray(value)
+        if bare.endswith(".weight") and v.ndim == 4:  # conv OIHW -> HWIO
+            v = v.transpose(2, 3, 1, 0)
+        elif bare.endswith(".weight") and v.ndim == 2:
+            if bare == "fc1.weight":
+                v = v[:, inv]
+            v = v.T
+        out[key] = np.ascontiguousarray(v)
+    return out
+
+
+def save_torch_checkpoint(state: Mapping[str, np.ndarray], path: str) -> None:
+    """Write ``state`` (OUR layouts, flat dotted keys, optional ``module.``
+    prefix) as a genuine ``torch.save`` state-dict file — byte-level
+    compatible with what the reference's consumers ``torch.load``."""
+    import collections
+
+    import torch
+
+    converted = state_dict_to_torch_layout(state)
+    sd = collections.OrderedDict(
+        (k, torch.from_numpy(np.asarray(v).copy())) for k, v in converted.items()
+    )
+    torch.save(sd, path)
+
+
+def load_torch_checkpoint(path: str) -> dict[str, np.ndarray]:
+    """Read a ``torch.save``d state dict (e.g. the reference's
+    ``mnist_cnn.pt``) and return a flat dict in OUR layouts.  The
+    reference's distributed-mode ``module.`` key prefix (mnist_ddp.py:195)
+    is preserved in the keys; utils/checkpoint.params_from_state_dict
+    strips it."""
+    import torch
+
+    raw = torch.load(path, map_location="cpu", weights_only=True)
+    flat = {k: v.detach().numpy() for k, v in raw.items()}
+    return state_dict_from_torch_layout(flat)
+
+
+def params_from_torch_checkpoint(path: str) -> dict[str, Any]:
+    """One-call import: reference ``.pt`` file -> Flax param tree ready for
+    ``Net().apply`` / trainer state."""
+    from .checkpoint import params_from_state_dict
+
+    return params_from_state_dict(load_torch_checkpoint(path))

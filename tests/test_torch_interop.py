@@ -1,0 +1,109 @@
+"""PyTorch checkpoint interchange tests (SURVEY.md N13, §3.5, §7 step 2).
+
+The decisive test builds the reference architecture in torch (CPU build is
+in the image), loads OUR exported checkpoint into it, and compares forward
+log-probabilities against our Flax model on the same inputs — which proves
+the conv HWIO<->OIHW transposes, the dense transposes, and the fc1
+NHWC<->NCHW flatten-order permutation all compose correctly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.models.net import Net, init_params
+from pytorch_mnist_ddp_tpu.utils.checkpoint import (
+    model_state_dict,
+    params_from_state_dict,
+)
+from pytorch_mnist_ddp_tpu.utils import torch_interop as ti
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+
+class TorchNet(nn.Module):
+    """The reference CNN rebuilt in torch for parity testing (architecture
+    per SURVEY.md §2a #3: conv(1->32,3) -> relu -> conv(32->64,3) -> relu ->
+    maxpool(2) -> dropout -> flatten -> fc(9216->128) -> relu -> dropout ->
+    fc(128->10) -> log_softmax; reference mnist.py:11-34)."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 32, 3, 1)
+        self.conv2 = nn.Conv2d(32, 64, 3, 1)
+        self.fc1 = nn.Linear(9216, 128)
+        self.fc2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        x = F.relu(self.conv1(x))
+        x = F.relu(self.conv2(x))
+        x = F.max_pool2d(x, 2)
+        x = torch.flatten(x, 1)
+        x = F.relu(self.fc1(x))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def _random_batch(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, 28, 28, 1)).astype(np.float32)
+
+
+def test_layout_roundtrip():
+    params = init_params(jax.random.PRNGKey(0))
+    sd = model_state_dict(params)
+    back = ti.state_dict_from_torch_layout(ti.state_dict_to_torch_layout(sd))
+    assert set(back) == set(sd)
+    for k in sd:
+        np.testing.assert_array_equal(back[k], np.asarray(sd[k]))
+
+
+def test_torch_file_roundtrip(tmp_path):
+    params = init_params(jax.random.PRNGKey(1))
+    sd = model_state_dict(params, ddp_prefix=True)
+    path = str(tmp_path / "mnist_cnn.pt")
+    ti.save_torch_checkpoint(sd, path)
+    # The file is a genuine torch checkpoint with the module. prefix quirk.
+    raw = torch.load(path, map_location="cpu", weights_only=True)
+    assert all(k.startswith("module.") for k in raw)
+    tree = ti.params_from_torch_checkpoint(path)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(tree)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_forward_parity_jax_to_torch(tmp_path):
+    """Our exported .pt, loaded by a torch consumer, computes the same
+    function."""
+    params = init_params(jax.random.PRNGKey(2))
+    path = str(tmp_path / "mnist_cnn.pt")
+    ti.save_torch_checkpoint(model_state_dict(params), path)
+
+    tnet = TorchNet()
+    tnet.load_state_dict(torch.load(path, map_location="cpu", weights_only=True))
+    tnet.eval()
+
+    x_nhwc = _random_batch()
+    ours = np.asarray(Net().apply({"params": params}, jnp.asarray(x_nhwc)))
+    with torch.no_grad():
+        theirs = tnet(torch.from_numpy(x_nhwc.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-5)
+
+
+def test_forward_parity_torch_to_jax(tmp_path):
+    """A reference user's torch-initialized checkpoint imports into our
+    model and computes the same function."""
+    torch.manual_seed(7)
+    tnet = TorchNet()
+    tnet.eval()
+    path = str(tmp_path / "ref_ckpt.pt")
+    torch.save(tnet.state_dict(), path)
+
+    params = params_from_state_dict(ti.load_torch_checkpoint(path))
+    x_nhwc = _random_batch(seed=3)
+    ours = np.asarray(Net().apply({"params": params}, jnp.asarray(x_nhwc)))
+    with torch.no_grad():
+        theirs = tnet(torch.from_numpy(x_nhwc.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-5)
