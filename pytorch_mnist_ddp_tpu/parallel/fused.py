@@ -259,6 +259,7 @@ def make_fused_run(
     eps: float = 1e-6,
     dropout: bool = True,
     use_pallas: bool | None = None,
+    from_key: bool = False,
 ):
     """Whole-run fusion: EVERY epoch's training scan plus its full-test-set
     eval as ONE jitted device call.
@@ -274,7 +275,15 @@ def make_fused_run(
     the per-epoch learning-rate array (host-computed StepLR values, so the
     schedule is bit-identical to the per-epoch paths) and ``evals`` rows
     are the psum'd ``[loss_sum, correct]`` test totals after each epoch.
+
+    ``from_key=True`` replaces ``run_fn``'s leading ``state`` argument with
+    an ``init_key``: parameter init (models/net.py semantics, same RNG
+    stream) and the Adadelta zero-state happen INSIDE the compiled program,
+    so a cold process reaches the hot loop with one device dispatch total —
+    no separate init program to compile/load, no parameter upload.
     """
+    from ..ops.adadelta import adadelta_init
+
     model = Net(compute_dtype=compute_dtype)
     n_shards = mesh.shape[DATA_AXIS]
     local_epoch, num_batches = _local_epoch_builder(
@@ -286,6 +295,15 @@ def make_fused_run(
     )
 
     def local_run(state, tr_x, tr_y, te_x, te_y, shuffle_key, dropout_key, lrs):
+        if from_key:
+            # ``state`` is the init PRNG key; same stream as
+            # models/net.py:init_params, so both entries are bit-identical.
+            params = model.init(
+                {"params": state}, jnp.zeros((1, 28, 28, 1), jnp.float32),
+                train=False,
+            )["params"]
+            state = TrainState(params, adadelta_init(params), jnp.int32(0))
+
         def one_epoch(state, epoch_and_lr):
             epoch, lr = epoch_and_lr
             state, losses = local_epoch(
@@ -306,4 +324,5 @@ def make_fused_run(
         out_specs=(P(), P(None, None, DATA_AXIS), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,)), num_batches
+    donate = () if from_key else (0,)
+    return jax.jit(sharded, donate_argnums=donate), num_batches

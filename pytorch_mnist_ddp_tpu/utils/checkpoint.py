@@ -102,6 +102,12 @@ def load_state_dict(path: str) -> dict[str, np.ndarray]:
     from .torch_interop import have_torch, load_torch_checkpoint
 
     if _is_torch_zip(path):
+        if not have_torch():
+            raise RuntimeError(
+                f"{path} is a torch-format checkpoint but torch is not "
+                "importable on this host; re-save it with "
+                "save_state_dict(..., format='npz') where torch is available"
+            )
         return load_torch_checkpoint(path)
     try:
         with np.load(path) as archive:

@@ -205,3 +205,32 @@ def test_fused_masks_final_partial_batch(devices):
     np.testing.assert_allclose(np.asarray(fused_losses[:, 0]), loop_losses, rtol=1e-4)
     for a, b in zip(jax.tree.leaves(sf.params), jax.tree.leaves(sp.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-5)
+
+
+def test_fused_run_from_key_matches_external_init(devices):
+    """from_key=True (param init inside the compiled run) must be
+    bit-identical to initializing via init_params and passing the state."""
+    mesh = make_mesh()
+    tr_images, tr_labels = _dataset(64, seed=21)
+    te_images, te_labels = _dataset(32, seed=22)
+    tx, ty = device_put_dataset(tr_images, tr_labels, mesh)
+    ex, ey = device_put_dataset(te_images, te_labels, mesh)
+    epochs, gb, eb = 2, 32, 16
+    init_key = jax.random.PRNGKey(0)
+    shuffle_key, dropout_key = jax.random.PRNGKey(5), jax.random.PRNGKey(6)
+    lrs = jnp.asarray([1.0, 0.7], jnp.float32)
+
+    run_a, _ = make_fused_run(mesh, 64, 32, gb, eb, epochs)
+    sa = replicate_params(make_train_state(init_params(init_key)), mesh)
+    sa, losses_a, evals_a = run_a(sa, tx, ty, ex, ey, shuffle_key, dropout_key, lrs)
+
+    run_b, _ = make_fused_run(mesh, 64, 32, gb, eb, epochs, from_key=True)
+    sb, losses_b, evals_b = run_b(
+        init_key, tx, ty, ex, ey, shuffle_key, dropout_key, lrs
+    )
+
+    np.testing.assert_array_equal(np.asarray(losses_a), np.asarray(losses_b))
+    np.testing.assert_array_equal(np.asarray(evals_a), np.asarray(evals_b))
+    for a, b in zip(jax.tree.leaves(sa.params), jax.tree.leaves(sb.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(sb.step) == int(sa.step)
