@@ -44,6 +44,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "optimizer update (ops/pallas_adadelta.py)")
     p.add_argument("--data-root", type=str, default="./data",
                    help="MNIST IDX directory")
+    p.add_argument("--profile", type=str, default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the run into DIR "
+                        "(view with TensorBoard/XProf)")
+    p.add_argument("--step-stats", action="store_true", default=False,
+                   help="print per-epoch host-side step latency summaries "
+                        "(per-batch path only)")
     return p
 
 

@@ -42,6 +42,7 @@ def train_one_epoch(
     log_interval: int = 10,
     dry_run: bool = False,
     per_rank_batch: int | None = None,
+    step_stats=None,
 ) -> TrainState:
     """One training epoch (reference train(), mnist_ddp.py:65-86).
 
@@ -55,8 +56,12 @@ def train_one_epoch(
     num_batches = len(loader)
     if per_rank_batch is None:
         per_rank_batch = loader.global_batch // max(dist.world_size, 1)
+    if step_stats is not None:
+        step_stats.start()
     for batch_idx, (x, y, w) in enumerate(loader.epoch(epoch)):
         state, losses = step_fn(state, x, y, w, dropout_key, lr_arr)
+        if step_stats is not None:
+            step_stats.mark(losses)
         if dist.is_chief and batch_idx % log_interval == 0:
             samples = dist.world_size * batch_idx * per_rank_batch
             if not dist.distributed:
@@ -101,7 +106,18 @@ def evaluate(
 
 def fit(args, dist: DistState, save_path: str | None = None) -> TrainState:
     """Full training run: data, model, optimizer, epoch loop, final save —
-    the body of the reference's main() (mnist_ddp.py:108-197)."""
+    the body of the reference's main() (mnist_ddp.py:108-197).
+
+    Opt-in observability beyond the reference (SURVEY.md §5): ``--profile
+    DIR`` wraps the run in a ``jax.profiler`` trace; ``--step-stats``
+    prints per-epoch host-side step-latency summaries (per-batch path)."""
+    from .utils.profiling import trace
+
+    with trace(getattr(args, "profile", None)):
+        return _fit_body(args, dist, save_path)
+
+
+def _fit_body(args, dist: DistState, save_path: str | None) -> TrainState:
     if dist.distributed:
         # Multi-host: the mesh spans every device in the world (JAX's global
         # view); single-host: the (possibly --nproc_per_node-capped) locals.
@@ -195,9 +211,13 @@ def fit(args, dist: DistState, save_path: str | None = None) -> TrainState:
             # even when the sampler pads ranks to equal length (multi-host).
             mask_padding=True,
         )
+        from .utils.profiling import StepStats
+
         step_fn = make_train_step(mesh, use_pallas=use_pallas)
         eval_fn = make_eval_step(mesh)
+        want_stats = bool(getattr(args, "step_stats", False))
         for epoch in range(1, args.epochs + 1):
+            stats = StepStats() if want_stats else None
             state = train_one_epoch(
                 step_fn,
                 state,
@@ -209,7 +229,10 @@ def fit(args, dist: DistState, save_path: str | None = None) -> TrainState:
                 log_interval=args.log_interval,
                 dry_run=args.dry_run,
                 per_rank_batch=args.batch_size,
+                step_stats=stats,
             )
+            if stats is not None and dist.is_chief:
+                print(stats.summary_line(epoch))
             evaluate(eval_fn, state.params, test_loader, dist)
             # scheduler.step() is implicit: lr_fn(epoch+1) next iteration.
 

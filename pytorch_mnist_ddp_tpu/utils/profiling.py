@@ -1,0 +1,82 @@
+"""Opt-in profiling hooks (SURVEY.md §5 'Tracing / profiling').
+
+The reference's only timing instrument is the whole-run wall clock
+(reference mnist_ddp.py:200-203).  This module adds what it lacks, without
+changing any default output:
+
+- ``trace(logdir)``: context manager around ``jax.profiler`` capture —
+  produces a TensorBoard/XProf trace of the XLA ops, host callbacks, and
+  transfer activity for the wrapped region.  No-op when ``logdir`` is
+  falsy, so call sites can pass the CLI flag straight through.
+- ``StepStats``: per-step host-side latency aggregator for the per-batch
+  training path; prints a one-line summary (count / mean / p50 / p95 /
+  steps-per-sec) per epoch.  The fused path has no per-step host boundary
+  — there, whole-epoch device time is the only meaningful number and the
+  wall clock already covers it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def trace(logdir: str | None):
+    """``jax.profiler.trace`` when ``logdir`` is set; no-op otherwise."""
+    if not logdir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield
+
+
+class StepStats:
+    """True per-step latency stats for one epoch of the per-batch training
+    loop.
+
+    ``mark(result)`` blocks on the step's output before timestamping, so
+    each interval is real device+host step time rather than the async
+    dispatch gap — the cost is one device sync per step, which perturbs
+    pipelining; that is the accepted trade for an opt-in diagnostic.  Call
+    ``start()`` before the loop so the first step is counted."""
+
+    def __init__(self) -> None:
+        self._times: list[float] = []
+        self._last: float | None = None
+
+    def start(self) -> None:
+        self._last = time.perf_counter()
+
+    def mark(self, result=None) -> None:
+        """Call once per step with the step's output array(s)."""
+        if result is not None:
+            import jax
+
+            jax.block_until_ready(result)
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+        self._last = now
+
+    def _percentile(self, q: float) -> float:
+        xs = sorted(self._times)
+        if not xs:
+            return 0.0
+        idx = min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))
+        return xs[idx]
+
+    def summary_line(self, epoch: int) -> str:
+        n = len(self._times)
+        if not n:
+            return f"Step stats epoch {epoch}: no steps recorded"
+        total = sum(self._times)
+        return (
+            f"Step stats epoch {epoch}: {n} steps, "
+            f"mean {1e3 * total / n:.2f} ms, "
+            f"p50 {1e3 * self._percentile(0.5):.2f} ms, "
+            f"p95 {1e3 * self._percentile(0.95):.2f} ms, "
+            f"{n / total:.1f} steps/s"
+        )

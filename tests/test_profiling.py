@@ -1,0 +1,81 @@
+"""Profiling-hook tests (utils/profiling.py; SURVEY.md §5 'Tracing /
+profiling'): the trace context manager produces an XProf capture, StepStats
+aggregates sanely, and the CLI flags thread through fit()."""
+
+import glob
+import os
+
+import numpy as np
+
+import jax
+
+from pytorch_mnist_ddp_tpu.utils.profiling import StepStats, trace
+
+
+def test_trace_noop_without_logdir():
+    with trace(None):
+        pass
+    with trace(""):
+        pass
+
+
+def test_trace_writes_capture(tmp_path):
+    logdir = str(tmp_path / "prof")
+    with trace(logdir):
+        jax.block_until_ready(jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8)))
+    # XProf layout: <logdir>/plugins/profile/<run>/<host>.xplane.pb
+    assert glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+
+
+def test_step_stats_summary():
+    s = StepStats()
+    assert "no steps" in s.summary_line(1)
+    s.start()
+    for _ in range(10):
+        s.mark()
+    line = s.summary_line(3)
+    assert line.startswith("Step stats epoch 3: 10 steps")
+    assert "p50" in line and "p95" in line and "steps/s" in line
+
+
+def test_step_stats_counts_single_step():
+    """A one-batch epoch (e.g. --dry-run) must record its single step."""
+    s = StepStats()
+    s.start()
+    s.mark(jax.numpy.ones((2,)))
+    assert s.summary_line(1).startswith("Step stats epoch 1: 1 steps")
+
+
+def test_fit_with_profile_and_step_stats(tmp_path, capsys):
+    """--profile + --step-stats through the real per-batch fit() path."""
+    from argparse import Namespace
+
+    from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
+    from pytorch_mnist_ddp_tpu.trainer import fit
+
+    rng = np.random.RandomState(0)
+    import pytorch_mnist_ddp_tpu.data.mnist as M
+
+    orig = M.load_mnist_arrays
+
+    def tiny(root="./data", split="train", *a, **kw):
+        n = 64 if split == "train" else 32
+        return (
+            rng.randint(0, 256, (n, 28, 28), np.uint8).copy(),
+            rng.randint(0, 10, n).astype(np.uint8),
+        )
+
+    M.load_mnist_arrays = tiny
+    try:
+        logdir = str(tmp_path / "prof")
+        args = Namespace(
+            batch_size=16, test_batch_size=16, epochs=1, lr=1.0, gamma=0.7,
+            seed=1, log_interval=2, dry_run=False, save_model=False,
+            fused=False, data_root="./data", profile=logdir, step_stats=True,
+        )
+        fit(args, DistState(devices=jax.devices()[:1]))
+    finally:
+        M.load_mnist_arrays = orig
+    out = capsys.readouterr().out
+    assert any(l.startswith("Step stats epoch 1:") for l in out.splitlines())
+    assert glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
