@@ -51,9 +51,23 @@ def make_train_state(params: Any) -> TrainState:
 
 def replicate_params(tree: Any, mesh: Mesh) -> Any:
     """Place a pytree fully-replicated on the mesh.  Together with same-key
-    init (models/net.py:init_params) this replaces DDP's rank-0 broadcast."""
+    init (models/net.py:init_params) this replaces DDP's rank-0 broadcast.
+
+    Multi-controller worlds can't ``device_put`` onto non-addressable
+    devices; there, every process contributes its (identical, same-PRNG)
+    local copy via ``make_array_from_process_local_data`` — replica
+    consistency by construction, no broadcast traffic at all."""
+    import numpy as np
+
     sharding = NamedSharding(mesh, P())
-    return jax.device_put(tree, sharding)
+    if all(d.process_index == jax.process_index() for d in mesh.devices.flat):
+        return jax.device_put(tree, sharding)
+    return jax.tree.map(
+        lambda v: jax.make_array_from_process_local_data(
+            sharding, np.asarray(v)
+        ),
+        tree,
+    )
 
 
 def make_train_step(

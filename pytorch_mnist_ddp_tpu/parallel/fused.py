@@ -52,18 +52,15 @@ def _normalize_dev(x_u8: jax.Array, compute_dtype) -> jax.Array:
 
 def device_put_dataset(images, labels, mesh: Mesh):
     """Stage the raw uint8 dataset replicated in HBM (one transfer per
-    run, amortized over every epoch)."""
+    run, amortized over every epoch).  Replication itself — including the
+    multi-controller path — lives in ddp.replicate_params."""
     import numpy as np
 
-    sharding = NamedSharding(mesh, P())
-    # make_array_from_process_local_data handles both single- and
-    # multi-host replication (device_put can't target non-addressable
-    # devices in a multi-controller world).
-    x = jax.make_array_from_process_local_data(sharding, np.asarray(images))
-    y = jax.make_array_from_process_local_data(
-        sharding, np.asarray(labels, dtype=np.int32)
+    from .ddp import replicate_params
+
+    return replicate_params(
+        (np.asarray(images), np.asarray(labels, dtype=np.int32)), mesh
     )
-    return x, y
 
 
 def _local_epoch_builder(
@@ -315,13 +312,18 @@ def make_fused_run(
         state, (losses, evals) = jax.lax.scan(
             one_epoch, state, (jnp.arange(1, epochs + 1), lrs)
         )
-        return state, losses[..., None], evals
+        # all_gather the per-shard loss traces so the output is fully
+        # replicated: every process can then read them with a plain local
+        # np.asarray — no chief-only gather program, which would diverge
+        # the collective schedule in a multi-controller world.
+        gathered = jax.lax.all_gather(losses, DATA_AXIS)  # [shards, E, B]
+        return state, jnp.moveaxis(gathered, 0, -1), evals
 
     sharded = jax.shard_map(
         local_run,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
-        out_specs=(P(), P(None, None, DATA_AXIS), P()),
+        out_specs=(P(), P(), P()),
         check_vma=False,
     )
     donate = () if from_key else (0,)

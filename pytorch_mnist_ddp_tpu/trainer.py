@@ -66,6 +66,12 @@ def train_one_epoch(
             samples = dist.world_size * batch_idx * per_rank_batch
             if not dist.distributed:
                 samples = batch_idx * per_rank_batch
+            # The chief's OWN first local replica — read from its local
+            # shard, never via `losses[0]`: indexing a globally-sharded
+            # array compiles a gather over the whole mesh, and a
+            # chief-only collective deadlocks/corrupts multi-process runs
+            # (every process must enqueue the same programs in order).
+            loss0 = float(np.asarray(losses.addressable_shards[0].data)[0])
             print(
                 train_log_line(
                     epoch,
@@ -73,7 +79,7 @@ def train_one_epoch(
                     loader.dataset_len,
                     batch_idx,
                     num_batches,
-                    float(losses[0]),
+                    loss0,
                 )
             )
         if dry_run:
@@ -94,7 +100,10 @@ def evaluate(
     loss_sum = 0.0
     correct = 0.0
     for x, y, w in loader.epoch(0):
-        totals = eval_fn(params, x, y, w)
+        # np.asarray on the fully-replicated psum output reads the local
+        # copy — no traced indexing, safe on every process of a
+        # multi-controller world.
+        totals = np.asarray(eval_fn(params, x, y, w))
         loss_sum += float(totals[0])
         correct += float(totals[1])
     n = loader.dataset_len
@@ -166,7 +175,9 @@ def _fit_body(args, dist: DistState, save_path: str | None) -> TrainState:
         if dist.is_chief:
             # One transfer for the whole run, then the reference's exact
             # interleaved output — train lines + test summary per epoch.
-            losses_host = np.asarray(losses[:, :, 0])
+            # (np.asarray reads replicated outputs locally; slicing happens
+            # on host so no chief-only device program is enqueued.)
+            losses_host = np.asarray(losses)[:, :, 0]
             evals_host = np.asarray(evals)
             for epoch in range(1, args.epochs + 1):
                 for batch_idx in range(0, num_batches, args.log_interval):

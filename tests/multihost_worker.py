@@ -1,0 +1,70 @@
+"""Subprocess body for the 2-process distributed test (test_multihost.py).
+
+Each worker is one "host" in a 2-process world: 4 virtual CPU devices
+locally, 8 globally.  World formation goes through the real entry path —
+``init_distributed_mode`` reading ``RANK``/``WORLD_SIZE``/``MASTER_ADDR``/
+``MASTER_PORT`` from the env and calling ``jax.distributed.initialize``
+(SURVEY.md N1) — then a full ``fit()`` runs, and the worker dumps its
+final params + eval totals for the parent to cross-check.
+
+Usage: python tests/multihost_worker.py <data_root> <out_npz> <fused|batch>
+"""
+
+import sys
+from argparse import Namespace
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    data_root, out_path, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+
+    from pytorch_mnist_ddp_tpu.parallel.distributed import init_distributed_mode
+    from pytorch_mnist_ddp_tpu.trainer import evaluate, fit
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import model_state_dict
+
+    dist = init_distributed_mode()
+    assert dist.distributed and dist.process_count == 2, dist
+    assert dist.world_size == 8, dist
+
+    args = Namespace(
+        batch_size=8, test_batch_size=16, epochs=2, lr=1.0, gamma=0.7,
+        seed=1, log_interval=4, dry_run=False, save_model=False,
+        fused=(mode == "fused"), data_root=data_root,
+    )
+    state = fit(args, dist)
+
+    # Re-run the distributed eval explicitly so EVERY process (not just the
+    # chief) holds the psum'd totals to report.
+    from pytorch_mnist_ddp_tpu.data.loader import DataLoader
+    from pytorch_mnist_ddp_tpu.data.mnist import MNIST
+    from pytorch_mnist_ddp_tpu.parallel.ddp import make_eval_step
+    from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(devices=jax.devices())
+    test_set = MNIST(root=data_root, train=False)
+    loader = DataLoader(
+        test_set.images, test_set.labels, 16, mesh=mesh, shuffle=False,
+        process_rank=dist.process_rank, process_count=dist.process_count,
+        mask_padding=True,
+    )
+    avg_loss, correct = evaluate(
+        make_eval_step(mesh), state.params, loader, dist
+    )
+
+    flat = model_state_dict(jax.device_get(state.params))
+    np.savez(
+        out_path,
+        avg_loss=np.float64(avg_loss),
+        correct=np.int64(correct),
+        **flat,
+    )
+    print(f"worker rank {dist.process_rank} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

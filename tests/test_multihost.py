@@ -1,0 +1,103 @@
+"""True multi-process distributed tests (SURVEY.md §2c 'Multi-node DP',
+§4 'Multi-node without a cluster').
+
+Two OS processes, four virtual CPU devices each, form an 8-device world
+via ``jax.distributed.initialize`` — the TPU-native counterpart of the
+reference's two-node NCCL rendezvous (reference mnist_ddp.py:20-22,35-37).
+The assertions are the DDP contract itself:
+
+- every process ends with bit-identical parameters (replica consistency —
+  what DDP's broadcast + allreduce guarantee);
+- every process computes the same global eval totals (psum correctness
+  across process boundaries);
+- the model learns (losses fall across the run).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from test_e2e import _write_idx
+
+_WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_world(tmp_path, mode: str) -> list[dict]:
+    root = _write_idx(tmp_path)
+    port = _free_port()
+    procs, outs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(
+            PYTHONPATH=os.path.dirname(os.path.dirname(_WORKER)),
+            RANK=str(rank),
+            WORLD_SIZE="2",
+            LOCAL_RANK="0",
+            MASTER_ADDR="127.0.0.1",
+            MASTER_PORT=str(port),
+            NPROC_PER_NODE="4",
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        )
+        out = str(tmp_path / f"rank{rank}.npz")
+        outs.append(out)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, _WORKER, root, out, mode],
+                env=env,
+                cwd=os.path.dirname(os.path.dirname(_WORKER)),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    logs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(stdout)
+    assert all(p.returncode == 0 for p in procs), "\n====\n".join(logs)
+    results = []
+    for out in outs:
+        with np.load(out) as z:
+            results.append({k: z[k] for k in z.files})
+    results.append(logs)
+    return results
+
+
+@pytest.mark.parametrize("mode", ["batch", "fused"])
+def test_two_process_world_replica_consistency(tmp_path, mode):
+    r0, r1, logs = _run_world(tmp_path, mode)
+    # Replica consistency: both processes hold bit-identical final params.
+    param_keys = [k for k in r0 if k not in ("avg_loss", "correct")]
+    assert len(param_keys) == 8
+    for k in param_keys:
+        np.testing.assert_array_equal(r0[k], r1[k], err_msg=k)
+    # psum correctness: identical global eval totals on every process.
+    assert r0["correct"] == r1["correct"]
+    np.testing.assert_allclose(r0["avg_loss"], r1["avg_loss"], rtol=1e-6)
+    assert 0 <= int(r0["correct"]) <= 256
+    # Learning: chief's logged train losses fall across the run.
+    chief_log = logs[0]
+    losses = [
+        float(line.rsplit("Loss:", 1)[1])
+        for line in chief_log.splitlines()
+        if line.startswith("Train Epoch")
+    ]
+    assert len(losses) >= 4
+    assert losses[-1] < losses[0]
