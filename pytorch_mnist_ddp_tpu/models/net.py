@@ -59,6 +59,13 @@ def _bias_init_like(fan_in: int) -> nn.initializers.Initializer:
     return init
 
 
+# Dropout rates of the reference architecture (reference mnist.py:17-18).
+# parallel/tp.py's raw-lax forward shares these so the TP and DP models
+# cannot drift apart silently.
+DROPOUT1_RATE = 0.25
+DROPOUT2_RATE = 0.5
+
+
 class Net(nn.Module):
     """2-conv MNIST CNN.  Input: ``[N, 28, 28, 1]`` float32/bfloat16.
     Output: ``[N, 10]`` float32 log-probabilities."""
@@ -79,14 +86,14 @@ class Net(nn.Module):
         )(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Dropout(0.25, deterministic=not train, name="dropout1")(x)
+        x = nn.Dropout(DROPOUT1_RATE, deterministic=not train, name="dropout1")(x)
         x = x.reshape(x.shape[0], -1)  # [N, 9216] (H*W*C ordering; see module docstring)
         x = nn.Dense(
             128, name="fc1", dtype=self.compute_dtype,
             kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(9216),
         )(x)
         x = nn.relu(x)
-        x = nn.Dropout(0.5, deterministic=not train, name="dropout2")(x)
+        x = nn.Dropout(DROPOUT2_RATE, deterministic=not train, name="dropout2")(x)
         x = nn.Dense(
             10, name="fc2", dtype=self.compute_dtype,
             kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(128),

@@ -1,0 +1,160 @@
+"""Tensor parallelism over the mesh's ``model`` axis (SURVEY.md §2c).
+
+The reference is data-parallel only, but its README points at DDP's
+model-parallel story (reference README.md:8) and SURVEY.md §2c directs the
+mesh design to "leave a ``model`` axis possible".  This module makes that
+axis REAL: a 2-D ``(data, model)`` train step where the classifier MLP is
+Megatron-style tensor-parallel —
+
+- **fc1 column-parallel**: kernel ``[9216, 128]`` split over ``model`` →
+  each shard computes its 128/M output features locally; relu and dropout
+  are feature-elementwise, so no communication.
+- **fc2 row-parallel**: kernel ``[128, 10]`` split along its input dim →
+  each shard holds a partial logit sum; ONE ``psum`` over ``model``
+  completes the logits (the only TP collective in the forward).
+- convs stay replicated (they are 0.03% of the params; sharding them would
+  trade one broadcast for no win at this scale).
+
+Gradients reverse the same pattern under ``jax.grad`` automatically
+(``psum`` transposes to identity on the partial-sum path, and the sharded
+params' grads stay sharded), then data-parallel ``pmean`` over ``data``
+runs per-shard — gradient traffic is 1/M of pure DP for the sharded
+layers.  The Adadelta update runs on local shards (elementwise, so sharded
+state is exact).
+
+Forward math, init, loss, and update are the same functions the DP path
+uses (models/net.py semantics; ops/adadelta.py) — parity is pinned by
+tests/test_tp.py against the single-device step.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.net import DROPOUT1_RATE, DROPOUT2_RATE
+from ..ops.adadelta import AdadeltaState, adadelta_update
+from ..ops.loss import nll_loss
+from .ddp import TrainState
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+
+def param_specs() -> dict:
+    """PartitionSpecs for the Net param tree under (data, model) sharding:
+    convs replicated, fc1 column-parallel, fc2 row-parallel."""
+    return {
+        "conv1": {"kernel": P(), "bias": P()},
+        "conv2": {"kernel": P(), "bias": P()},
+        "fc1": {"kernel": P(None, MODEL_AXIS), "bias": P(MODEL_AXIS)},
+        "fc2": {"kernel": P(MODEL_AXIS, None), "bias": P()},
+    }
+
+
+def state_specs() -> Any:
+    """Specs for the full TrainState (params + both Adadelta accumulators +
+    step counter): accumulators shard exactly like their params."""
+    ps = param_specs()
+    return TrainState(
+        params=ps, opt=AdadeltaState(square_avg=ps, acc_delta=ps), step=P()
+    )
+
+
+def shard_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place a (host/replicated) TrainState onto the 2-D mesh with TP
+    shardings.  Single-controller only (tests/dryrun); multi-controller TP
+    placement would mirror ddp.replicate_params's local-data path."""
+    return jax.tree.map(
+        lambda v, spec: jax.device_put(v, NamedSharding(mesh, spec)),
+        state,
+        state_specs(),
+    )
+
+
+def _tp_forward(params: dict, x: jax.Array, train: bool, key: jax.Array) -> jax.Array:
+    """The reference CNN forward (models/net.py architecture) written over
+    raw params so the dense layers can be local shards.  ``x`` is the
+    data-shard batch [n, 28, 28, 1]; fc1/fc2 params are model shards."""
+    dn = jax.lax.conv_dimension_numbers(x.shape, params["conv1"]["kernel"].shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+    x = jax.lax.conv_general_dilated(
+        x, params["conv1"]["kernel"], (1, 1), "VALID", dimension_numbers=dn
+    ) + params["conv1"]["bias"]
+    x = jax.nn.relu(x)
+    dn = jax.lax.conv_dimension_numbers(x.shape, params["conv2"]["kernel"].shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+    x = jax.lax.conv_general_dilated(
+        x, params["conv2"]["kernel"], (1, 1), "VALID", dimension_numbers=dn
+    ) + params["conv2"]["bias"]
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+    if train:
+        keep1 = 1.0 - DROPOUT1_RATE
+        k1 = jax.random.fold_in(key, 1)
+        x = x * jax.random.bernoulli(k1, keep1, x.shape) / keep1
+    x = x.reshape(x.shape[0], -1)  # [n, 9216] NHWC flatten order
+
+    # Column-parallel fc1: local [9216, 128/M] shard -> local features.
+    h = x @ params["fc1"]["kernel"] + params["fc1"]["bias"]
+    h = jax.nn.relu(h)
+    if train:
+        # Distinct dropout mask per model shard (its features are distinct).
+        keep2 = 1.0 - DROPOUT2_RATE
+        k2 = jax.random.fold_in(
+            jax.random.fold_in(key, 2), jax.lax.axis_index(MODEL_AXIS)
+        )
+        h = h * jax.random.bernoulli(k2, keep2, h.shape) / keep2
+    # Row-parallel fc2: partial logits, completed by one psum over model.
+    logits = h @ params["fc2"]["kernel"]
+    logits = jax.lax.psum(logits, MODEL_AXIS) + params["fc2"]["bias"]
+    return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+
+def make_tp_train_step(
+    mesh: Mesh,
+    rho: float = 0.9,
+    eps: float = 1e-6,
+    dropout: bool = True,
+):
+    """Build the jitted 2-D (data x model) train step.
+
+    ``step_fn(state, x, y, w, dropout_key, lr) -> (state, losses)`` with
+    ``state`` sharded per ``state_specs()`` (see ``shard_state``), ``x``
+    sharded over ``data``, and ``losses`` one local loss per data shard.
+    """
+    num_data = mesh.shape[DATA_AXIS]
+
+    def local_step(state: TrainState, x, y, w, dropout_key, lr):
+        key = jax.random.fold_in(dropout_key, state.step)
+        key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+
+        def loss_fn(params):
+            logp = _tp_forward(params, x, train=dropout, key=key)
+            return nll_loss(logp, y, w, reduction="mean")
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        # This shard_map runs with VMA tracking ON (check_vma default), so
+        # AD already psums each param's cotangent over every mesh axis the
+        # param is invariant on — the DP allreduce over ``data`` AND the
+        # model-axis reduction for replicated (conv) params come out of the
+        # transpose itself.  What arrives here is the SUM of per-shard
+        # local-mean grads; DDP semantics are the mean, so divide by the
+        # data-parallel degree.  (A manual pmean would re-sum the already-
+        # reduced value — 4x grads on a 4-way data axis.)
+        grads = jax.tree.map(lambda g: g / num_data, grads)
+        params, opt = adadelta_update(
+            state.params, grads, state.opt, lr, rho, eps
+        )
+        return TrainState(params, opt, state.step + 1), loss[None]
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_specs(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        out_specs=(state_specs(), P(DATA_AXIS)),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))

@@ -1,0 +1,98 @@
+"""Tensor-parallel step tests (parallel/tp.py; SURVEY.md §2c 'model axis').
+
+The decisive check: a (data=4, model=2) 2-D-sharded train step must
+reproduce the pure-DP step's math exactly (dropout off) — same losses,
+same params after several updates — proving the column/row-parallel
+decomposition, the logits psum, and the per-axis gradient reductions are
+the identity transformation they claim to be.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.models.net import init_params
+from pytorch_mnist_ddp_tpu.parallel.ddp import (
+    make_train_state,
+    make_train_step,
+    replicate_params,
+)
+from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+from pytorch_mnist_ddp_tpu.parallel.tp import make_tp_train_step, shard_state
+
+
+def _batch(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.standard_normal((n, 28, 28, 1)).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.int32)
+    w = np.ones(n, np.float32)
+    return jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)
+
+
+def test_tp_matches_dp_exactly(devices):
+    """3 steps of (4 data x 2 model) TP == 3 steps of 8-way pure DP ==
+    (by the existing parity suite) the single-device step."""
+    params = init_params(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(7)
+    lr = jnp.float32(1.0)
+
+    dp_mesh = make_mesh()  # 8 x 1
+    dp_step = make_train_step(dp_mesh, dropout=False)
+    dp_state = replicate_params(make_train_state(params), dp_mesh)
+
+    tp_mesh = make_mesh(num_data=4, num_model=2)
+    tp_step = make_tp_train_step(tp_mesh, dropout=False)
+    # Deep-copy: device_put's shard cache aliases replicated buffers across
+    # shardings, and dp_step's donation would delete the shared copies.
+    params_copy = jax.tree.map(jnp.array, params)
+    tp_state = shard_state(make_train_state(params_copy), tp_mesh)
+
+    for step in range(3):
+        x, y, w = _batch(seed=step)
+        dp_state, dp_losses = dp_step(dp_state, x, y, w, key, lr)
+        tp_state, tp_losses = tp_step(tp_state, x, y, w, key, lr)
+
+    # Mean loss over the global batch is identical (per-shard losses
+    # differ only in how the batch is split 8 vs 4 ways).
+    np.testing.assert_allclose(
+        float(jnp.mean(dp_losses)), float(jnp.mean(tp_losses)), rtol=1e-5
+    )
+    for (path_a, a), (path_b, b) in zip(
+        jax.tree_util.tree_flatten_with_path(dp_state.params)[0],
+        jax.tree_util.tree_flatten_with_path(tp_state.params)[0],
+    ):
+        assert path_a == path_b
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-6,
+            err_msg=str(path_a),
+        )
+    assert int(tp_state.step) == 3
+
+
+def test_tp_params_are_actually_sharded(devices):
+    """fc1/fc2 really live as shards on the model axis (not replicated)."""
+    tp_mesh = make_mesh(num_data=4, num_model=2)
+    state = shard_state(make_train_state(init_params(jax.random.PRNGKey(0))), tp_mesh)
+    fc1 = state.params["fc1"]["kernel"]
+    assert fc1.shape == (9216, 128)
+    # Each device holds half the columns.
+    shard_shapes = {s.data.shape for s in fc1.addressable_shards}
+    assert shard_shapes == {(9216, 64)}
+    fc2 = state.params["fc2"]["kernel"]
+    assert {s.data.shape for s in fc2.addressable_shards} == {(64, 10)}
+
+
+def test_tp_trains_with_dropout(devices):
+    """Dropout path runs and the loss falls over a few steps."""
+    tp_mesh = make_mesh(num_data=4, num_model=2)
+    tp_step = make_tp_train_step(tp_mesh, dropout=True)
+    state = shard_state(make_train_state(init_params(jax.random.PRNGKey(0))), tp_mesh)
+    key = jax.random.PRNGKey(3)
+    x, y, w = _batch(n=64, seed=1)
+    first = None
+    for _ in range(6):
+        state, losses = tp_step(state, x, y, w, key, jnp.float32(1.0))
+        if first is None:
+            first = float(jnp.mean(losses))
+    assert float(jnp.mean(losses)) < first
