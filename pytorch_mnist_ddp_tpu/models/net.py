@@ -102,6 +102,34 @@ class Net(nn.Module):
         return jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
 
 
+def raw_conv_stack(params: dict, x: jax.Array) -> jax.Array:
+    """The conv block of ``Net`` written over raw params: conv1 -> relu ->
+    conv2 -> relu -> maxpool.  ``[n, 28, 28, 1] -> [n, 12, 12, 64]``.
+
+    Shared by the tensor-parallel and pipeline-parallel steps
+    (parallel/tp.py, parallel/pp.py), whose param shards can't go through
+    ``nn.Module.apply`` — one definition so the raw and Flax forwards
+    cannot drift apart (their equality is pinned by the parity tests).
+    """
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, params["conv1"]["kernel"].shape, ("NHWC", "HWIO", "NHWC")
+    )
+    x = jax.lax.conv_general_dilated(
+        x, params["conv1"]["kernel"], (1, 1), "VALID", dimension_numbers=dn
+    ) + params["conv1"]["bias"]
+    x = jax.nn.relu(x)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, params["conv2"]["kernel"].shape, ("NHWC", "HWIO", "NHWC")
+    )
+    x = jax.lax.conv_general_dilated(
+        x, params["conv2"]["kernel"], (1, 1), "VALID", dimension_numbers=dn
+    ) + params["conv2"]["bias"]
+    x = jax.nn.relu(x)
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+
+
 def init_params(key: jax.Array, compute_dtype: jnp.dtype = jnp.float32):
     """Initialize params from one key.  Every data-parallel replica calls
     this with the SAME key, which replaces DDP's rank-0 parameter broadcast

@@ -1,0 +1,164 @@
+"""Pipeline parallelism over the mesh's second axis (SURVEY.md §2c).
+
+The reference has no pipeline parallelism (single ``Net.forward``); this
+module is the "beyond parity" counterpart of parallel/tp.py, demonstrating
+that the same reserved mesh axis also supports a GPipe-style **stage**
+decomposition of the reference CNN:
+
+- **stage 0**: conv1 -> relu -> conv2 -> relu -> maxpool -> flatten
+- **stage 1**: fc1 -> relu -> fc2 -> log_softmax -> weighted NLL
+
+The per-data-shard batch is split into ``num_micro`` microbatches; a
+``lax.scan`` over ``num_micro + 1`` ticks drives the pipeline, and each
+tick moves one activation block stage0 -> stage1 through a single
+``lax.ppermute`` hop (the ICI neighbor link).  Stage identity is the
+device's index on the stage axis, so both stages run the SAME SPMD program
+with a runtime ``lax.cond`` selecting their work — the idiomatic way to
+express heterogeneous stages under ``shard_map``.
+
+The backward pipeline is not hand-written: ``jax.grad`` transposes the
+scan + ppermute into the reverse schedule automatically, and VMA tracking
+(check_vma default) inserts the stage/data-axis gradient reductions for
+the replicated params, exactly as in parallel/tp.py.  Params are
+replicated over the stage axis (each stage reads only its half; at 1.2M
+params the duplication is noise — stage-sharding them is the TP module's
+job, composition is future work).
+
+Stage selection is arithmetic masking rather than ``lax.cond``: both
+stage bodies are traced on every device and the inactive one is masked
+out.  ``cond`` would skip the inactive stage's FLOPs, but transposing a
+``cond`` nested in this scan+ppermute aborts the XLA:CPU runtime (hard
+SIGABRT, jaxlib in this image), and the test mesh is CPU; at two
+heterogeneous stages of this size the redundancy is cheap, and a
+production pipeline of N homogeneous layers would stage-shard the params
+so the SPMD program needs no branch at all.
+
+Parity with the DP step is exact (dropout off) and pinned by
+tests/test_pp.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.net import raw_conv_stack
+from ..ops.adadelta import adadelta_update
+from ..ops.loss import nll_loss
+from .ddp import TrainState
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+STAGE_AXIS = MODEL_AXIS  # the reserved second mesh axis doubles as stages
+NUM_STAGES = 2
+_FLAT = 9216  # stage-boundary activation width (64 * 12 * 12)
+
+
+def _stage0(params: dict, x: jax.Array) -> jax.Array:
+    """convs + pool + flatten: [n, 28, 28, 1] -> [n, 9216]."""
+    x = raw_conv_stack(params, x)
+    return x.reshape(x.shape[0], -1)
+
+
+def _stage1_loss_sum(params: dict, act: jax.Array, y: jax.Array, w: jax.Array) -> jax.Array:
+    """dense head + weighted NLL SUM over the microbatch."""
+    h = jax.nn.relu(act @ params["fc1"]["kernel"] + params["fc1"]["bias"])
+    logits = h @ params["fc2"]["kernel"] + params["fc2"]["bias"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return nll_loss(logp, y, w, reduction="sum")
+
+
+def make_pp_train_step(
+    mesh: Mesh,
+    num_micro: int = 2,
+    rho: float = 0.9,
+    eps: float = 1e-6,
+):
+    """Build the jitted (data x stage) pipelined train step.
+
+    ``step_fn(state, x, y, w, lr) -> (state, losses)``: ``state``
+    replicated (P() everywhere), ``x/y/w`` sharded over ``data``,
+    ``losses`` one local mean loss per data shard.  The stage axis must
+    have size ``NUM_STAGES`` (2).  Dropout is not pipelined here — this
+    module demonstrates the schedule; use the DP/TP steps for training
+    with dropout.
+    """
+    if mesh.shape[STAGE_AXIS] != NUM_STAGES:
+        raise ValueError(
+            f"pipeline needs a {NUM_STAGES}-wide '{STAGE_AXIS}' axis, got "
+            f"{mesh.shape[STAGE_AXIS]}"
+        )
+    num_data = mesh.shape[DATA_AXIS]
+
+    def local_step(state: TrainState, x, y, w, lr):
+        n = x.shape[0]
+        if n % num_micro:
+            raise ValueError(f"shard batch {n} not divisible by {num_micro} microbatches")
+        mb = n // num_micro
+        stage = jax.lax.axis_index(STAGE_AXIS)
+
+        def loss_fn(params):
+            x_mbs = x.reshape(num_micro, mb, *x.shape[1:])
+            y_mbs = y.reshape(num_micro, mb)
+            w_mbs = w.reshape(num_micro, mb)
+
+            def tick(carry, t):
+                in_flight = carry  # activation block arriving at stage 1
+
+                # Stage 0 produces microbatch t (its last tick is idle;
+                # non-stage-0 devices produce a masked-out zero block).
+                t0 = jnp.clip(t, 0, num_micro - 1)
+                feed = jax.lax.dynamic_index_in_dim(x_mbs, t0, keepdims=False)
+                on0 = jnp.logical_and(stage == 0, t < num_micro)
+                out = jnp.where(on0, _stage0(params, feed), 0.0)
+
+                # Stage 1 consumes the block sent at tick t-1 (idle at
+                # t=0); masking the sample weights zeroes both the loss
+                # contribution and, through AD, the gradients of the idle
+                # evaluations.
+                t1 = jnp.clip(t - 1, 0, num_micro - 1)
+                y_mb = jax.lax.dynamic_index_in_dim(y_mbs, t1, keepdims=False)
+                w_mb = jax.lax.dynamic_index_in_dim(w_mbs, t1, keepdims=False)
+                on1 = jnp.logical_and(stage == 1, t >= 1)
+                part = _stage1_loss_sum(
+                    params, in_flight, y_mb, w_mb * on1.astype(w_mb.dtype)
+                )
+
+                # One hop down the pipe: stage0 -> stage1 (stage1's output
+                # wraps back but is never consumed).
+                moved = jax.lax.ppermute(
+                    out, STAGE_AXIS,
+                    [(i, (i + 1) % NUM_STAGES) for i in range(NUM_STAGES)],
+                )
+                return moved, part
+
+            # The carry must enter the scan with the same varying-manual-
+            # axes type ppermute's output has (varying over both axes).
+            zero = jax.lax.pcast(
+                jnp.zeros((mb, _FLAT), x.dtype),
+                (DATA_AXIS, STAGE_AXIS),
+                to="varying",
+            )
+            _, parts = jax.lax.scan(
+                tick, zero, jnp.arange(num_micro + NUM_STAGES - 1)
+            )
+            # Weighted-mean loss over the shard, computed on stage 1 and
+            # shared to every stage (psum of a stage-1-only value).
+            total = jax.lax.psum(parts.sum(), STAGE_AXIS)
+            return total / jnp.maximum(w.sum(), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        # VMA AD pre-reduces over both axes (params are fully replicated);
+        # divide the data-axis SUM of local means down to the DDP mean,
+        # exactly as in parallel/tp.py.
+        grads = jax.tree.map(lambda g: g / num_data, grads)
+        params, opt = adadelta_update(state.params, grads, state.opt, lr, rho, eps)
+        return TrainState(params, opt, state.step + 1), loss[None]
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(P(), P(DATA_AXIS)),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))

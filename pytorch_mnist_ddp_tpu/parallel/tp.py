@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.net import DROPOUT1_RATE, DROPOUT2_RATE
+from ..models.net import DROPOUT1_RATE, DROPOUT2_RATE, raw_conv_stack
 from ..ops.adadelta import AdadeltaState, adadelta_update
 from ..ops.loss import nll_loss
 from .ddp import TrainState
@@ -77,21 +77,7 @@ def _tp_forward(params: dict, x: jax.Array, train: bool, key: jax.Array) -> jax.
     """The reference CNN forward (models/net.py architecture) written over
     raw params so the dense layers can be local shards.  ``x`` is the
     data-shard batch [n, 28, 28, 1]; fc1/fc2 params are model shards."""
-    dn = jax.lax.conv_dimension_numbers(x.shape, params["conv1"]["kernel"].shape,
-                                        ("NHWC", "HWIO", "NHWC"))
-    x = jax.lax.conv_general_dilated(
-        x, params["conv1"]["kernel"], (1, 1), "VALID", dimension_numbers=dn
-    ) + params["conv1"]["bias"]
-    x = jax.nn.relu(x)
-    dn = jax.lax.conv_dimension_numbers(x.shape, params["conv2"]["kernel"].shape,
-                                        ("NHWC", "HWIO", "NHWC"))
-    x = jax.lax.conv_general_dilated(
-        x, params["conv2"]["kernel"], (1, 1), "VALID", dimension_numbers=dn
-    ) + params["conv2"]["bias"]
-    x = jax.nn.relu(x)
-    x = jax.lax.reduce_window(
-        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
-    )
+    x = raw_conv_stack(params, x)
     if train:
         keep1 = 1.0 - DROPOUT1_RATE
         k1 = jax.random.fold_in(key, 1)

@@ -1,0 +1,91 @@
+"""Pipeline-parallel step tests (parallel/pp.py; SURVEY.md §2c).
+
+A (data=4, stage=2) GPipe-style pipelined step — microbatched scan with a
+ppermute hop between the conv stage and the dense stage — must reproduce
+the pure-DP step's math exactly (dropout off): identical mean losses and
+bit-close params after several updates, proving the schedule, the
+activation hand-off, and AD's reverse pipeline are the identity transform.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.models.net import init_params
+from pytorch_mnist_ddp_tpu.parallel.ddp import (
+    make_train_state,
+    make_train_step,
+    replicate_params,
+)
+from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+from pytorch_mnist_ddp_tpu.parallel.pp import make_pp_train_step
+
+
+def _batch(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.standard_normal((n, 28, 28, 1)).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 10, n).astype(np.int32)),
+        jnp.ones(n, jnp.float32),
+    )
+
+
+def test_pp_matches_dp_exactly(devices):
+    params = init_params(jax.random.PRNGKey(0))
+    key, lr = jax.random.PRNGKey(7), jnp.float32(1.0)
+
+    dp_mesh = make_mesh()  # 8 x 1
+    dp_step = make_train_step(dp_mesh, dropout=False)
+    dp_state = replicate_params(make_train_state(params), dp_mesh)
+
+    pp_mesh = make_mesh(num_data=4, num_model=2)
+    pp_step = make_pp_train_step(pp_mesh, num_micro=2)
+    # Deep copy before the donating DP step deletes aliased buffers.
+    pp_state = replicate_params(
+        make_train_state(jax.tree.map(jnp.array, params)), pp_mesh
+    )
+
+    for step in range(3):
+        x, y, w = _batch(seed=step)
+        dp_state, dp_losses = dp_step(dp_state, x, y, w, key, lr)
+        pp_state, pp_losses = pp_step(pp_state, x, y, w, lr)
+
+    np.testing.assert_allclose(
+        float(jnp.mean(dp_losses)), float(jnp.mean(pp_losses)), rtol=1e-5
+    )
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(dp_state.params)[0],
+        jax.tree_util.tree_flatten_with_path(pp_state.params)[0],
+    ):
+        assert pa == pb
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-6, err_msg=str(pa)
+        )
+    assert int(pp_state.step) == 3
+
+
+def test_pp_microbatch_counts(devices):
+    """4 microbatches work too, and a non-divisible shard batch raises."""
+    import pytest
+
+    pp_mesh = make_mesh(num_data=4, num_model=2)
+    pp_step = make_pp_train_step(pp_mesh, num_micro=4)
+    state = replicate_params(
+        make_train_state(init_params(jax.random.PRNGKey(0))), pp_mesh
+    )
+    x, y, w = _batch(n=32, seed=1)
+    state, losses = pp_step(state, x, y, w, jnp.float32(1.0))
+    assert losses.shape == (4,)
+    assert int(state.step) == 1
+
+    bad_step = make_pp_train_step(pp_mesh, num_micro=3)  # 8 % 3 != 0
+    with pytest.raises(ValueError, match="microbatch"):
+        bad_step(state, x, y, w, jnp.float32(1.0))
+
+
+def test_pp_requires_two_stages(devices):
+    import pytest
+
+    with pytest.raises(ValueError, match="axis"):
+        make_pp_train_step(make_mesh(), num_micro=2)  # 8x1 mesh: no stages
