@@ -1,0 +1,91 @@
+"""World-formation decision-tree tests (parallel/distributed.py;
+SURVEY.md N1/N4, reference mnist_ddp.py:13-37).
+
+The contract mirrored from the reference: RANK/WORLD_SIZE env wins,
+SLURM_PROCID is the fallback, bare launch degrades to single-device with
+the "Not using distributed mode" notice, and --nproc_per_node caps local
+devices.  (True multi-process rendezvous is covered by test_multihost.py;
+these tests pin the env parsing and the single-process branches.)
+"""
+
+import pytest
+
+import jax
+
+from pytorch_mnist_ddp_tpu.parallel.distributed import (
+    _coordinator_address,
+    init_distributed_mode,
+)
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    for var in ("RANK", "WORLD_SIZE", "LOCAL_RANK", "SLURM_PROCID",
+                "SLURM_NTASKS", "NPROC_PER_NODE", "MASTER_ADDR", "MASTER_PORT"):
+        monkeypatch.delenv(var, raising=False)
+    return monkeypatch
+
+
+def test_bare_launch_degrades_to_single_device(clean_env, capsys):
+    dist = init_distributed_mode()
+    assert not dist.distributed
+    assert dist.world_size == 1 and dist.local_device_count == 1
+    assert "Not using distributed mode" in capsys.readouterr().out
+
+
+def test_rank_env_single_process_world(clean_env, devices):
+    clean_env.setenv("RANK", "0")
+    clean_env.setenv("WORLD_SIZE", "1")
+    clean_env.setenv("LOCAL_RANK", "0")
+    dist = init_distributed_mode(quiet=True)
+    assert dist.distributed and dist.is_chief
+    assert dist.process_count == 1
+    assert dist.world_size == len(jax.local_devices())
+
+
+def test_slurm_fallback(clean_env, devices):
+    clean_env.setenv("SLURM_PROCID", "0")
+    clean_env.setenv("SLURM_NTASKS", "1")
+    dist = init_distributed_mode(quiet=True)
+    assert dist.distributed and dist.process_rank == 0
+    assert dist.process_count == 1
+
+
+def test_nproc_per_node_caps_devices(clean_env, devices):
+    clean_env.setenv("NPROC_PER_NODE", "4")
+    dist = init_distributed_mode(quiet=True)
+    assert dist.distributed
+    assert dist.local_device_count == 4
+    assert dist.world_size == 4
+
+
+def test_nproc_over_available_raises(clean_env, devices):
+    clean_env.setenv("RANK", "0")
+    clean_env.setenv("WORLD_SIZE", "1")
+    with pytest.raises(RuntimeError, match="nproc_per_node"):
+        init_distributed_mode(devices_per_process=1024, quiet=True)
+
+
+def test_coordinator_address_resolution(clean_env):
+    assert _coordinator_address("tcp://10.0.0.1:1234") == "10.0.0.1:1234"
+    assert _coordinator_address("10.0.0.1:1234") == "10.0.0.1:1234"
+    assert _coordinator_address("env://") is None
+    clean_env.setenv("MASTER_ADDR", "h0")
+    clean_env.setenv("MASTER_PORT", "29500")
+    assert _coordinator_address("env://") == "h0:29500"
+
+
+def test_no_cuda_alias_sets_no_accel():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "ref_mnist_cli",
+        os.path.join(os.path.dirname(__file__), "..", "mnist.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    args = mod.build_parser().parse_args(["--no-cuda"])
+    assert args.no_accel
+    args = mod.build_parser().parse_args(["--no-accel"])
+    assert args.no_accel
