@@ -234,3 +234,51 @@ def test_fused_run_from_key_matches_external_init(devices):
     for a, b in zip(jax.tree.leaves(sa.params), jax.tree.leaves(sb.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert int(sb.step) == int(sa.step)
+
+
+def test_fused_run_with_rbg_keys_matches_per_epoch(devices):
+    """bench.py flips the default PRNG to rbg; the fused machinery must be
+    generator-agnostic.  Under rbg keys the whole-run fusion still matches
+    the per-epoch fusion exactly and is deterministic across reruns."""
+    mesh = make_mesh()
+    tr_images, tr_labels = _dataset(96, seed=31)
+    te_images, te_labels = _dataset(40, seed=32)
+    tx, ty = device_put_dataset(tr_images, tr_labels, mesh)
+    ex, ey = device_put_dataset(te_images, te_labels, mesh)
+    epochs, gb, eb = 2, 32, 8
+    init_key = jax.random.key(0, impl="rbg")
+    shuffle_key = jax.random.key(5, impl="rbg")
+    dropout_key = jax.random.key(6, impl="rbg")
+    lrs = jnp.asarray([1.0, 0.7], jnp.float32)
+
+    run_fn, num_batches = make_fused_run(mesh, 96, 40, gb, eb, epochs, from_key=True)
+    args = (init_key, tx, ty, ex, ey, shuffle_key, dropout_key, lrs)
+    s1, losses1, evals1 = run_fn(*args)
+    s2, losses2, evals2 = run_fn(*args)
+    np.testing.assert_array_equal(np.asarray(losses1), np.asarray(losses2))
+    np.testing.assert_array_equal(np.asarray(evals1), np.asarray(evals2))
+
+    # Per-epoch fusion with the same rbg keys reproduces the same run.
+    epoch_fn, _ = make_fused_train_epoch(mesh, 96, gb)
+    eval_fn = make_fused_eval(mesh, 40, eb)
+    from pytorch_mnist_ddp_tpu.models.net import Net
+    from pytorch_mnist_ddp_tpu.ops.adadelta import adadelta_init
+    model = Net()
+    params = model.init(
+        {"params": init_key}, jnp.zeros((1, 28, 28, 1), jnp.float32), train=False
+    )["params"]
+    from pytorch_mnist_ddp_tpu.parallel.ddp import TrainState
+    se = replicate_params(
+        TrainState(params, adadelta_init(params), jnp.int32(0)), mesh
+    )
+    for epoch in range(1, epochs + 1):
+        se, losses = epoch_fn(
+            se, tx, ty, jnp.int32(epoch), shuffle_key, dropout_key, lrs[epoch - 1]
+        )
+        totals = eval_fn(se.params, ex, ey)
+        np.testing.assert_allclose(
+            np.asarray(losses1[epoch - 1]), np.asarray(losses), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(evals1[epoch - 1]), np.asarray(totals), rtol=1e-5
+        )
