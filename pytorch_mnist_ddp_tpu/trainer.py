@@ -113,20 +113,33 @@ def evaluate(
     return avg, int(correct)
 
 
-def fit(args, dist: DistState, save_path: str | None = None) -> TrainState:
+def fit(
+    args,
+    dist: DistState,
+    save_path: str | None = None,
+    timings: dict | None = None,
+) -> TrainState:
     """Full training run: data, model, optimizer, epoch loop, final save —
     the body of the reference's main() (mnist_ddp.py:108-197).
 
     Opt-in observability beyond the reference (SURVEY.md §5): ``--profile
     DIR`` wraps the run in a ``jax.profiler`` trace; ``--step-stats``
-    prints per-epoch host-side step-latency summaries (per-batch path)."""
+    prints per-epoch host-side step-latency summaries (per-batch path).
+    When ``timings`` is a dict, the fused path records wall-clock
+    attribution into it: ``data_s`` (device_put + sharding of the already-
+    loaded dataset arrays), ``compile_s`` (trace + compile, or persistent-
+    cache load, of the fused program), and ``run_s`` (pure execution of the
+    compiled multi-epoch run, blocked to completion) — the host-vs-device
+    split bench.py reports."""
     from .utils.profiling import trace
 
     with trace(getattr(args, "profile", None)):
-        return _fit_body(args, dist, save_path)
+        return _fit_body(args, dist, save_path, timings)
 
 
-def _fit_body(args, dist: DistState, save_path: str | None) -> TrainState:
+def _fit_body(
+    args, dist: DistState, save_path: str | None, timings: dict | None = None
+) -> TrainState:
     if dist.distributed:
         # Multi-host: the mesh spans every device in the world (JAX's global
         # view); single-host: the (possibly --nproc_per_node-capped) locals.
@@ -152,10 +165,16 @@ def _fit_body(args, dist: DistState, save_path: str | None) -> TrainState:
     use_pallas = bool(getattr(args, "pallas_opt", False))
 
     if fused:
+        import time as _time
+
         from .parallel.fused import device_put_dataset, make_fused_run
 
+        _t0 = _time.perf_counter()
         tr_x, tr_y = device_put_dataset(train_set.images, train_set.labels, mesh)
         te_x, te_y = device_put_dataset(test_set.images, test_set.labels, mesh)
+        if timings is not None:
+            jax.block_until_ready((tr_x, te_x))
+            timings["data_s"] = _time.perf_counter() - _t0
         # from_key: param init happens inside the compiled run — a cold
         # process reaches the hot loop in ONE device dispatch, with no
         # separate init program (same RNG stream as init_params, so the
@@ -168,10 +187,23 @@ def _fit_body(args, dist: DistState, save_path: str | None) -> TrainState:
         lrs = jnp.asarray(
             [lr_fn(e) for e in range(1, args.epochs + 1)], jnp.float32
         )
-        state, losses, evals = run_fn(
+        run_args = (
             keys["init"], tr_x, tr_y, te_x, te_y,
             keys["shuffle"], keys["dropout"], lrs,
         )
+        if timings is not None:
+            # AOT split so compile (or cache load) and execution are timed
+            # separately — on a cold cache the ~20 s compile would otherwise
+            # masquerade as device time in run_s.
+            _t1 = _time.perf_counter()
+            compiled = run_fn.lower(*run_args).compile()
+            timings["compile_s"] = _time.perf_counter() - _t1
+            _t1 = _time.perf_counter()
+            state, losses, evals = compiled(*run_args)
+            jax.block_until_ready((losses, evals))
+            timings["run_s"] = _time.perf_counter() - _t1
+        else:
+            state, losses, evals = run_fn(*run_args)
         if dist.is_chief:
             # One transfer for the whole run, then the reference's exact
             # interleaved output — train lines + test summary per epoch.

@@ -81,6 +81,22 @@ def test_fit_distributed_mesh(tmp_path, capsys, devices):
     assert any(TEST_RE.match(l) for l in out.splitlines())
 
 
+def test_fit_fused_populates_timings(tmp_path, capsys, devices):
+    """bench.py's host-vs-device attribution: the fused path must record
+    data_s (dataset load + device_put) and run_s (compiled run, blocked)."""
+    root = _write_idx(tmp_path)
+    args = _args(root, batch_size=8, fused=True, log_interval=10_000_000)
+    dist = DistState(
+        distributed=True, process_rank=0, process_count=1,
+        world_size=8, devices=list(devices),
+    )
+    timings = {}
+    fit(args, dist, timings=timings)
+    capsys.readouterr()
+    assert set(timings) == {"data_s", "compile_s", "run_s"}
+    assert all(v > 0 for v in timings.values())
+
+
 def test_dry_run_single_batch(tmp_path, capsys):
     root = _write_idx(tmp_path)
     args = _args(root, dry_run=True, epochs=1)
