@@ -96,15 +96,21 @@ def _probe_backend_once() -> tuple[bool, str]:
     return True, proc.stdout.strip()
 
 
-def _acquire_backend(metric: str, allow_cpu: bool) -> None:
+def _acquire_backend(metric: str, allow_cpu: bool, attempts: int | None = None) -> None:
     """Probe until the accelerator answers, with backoff; on exhaustion emit
     the failure JSON and exit (never raise a raw traceback to the driver).
 
     A probe that resolves to the CPU platform counts as FAILURE unless
     ``allow_cpu``: a silent jax fallback to CPU would otherwise record a
-    multi-minute CPU wall clock as the round's headline TPU number."""
+    multi-minute CPU wall clock as the round's headline TPU number.
+    ``attempts`` caps the probe count (callers with their own deadline,
+    e.g. the in-suite convergence test, want one quick probe, not the
+    driver's ~5-minute patience)."""
     errors = []
-    for i, backoff in enumerate((0,) + PROBE_BACKOFFS_S):
+    schedule = (0,) + PROBE_BACKOFFS_S
+    if attempts is not None:
+        schedule = schedule[: max(attempts, 1)]  # 0 still probes once
+    for i, backoff in enumerate(schedule):
         if backoff:
             print(f"bench: backend unavailable, retry in {backoff}s "
                   f"({errors[-1]})", file=sys.stderr, flush=True)
@@ -139,12 +145,16 @@ def main() -> None:
                    help="permit benchmarking on the CPU platform (never the "
                         "headline metric; off by default so a silent CPU "
                         "fallback can't masquerade as a TPU number)")
+    p.add_argument("--probe-attempts", type=int, default=None,
+                   help="cap backend-probe attempts (default: full "
+                        f"{1 + len(PROBE_BACKOFFS_S)}-attempt schedule, "
+                        "~5 min of patience)")
     args = p.parse_args()
     if args.quick:
         args.epochs = 2
     metric = f"mnist_{args.epochs}epoch_wall_clock"
 
-    _acquire_backend(metric, args.allow_cpu)
+    _acquire_backend(metric, args.allow_cpu, args.probe_attempts)
 
     # Watchdog: a post-probe hang (tunnel dropping mid-run) must still
     # produce a structured result line, not a driver timeout with nothing
@@ -258,6 +268,17 @@ def main() -> None:
         result["device_run_share"] = round(timings["run_s"] / elapsed, 3)
         result["compile_s"] = round(timings.get("compile_s", 0.0), 2)
         result["data_s"] = round(timings.get("data_s", 0.0), 2)
+    if "final_test_accuracy" in timings:
+        # BASELINE.json's accuracy axis (>=99% target), recorded with the
+        # wall clock so neither can regress unnoticed.  The synthetic task
+        # is tuned non-saturating (data/mnist.py): 100.0 here would itself
+        # be a red flag.
+        result["final_test_accuracy"] = round(
+            timings["final_test_accuracy"] * 100, 2
+        )
+        result["epoch1_test_accuracy"] = round(
+            timings["epoch1_test_accuracy"] * 100, 2
+        )
     print(json.dumps(result))
 
 

@@ -35,6 +35,16 @@ def main() -> None:
                    help="number of processes (env WORLD_SIZE wins)")
     p.add_argument("--dist-url", type=str, default="env://",
                    help="rendezvous URL for multi-host init")
+    # Beyond-parity parallelism over the mesh's model axis (the reference
+    # is DP-only; its README only *mentions* model parallelism, README.md:8).
+    p.add_argument("--tp", type=int, default=1, metavar="N",
+                   help="tensor-parallel degree: shard the dense head over "
+                        "N model-axis devices (data axis = devices / N)")
+    p.add_argument("--pp", action="store_true",
+                   help="pipeline the two stages (convs | dense head) over "
+                        "a 2-wide model axis with microbatched ppermute")
+    p.add_argument("--pp-microbatches", type=int, default=2, metavar="M",
+                   help="microbatches per shard batch in --pp mode")
     args = p.parse_args()
 
     import jax

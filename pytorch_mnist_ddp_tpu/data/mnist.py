@@ -103,58 +103,98 @@ def _try_download(root: str, filename: str) -> bytes | None:
 # Synthetic fallback
 
 
+# Difficulty knobs for the v2 generator, tuned on the real chip so the
+# reference CNN's 20-epoch benchmark curve mirrors real MNIST's shape:
+# epoch-1 accuracy ~90.7%, crossing 99% around epoch 4-5, topping out at
+# ~99.35% by epoch 8-14 — never saturating at 100%, so the >=99% target of
+# BASELINE.json stays meaningful (VERDICT r1 'Next round' #3).
+_N_COARSE = 5      # coarse fields shared by class pairs (c and c+5)
+_N_MODES = 10      # intra-class modes (all clean; slow learning, high floor)
+_FINE_AMP = 0.7    # per-class fine detail: the pair discriminator
+_MODE_AMP = 0.45   # mode-distortion amplitude (intra-class variance)
+_NOISE = 0.18      # per-pixel Gaussian noise (sets the Bayes floor)
+_SHIFT = 4         # max |shift| in px, each axis
+_CONTRAST = 0.25   # multiplicative gain jitter half-range
+_FLIP = 0.004      # label-flip rate: hard ~99.5% ceiling on test accuracy
+
+
 def synthetic_mnist(
     split: str, n: int | None = None, seed: int = 1234
 ) -> tuple[np.ndarray, np.ndarray]:
     """Deterministic MNIST-shaped dataset for air-gapped hosts.
 
-    Each class k is a fixed smooth random template (per-class blob pattern);
-    a sample is its template under a random ±2px shift plus pixel noise.
-    The task is learnable to >99% by the reference CNN while remaining
-    non-trivial (shift invariance matters, which exercises the convs).
-    Train and test are drawn from the same distribution with disjoint RNG
-    streams.
+    Construction (v2 — non-saturating): class identity is carried by TWO
+    spatial scales.  A pool of ``_N_COARSE`` smooth low-frequency fields is
+    shared pairwise (class ``c`` and ``c + 5`` use the same coarse field),
+    so coarse shape alone cannot separate all 10 classes; each class adds
+    its own higher-frequency fine-detail field (amplitude ``_FINE_AMP``) —
+    the discriminator the CNN must actually learn.  Intra-class variation
+    comes from ``_N_MODES`` shared mode-distortion fields (shared across
+    classes, so the mode id carries no label information), random shifts of
+    up to ±``_SHIFT`` px on a 36x36 canvas, multiplicative contrast jitter,
+    and per-pixel Gaussian noise.  A ``_FLIP`` fraction of labels is
+    remapped to a random other class, putting a hard ceiling on attainable
+    accuracy so no regression can hide behind a saturated 100%.
+
+    Train and test are drawn from the same distribution with disjoint
+    sample-RNG streams (the template stream is shared across splits).
     """
     if n is None:
         n = 60000 if split == "train" else 10000
+    num_classes = 10
     rng = np.random.RandomState(seed)  # template stream: shared across splits
-    # 10 class templates: low-frequency random fields, rendered at 36x36 so
-    # shifted 28x28 crops stay fully inside the canvas.
-    freq = rng.normal(size=(10, 6, 6))
-    templates = np.zeros((10, 36, 36), dtype=np.float32)
-    for k in range(10):
-        t = np.kron(freq[k], np.ones((6, 6)))  # 36x36 blocky field
-        # cheap smoothing: two passes of a box blur
-        for _ in range(2):
+
+    def smooth(t: np.ndarray, passes: int) -> np.ndarray:
+        for _ in range(passes):  # cheap box-blur via rolls
             t = (
                 t
-                + np.roll(t, 1, 0) + np.roll(t, -1, 0)
-                + np.roll(t, 1, 1) + np.roll(t, -1, 1)
+                + np.roll(t, 1, -2) + np.roll(t, -1, -2)
+                + np.roll(t, 1, -1) + np.roll(t, -1, -1)
             ) / 5.0
-        t = (t - t.min()) / (np.ptp(t) + 1e-8)
-        templates[k] = t
+        return t
+
+    # All fields are rendered at 36x36 so shifted 28x28 crops stay inside
+    # the canvas (base origin 4, shifts up to ±4).
+    coarse = smooth(np.kron(rng.normal(size=(_N_COARSE, 6, 6)), np.ones((6, 6))), 2)
+    fine = smooth(np.kron(rng.normal(size=(num_classes, 18, 18)), np.ones((2, 2))), 1)
+    modes = smooth(np.kron(rng.normal(size=(_N_MODES, 9, 9)), np.ones((4, 4))), 2)
+
+    templates = np.empty((num_classes, _N_MODES, 36, 36), dtype=np.float32)
+    for c in range(num_classes):
+        for m in range(_N_MODES):
+            t = coarse[c % _N_COARSE] + _FINE_AMP * fine[c] + _MODE_AMP * modes[m]
+            templates[c, m] = (t - t.min()) / (np.ptp(t) + 1e-8)
 
     sample_rng = np.random.RandomState(seed + (1 if split == "train" else 2))
-    labels = sample_rng.randint(0, 10, size=n).astype(np.uint8)
-    shifts = sample_rng.randint(-2, 3, size=(n, 2))
-    noise = sample_rng.normal(0.0, 0.08, size=(n, 28, 28)).astype(np.float32)
+    labels = sample_rng.randint(0, num_classes, size=n).astype(np.uint8)
+    mode_ix = sample_rng.randint(0, _N_MODES, size=n)
+    shifts = sample_rng.randint(-_SHIFT, _SHIFT + 1, size=(n, 2))
+    gain = 1.0 + sample_rng.uniform(
+        -_CONTRAST, _CONTRAST, size=(n, 1, 1)
+    ).astype(np.float32)
+    noise = sample_rng.normal(0.0, _NOISE, size=(n, 28, 28)).astype(np.float32)
+
     base = 4  # crop origin for zero shift
-    # All 5x5 shifted crops of every template, then one gather per sample —
-    # vectorized but bit-identical to the per-sample crop loop.
-    crops = np.empty((10, 5, 5, 28, 28), dtype=np.float32)
-    for dy in range(-2, 3):
-        for dx in range(-2, 3):
-            crops[:, dy + 2, dx + 2] = templates[
-                :, base + dy : base + dy + 28, base + dx : base + dx + 28
-            ]
-    gathered = crops[labels, shifts[:, 0] + 2, shifts[:, 1] + 2]
-    images = (np.clip(gathered + noise, 0.0, 1.0) * 255).astype(np.uint8)
+    rows = (base + shifts[:, 0])[:, None] + np.arange(28)[None, :]  # [n, 28]
+    cols = (base + shifts[:, 1])[:, None] + np.arange(28)[None, :]
+    # One fused advanced index (no [n, 36, 36] intermediate): ~190MB peak
+    # instead of ~500MB for the 60k split.
+    gathered = templates[
+        labels[:, None, None], mode_ix[:, None, None],
+        rows[:, :, None], cols[:, None, :],
+    ]
+    images = np.clip(gathered * gain + noise, 0.0, 1.0)
+    images = (images * 255).astype(np.uint8)
+
+    flips = sample_rng.rand(n) < _FLIP
+    offsets = sample_rng.randint(1, num_classes, size=n)
+    labels = np.where(flips, (labels + offsets) % num_classes, labels).astype(np.uint8)
     return images, labels
 
 
 # Bump when synthetic_mnist's algorithm or defaults change, so stale disk
 # caches regenerate instead of silently serving pre-change data.
-_SYNTH_VERSION = 1
+_SYNTH_VERSION = 2
 
 
 def _synthetic_cached(split: str, seed: int = 1234) -> tuple[np.ndarray, np.ndarray]:

@@ -1,49 +1,56 @@
 """Pipeline parallelism over the mesh's second axis (SURVEY.md §2c).
 
 The reference has no pipeline parallelism (single ``Net.forward``); this
-module is the "beyond parity" counterpart of parallel/tp.py, demonstrating
-that the same reserved mesh axis also supports a GPipe-style **stage**
-decomposition of the reference CNN:
+module gives the reserved mesh axis a GPipe-style **stage** decomposition
+of the reference CNN:
 
-- **stage 0**: conv1 -> relu -> conv2 -> relu -> maxpool -> flatten
-- **stage 1**: fc1 -> relu -> fc2 -> log_softmax -> weighted NLL
+- **stage 0**: conv1 -> relu -> conv2 -> relu -> maxpool -> dropout(.25)
+  -> flatten
+- **stage 1**: fc1 -> relu -> dropout(.5) -> fc2 -> log_softmax ->
+  weighted NLL
 
-The per-data-shard batch is split into ``num_micro`` microbatches; a
-``lax.scan`` over ``num_micro + 1`` ticks drives the pipeline, and each
-tick moves one activation block stage0 -> stage1 through a single
-``lax.ppermute`` hop (the ICI neighbor link).  Stage identity is the
-device's index on the stage axis, so both stages run the SAME SPMD program
-with a runtime ``lax.cond`` selecting their work — the idiomatic way to
-express heterogeneous stages under ``shard_map``.
+The per-data-shard batch is split into ``num_micro`` microbatches.  Both
+passes are explicit schedules driven by ``lax.scan``, with one
+``lax.ppermute`` hop per tick (the ICI neighbor link):
 
-The backward pipeline is not hand-written: ``jax.grad`` transposes the
-scan + ppermute into the reverse schedule automatically, and VMA tracking
-(check_vma default) inserts the stage/data-axis gradient reductions for
-the replicated params, exactly as in parallel/tp.py.  Params are
-replicated over the stage axis (each stage reads only its half; at 1.2M
-params the duplication is noise — stage-sharding them is the TP module's
-job, composition is future work).
+- **forward** (``num_micro + 1`` ticks): stage 0 runs microbatch ``t``
+  while stage 1 consumes the activation sent at ``t - 1`` and accumulates
+  the loss; arriving activations are stashed for the backward pass.
+- **backward** (``num_micro + 1`` ticks, reverse order): stage 1 re-runs
+  its microbatch body under ``jax.vjp`` (rematerialization — same folded
+  dropout keys, so masks replay exactly), accumulates its param grads,
+  and ppermutes the activation cotangent back; stage 1's ppermute partner
+  consumes it one tick later for the conv backward.
 
-Stage selection is arithmetic masking rather than ``lax.cond``: both
-stage bodies are traced on every device and the inactive one is masked
-out.  ``cond`` would skip the inactive stage's FLOPs, but transposing a
-``cond`` nested in this scan+ppermute aborts the XLA:CPU runtime (hard
-SIGABRT, jaxlib in this image), and the test mesh is CPU; at two
-heterogeneous stages of this size the redundancy is cheap, and a
-production pipeline of N homogeneous layers would stage-shard the params
-so the SPMD program needs no branch at all.
+Each device executes ONLY its own stage's FLOPs: stage selection is a
+runtime ``lax.cond`` on the device's stage-axis index — the idiomatic
+SPMD form.  Transposing such a ``cond`` nested in this scan+ppermute
+SIGABRTs the XLA:CPU runtime (jaxlib in this image), which is why the
+round-1 version burned 2x masked FLOPs instead; the fix here is
+``jax.custom_vjp``: the backward schedule is hand-written primal-style
+code, so autodiff never transposes anything.  This also makes the
+pipeline's collective pattern fully explicit — the only cross-device
+traffic is the per-tick activation/cotangent ppermute and one stage-axis
+``psum`` of the (disjoint) per-stage grad trees.
+
+Params stay replicated in HBM (1.2M params; duplication is noise at this
+scale) but the *work* is stage-partitioned, and the gradient psum over
+the stage axis is exactly the sync a stage-sharded layout would need.
 
 Parity with the DP step is exact (dropout off) and pinned by
-tests/test_pp.py.
+tests/test_pp.py; dropout uses per-microbatch folded keys (mask geometry
+differs from DP's per-shard masks, as with TP's per-shard masks).
 """
 
 from __future__ import annotations
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.net import raw_conv_stack
+from ..models.net import DROPOUT1_RATE, DROPOUT2_RATE, raw_conv_stack
 from ..ops.adadelta import adadelta_update
 from ..ops.loss import nll_loss
 from .ddp import TrainState
@@ -54,18 +61,40 @@ NUM_STAGES = 2
 _FLAT = 9216  # stage-boundary activation width (64 * 12 * 12)
 
 
-def _stage0(params: dict, x: jax.Array) -> jax.Array:
-    """convs + pool + flatten: [n, 28, 28, 1] -> [n, 9216]."""
+def _float0_zeros(v: jax.Array):
+    """Cotangent for a non-differentiable (integer) primal."""
+    return np.zeros(v.shape, jax.dtypes.float0)
+
+
+def _stage0_fwd(params: dict, x: jax.Array, key: jax.Array, train: bool) -> jax.Array:
+    """convs + pool (+ dropout1 when training) + flatten:
+    [n, 28, 28, 1] -> [n, 9216]."""
     x = raw_conv_stack(params, x)
+    if train:
+        keep = 1.0 - DROPOUT1_RATE
+        x = x * jax.random.bernoulli(key, keep, x.shape) / keep
     return x.reshape(x.shape[0], -1)
 
 
-def _stage1_loss_sum(params: dict, act: jax.Array, y: jax.Array, w: jax.Array) -> jax.Array:
-    """dense head + weighted NLL SUM over the microbatch."""
+def _stage1_loss_sum(
+    params: dict, act: jax.Array, y: jax.Array, w: jax.Array,
+    key: jax.Array, train: bool,
+) -> jax.Array:
+    """dense head (+ dropout2 when training) + weighted NLL SUM."""
     h = jax.nn.relu(act @ params["fc1"]["kernel"] + params["fc1"]["bias"])
+    if train:
+        keep = 1.0 - DROPOUT2_RATE
+        h = h * jax.random.bernoulli(key, keep, h.shape) / keep
     logits = h @ params["fc2"]["kernel"] + params["fc2"]["bias"]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     return nll_loss(logp, y, w, reduction="sum")
+
+
+def _mb_keys(key: jax.Array, j: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-microbatch dropout keys, identical in forward and backward so
+    rematerialized masks replay exactly."""
+    kmb = jax.random.fold_in(key, j)
+    return jax.random.fold_in(kmb, 1), jax.random.fold_in(kmb, 2)
 
 
 def make_pp_train_step(
@@ -73,92 +102,180 @@ def make_pp_train_step(
     num_micro: int = 2,
     rho: float = 0.9,
     eps: float = 1e-6,
+    dropout: bool = True,
 ):
     """Build the jitted (data x stage) pipelined train step.
 
-    ``step_fn(state, x, y, w, lr) -> (state, losses)``: ``state``
-    replicated (P() everywhere), ``x/y/w`` sharded over ``data``,
-    ``losses`` one local mean loss per data shard.  The stage axis must
-    have size ``NUM_STAGES`` (2).  Dropout is not pipelined here — this
-    module demonstrates the schedule; use the DP/TP steps for training
-    with dropout.
+    ``step_fn(state, x, y, w, dropout_key, lr) -> (state, losses)`` — the
+    same signature as the DP/TP steps so the trainer can route ``--pp``
+    through the common epoch loop.  ``state`` is replicated (P()
+    everywhere), ``x/y/w`` are sharded over ``data``, ``losses`` is one
+    local mean loss per data shard.  The stage axis must have size
+    ``NUM_STAGES`` (2).
     """
     if mesh.shape[STAGE_AXIS] != NUM_STAGES:
         raise ValueError(
             f"pipeline needs a {NUM_STAGES}-wide '{STAGE_AXIS}' axis, got "
             f"{mesh.shape[STAGE_AXIS]}"
         )
+    if num_micro < 1:
+        raise ValueError(f"num_micro must be >= 1, got {num_micro}")
     num_data = mesh.shape[DATA_AXIS]
+    ring = [(i, (i + 1) % NUM_STAGES) for i in range(NUM_STAGES)]
+    ring_rev = [(dst, src) for src, dst in ring]
 
-    def local_step(state: TrainState, x, y, w, lr):
+    def _pipeline_forward(params, x_mbs, y_mbs, w_mbs, key):
+        """The scheduled forward: returns (stage-psum'd loss SUM over this
+        data shard, stashed arriving activations [ticks, mb, 9216])."""
+        stage = jax.lax.axis_index(STAGE_AXIS)
+        mb = x_mbs.shape[1]
+
+        def tick(carry, t):
+            in_flight = carry  # activation that arrived at this device
+
+            # stage 0 forwards microbatch t (idle on its last tick)
+            t0 = jnp.clip(t, 0, num_micro - 1)
+            x_mb = jax.lax.dynamic_index_in_dim(x_mbs, t0, keepdims=False)
+            k0, _ = _mb_keys(key, t0)
+            out = jax.lax.cond(
+                stage == 0,
+                lambda: _stage0_fwd(params, x_mb, k0, dropout)
+                * (t < num_micro).astype(x_mb.dtype),
+                lambda: jnp.zeros((mb, _FLAT), x_mb.dtype),
+            )
+
+            # stage 1 consumes the block sent at tick t-1 (idle at t=0);
+            # the idle tick's weights are zeroed so its loss part is 0.
+            t1 = jnp.clip(t - 1, 0, num_micro - 1)
+            y_mb = jax.lax.dynamic_index_in_dim(y_mbs, t1, keepdims=False)
+            w_mb = jax.lax.dynamic_index_in_dim(w_mbs, t1, keepdims=False)
+            _, k1 = _mb_keys(key, t1)
+            on1 = jnp.logical_and(stage == 1, t >= 1)
+            part = jax.lax.cond(
+                stage == 1,
+                lambda: _stage1_loss_sum(
+                    params, in_flight, y_mb,
+                    w_mb * on1.astype(w_mb.dtype), k1, dropout,
+                ),
+                lambda: jnp.float32(0.0),
+            )
+
+            moved = jax.lax.ppermute(out, STAGE_AXIS, ring)
+            return moved, (part, in_flight)
+
+        zero = jnp.zeros((mb, _FLAT), x_mbs.dtype)
+        _, (parts, stash) = jax.lax.scan(
+            tick, zero, jnp.arange(num_micro + NUM_STAGES - 1)
+        )
+        return jax.lax.psum(parts.sum(), STAGE_AXIS), stash
+
+    @jax.custom_vjp
+    def pipeline_loss(params, x_mbs, y_mbs, w_mbs, key):
+        loss_sum, _ = _pipeline_forward(params, x_mbs, y_mbs, w_mbs, key)
+        return loss_sum
+
+    def pipeline_loss_fwd(params, x_mbs, y_mbs, w_mbs, key):
+        loss_sum, stash = _pipeline_forward(params, x_mbs, y_mbs, w_mbs, key)
+        return loss_sum, (params, x_mbs, y_mbs, w_mbs, key, stash)
+
+    def pipeline_loss_bwd(res, g):
+        """The reverse schedule, hand-written (never a cond transpose).
+
+        Tick s: stage 1 rematerializes microbatch ``num_micro - 1 - s``
+        under ``jax.vjp`` (grads for its params + the activation
+        cotangent, scaled by ``g``), ppermutes the cotangent back; stage 0
+        consumes it at tick ``s + 1`` for the conv backward.  Param-grad
+        trees are disjoint per stage; one stage-axis psum at the end makes
+        every device hold the full gradient."""
+        params, x_mbs, y_mbs, w_mbs, key, stash = res
+        stage = jax.lax.axis_index(STAGE_AXIS)
+        mb = x_mbs.shape[1]
+        zero_grads = jax.tree.map(jnp.zeros_like, params)
+
+        def tick(carry, s):
+            g_act_in, acc = carry
+
+            def s1_body():
+                # stage 1: microbatch j arrived at forward tick j+1
+                j = jnp.clip(num_micro - 1 - s, 0, num_micro - 1)
+                act = jax.lax.dynamic_index_in_dim(stash, j + 1, keepdims=False)
+                y_mb = jax.lax.dynamic_index_in_dim(y_mbs, j, keepdims=False)
+                w_mb = jax.lax.dynamic_index_in_dim(w_mbs, j, keepdims=False)
+                _, k1 = _mb_keys(key, j)
+                _, vjp = jax.vjp(
+                    lambda p, a: _stage1_loss_sum(p, a, y_mb, w_mb, k1, dropout),
+                    params, act,
+                )
+                gp, ga = vjp(g)
+                active = (s < num_micro).astype(jnp.float32)
+                gp = jax.tree.map(lambda t: t * active, gp)
+                return gp, ga * active
+
+            def s0_body():
+                # stage 0: the cotangent arriving at tick s is for the
+                # microbatch stage 1 processed at tick s-1
+                j = jnp.clip(num_micro - s, 0, num_micro - 1)
+                x_mb = jax.lax.dynamic_index_in_dim(x_mbs, j, keepdims=False)
+                k0, _ = _mb_keys(key, j)
+                _, vjp = jax.vjp(
+                    lambda p: _stage0_fwd(p, x_mb, k0, dropout), params
+                )
+                gp, = vjp(g_act_in)
+                active = (s >= 1).astype(jnp.float32)
+                gp = jax.tree.map(lambda t: t * active, gp)
+                return gp, jnp.zeros((mb, _FLAT), x_mbs.dtype)
+
+            gp, ga = jax.lax.cond(stage == 1, s1_body, s0_body)
+            acc = jax.tree.map(jnp.add, acc, gp)
+            moved = jax.lax.ppermute(ga, STAGE_AXIS, ring_rev)
+            return (moved, acc), None
+
+        zero_act = jnp.zeros((mb, _FLAT), x_mbs.dtype)
+        (_, acc), _ = jax.lax.scan(
+            tick, (zero_act, zero_grads),
+            jnp.arange(num_micro + NUM_STAGES - 1),
+        )
+        # Disjoint per-stage trees -> full gradient everywhere.
+        acc = jax.lax.psum(acc, STAGE_AXIS)
+        return (
+            acc,
+            jnp.zeros_like(x_mbs),
+            _float0_zeros(y_mbs),
+            jnp.zeros_like(w_mbs),
+            _float0_zeros(key),
+        )
+
+    pipeline_loss.defvjp(pipeline_loss_fwd, pipeline_loss_bwd)
+
+    def local_step(state: TrainState, x, y, w, dropout_key, lr):
         n = x.shape[0]
         if n % num_micro:
-            raise ValueError(f"shard batch {n} not divisible by {num_micro} microbatches")
+            raise ValueError(
+                f"shard batch {n} not divisible by {num_micro} microbatches"
+            )
         mb = n // num_micro
-        stage = jax.lax.axis_index(STAGE_AXIS)
+        key = jax.random.fold_in(dropout_key, state.step)
+        key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+        x_mbs = x.reshape(num_micro, mb, *x.shape[1:])
+        y_mbs = y.reshape(num_micro, mb)
+        w_mbs = w.reshape(num_micro, mb)
+        denom = jnp.maximum(w.sum(), 1.0)
 
         def loss_fn(params):
-            x_mbs = x.reshape(num_micro, mb, *x.shape[1:])
-            y_mbs = y.reshape(num_micro, mb)
-            w_mbs = w.reshape(num_micro, mb)
-
-            def tick(carry, t):
-                in_flight = carry  # activation block arriving at stage 1
-
-                # Stage 0 produces microbatch t (its last tick is idle;
-                # non-stage-0 devices produce a masked-out zero block).
-                t0 = jnp.clip(t, 0, num_micro - 1)
-                feed = jax.lax.dynamic_index_in_dim(x_mbs, t0, keepdims=False)
-                on0 = jnp.logical_and(stage == 0, t < num_micro)
-                out = jnp.where(on0, _stage0(params, feed), 0.0)
-
-                # Stage 1 consumes the block sent at tick t-1 (idle at
-                # t=0); masking the sample weights zeroes both the loss
-                # contribution and, through AD, the gradients of the idle
-                # evaluations.
-                t1 = jnp.clip(t - 1, 0, num_micro - 1)
-                y_mb = jax.lax.dynamic_index_in_dim(y_mbs, t1, keepdims=False)
-                w_mb = jax.lax.dynamic_index_in_dim(w_mbs, t1, keepdims=False)
-                on1 = jnp.logical_and(stage == 1, t >= 1)
-                part = _stage1_loss_sum(
-                    params, in_flight, y_mb, w_mb * on1.astype(w_mb.dtype)
-                )
-
-                # One hop down the pipe: stage0 -> stage1 (stage1's output
-                # wraps back but is never consumed).
-                moved = jax.lax.ppermute(
-                    out, STAGE_AXIS,
-                    [(i, (i + 1) % NUM_STAGES) for i in range(NUM_STAGES)],
-                )
-                return moved, part
-
-            # The carry must enter the scan with the same varying-manual-
-            # axes type ppermute's output has (varying over both axes).
-            zero = jax.lax.pcast(
-                jnp.zeros((mb, _FLAT), x.dtype),
-                (DATA_AXIS, STAGE_AXIS),
-                to="varying",
-            )
-            _, parts = jax.lax.scan(
-                tick, zero, jnp.arange(num_micro + NUM_STAGES - 1)
-            )
-            # Weighted-mean loss over the shard, computed on stage 1 and
-            # shared to every stage (psum of a stage-1-only value).
-            total = jax.lax.psum(parts.sum(), STAGE_AXIS)
-            return total / jnp.maximum(w.sum(), 1.0)
+            return pipeline_loss(params, x_mbs, y_mbs, w_mbs, key) / denom
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        # VMA AD pre-reduces over both axes (params are fully replicated);
-        # divide the data-axis SUM of local means down to the DDP mean,
-        # exactly as in parallel/tp.py.
-        grads = jax.tree.map(lambda g: g / num_data, grads)
+        # custom bwd psums over the stage axis; the DP mean over data is
+        # explicit here (check_vma=False: nothing is auto-inserted).
+        grads = jax.lax.pmean(grads, DATA_AXIS)
         params, opt = adadelta_update(state.params, grads, state.opt, lr, rho, eps)
         return TrainState(params, opt, state.step + 1), loss[None]
 
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
         out_specs=(P(), P(DATA_AXIS)),
+        check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))

@@ -64,13 +64,33 @@ def state_specs() -> Any:
 
 def shard_state(state: TrainState, mesh: Mesh) -> TrainState:
     """Place a (host/replicated) TrainState onto the 2-D mesh with TP
-    shardings.  Single-controller only (tests/dryrun); multi-controller TP
-    placement would mirror ddp.replicate_params's local-data path."""
-    return jax.tree.map(
-        lambda v, spec: jax.device_put(v, NamedSharding(mesh, spec)),
-        state,
-        state_specs(),
-    )
+    shardings.
+
+    Single-controller worlds ``device_put`` each leaf.  Multi-controller
+    worlds can't place onto non-addressable devices; there, every process
+    holds the full (identical, same-PRNG) value — the DP replication story
+    of ``ddp.replicate_params`` — and each contributes its addressable
+    shards via ``make_array_from_callback``, which slices the local piece
+    per shard index.  Shard-identical state by construction, no broadcast.
+    """
+    import numpy as np
+
+    specs = state_specs()
+    if all(d.process_index == jax.process_index() for d in mesh.devices.flat):
+        return jax.tree.map(
+            lambda v, spec: jax.device_put(v, NamedSharding(mesh, spec)),
+            state,
+            specs,
+        )
+
+    def place(v, spec):
+        host = np.asarray(v)
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx, host=host: host[idx]
+        )
+
+    return jax.tree.map(place, state, specs)
 
 
 def _tp_forward(params: dict, x: jax.Array, train: bool, key: jax.Array) -> jax.Array:
@@ -98,6 +118,40 @@ def _tp_forward(params: dict, x: jax.Array, train: bool, key: jax.Array) -> jax.
     logits = h @ params["fc2"]["kernel"]
     logits = jax.lax.psum(logits, MODEL_AXIS) + params["fc2"]["bias"]
     return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+
+def gather_replicated(tree: Any, mesh: Mesh) -> Any:
+    """All-gather a (possibly model-sharded) pytree to a fully-replicated
+    copy every process can read locally (``np.asarray`` on each leaf).
+
+    This is a COLLECTIVE: call it on every process of a multi-controller
+    world, never behind a chief-only gate."""
+    return jax.jit(lambda t: t, out_shardings=NamedSharding(mesh, P()))(tree)
+
+
+def make_tp_eval_step(mesh: Mesh):
+    """Build the jitted TP eval step: the TP forward (logits completed by
+    the model-axis psum) feeding the same psum'd (loss_sum, correct)
+    totals as ddp.make_eval_step — so ``--tp`` runs evaluate with
+    model-sharded params instead of gathering them every epoch.
+
+    ``eval_fn(params, x, y, w) -> [loss_sum, correct]`` with ``params``
+    sharded per ``param_specs()`` and ``x/y/w`` sharded over ``data``."""
+
+    def local_eval(params, x, y, w):
+        # train=False: the key argument is never consumed.
+        logp = _tp_forward(params, x, train=False, key=jax.random.PRNGKey(0))
+        loss_sum = nll_loss(logp, y, w, reduction="sum")
+        correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
+        return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
+
+    sharded = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(param_specs(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    )
+    return jax.jit(sharded)
 
 
 def make_tp_train_step(

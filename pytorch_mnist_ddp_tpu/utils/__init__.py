@@ -5,5 +5,4 @@ from .logging import (
     distributed_init_banner,
     total_time_line,
 )
-from .timer import WallClock
 from .checkpoint import save_state_dict, load_state_dict, model_state_dict

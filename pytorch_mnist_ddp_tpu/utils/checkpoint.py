@@ -72,6 +72,13 @@ def save_state_dict(
     state = {k: np.asarray(jax.device_get(v)) for k, v in state.items()}
     if format == "auto":
         format = "torch" if have_torch() else "npz"
+        if format == "npz" and path.endswith(".pt"):
+            # Torch-less host writing under the reference's .pt name: say so
+            # now, not at some downstream torch.load failure.
+            print(
+                f"torch not importable; saving {path} as a numpy .npz "
+                "archive (readable by load_state_dict, not by torch.load)"
+            )
     tmp = path + ".tmp"
     if format == "torch":
         save_torch_checkpoint(state, tmp)
@@ -112,11 +119,17 @@ def load_state_dict(path: str) -> dict[str, np.ndarray]:
     try:
         with np.load(path) as archive:
             return {k: archive[k] for k in archive.files}
-    except Exception:
-        # Legacy (pre-zip) torch.save pickles are neither npz nor torch-zip;
-        # torch.load still reads them.
+    except ValueError as not_npz:
+        # np.load raises ValueError for data that is not an npz archive
+        # (e.g. a legacy pre-zip torch.save pickle, which torch.load still
+        # reads).  Genuine I/O failures (missing file, permissions, corrupt
+        # zip member) propagate with their real cause instead of being
+        # retried through torch's unpickler.
         if have_torch():
-            return load_torch_checkpoint(path)
+            try:
+                return load_torch_checkpoint(path)
+            except Exception as torch_err:
+                raise torch_err from not_npz
         raise
 
 

@@ -12,9 +12,13 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
-# Keep any accelerator tunnel out of test subprocesses too.
+# Keep any accelerator tunnel out of test subprocesses too.  The popped
+# tunnel hook is stashed so the opt-in real-hardware tests
+# (tests/test_convergence.py) can restore it in THEIR subprocess env.
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+_tunnel = os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+if _tunnel is not None:
+    os.environ["_STASHED_PALLAS_AXON_POOL_IPS"] = _tunnel
 
 import jax
 

@@ -7,7 +7,13 @@ locally, 8 globally.  World formation goes through the real entry path —
 (SURVEY.md N1) — then a full ``fit()`` runs, and the worker dumps its
 final params + eval totals for the parent to cross-check.
 
-Usage: python tests/multihost_worker.py <data_root> <out_npz> <fused|batch>
+Usage: python tests/multihost_worker.py <data_root> <out_npz> <fused|batch|tp>
+
+``tp`` mode trains tensor-parallel over a (data=4, model=2) mesh that
+spans both processes — fc1/fc2 shards live on model-axis device pairs
+whose data rows split across the process boundary — exercising
+``tp.shard_state``'s multi-controller ``make_array_from_callback`` path
+and the cross-process logits psum.
 """
 
 import sys
@@ -35,8 +41,24 @@ def main() -> None:
         batch_size=8, test_batch_size=16, epochs=2, lr=1.0, gamma=0.7,
         seed=1, log_interval=4, dry_run=False, save_model=False,
         fused=(mode == "fused"), data_root=data_root,
+        tp=(2 if mode == "tp" else 1),
     )
     state = fit(args, dist)
+
+    if mode == "tp":
+        # Gather the model-axis shards to a replicated copy so every
+        # process can read its local value.
+        from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+        from pytorch_mnist_ddp_tpu.parallel.tp import gather_replicated
+
+        mesh = make_mesh(num_model=2, devices=jax.devices())
+        gathered = gather_replicated(state.params, mesh)
+        flat = model_state_dict(
+            jax.tree.map(lambda v: np.asarray(v), gathered)
+        )
+        np.savez(out_path, **flat)
+        print(f"worker rank {dist.process_rank} done", flush=True)
+        return
 
     # Re-run the distributed eval explicitly so EVERY process (not just the
     # chief) holds the psum'd totals to report.

@@ -42,6 +42,36 @@ def test_save_load_roundtrip(tmp_path):
         np.testing.assert_array_equal(loaded[k], np.asarray(sd[k]))
 
 
+def test_npz_fallback_prints_notice(tmp_path, monkeypatch, capsys):
+    """On a torch-less host, format='auto' under a .pt name announces the
+    npz fallback instead of silently writing an archive torch.load cannot
+    open (ADVICE r1)."""
+    from pytorch_mnist_ddp_tpu.utils import torch_interop
+
+    monkeypatch.setattr(torch_interop, "have_torch", lambda: False)
+    params = init_params(jax.random.PRNGKey(3))
+    path = str(tmp_path / "mnist_cnn.pt")
+    save_state_dict(model_state_dict(params), path)
+    out = capsys.readouterr().out
+    assert "npz" in out and "mnist_cnn.pt" in out
+    # and the file is still readable through our own load path
+    assert set(load_state_dict(path)) == set(model_state_dict(params))
+
+
+def test_corrupt_file_surfaces_real_error(tmp_path):
+    """A file that is neither npz nor torch-zip must raise an error naming
+    the actual cause, not be laundered through torch's unpickler
+    (ADVICE r1).  A truncated zip propagates its zipfile error."""
+    import pytest
+    import zipfile
+
+    path = str(tmp_path / "broken.pt")
+    with open(path, "wb") as f:
+        f.write(b"PK\x03\x04" + b"\x00" * 16)  # zip magic, garbage body
+    with pytest.raises((zipfile.BadZipFile, OSError, ValueError)):
+        load_state_dict(path)
+
+
 def test_params_from_state_dict_inverts(tmp_path):
     params = init_params(jax.random.PRNGKey(2))
     for prefix in (False, True):

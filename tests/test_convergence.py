@@ -1,0 +1,131 @@
+"""Convergence tests for the non-saturating synthetic task (VERDICT r1 #3).
+
+The v2 synthetic dataset (data/mnist.py) is tuned so the reference CNN's
+benchmark-config curve mirrors real MNIST: epoch-1 well under 97%, final
+accuracy in the 99-99.5% band, never a saturated 100% — so the >=99%
+target of BASELINE.json means something and a numerics regression that
+costs "only" the last 1% is visible.
+
+Two layers of evidence:
+
+- a CPU test on a small training subset (budget ~1 min on the 1-core CI
+  box): the curve must INCREASE substantially and stay sub-100%;
+- an accelerator test that drives ``bench.py`` end-to-end (full 60k x 20
+  epochs, the reference protocol, reference README.md:42) and asserts the
+  real thresholds: epoch-1 < 97%, final >= 99%, everything < 100%.  Skips
+  cleanly when no accelerator is reachable (bench emits its structured
+  failure JSON instead of hanging — the round-1 armor).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.data.loader import DataLoader
+from pytorch_mnist_ddp_tpu.data.mnist import synthetic_mnist
+from pytorch_mnist_ddp_tpu.models.net import init_params
+from pytorch_mnist_ddp_tpu.ops.schedule import step_lr
+from pytorch_mnist_ddp_tpu.parallel.ddp import (
+    make_eval_step,
+    make_train_state,
+    make_train_step,
+    replicate_params,
+)
+from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+
+ACC_RE = re.compile(r"Accuracy: (\d+)/(\d+)")
+
+
+def test_small_subset_curve_increases_sub100(devices):
+    """3k-sample subset, 5 epochs, per-batch path on the 8-device mesh:
+    the task must be learnable but NOT saturable — accuracy climbs well
+    above chance and stays strictly below 100%."""
+    train_n, test_n, batch, epochs = 3000, 2000, 200, 5
+    tr_i, tr_l = synthetic_mnist("train")
+    te_i, te_l = synthetic_mnist("test")
+    mesh = make_mesh(num_data=8, devices=devices)
+    train_loader = DataLoader(
+        tr_i[:train_n], tr_l[:train_n], batch, mesh=mesh, shuffle=True, seed=1
+    )
+    test_loader = DataLoader(te_i[:test_n], te_l[:test_n], 1000, mesh=mesh, shuffle=False)
+    state = replicate_params(make_train_state(init_params(jax.random.PRNGKey(1))), mesh)
+    step_fn = make_train_step(mesh)
+    eval_fn = make_eval_step(mesh)
+    lr_fn = step_lr(1.0, 0.7, step_size=1)
+    dropout_key = jax.random.PRNGKey(3)
+
+    accs = []
+    for epoch in range(1, epochs + 1):
+        for x, y, w in train_loader.epoch(epoch):
+            state, _ = step_fn(state, x, y, w, dropout_key, jnp.float32(lr_fn(epoch)))
+        correct = 0.0
+        for x, y, w in test_loader.epoch(0):
+            correct += float(np.asarray(eval_fn(state.params, x, y, w))[1])
+        accs.append(correct / test_n * 100)
+
+    assert all(a < 100.0 for a in accs), f"synthetic task saturated: {accs}"
+    assert accs[0] < 97.0, f"epoch-1 accuracy suspiciously high: {accs}"
+    # learnable: clear climb over 5 epochs (calibrated curve on this exact
+    # config: 38.1 48.2 64.3 68.4 74.6 — margins are wide on purpose)
+    assert accs[-1] > accs[0] + 15.0, f"no learning progress: {accs}"
+    assert accs[-1] > 55.0, f"final subset accuracy too low: {accs}"
+
+
+@pytest.mark.skipif(
+    "_STASHED_PALLAS_AXON_POOL_IPS" not in os.environ
+    and "PALLAS_AXON_POOL_IPS" not in os.environ,
+    reason="no accelerator tunnel configured on this host",
+)
+def test_full_benchmark_curve_on_accelerator():
+    """The real thresholds, on the real protocol, on real hardware:
+    ``bench.py`` (60k x 20 epochs, reference README.md:42) must report
+    epoch-1 < 97%%, final >= 99%%, and a sub-100%% curve throughout.
+
+    Runs bench.py exactly as the driver does, so it also validates the
+    armored probe/watchdog path mid-suite.  Skips (not fails) when the
+    accelerator is down — bench's structured failure JSON says why."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    stashed = env.pop("_STASHED_PALLAS_AXON_POOL_IPS", None)
+    if stashed is not None:
+        env["PALLAS_AXON_POOL_IPS"] = stashed
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"),
+             "--run-timeout", "420", "--probe-attempts", "1"],
+            capture_output=True, text=True, env=env, cwd=repo, timeout=500,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("bench.py did not finish within the test budget")
+    out_lines = proc.stdout.strip().splitlines()
+    if not out_lines:
+        pytest.skip(
+            f"bench.py died without output (rc={proc.returncode}): "
+            + "; ".join(proc.stderr.strip().splitlines()[-2:])
+        )
+    result = json.loads(out_lines[-1])
+    if result.get("error"):
+        pytest.skip(f"accelerator unavailable: {result['error']}")
+
+    assert result["final_test_accuracy"] >= 99.0, result
+    assert result["final_test_accuracy"] < 100.0, result
+    assert result["epoch1_test_accuracy"] < 97.0, result
+    # full per-epoch curve from the training log on stderr
+    curve = [
+        int(c) / int(n) * 100
+        for c, n in ACC_RE.findall(proc.stderr)
+    ]
+    assert len(curve) == 20, f"expected 20 epoch evals, got {len(curve)}"
+    assert all(a < 100.0 for a in curve), f"saturated mid-run: {curve}"
+    assert max(curve[10:]) >= 99.0, f"never reached 99% in epochs 11-20: {curve}"
