@@ -145,6 +145,9 @@ def main() -> None:
                    help="permit benchmarking on the CPU platform (never the "
                         "headline metric; off by default so a silent CPU "
                         "fallback can't masquerade as a TPU number)")
+    p.add_argument("--bf16", action="store_true",
+                   help="benchmark the bfloat16 compute path (recorded in "
+                        "the JSON; the default headline stays fp32)")
     p.add_argument("--probe-attempts", type=int, default=None,
                    help="cap backend-probe attempts (default: full "
                         f"{1 + len(PROBE_BACKOFFS_S)}-attempt schedule, "
@@ -211,6 +214,7 @@ def main() -> None:
         dry_run=False,
         save_model=False,
         fused=True,
+        bf16=args.bf16,
         data_root="./data",
     )
     if len(devices) > 1:
@@ -259,7 +263,11 @@ def main() -> None:
         ),
         "n_chips": len(devices),
         "prng_impl": prng_impl,
+        "compute_dtype": "bfloat16" if args.bf16 else "float32",
         "cache": cache_state,
+        # "idx" (real MNIST files) or "synthetic" (air-gapped fallback):
+        # says which task produced the accuracy fields below.
+        "dataset": timings.get("dataset", "unknown"),
     }
     if "run_s" in timings:
         # Fraction of the wall clock executing the compiled training run;

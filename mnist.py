@@ -42,6 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pallas-opt", action="store_true", default=False,
                    help="use the fused Pallas Adadelta kernel for the "
                         "optimizer update (ops/pallas_adadelta.py)")
+    p.add_argument("--bf16", action="store_true", default=False,
+                   help="bfloat16 activations/matmuls (MXU-native width; "
+                        "params, optimizer state, and log_softmax/NLL stay "
+                        "fp32)")
     p.add_argument("--data-root", type=str, default="./data",
                    help="MNIST IDX directory")
     p.add_argument("--profile", type=str, default=None, metavar="DIR",

@@ -237,14 +237,18 @@ def load_mnist_arrays(
     split: str = "train",
     download: bool = True,
     allow_synthetic: bool = True,
-) -> tuple[np.ndarray, np.ndarray]:
-    """Return ``(images uint8 [N,28,28], labels uint8 [N])`` for a split.
+    return_source: bool = False,
+):
+    """Return ``(images uint8 [N,28,28], labels uint8 [N])`` for a split
+    (plus the provenance string ``"idx"`` | ``"synthetic"`` when
+    ``return_source``).
 
     Resolution order: ``$MNIST_DATA_DIR`` / ``root`` IDX files -> download
     (when allowed) -> deterministic synthetic fallback.
     """
     root = os.environ.get("MNIST_DATA_DIR", root)
     arrays = {}
+    source = "idx"
     for kind in ("images", "labels"):
         filename = _FILES[(split, kind)]
         raw = _read_maybe_gz(os.path.join(root, filename))
@@ -262,17 +266,21 @@ def load_mnist_arrays(
                     "failed); using deterministic synthetic MNIST-like data"
                 )
                 _synthetic_notice_printed = True
-            return _synthetic_cached(split)
+            images, labels = _synthetic_cached(split)
+            return (images, labels, "synthetic") if return_source else (images, labels)
         arrays[kind] = parse_idx(raw)
     images, labels = arrays["images"], arrays["labels"]
     if len(images) != len(labels):
         raise ValueError("image/label count mismatch")
-    return images, labels
+    return (images, labels, source) if return_source else (images, labels)
 
 
 class MNIST:
     """Dataset object: raw uint8 arrays + length; transforms happen at batch
-    time in the loader (vectorized, not per-sample like torchvision)."""
+    time in the loader (vectorized, not per-sample like torchvision).
+    ``source`` records provenance: ``"idx"`` (real files) or
+    ``"synthetic"`` (air-gapped fallback) — surfaced in bench.py's JSON so
+    recorded accuracy numbers say which task produced them."""
 
     def __init__(
         self,
@@ -281,8 +289,9 @@ class MNIST:
         download: bool = True,
         allow_synthetic: bool = True,
     ) -> None:
-        self.images, self.labels = load_mnist_arrays(
-            root, "train" if train else "test", download, allow_synthetic
+        self.images, self.labels, self.source = load_mnist_arrays(
+            root, "train" if train else "test", download, allow_synthetic,
+            return_source=True,
         )
 
     def __len__(self) -> int:

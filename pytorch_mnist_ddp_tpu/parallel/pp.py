@@ -133,29 +133,29 @@ def make_pp_train_step(
         def tick(carry, t):
             in_flight = carry  # activation that arrived at this device
 
-            # stage 0 forwards microbatch t (idle on its last tick)
+            # stage 0 forwards microbatch t; the activity test lives in the
+            # cond PREDICATE, so idle ticks take the zeros branch for free
+            # (the cond is never transposed — custom_vjp below — so this
+            # costs nothing in AD).
             t0 = jnp.clip(t, 0, num_micro - 1)
             x_mb = jax.lax.dynamic_index_in_dim(x_mbs, t0, keepdims=False)
             k0, _ = _mb_keys(key, t0)
             out = jax.lax.cond(
-                stage == 0,
-                lambda: _stage0_fwd(params, x_mb, k0, dropout)
-                * (t < num_micro).astype(x_mb.dtype),
+                jnp.logical_and(stage == 0, t < num_micro),
+                lambda: _stage0_fwd(params, x_mb, k0, dropout),
                 lambda: jnp.zeros((mb, _FLAT), x_mb.dtype),
             )
 
-            # stage 1 consumes the block sent at tick t-1 (idle at t=0);
-            # the idle tick's weights are zeroed so its loss part is 0.
+            # stage 1 consumes the block sent at tick t-1 (idle at t=0
+            # takes the zero branch).
             t1 = jnp.clip(t - 1, 0, num_micro - 1)
             y_mb = jax.lax.dynamic_index_in_dim(y_mbs, t1, keepdims=False)
             w_mb = jax.lax.dynamic_index_in_dim(w_mbs, t1, keepdims=False)
             _, k1 = _mb_keys(key, t1)
-            on1 = jnp.logical_and(stage == 1, t >= 1)
             part = jax.lax.cond(
-                stage == 1,
+                jnp.logical_and(stage == 1, t >= 1),
                 lambda: _stage1_loss_sum(
-                    params, in_flight, y_mb,
-                    w_mb * on1.astype(w_mb.dtype), k1, dropout,
+                    params, in_flight, y_mb, w_mb, k1, dropout
                 ),
                 lambda: jnp.float32(0.0),
             )
@@ -194,6 +194,7 @@ def make_pp_train_step(
 
         def tick(carry, s):
             g_act_in, acc = carry
+            zero_ga = jnp.zeros((mb, _FLAT), x_mbs.dtype)
 
             def s1_body():
                 # stage 1: microbatch j arrived at forward tick j+1
@@ -207,9 +208,7 @@ def make_pp_train_step(
                     params, act,
                 )
                 gp, ga = vjp(g)
-                active = (s < num_micro).astype(jnp.float32)
-                gp = jax.tree.map(lambda t: t * active, gp)
-                return gp, ga * active
+                return gp, ga
 
             def s0_body():
                 # stage 0: the cotangent arriving at tick s is for the
@@ -221,11 +220,20 @@ def make_pp_train_step(
                     lambda p: _stage0_fwd(p, x_mb, k0, dropout), params
                 )
                 gp, = vjp(g_act_in)
-                active = (s >= 1).astype(jnp.float32)
-                gp = jax.tree.map(lambda t: t * active, gp)
-                return gp, jnp.zeros((mb, _FLAT), x_mbs.dtype)
+                return gp, zero_ga
 
-            gp, ga = jax.lax.cond(stage == 1, s1_body, s0_body)
+            def idle():
+                return zero_grads, zero_ga
+
+            # Activity in the PREDICATES: each device's idle tick takes the
+            # free zeros branch instead of computing-then-masking.
+            gp, ga = jax.lax.cond(
+                jnp.logical_and(stage == 1, s < num_micro),
+                s1_body,
+                lambda: jax.lax.cond(
+                    jnp.logical_and(stage == 0, s >= 1), s0_body, idle
+                ),
+            )
             acc = jax.tree.map(jnp.add, acc, gp)
             moved = jax.lax.ppermute(ga, STAGE_AXIS, ring_rev)
             return (moved, acc), None

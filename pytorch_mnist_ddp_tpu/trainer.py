@@ -153,6 +153,10 @@ def _fit_body(
     num_model = tp_degree if tp_degree > 1 else (2 if pp_on else 1)
     if num_model > 1 and bool(getattr(args, "fused", False)):
         raise ValueError("--fused is data-parallel only; drop it for --tp/--pp")
+    if num_model > 1 and bool(getattr(args, "pallas_opt", False)):
+        raise ValueError(
+            "--pallas-opt is implemented for the DP paths; drop --tp/--pp"
+        )
     if num_model > 1 and not dist.distributed:
         raise ValueError("--tp/--pp need a multi-device mesh (use the launcher)")
 
@@ -167,6 +171,8 @@ def _fit_body(
 
     train_set = MNIST(root=getattr(args, "data_root", "./data"), train=True)
     test_set = MNIST(root=getattr(args, "data_root", "./data"), train=False)
+    if timings is not None:
+        timings["dataset"] = train_set.source
 
     keys = split_streams(root_key(args.seed))
 
@@ -179,11 +185,29 @@ def _fit_body(
     # dry-run stays on the per-batch loop (it IS the per-batch smoke test).
     fused = bool(getattr(args, "fused", False)) and not args.dry_run
     use_pallas = bool(getattr(args, "pallas_opt", False))
+    # --bf16: activations/matmuls at the MXU's native width; params, the
+    # Adadelta state, and the log_softmax/NLL tail stay fp32 (models/net.py).
+    compute_dtype = jnp.bfloat16 if getattr(args, "bf16", False) else jnp.float32
+    if num_model > 1 and compute_dtype != jnp.float32:
+        raise ValueError("--bf16 is implemented for the DP paths; drop --tp/--pp")
 
     if fused:
         import time as _time
 
         from .parallel.fused import device_put_dataset, make_fused_run
+
+        if mesh.devices.flat[0].platform == "cpu" and len(train_set) > 10000:
+            # XLA:CPU emits poor code for convs inside the scan bodies the
+            # fused path is built from (~25x the eager per-step cost at
+            # benchmark shapes); the per-batch path has no such cliff.
+            import sys as _sys
+
+            print(
+                "warning: --fused on the CPU backend is much slower than "
+                "the per-batch path at this dataset size (XLA:CPU lowers "
+                "convolutions inside scan bodies poorly); drop --fused",
+                file=_sys.stderr,
+            )
 
         _t0 = _time.perf_counter()
         tr_x, tr_y = device_put_dataset(train_set.images, train_set.labels, mesh)
@@ -197,7 +221,8 @@ def _fit_body(
         # result is bit-identical to the per-epoch path).
         run_fn, num_batches = make_fused_run(
             mesh, len(train_set), len(test_set), global_batch, eval_batch,
-            args.epochs, use_pallas=use_pallas, from_key=True,
+            args.epochs, compute_dtype=compute_dtype, use_pallas=use_pallas,
+            from_key=True,
         )
         # Host-computed StepLR values: bit-identical to the per-epoch paths.
         lrs = jnp.asarray(
@@ -292,8 +317,10 @@ def _fit_body(
             )
             eval_fn = make_eval_step(mesh)
         else:
-            step_fn = make_train_step(mesh, use_pallas=use_pallas)
-            eval_fn = make_eval_step(mesh)
+            step_fn = make_train_step(
+                mesh, compute_dtype=compute_dtype, use_pallas=use_pallas
+            )
+            eval_fn = make_eval_step(mesh, compute_dtype=compute_dtype)
         want_stats = bool(getattr(args, "step_stats", False))
         for epoch in range(1, args.epochs + 1):
             stats = StepStats() if want_stats else None

@@ -7,13 +7,16 @@ locally, 8 globally.  World formation goes through the real entry path —
 (SURVEY.md N1) — then a full ``fit()`` runs, and the worker dumps its
 final params + eval totals for the parent to cross-check.
 
-Usage: python tests/multihost_worker.py <data_root> <out_npz> <fused|batch|tp>
+Usage: python tests/multihost_worker.py <data_root> <out_npz> <fused|batch|tp|pp>
 
 ``tp`` mode trains tensor-parallel over a (data=4, model=2) mesh that
 spans both processes — fc1/fc2 shards live on model-axis device pairs
 whose data rows split across the process boundary — exercising
 ``tp.shard_state``'s multi-controller ``make_array_from_callback`` path
-and the cross-process logits psum.
+and the cross-process logits psum.  ``pp`` mode pipelines the two stages
+over the same mesh, driving the per-tick activation/cotangent
+``ppermute`` and the stage-axis gradient psum across the process
+boundary.
 """
 
 import sys
@@ -41,13 +44,13 @@ def main() -> None:
         batch_size=8, test_batch_size=16, epochs=2, lr=1.0, gamma=0.7,
         seed=1, log_interval=4, dry_run=False, save_model=False,
         fused=(mode == "fused"), data_root=data_root,
-        tp=(2 if mode == "tp" else 1),
+        tp=(2 if mode == "tp" else 1), pp=(mode == "pp"),
     )
     state = fit(args, dist)
 
-    if mode == "tp":
-        # Gather the model-axis shards to a replicated copy so every
-        # process can read its local value.
+    if mode in ("tp", "pp"):
+        # Gather (tp: model-axis shards; pp: already replicated — the
+        # gather is an identity) so every process reads its local value.
         from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
         from pytorch_mnist_ddp_tpu.parallel.tp import gather_replicated
 

@@ -96,12 +96,33 @@ def test_fit_fused_populates_timings(tmp_path, capsys, devices):
     fit(args, dist, timings=timings)
     capsys.readouterr()
     assert set(timings) == {
-        "data_s", "compile_s", "run_s",
+        "data_s", "compile_s", "run_s", "dataset",
         "epoch1_test_accuracy", "final_test_accuracy",
     }
+    assert timings.pop("dataset") == "idx"  # _write_idx provides real files
     assert timings["data_s"] > 0 and timings["compile_s"] > 0
     assert timings["run_s"] > 0
     assert 0.0 <= timings["final_test_accuracy"] <= 1.0
+
+
+def test_fit_bf16_trains(tmp_path, capsys, devices):
+    """--bf16 end-to-end: the per-batch DP path trains in bfloat16 compute
+    (fp32 params/opt state) and produces sane printed output."""
+    root = _write_idx(tmp_path)
+    args = _args(root, batch_size=8, bf16=True, epochs=3)
+    dist = DistState(
+        distributed=True, process_rank=0, process_count=1,
+        world_size=8, devices=list(devices),
+    )
+    fit(args, dist)
+    out = capsys.readouterr().out
+    train_lines = [l for l in out.splitlines() if TRAIN_RE.match(l)]
+    assert len(train_lines) >= 6, out
+    losses = [float(l.rsplit(" ", 1)[-1]) for l in train_lines]
+    assert all(np.isfinite(losses))
+    # learning trend, windowed (per-step logged losses are noisy at 8
+    # steps/epoch on the deliberately-hard v2 synthetic task)
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
 
 
 def test_dry_run_single_batch(tmp_path, capsys):

@@ -18,6 +18,22 @@ def test_output_shape_and_log_softmax():
     np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0, rtol=1e-5)
 
 
+def test_bf16_compute_close_to_f32():
+    """--bf16 runs the matmuls/convs in bfloat16 with fp32 params and an
+    fp32 log_softmax tail: predictions match fp32 and log-probs agree to
+    bf16 tolerance."""
+    params = init_params(jax.random.PRNGKey(0))
+    x = jnp.asarray(
+        np.random.RandomState(0).standard_normal((16, 28, 28, 1)), jnp.float32
+    )
+    out32 = Net().apply({"params": params}, x, train=False)
+    out16 = Net(compute_dtype=jnp.bfloat16).apply({"params": params}, x, train=False)
+    assert out16.dtype == jnp.float32  # fp32 tail regardless of compute dtype
+    np.testing.assert_allclose(np.asarray(out16), np.asarray(out32), atol=0.15)
+    agree = (np.argmax(np.asarray(out16), 1) == np.argmax(np.asarray(out32), 1))
+    assert agree.mean() >= 0.9
+
+
 def test_param_count():
     """320 + 18,496 + 1,179,776 + 1,290 = 1,199,882 params — the ~1.2M of
     the reference Net (SURVEY.md §2a #3)."""

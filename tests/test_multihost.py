@@ -80,12 +80,14 @@ def _run_world(tmp_path, mode: str) -> list[dict]:
     return results
 
 
-@pytest.mark.parametrize("mode", ["batch", "fused", "tp"])
+@pytest.mark.parametrize("mode", ["batch", "fused", "tp", "pp"])
 def test_two_process_world_replica_consistency(tmp_path, mode):
     """batch/fused: pure DP replica consistency.  tp: the (data=4, model=2)
     mesh spans the process boundary — multi-controller shard placement,
     cross-process logits psum, and the gathered params must still be
-    identical on both processes."""
+    identical on both processes.  pp: the same mesh pipelined — per-tick
+    activation/cotangent ppermute and the stage-axis grad psum cross the
+    process boundary."""
     r0, r1, logs = _run_world(tmp_path, mode)
     # Replica/shard consistency: both processes hold bit-identical params.
     param_keys = [k for k in r0 if k not in ("avg_loss", "correct")]
@@ -93,7 +95,7 @@ def test_two_process_world_replica_consistency(tmp_path, mode):
     for k in param_keys:
         np.testing.assert_array_equal(r0[k], r1[k], err_msg=k)
     assert r0["fc1.weight"].shape == (9216, 128)  # full gathered tensor
-    if mode != "tp":
+    if mode not in ("tp", "pp"):
         # psum correctness: identical global eval totals on every process.
         assert r0["correct"] == r1["correct"]
         np.testing.assert_allclose(r0["avg_loss"], r1["avg_loss"], rtol=1e-6)

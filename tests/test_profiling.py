@@ -58,12 +58,13 @@ def test_fit_with_profile_and_step_stats(tmp_path, capsys):
 
     orig = M.load_mnist_arrays
 
-    def tiny(root="./data", split="train", *a, **kw):
+    def tiny(root="./data", split="train", *a, return_source=False, **kw):
         n = 64 if split == "train" else 32
-        return (
+        arrays = (
             rng.randint(0, 256, (n, 28, 28), np.uint8).copy(),
             rng.randint(0, 10, n).astype(np.uint8),
         )
+        return (*arrays, "idx") if return_source else arrays
 
     M.load_mnist_arrays = tiny
     try:
