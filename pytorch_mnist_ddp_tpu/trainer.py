@@ -157,6 +157,8 @@ def _fit_body(
         raise ValueError(
             "--pallas-opt is implemented for the DP paths; drop --tp/--pp"
         )
+    if num_model > 1 and bool(getattr(args, "bf16", False)):
+        raise ValueError("--bf16 is implemented for the DP paths; drop --tp/--pp")
     if num_model > 1 and not dist.distributed:
         raise ValueError("--tp/--pp need a multi-device mesh (use the launcher)")
 
@@ -187,16 +189,20 @@ def _fit_body(
     use_pallas = bool(getattr(args, "pallas_opt", False))
     # --bf16: activations/matmuls at the MXU's native width; params, the
     # Adadelta state, and the log_softmax/NLL tail stay fp32 (models/net.py).
+    # (Incompatibility with --tp/--pp is rejected up top with the other
+    # flag checks, before any dataset work.)
     compute_dtype = jnp.bfloat16 if getattr(args, "bf16", False) else jnp.float32
-    if num_model > 1 and compute_dtype != jnp.float32:
-        raise ValueError("--bf16 is implemented for the DP paths; drop --tp/--pp")
 
     if fused:
         import time as _time
 
         from .parallel.fused import device_put_dataset, make_fused_run
 
-        if mesh.devices.flat[0].platform == "cpu" and len(train_set) > 10000:
+        if (
+            dist.is_chief
+            and mesh.devices.flat[0].platform == "cpu"
+            and len(train_set) > 10000
+        ):
             # XLA:CPU emits poor code for convs inside the scan bodies the
             # fused path is built from (~25x the eager per-step cost at
             # benchmark shapes); the per-batch path has no such cliff.
@@ -212,9 +218,11 @@ def _fit_body(
         _t0 = _time.perf_counter()
         tr_x, tr_y = device_put_dataset(train_set.images, train_set.labels, mesh)
         te_x, te_y = device_put_dataset(test_set.images, test_set.labels, mesh)
-        if timings is not None:
-            jax.block_until_ready((tr_x, te_x))
-            timings["data_s"] = _time.perf_counter() - _t0
+        # device_put is async: the H2D transfer proceeds while the program
+        # below is loaded/compiled, so no block here — data_s is the
+        # dispatch cost plus whatever transfer tail the compile didn't hide
+        # (measured after compile).
+        _data_dispatch = _time.perf_counter() - _t0
         # from_key: param init happens inside the compiled run — a cold
         # process reaches the hot loop in ONE device dispatch, with no
         # separate init program (same RNG stream as init_params, so the
@@ -239,6 +247,9 @@ def _fit_body(
             _t1 = _time.perf_counter()
             compiled = run_fn.lower(*run_args).compile()
             timings["compile_s"] = _time.perf_counter() - _t1
+            _t1 = _time.perf_counter()
+            jax.block_until_ready((tr_x, te_x))  # transfer tail, if any
+            timings["data_s"] = _data_dispatch + _time.perf_counter() - _t1
             _t1 = _time.perf_counter()
             state, losses, evals = compiled(*run_args)
             jax.block_until_ready((losses, evals))

@@ -48,29 +48,23 @@ def main() -> None:
     )
     state = fit(args, dist)
 
-    if mode in ("tp", "pp"):
-        # Gather (tp: model-axis shards; pp: already replicated — the
-        # gather is an identity) so every process reads its local value.
-        from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
-        from pytorch_mnist_ddp_tpu.parallel.tp import gather_replicated
-
-        mesh = make_mesh(num_model=2, devices=jax.devices())
-        gathered = gather_replicated(state.params, mesh)
-        flat = model_state_dict(
-            jax.tree.map(lambda v: np.asarray(v), gathered)
-        )
-        np.savez(out_path, **flat)
-        print(f"worker rank {dist.process_rank} done", flush=True)
-        return
-
     # Re-run the distributed eval explicitly so EVERY process (not just the
-    # chief) holds the psum'd totals to report.
+    # chief) holds the psum'd totals to report.  tp/pp evaluate over the
+    # same (data=4, model=2) mesh they trained on; tp's model-axis shards
+    # are gathered to a replicated copy first (identity for pp), after
+    # which the standard DP eval applies — each model column computes the
+    # same local sums and the psum runs over data only.
     from pytorch_mnist_ddp_tpu.data.loader import DataLoader
     from pytorch_mnist_ddp_tpu.data.mnist import MNIST
     from pytorch_mnist_ddp_tpu.parallel.ddp import make_eval_step
     from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+    from pytorch_mnist_ddp_tpu.parallel.tp import gather_replicated
 
-    mesh = make_mesh(devices=jax.devices())
+    model_axis = 2 if mode in ("tp", "pp") else 1
+    mesh = make_mesh(num_model=model_axis, devices=jax.devices())
+    params = state.params
+    if mode in ("tp", "pp"):
+        params = gather_replicated(params, mesh)
     test_set = MNIST(root=data_root, train=False)
     loader = DataLoader(
         test_set.images, test_set.labels, 16, mesh=mesh, shuffle=False,
@@ -78,10 +72,10 @@ def main() -> None:
         mask_padding=True,
     )
     avg_loss, correct = evaluate(
-        make_eval_step(mesh), state.params, loader, dist
+        make_eval_step(mesh), params, loader, dist
     )
 
-    flat = model_state_dict(jax.device_get(state.params))
+    flat = model_state_dict(jax.tree.map(lambda v: np.asarray(v), params))
     np.savez(
         out_path,
         avg_loss=np.float64(avg_loss),

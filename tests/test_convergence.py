@@ -121,9 +121,11 @@ def test_full_benchmark_curve_on_accelerator():
     assert result["final_test_accuracy"] >= 99.0, result
     assert result["final_test_accuracy"] < 100.0, result
     if result.get("dataset") == "synthetic":
-        # the tuned v2 curve; real MNIST's epoch-1 lands ~98% so the
-        # threshold only applies to the synthetic task
+        # the tuned v2 curve; real MNIST's epoch-1 lands ~98%
         assert result["epoch1_test_accuracy"] < 97.0, result
+    else:
+        # degenerate-curve catch for real MNIST (e.g. eval on train data)
+        assert result["epoch1_test_accuracy"] < 99.5, result
     # full per-epoch curve from the training log on stderr
     curve = [
         int(c) / int(n) * 100

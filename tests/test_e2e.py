@@ -125,6 +125,24 @@ def test_fit_bf16_trains(tmp_path, capsys, devices):
     assert np.mean(losses[-3:]) < np.mean(losses[:3])
 
 
+def test_fused_save_model_checkpoint(tmp_path, capsys, devices):
+    """--fused --save-model: the fused run's final params save and load
+    like the per-batch path's."""
+    root = _write_idx(tmp_path)
+    args = _args(root, batch_size=8, fused=True, save_model=True,
+                 log_interval=10_000_000)
+    dist = DistState(
+        distributed=True, process_rank=0, process_count=1,
+        world_size=8, devices=list(devices),
+    )
+    path = str(tmp_path / "mnist_cnn.pt")
+    fit(args, dist, save_path=path)
+    capsys.readouterr()
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import load_state_dict
+    sd = load_state_dict(path)
+    assert all(k.startswith("module.") for k in sd)  # distributed-mode quirk
+
+
 def test_dry_run_single_batch(tmp_path, capsys):
     root = _write_idx(tmp_path)
     args = _args(root, dry_run=True, epochs=1)
