@@ -51,19 +51,39 @@ PROBE_BACKOFFS_S = (5, 15, 30, 60)
 # thread-local), and the failure JSON must still reach the driver's stdout.
 _REAL_STDOUT = sys.stdout
 
+# Every successful run snapshots its JSON here; failure JSONs embed it as
+# "last_known_good" so a dead accelerator tunnel at recording time (a
+# recurring failure mode of this host) still surfaces the most recent real
+# measurement — clearly labeled as historical, never as the run's value.
+LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_last_good.json"
+)
+
+
+def _read_last_good() -> dict | None:
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
 
 def _fail(metric: str, reason: str, exit_code: int, hard: bool = False) -> None:
     """Emit the structured failure JSON on the real stdout and exit.
 
     ``hard`` uses os._exit so a hung backend thread cannot block the
     interpreter's normal shutdown path."""
-    print(json.dumps({
+    payload = {
         "metric": metric,
         "value": None,
         "unit": "s",
         "vs_baseline": None,
         "error": reason,
-    }), file=_REAL_STDOUT, flush=True)
+    }
+    last_good = _read_last_good()
+    if last_good is not None:
+        payload["last_known_good"] = last_good
+    print(json.dumps(payload), file=_REAL_STDOUT, flush=True)
     if hard:
         os._exit(exit_code)
     sys.exit(exit_code)
@@ -96,6 +116,14 @@ def _probe_backend_once() -> tuple[bool, str]:
     return True, proc.stdout.strip()
 
 
+def _probe_schedule(attempts: int | None) -> tuple[int, ...]:
+    """Backoff schedule, capped to ``attempts`` probes (0 still probes once)."""
+    schedule = (0,) + PROBE_BACKOFFS_S
+    if attempts is not None:
+        schedule = schedule[: max(attempts, 1)]
+    return schedule
+
+
 def _acquire_backend(metric: str, allow_cpu: bool, attempts: int | None = None) -> None:
     """Probe until the accelerator answers, with backoff; on exhaustion emit
     the failure JSON and exit (never raise a raw traceback to the driver).
@@ -107,10 +135,7 @@ def _acquire_backend(metric: str, allow_cpu: bool, attempts: int | None = None) 
     e.g. the in-suite convergence test, want one quick probe, not the
     driver's ~5-minute patience)."""
     errors = []
-    schedule = (0,) + PROBE_BACKOFFS_S
-    if attempts is not None:
-        schedule = schedule[: max(attempts, 1)]  # 0 still probes once
-    for i, backoff in enumerate(schedule):
+    for i, backoff in enumerate(_probe_schedule(attempts)):
         if backoff:
             print(f"bench: backend unavailable, retry in {backoff}s "
                   f"({errors[-1]})", file=sys.stderr, flush=True)
@@ -287,6 +312,30 @@ def main() -> None:
         result["epoch1_test_accuracy"] = round(
             timings["epoch1_test_accuracy"] * 100, 2
         )
+    # Snapshot for the last-known-good fallback (full headline config only:
+    # a --quick/--allow-cpu/--bf16 run must not overwrite the real number).
+    # The snapshot is self-describing (carries its "dataset" field), but a
+    # synthetic-task run never replaces a real-MNIST record.
+    prev = _read_last_good()
+    if (
+        not args.quick
+        and not args.allow_cpu
+        and not args.bf16
+        and args.epochs == 20
+        and args.batch_size == 200
+        and not (
+            prev is not None
+            and prev.get("dataset") == "idx"
+            and result.get("dataset") != "idx"
+        )
+    ):
+        try:
+            snap = dict(result, recorded_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+            with open(LAST_GOOD_PATH + ".tmp", "w") as f:
+                json.dump(snap, f)
+            os.replace(LAST_GOOD_PATH + ".tmp", LAST_GOOD_PATH)
+        except OSError:
+            pass
     print(json.dumps(result))
 
 

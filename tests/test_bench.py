@@ -1,0 +1,61 @@
+"""Unit tests for bench.py's resilience plumbing (the parts that exist
+because round-1 recorded nothing when the accelerator tunnel died)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def test_fail_embeds_last_known_good(tmp_path, capsys, monkeypatch):
+    """A failure JSON carries the most recent successful measurement,
+    labeled as historical — a dead tunnel at recording time must not
+    erase the round's real number."""
+    snap = {"metric": "mnist_20epoch_wall_clock", "value": 8.6,
+            "vs_baseline": 8.558, "recorded_at": "2026-07-30T00:00:00Z"}
+    path = str(tmp_path / "last_good.json")
+    with open(path, "w") as f:
+        json.dump(snap, f)
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", path)
+    monkeypatch.setattr(bench, "_REAL_STDOUT", sys.stdout)
+    with pytest.raises(SystemExit):
+        bench._fail("mnist_20epoch_wall_clock", "backend unavailable", 1)
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] is None and "backend unavailable" in out["error"]
+    assert out["last_known_good"]["value"] == 8.6
+    assert out["last_known_good"]["recorded_at"] == "2026-07-30T00:00:00Z"
+
+
+def test_fail_without_snapshot_has_no_last_good(tmp_path, capsys, monkeypatch):
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "missing.json"))
+    monkeypatch.setattr(bench, "_REAL_STDOUT", sys.stdout)
+    with pytest.raises(SystemExit):
+        bench._fail("m", "down", 1)
+    out = json.loads(capsys.readouterr().out.strip())
+    assert "last_known_good" not in out
+
+
+def test_corrupt_snapshot_is_ignored(tmp_path, capsys, monkeypatch):
+    path = str(tmp_path / "bad.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", path)
+    monkeypatch.setattr(bench, "_REAL_STDOUT", sys.stdout)
+    with pytest.raises(SystemExit):
+        bench._fail("m", "down", 1)
+    out = json.loads(capsys.readouterr().out.strip())
+    assert "last_known_good" not in out
+
+
+def test_probe_schedule_capping():
+    """--probe-attempts slices the schedule; 0 still probes once (a caller
+    asking for 'no patience' gets one quick probe, not the full ~5 min)."""
+    assert bench._probe_schedule(None) == (0,) + bench.PROBE_BACKOFFS_S
+    assert bench._probe_schedule(1) == (0,)
+    assert bench._probe_schedule(0) == (0,)
+    assert bench._probe_schedule(2) == (0, bench.PROBE_BACKOFFS_S[0])
