@@ -128,8 +128,10 @@ def fit(
     When ``timings`` is a dict, the fused path records wall-clock
     attribution into it: ``data_s`` (device_put + sharding of the already-
     loaded dataset arrays), ``compile_s`` (trace + compile, or persistent-
-    cache load, of the fused program), and ``run_s`` (pure execution of the
-    compiled multi-epoch run, blocked to completion) — the host-vs-device
+    cache load, of the fused program), and ``run_s`` (execution of the
+    compiled multi-epoch run through to host-materialized loss/eval
+    outputs — D2H included, because through the remote-accelerator tunnel
+    ``block_until_ready`` alone can return early) — the host-vs-device
     split bench.py reports.  Both paths also record
     ``epoch1_test_accuracy`` / ``final_test_accuracy`` (fractions), so the
     recorded benchmark carries the >=99% accuracy target of BASELINE.json
@@ -252,21 +254,28 @@ def _fit_body(
             timings["data_s"] = _data_dispatch + _time.perf_counter() - _t1
             _t1 = _time.perf_counter()
             state, losses, evals = compiled(*run_args)
-            jax.block_until_ready((losses, evals))
-            timings["run_s"] = _time.perf_counter() - _t1
-        else:
-            state, losses, evals = run_fn(*run_args)
-        if timings is not None:
+            # Materialize the outputs on host INSIDE the timed window:
+            # through the remote-accelerator tunnel, block_until_ready can
+            # return while device work is still in flight, which would park
+            # the whole run's device time in whichever later call first
+            # touches the values (measured round 2: run_s ~0 with ~6 s
+            # landing in the chief's print section).  A D2H read cannot
+            # return early, so run_s is dispatch -> host-visible results.
+            losses_np = np.asarray(losses)
             evals_np = np.asarray(evals)
+            timings["run_s"] = _time.perf_counter() - _t1
             timings["epoch1_test_accuracy"] = float(evals_np[0, 1]) / len(test_set)
             timings["final_test_accuracy"] = float(evals_np[-1, 1]) / len(test_set)
+        else:
+            state, losses, evals = run_fn(*run_args)
+            losses_np = evals_np = None
         if dist.is_chief:
             # One transfer for the whole run, then the reference's exact
             # interleaved output — train lines + test summary per epoch.
             # (np.asarray reads replicated outputs locally; slicing happens
             # on host so no chief-only device program is enqueued.)
-            losses_host = np.asarray(losses)[:, :, 0]
-            evals_host = np.asarray(evals)
+            losses_host = (np.asarray(losses) if losses_np is None else losses_np)[:, :, 0]
+            evals_host = np.asarray(evals) if evals_np is None else evals_np
             for epoch in range(1, args.epochs + 1):
                 for batch_idx in range(0, num_batches, args.log_interval):
                     samples = dist.world_size * batch_idx * args.batch_size

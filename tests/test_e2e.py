@@ -85,7 +85,8 @@ def test_fit_distributed_mesh(tmp_path, capsys, devices):
 
 def test_fit_fused_populates_timings(tmp_path, capsys, devices):
     """bench.py's host-vs-device attribution: the fused path must record
-    data_s (dataset load + device_put) and run_s (compiled run, blocked)."""
+    data_s (dataset load + device_put) and run_s (compiled run through to
+    host-materialized outputs)."""
     root = _write_idx(tmp_path)
     args = _args(root, batch_size=8, fused=True, log_interval=10_000_000)
     dist = DistState(
