@@ -299,6 +299,7 @@ def main() -> None:
         # compile_s (trace+compile or cache load) and data_s (device_put)
         # cover the rest, so a regression is attributable at a glance.
         result["device_run_share"] = round(timings["run_s"] / elapsed, 3)
+        result["run_s"] = round(timings["run_s"], 2)
         result["compile_s"] = round(timings.get("compile_s", 0.0), 2)
         result["data_s"] = round(timings.get("data_s", 0.0), 2)
     if "final_test_accuracy" in timings:

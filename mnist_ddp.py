@@ -45,6 +45,10 @@ def main() -> None:
                         "a 2-wide model axis with microbatched ppermute")
     p.add_argument("--pp-microbatches", type=int, default=2, metavar="M",
                    help="microbatches per shard batch in --pp mode")
+    p.add_argument("--syncbn", action="store_true",
+                   help="add BatchNorm after each conv with batch statistics "
+                        "synced across the data axis (torch.nn.SyncBatchNorm "
+                        "semantics; the scaled-batch config of BASELINE.json)")
     args = p.parse_args()
 
     import jax

@@ -30,6 +30,7 @@ import functools
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def torch_reset_uniform(gain: float = 1.0) -> nn.initializers.Initializer:
@@ -66,34 +67,158 @@ DROPOUT1_RATE = 0.25
 DROPOUT2_RATE = 0.5
 
 
-class Net(nn.Module):
-    """2-conv MNIST CNN.  Input: ``[N, 28, 28, 1]`` float32/bfloat16.
-    Output: ``[N, 10]`` float32 log-probabilities."""
+# torch.nn.BatchNorm2d defaults (SyncBatchNorm inherits them): eps=1e-5,
+# momentum=0.1 (torch's momentum weights the NEW batch statistic).
+BN_EPS = 1e-5
+BN_TORCH_MOMENTUM = 0.1
 
+
+class SyncBatchNorm(nn.Module):
+    """Cross-replica BatchNorm with ``torch.nn.SyncBatchNorm`` semantics,
+    written as explicit psum'd (sum, sum-of-squares, count) reductions.
+
+    Why not ``nn.BatchNorm(axis_name=...)``: the input pipeline pads the
+    final batch of an epoch to the static global batch shape with zero
+    samples (data/loader.py), and those rows must not enter the statistics
+    (torch's loader simply yields a smaller real-only batch).  Masked
+    statistics across shards need COUNT-weighted reductions — a plain
+    ``pmean`` of per-shard means would weight a nearly-empty shard like a
+    full one, and a shard holding only padding would divide 0/0.  Summing
+    (s1, s2, n) per shard and ``psum``-ing the three scalars-per-channel is
+    the TPU-idiomatic form: one fused ICI allreduce, exact statistics over
+    precisely the real samples, valid for any real/padding split.
+
+    Torch-parity details: normalization uses the biased batch variance;
+    the running average blends the UNBIASED one (Bessel ``n/(n-1)`` —
+    torch's documented running-var behavior) with weight
+    ``BN_TORCH_MOMENTUM``; eval normalizes with the running averages.
+    Statistics are always computed in float32.
+    """
+
+    momentum: float = BN_TORCH_MOMENTUM
+    epsilon: float = BN_EPS
+    axis_name: str | None = None
     compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+    def __call__(
+        self,
+        x: jax.Array,
+        *,
+        train: bool = False,
+        mask: jax.Array | None = None,
+    ) -> jax.Array:
+        features = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (features,))
+        bias = self.param("bias", nn.initializers.zeros, (features,))
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+
+        if train:
+            x32 = x.astype(jnp.float32)
+            reduce_axes = tuple(range(x.ndim - 1))  # all but channels
+            if mask is None:
+                n = jnp.float32(np.prod([x.shape[a] for a in reduce_axes]))
+                s1 = x32.sum(reduce_axes)
+                s2 = (x32 * x32).sum(reduce_axes)
+            else:
+                m = mask.astype(jnp.float32).reshape(
+                    mask.shape + (1,) * (x.ndim - mask.ndim)
+                )
+                spatial = np.prod(x.shape[1:-1], dtype=np.float64)
+                n = mask.astype(jnp.float32).sum() * jnp.float32(spatial)
+                s1 = (x32 * m).sum(reduce_axes)
+                s2 = (x32 * x32 * m).sum(reduce_axes)
+            if self.axis_name is not None:
+                n, s1, s2 = jax.lax.psum((n, s1, s2), self.axis_name)
+            mean = s1 / n
+            var = jnp.maximum(s2 / n - mean * mean, 0.0)
+            if not self.is_initializing():
+                unbiased = var * (n / jnp.maximum(n - 1.0, 1.0))
+                ra_mean.value = (
+                    (1.0 - self.momentum) * ra_mean.value + self.momentum * mean
+                )
+                ra_var.value = (
+                    (1.0 - self.momentum) * ra_var.value
+                    + self.momentum * unbiased
+                )
+        else:
+            mean, var = ra_mean.value, ra_var.value
+
+        y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.epsilon)
+        y = y * scale + bias
+        return y.astype(self.compute_dtype)
+
+
+class Net(nn.Module):
+    """2-conv MNIST CNN.  Input: ``[N, 28, 28, 1]`` float32/bfloat16.
+    Output: ``[N, 10]`` float32 log-probabilities.
+
+    ``use_bn`` inserts BatchNorm after each conv (conv -> BN -> relu, the
+    torch-canonical placement) — the reference Net has none, but
+    BASELINE.json's scaled-batch config calls for SyncBN, the standard
+    DDP-at-scale addition (``torch.nn.SyncBatchNorm``).  With ``bn_axis``
+    set to a mesh axis name, train-mode batch statistics are psum-synced
+    across that axis (see :class:`SyncBatchNorm`), so every replica
+    normalizes by GLOBAL-batch statistics exactly like SyncBatchNorm's
+    process-group allreduce; running averages (tracked in the
+    ``batch_stats`` collection) then update identically on every
+    replica."""
+
+    compute_dtype: jnp.dtype = jnp.float32
+    use_bn: bool = False
+    bn_axis: str | None = None
+
+    def _maybe_bn(
+        self, x: jax.Array, name: str, train: bool, mask: jax.Array | None
+    ) -> jax.Array:
+        if not self.use_bn:
+            return x
+        return SyncBatchNorm(
+            axis_name=self.bn_axis, name=name, compute_dtype=self.compute_dtype
+        )(x, train=train, mask=mask)
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        *,
+        train: bool = False,
+        dropout: bool | None = None,
+        mask: jax.Array | None = None,
+    ) -> jax.Array:
+        # ``train`` selects train-mode statistics (BN batch stats, and —
+        # unless overridden — active dropout); ``dropout`` decouples the
+        # dropout masks from it so deterministic parity tests can train
+        # BN with dropout off.  ``mask`` (the loader's 0/1 padding weights,
+        # shape [N]) keeps zero-padded samples out of the BN statistics.
+        use_dropout = train if dropout is None else dropout
         x = x.astype(self.compute_dtype)
         x = nn.Conv(
             32, (3, 3), padding="VALID", name="conv1", dtype=self.compute_dtype,
             kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(1 * 9),
         )(x)
+        x = self._maybe_bn(x, "bn1", train, mask)
         x = nn.relu(x)
         x = nn.Conv(
             64, (3, 3), padding="VALID", name="conv2", dtype=self.compute_dtype,
             kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(32 * 9),
         )(x)
+        x = self._maybe_bn(x, "bn2", train, mask)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Dropout(DROPOUT1_RATE, deterministic=not train, name="dropout1")(x)
+        x = nn.Dropout(DROPOUT1_RATE, deterministic=not use_dropout, name="dropout1")(x)
         x = x.reshape(x.shape[0], -1)  # [N, 9216] (H*W*C ordering; see module docstring)
         x = nn.Dense(
             128, name="fc1", dtype=self.compute_dtype,
             kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(9216),
         )(x)
         x = nn.relu(x)
-        x = nn.Dropout(DROPOUT2_RATE, deterministic=not train, name="dropout2")(x)
+        x = nn.Dropout(DROPOUT2_RATE, deterministic=not use_dropout, name="dropout2")(x)
         x = nn.Dense(
             10, name="fc2", dtype=self.compute_dtype,
             kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(128),
@@ -139,15 +264,26 @@ def init_params(key: jax.Array, compute_dtype: jnp.dtype = jnp.float32):
     Jitted: eager flax init dispatches one device call per tensor, which is
     costly when dispatch crosses a network tunnel; one fused call also
     lands in the persistent compile cache."""
-    return _init_params_jit(compute_dtype)(key)
+    return _init_variables_jit(compute_dtype, False)(key)["params"]
+
+
+def init_variables(
+    key: jax.Array,
+    compute_dtype: jnp.dtype = jnp.float32,
+    use_bn: bool = False,
+):
+    """Like :func:`init_params` but returns the FULL variable dict —
+    ``{"params": ..., "batch_stats": ...}`` when ``use_bn`` (BN running
+    stats start at torch's defaults: mean 0, var 1, scale 1, bias 0)."""
+    return dict(_init_variables_jit(compute_dtype, use_bn)(key))
 
 
 @functools.lru_cache(maxsize=None)
-def _init_params_jit(compute_dtype):
-    model = Net(compute_dtype=compute_dtype)
+def _init_variables_jit(compute_dtype, use_bn: bool):
+    model = Net(compute_dtype=compute_dtype, use_bn=use_bn)
     dummy = jnp.zeros((1, 28, 28, 1), jnp.float32)
 
     def init(key):
-        return model.init({"params": key}, dummy, train=False)["params"]
+        return model.init({"params": key}, dummy, train=False)
 
     return jax.jit(init)

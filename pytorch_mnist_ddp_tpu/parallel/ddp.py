@@ -38,15 +38,23 @@ from .mesh import DATA_AXIS
 
 
 class TrainState(NamedTuple):
-    """Replicated training state: params + Adadelta accumulators + step."""
+    """Replicated training state: params + Adadelta accumulators + step.
+
+    ``batch_stats`` is the BN running-average collection when the model has
+    (Sync)BatchNorm layers (``--syncbn``); the default empty tuple is a
+    leafless pytree, so non-BN paths are untouched."""
 
     params: Any
     opt: AdadeltaState
     step: jax.Array  # int32 global step counter (drives per-step dropout keys)
+    batch_stats: Any = ()
 
 
-def make_train_state(params: Any) -> TrainState:
-    return TrainState(params=params, opt=adadelta_init(params), step=jnp.int32(0))
+def make_train_state(params: Any, batch_stats: Any = ()) -> TrainState:
+    return TrainState(
+        params=params, opt=adadelta_init(params), step=jnp.int32(0),
+        batch_stats=batch_stats,
+    )
 
 
 def replicate_params(tree: Any, mesh: Mesh) -> Any:
@@ -77,6 +85,7 @@ def make_train_step(
     eps: float = 1e-6,
     dropout: bool = True,
     use_pallas: bool | None = None,
+    use_bn: bool = False,
 ):
     """Build the jitted DP train step.
 
@@ -84,8 +93,18 @@ def make_train_step(
     where ``x`` is the *global* batch (sharded over the ``data`` axis by the
     input pipeline), ``w`` the 0/1 padding mask, and ``losses`` a
     ``[num_data_shards]`` array of per-replica local losses.
+
+    ``use_bn``: the model carries (Sync)BatchNorm layers — batch statistics
+    are pmean-synced over the ``data`` axis inside the forward (the
+    ``torch.nn.SyncBatchNorm`` allreduce, ridden on ICI), gradients flow
+    through the synced stats exactly as torch's does, and the updated
+    running averages (identical on every replica, since they blend the
+    synced stats) travel in ``state.batch_stats``.
     """
-    model = Net(compute_dtype=compute_dtype)
+    model = Net(
+        compute_dtype=compute_dtype, use_bn=use_bn,
+        bn_axis=DATA_AXIS if use_bn else None,
+    )
 
     def local_step(state: TrainState, x, y, w, dropout_key, lr):
         # Per-step, per-replica dropout stream folded from the single root
@@ -94,18 +113,38 @@ def make_train_step(
         key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
 
         def loss_fn(params):
-            log_probs = model.apply(
-                {"params": params}, x, train=dropout, rngs={"dropout": key}
-            )
-            return nll_loss(log_probs, y, w, reduction="mean")
+            variables = {"params": params}
+            if use_bn:
+                # train=True regardless of the dropout flag: BN must use
+                # (and update) batch statistics whenever training, even in
+                # the deterministic-dropout parity configurations.
+                variables["batch_stats"] = state.batch_stats
+                # mask=w: zero-padded samples of the final partial batch
+                # stay out of the (psum'd) batch statistics, matching
+                # torch's real-only smaller last batch.
+                log_probs, mutated = model.apply(
+                    variables, x, train=True, dropout=dropout, mask=w,
+                    rngs={"dropout": key}, mutable=["batch_stats"],
+                )
+                new_stats = mutated["batch_stats"]
+            else:
+                log_probs = model.apply(
+                    variables, x, train=dropout, rngs={"dropout": key}
+                )
+                new_stats = state.batch_stats
+            return nll_loss(log_probs, y, w, reduction="mean"), new_stats
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
         # The DDP allreduce: mean over replicas == bucketed NCCL sum / world.
         grads = jax.lax.pmean(grads, DATA_AXIS)
         params, opt = adadelta_update_best(
             state.params, grads, state.opt, lr, rho, eps, use_pallas=use_pallas
         )
-        new_state = TrainState(params=params, opt=opt, step=state.step + 1)
+        new_state = TrainState(
+            params=params, opt=opt, step=state.step + 1, batch_stats=new_stats
+        )
         return new_state, loss[None]  # keep a per-shard loss axis
 
     sharded = jax.shard_map(
@@ -118,18 +157,25 @@ def make_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_eval_step(mesh: Mesh, compute_dtype: jnp.dtype = jnp.float32):
+def make_eval_step(
+    mesh: Mesh, compute_dtype: jnp.dtype = jnp.float32, use_bn: bool = False
+):
     """Build the jitted distributed eval step.
 
     Returns ``eval_fn(params, x, y, w) -> (loss_sum, correct)`` — the
     sum-reduced NLL (reference mnist_ddp.py:97) and the argmax-match count
     (mnist_ddp.py:98-99) over the REAL (unpadded) samples of the global
     batch, psum'd over the mesh so every process holds the totals.
+
+    With ``use_bn``, ``params`` is the full variable dict
+    ``{"params": ..., "batch_stats": ...}`` and eval normalizes by the
+    running averages (torch ``model.eval()`` semantics).
     """
-    model = Net(compute_dtype=compute_dtype)
+    model = Net(compute_dtype=compute_dtype, use_bn=use_bn)
 
     def local_eval(params, x, y, w):
-        log_probs = model.apply({"params": params}, x, train=False)
+        variables = params if use_bn else {"params": params}
+        log_probs = model.apply(variables, x, train=False)
         loss_sum = nll_loss(log_probs, y, w, reduction="sum")
         pred = jnp.argmax(log_probs, axis=1)
         correct = ((pred == y) * w).sum()

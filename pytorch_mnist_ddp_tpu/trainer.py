@@ -163,6 +163,13 @@ def _fit_body(
         raise ValueError("--bf16 is implemented for the DP paths; drop --tp/--pp")
     if num_model > 1 and not dist.distributed:
         raise ValueError("--tp/--pp need a multi-device mesh (use the launcher)")
+    # --syncbn (cross-replica BatchNorm, the torch.nn.SyncBatchNorm
+    # equivalent) rides the per-batch DP step only.
+    syncbn = bool(getattr(args, "syncbn", False))
+    if syncbn and bool(getattr(args, "fused", False)):
+        raise ValueError("--syncbn rides the per-batch DP path; drop --fused")
+    if syncbn and num_model > 1:
+        raise ValueError("--syncbn rides the per-batch DP path; drop --tp/--pp")
 
     if dist.distributed:
         # Multi-host: the mesh spans every device in the world (JAX's global
@@ -295,13 +302,21 @@ def _fit_body(
                     )
                 )
     else:
-        params = init_params(keys["init"])
+        if syncbn:
+            from .models.net import init_variables
+
+            variables = init_variables(keys["init"], use_bn=True)
+            params = variables["params"]
+            bn_stats = variables["batch_stats"]
+        else:
+            params = init_params(keys["init"])
+            bn_stats = ()
         if tp_degree > 1:
             from .parallel.tp import make_tp_eval_step, make_tp_train_step, shard_state
 
             state = shard_state(make_train_state(params), mesh)
         else:
-            state = replicate_params(make_train_state(params), mesh)
+            state = replicate_params(make_train_state(params, bn_stats), mesh)
         train_loader = DataLoader(
             train_set.images,
             train_set.labels,
@@ -338,9 +353,12 @@ def _fit_body(
             eval_fn = make_eval_step(mesh)
         else:
             step_fn = make_train_step(
-                mesh, compute_dtype=compute_dtype, use_pallas=use_pallas
+                mesh, compute_dtype=compute_dtype, use_pallas=use_pallas,
+                use_bn=syncbn,
             )
-            eval_fn = make_eval_step(mesh, compute_dtype=compute_dtype)
+            eval_fn = make_eval_step(
+                mesh, compute_dtype=compute_dtype, use_bn=syncbn
+            )
         want_stats = bool(getattr(args, "step_stats", False))
         for epoch in range(1, args.epochs + 1):
             stats = StepStats() if want_stats else None
@@ -359,7 +377,11 @@ def _fit_body(
             )
             if stats is not None and dist.is_chief:
                 print(stats.summary_line(epoch))
-            _, correct = evaluate(eval_fn, state.params, test_loader, dist)
+            eval_vars = (
+                {"params": state.params, "batch_stats": state.batch_stats}
+                if syncbn else state.params
+            )
+            _, correct = evaluate(eval_fn, eval_vars, test_loader, dist)
             if timings is not None:
                 acc = correct / len(test_set)
                 timings.setdefault("epoch1_test_accuracy", acc)
@@ -379,7 +401,12 @@ def _fit_body(
             # DDP-mode checkpoints carry the module. key prefix quirk
             # (reference mnist_ddp.py:195; SURVEY.md §3.5).
             sd = model_state_dict(
-                jax.device_get(params_for_save), ddp_prefix=dist.distributed
+                jax.device_get(params_for_save),
+                ddp_prefix=dist.distributed,
+                batch_stats=(
+                    jax.device_get(state.batch_stats) if syncbn else None
+                ),
+                num_batches=int(np.asarray(state.step)) if syncbn else None,
             )
             save_state_dict(sd, save_path)
     return state

@@ -29,9 +29,13 @@ import jax
 import numpy as np
 
 # Flax param-name → torch state-dict-name translation for the Net module:
-# flax uses {'kernel','bias'}, torch uses {'weight','bias'}.
-_LEAF_RENAME = {"kernel": "weight", "bias": "bias"}
-_LEAF_RENAME_INV = {"weight": "kernel", "bias": "bias"}
+# flax uses {'kernel','bias'} ({'scale','bias'} for BatchNorm), torch uses
+# {'weight','bias'} for both.  The inverse is ndim-disambiguated: a 1-D
+# ``weight`` is a BN scale, anything else is a kernel.
+_LEAF_RENAME = {"kernel": "weight", "scale": "weight", "bias": "bias"}
+# BN running statistics (the flax ``batch_stats`` collection) → torch names.
+_STATS_RENAME = {"mean": "running_mean", "var": "running_var"}
+_STATS_RENAME_INV = {v: k for k, v in _STATS_RENAME.items()}
 
 
 def _flatten(tree: Mapping[str, Any], prefix: str = "") -> dict[str, np.ndarray]:
@@ -45,14 +49,33 @@ def _flatten(tree: Mapping[str, Any], prefix: str = "") -> dict[str, np.ndarray]
     return out
 
 
-def model_state_dict(params: Mapping[str, Any], ddp_prefix: bool = False) -> dict[str, np.ndarray]:
+def model_state_dict(
+    params: Mapping[str, Any],
+    ddp_prefix: bool = False,
+    batch_stats: Mapping[str, Any] | None = None,
+    num_batches: int | None = None,
+) -> dict[str, np.ndarray]:
     """Flatten a Flax param tree into a torch-style flat state dict.
 
     ``ddp_prefix=True`` reproduces the reference's distributed-mode quirk of
     saving the wrapped module's keys (``module.conv1.weight`` etc.,
     mnist_ddp.py:195).
+
+    ``batch_stats`` (the BN running-average collection, ``--syncbn`` runs)
+    adds torch-named ``bnN.running_mean``/``bnN.running_var`` entries, plus
+    ``bnN.num_batches_tracked`` (int64, like ``torch.nn.BatchNorm2d``) when
+    ``num_batches`` is given.
     """
     flat = _flatten(params)
+    if batch_stats:
+        for mod, leaves in batch_stats.items():
+            for leaf, value in leaves.items():
+                name = _STATS_RENAME.get(leaf, leaf)
+                flat[f"{mod}.{name}"] = np.asarray(value)
+            if num_batches is not None:
+                flat[f"{mod}.num_batches_tracked"] = np.asarray(
+                    num_batches, np.int64
+                )
     if ddp_prefix:
         flat = {"module." + k: v for k, v in flat.items()}
     return flat
@@ -133,17 +156,49 @@ def load_state_dict(path: str) -> dict[str, np.ndarray]:
         raise
 
 
+def _param_leaf_name(torch_leaf: str, value: np.ndarray) -> str:
+    """Torch leaf name -> flax param leaf name.  ``weight`` is ambiguous:
+    conv/dense kernels (ndim >= 2) map to ``kernel``, BatchNorm's per-
+    channel vector (ndim 1) to ``scale``."""
+    if torch_leaf == "weight":
+        return "scale" if np.ndim(value) == 1 else "kernel"
+    return torch_leaf
+
+
 def params_from_state_dict(state: Mapping[str, np.ndarray]) -> dict[str, Any]:
     """Rebuild a nested Flax param tree from a flat torch-style state dict,
-    accepting (and stripping) the ``module.`` prefix quirk."""
-    tree: dict[str, Any] = {}
+    accepting (and stripping) the ``module.`` prefix quirk.  BN running
+    statistics, if present, are ignored here — use
+    :func:`variables_from_state_dict` to recover them too."""
+    return variables_from_state_dict(state)["params"]
+
+
+def variables_from_state_dict(
+    state: Mapping[str, np.ndarray],
+) -> dict[str, dict[str, Any]]:
+    """Rebuild the full Flax variable dict — ``{"params": ...}`` plus, for
+    checkpoints of BN-bearing models (``--syncbn``), ``{"batch_stats": ...}``
+    with torch's ``running_mean``/``running_var`` mapped back to flax's
+    ``mean``/``var``.  ``num_batches_tracked`` (torch bookkeeping our
+    momentum-based update never reads) is dropped."""
+    params: dict[str, Any] = {}
+    stats: dict[str, Any] = {}
     for key, value in state.items():
         parts = key.split(".")
         if parts[0] == "module":
             parts = parts[1:]
-        parts[-1] = _LEAF_RENAME_INV.get(parts[-1], parts[-1])
-        node = tree
+        leaf = parts[-1]
+        if leaf == "num_batches_tracked":
+            continue
+        if leaf in _STATS_RENAME_INV:
+            dest, leaf = stats, _STATS_RENAME_INV[leaf]
+        else:
+            dest, leaf = params, _param_leaf_name(leaf, value)
+        node = dest
         for p in parts[:-1]:
             node = node.setdefault(p, {})
-        node[parts[-1]] = value
-    return tree
+        node[leaf] = value
+    out = {"params": params}
+    if stats:
+        out["batch_stats"] = stats
+    return out

@@ -1,0 +1,308 @@
+"""SyncBN tests (--syncbn): cross-replica BatchNorm with
+``torch.nn.SyncBatchNorm`` semantics over the data mesh axis.
+
+The reference Net has no BN; BASELINE.json's scaled-batch config calls for
+"SyncBN added" — the canonical DDP-at-scale addition.  These tests pin:
+
+- the SYNC property itself: an 8-way sharded train step must match the
+  same global batch on ONE device, because train-mode statistics are
+  pmean'd over the data axis (unsynced local-stats BN diverges ~10x
+  farther — measured 1.05e-2 vs 1.2e-3 max param diff after 3 steps);
+- forward/running-stat parity against ``torch.nn.BatchNorm2d``;
+- checkpoint round-trip with torch-named BN entries
+  (``bn1.weight``/``running_mean``/...);
+- the CLI surface (--syncbn dry-run; flag incompatibilities).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.models.net import (
+    BN_EPS,
+    Net,
+    init_variables,
+)
+from pytorch_mnist_ddp_tpu.parallel.ddp import (
+    make_eval_step,
+    make_train_state,
+    make_train_step,
+    replicate_params,
+)
+from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+
+
+def _global_batch(seed=0, n=64):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.rand(n, 28, 28, 1), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, n))
+    w = jnp.ones(n, jnp.float32)
+    return x, y, w
+
+
+def _run_steps(num_shards, devices, steps=3):
+    mesh = make_mesh(num_data=num_shards, devices=devices[:num_shards])
+    v = init_variables(jax.random.PRNGKey(1), use_bn=True)
+    state = replicate_params(
+        make_train_state(v["params"], v["batch_stats"]), mesh
+    )
+    step_fn = make_train_step(mesh, dropout=False, use_bn=True)
+    x, y, w = _global_batch()
+    for _ in range(steps):
+        state, _ = step_fn(
+            state, x, y, w, jax.random.PRNGKey(2), jnp.float32(1.0)
+        )
+    eval_fn = make_eval_step(mesh, use_bn=True)
+    totals = np.asarray(
+        eval_fn({"params": state.params, "batch_stats": state.batch_stats},
+                x, y, w)
+    )
+    return state, totals
+
+
+def test_syncbn_sharded_matches_global_batch(devices):
+    """8-way sharded SyncBN == single-device global-batch BN.  The margins
+    matter: synced runs agree to ~1e-3 (params) / ~4e-5 (stats) after 3
+    Adadelta steps, while UNSYNCED per-shard statistics drift to ~1e-2 /
+    ~4e-3 — an order of magnitude outside these bounds."""
+    s8, t8 = _run_steps(8, devices)
+    s1, t1 = _run_steps(1, devices)
+    for a, b in zip(jax.tree.leaves(s8.params), jax.tree.leaves(s1.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=4e-3, rtol=0
+        )
+    for a, b in zip(
+        jax.tree.leaves(s8.batch_stats), jax.tree.leaves(s1.batch_stats)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-4, rtol=0
+        )
+    # eval totals (running-average normalization) agree as well
+    np.testing.assert_allclose(t8, t1, rtol=1e-3)
+
+
+def test_bn_updates_stats_and_eval_uses_them(devices):
+    """Train steps move the running averages off their (0, 1) init, eval
+    normalizes with them (not batch stats), and the state pytree carries
+    them alongside params."""
+    state, _ = _run_steps(1, devices, steps=2)
+    means = np.asarray(state.batch_stats["bn1"]["mean"])
+    vars_ = np.asarray(state.batch_stats["bn1"]["var"])
+    assert not np.allclose(means, 0.0)
+    assert not np.allclose(vars_, 1.0)
+    # eval normalizes with the RUNNING averages, not batch statistics: a
+    # sample's eval output must not depend on which batch it sits in
+    # (train-mode batch stats would change with the other rows)
+    model = Net(use_bn=True)
+    variables = {
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+    }
+    xa, _, _ = _global_batch(seed=1, n=8)
+    xb, _, _ = _global_batch(seed=2, n=8)
+    xb = jnp.concatenate([xa[:1], xb[1:]])  # same row 0, different company
+    out_a = model.apply(variables, xa, train=False)
+    out_b = model.apply(variables, xb, train=False)
+    np.testing.assert_array_equal(np.asarray(out_a)[0], np.asarray(out_b)[0])
+    # and the same row in TRAIN mode does depend on its batch
+    tr_a, _ = model.apply(variables, xa, train=True, dropout=False,
+                          mutable=["batch_stats"])
+    tr_b, _ = model.apply(variables, xb, train=True, dropout=False,
+                          mutable=["batch_stats"])
+    assert not np.allclose(np.asarray(tr_a)[0], np.asarray(tr_b)[0])
+
+
+def test_bn_forward_parity_with_torch():
+    """Train-mode forward + running-stat update against
+    ``torch.nn.BatchNorm2d``: normalization uses the biased batch variance
+    and the running average blends the unbiased one (Bessel n/(n-1)) with
+    momentum 0.1 — our SyncBatchNorm reproduces both exactly."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+    import torch.nn.functional as F
+
+    v = init_variables(jax.random.PRNGKey(3), use_bn=True)
+    params, stats = v["params"], v["batch_stats"]
+
+    class TorchBNNet(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = tnn.Conv2d(1, 32, 3, 1)
+            self.bn1 = tnn.BatchNorm2d(32, eps=BN_EPS)
+            self.conv2 = tnn.Conv2d(32, 64, 3, 1)
+            self.bn2 = tnn.BatchNorm2d(64, eps=BN_EPS)
+            self.fc1 = tnn.Linear(9216, 128)
+            self.fc2 = tnn.Linear(128, 10)
+
+        def forward(self, x):
+            x = F.relu(self.bn1(self.conv1(x)))
+            x = F.relu(self.bn2(self.conv2(x)))
+            x = F.max_pool2d(x, 2)
+            x = torch.flatten(x, 1)
+            x = F.relu(self.fc1(x))
+            x = self.fc2(x)
+            return F.log_softmax(x, dim=1)
+
+    net = TorchBNNet()
+    with torch.no_grad():
+        for name in ("conv1", "conv2"):
+            k = np.asarray(params[name]["kernel"])  # HWIO
+            getattr(net, name).weight.copy_(torch.tensor(k.transpose(3, 2, 0, 1)))
+            getattr(net, name).bias.copy_(
+                torch.tensor(np.asarray(params[name]["bias"]))
+            )
+        for name in ("bn1", "bn2"):
+            getattr(net, name).weight.copy_(
+                torch.tensor(np.asarray(params[name]["scale"]))
+            )
+            getattr(net, name).bias.copy_(
+                torch.tensor(np.asarray(params[name]["bias"]))
+            )
+        k = np.asarray(params["fc1"]["kernel"])
+        k_chw = k.reshape(12, 12, 64, 128).transpose(2, 0, 1, 3).reshape(9216, 128)
+        net.fc1.weight.copy_(torch.tensor(k_chw.T))
+        net.fc1.bias.copy_(torch.tensor(np.asarray(params["fc1"]["bias"])))
+        net.fc2.weight.copy_(torch.tensor(np.asarray(params["fc2"]["kernel"]).T))
+        net.fc2.bias.copy_(torch.tensor(np.asarray(params["fc2"]["bias"])))
+
+    x = np.random.RandomState(0).rand(16, 28, 28, 1).astype(np.float32)
+    net.train()
+    theirs = net(torch.tensor(x.transpose(0, 3, 1, 2))).detach().numpy()
+    ours, mutated = Net(use_bn=True).apply(
+        {"params": params, "batch_stats": stats},
+        jnp.asarray(x), train=True, dropout=False, mutable=["batch_stats"],
+    )
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-3, atol=1e-4)
+    for name in ("bn1", "bn2"):
+        np.testing.assert_allclose(
+            np.asarray(mutated["batch_stats"][name]["mean"]),
+            getattr(net, name).running_mean.numpy(),
+            rtol=1e-4, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(mutated["batch_stats"][name]["var"]),
+            getattr(net, name).running_var.numpy(),
+            rtol=1e-4,
+        )
+
+
+def test_padded_batch_stays_out_of_bn_stats(devices):
+    """The loader zero-pads the final partial batch (w=0 rows); with the
+    batch sharded over 8 devices some shards can be ENTIRELY padding.  The
+    psum'd (sum, sum-of-squares, count) reduction must produce statistics
+    over exactly the real samples — identical to running the real rows
+    alone, with no NaN from empty shards (a plain per-shard mean would
+    divide 0/0)."""
+    x, y, _ = _global_batch(n=96)
+    pad = 128 - 96
+    xp = jnp.concatenate([x, jnp.zeros((pad, 28, 28, 1), jnp.float32)])
+    yp = jnp.concatenate([y, jnp.zeros(pad, y.dtype)])
+    wp = jnp.concatenate([jnp.ones(96, jnp.float32), jnp.zeros(pad, jnp.float32)])
+
+    # fresh init per mesh: the donated train step consumes its state's
+    # buffers, which device_put may alias with the init tree's
+    v = init_variables(jax.random.PRNGKey(1), use_bn=True)
+
+    # padded batch over the 8-way mesh (shards 6-7 are all padding)
+    mesh8 = make_mesh(num_data=8, devices=devices)
+    s8 = replicate_params(make_train_state(v["params"], v["batch_stats"]), mesh8)
+    step8 = make_train_step(mesh8, dropout=False, use_bn=True)
+    s8, loss8 = step8(s8, xp, yp, wp, jax.random.PRNGKey(2), jnp.float32(1.0))
+
+    # the same 96 real samples, unpadded, on one device
+    v = init_variables(jax.random.PRNGKey(1), use_bn=True)
+    mesh1 = make_mesh(num_data=1, devices=devices[:1])
+    s1 = replicate_params(make_train_state(v["params"], v["batch_stats"]), mesh1)
+    step1 = make_train_step(mesh1, dropout=False, use_bn=True)
+    s1, _ = step1(
+        s1, x, y, jnp.ones(96, jnp.float32),
+        jax.random.PRNGKey(2), jnp.float32(1.0),
+    )
+
+    assert np.isfinite(np.asarray(loss8)).all()
+    for a, b in zip(
+        jax.tree.leaves(s8.batch_stats), jax.tree.leaves(s1.batch_stats)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-4, rtol=0
+        )
+
+
+def test_bn_checkpoint_roundtrip(tmp_path):
+    """model_state_dict + variables_from_state_dict invert for BN models,
+    with torch-named entries (bnN.weight / running_mean / ...)."""
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import (
+        load_state_dict,
+        model_state_dict,
+        save_state_dict,
+        variables_from_state_dict,
+    )
+
+    v = init_variables(jax.random.PRNGKey(5), use_bn=True)
+    sd = model_state_dict(
+        v["params"], ddp_prefix=True, batch_stats=v["batch_stats"],
+        num_batches=7,
+    )
+    assert "module.bn1.weight" in sd and "module.bn2.running_var" in sd
+    assert sd["module.bn1.num_batches_tracked"].dtype == np.int64
+    path = str(tmp_path / "bn.pt")
+    save_state_dict(sd, path)
+    back = variables_from_state_dict(load_state_dict(path))
+    for mod in ("bn1", "bn2"):
+        np.testing.assert_array_equal(
+            back["params"][mod]["scale"], np.asarray(v["params"][mod]["scale"])
+        )
+        np.testing.assert_array_equal(
+            back["batch_stats"][mod]["mean"],
+            np.asarray(v["batch_stats"][mod]["mean"]),
+        )
+    # conv entries unaffected by the BN renames
+    np.testing.assert_array_equal(
+        back["params"]["conv1"]["kernel"],
+        np.asarray(v["params"]["conv1"]["kernel"]),
+    )
+
+
+def test_syncbn_cli_dry_run(tmp_path):
+    from tests.test_e2e import _write_idx
+
+    root = _write_idx(tmp_path)
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MNIST_DATA_DIR"] = root
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "mnist_ddp.py"), "--syncbn",
+         "--dry-run", "--epochs", "1", "--batch-size", "32",
+         "--test-batch-size", "64"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path), timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "Train Epoch: 1 [0/512 (0%)]" in proc.stdout
+    assert "Test set: Average loss:" in proc.stdout
+
+
+@pytest.mark.parametrize("bad", [
+    dict(fused=True),
+    dict(tp=2),
+    dict(pp=True),
+])
+def test_syncbn_flag_incompatibilities(tmp_path, devices, bad):
+    from tests.test_e2e import _args, _write_idx
+    from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
+    from pytorch_mnist_ddp_tpu.trainer import fit
+
+    root = _write_idx(tmp_path)
+    args = _args(root, syncbn=True, **bad)
+    dist = DistState(
+        distributed=True, process_rank=0, process_count=1,
+        world_size=8, devices=list(devices),
+    )
+    with pytest.raises(ValueError, match="--syncbn"):
+        fit(args, dist)
