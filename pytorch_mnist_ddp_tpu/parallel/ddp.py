@@ -95,11 +95,12 @@ def make_train_step(
     ``[num_data_shards]`` array of per-replica local losses.
 
     ``use_bn``: the model carries (Sync)BatchNorm layers — batch statistics
-    are pmean-synced over the ``data`` axis inside the forward (the
-    ``torch.nn.SyncBatchNorm`` allreduce, ridden on ICI), gradients flow
-    through the synced stats exactly as torch's does, and the updated
-    running averages (identical on every replica, since they blend the
-    synced stats) travel in ``state.batch_stats``.
+    come from a (sum, sq-sum, count) psum over the ``data`` axis inside the
+    forward (the ``torch.nn.SyncBatchNorm`` allreduce, ridden on ICI; see
+    models/net.py:SyncBatchNorm for why not a pmean of shard means),
+    gradients flow through the synced stats exactly as torch's do, and the
+    updated running averages (identical on every replica, since they blend
+    the synced stats) travel in ``state.batch_stats``.
     """
     model = Net(
         compute_dtype=compute_dtype, use_bn=use_bn,

@@ -7,7 +7,8 @@ locally, 8 globally.  World formation goes through the real entry path —
 (SURVEY.md N1) — then a full ``fit()`` runs, and the worker dumps its
 final params + eval totals for the parent to cross-check.
 
-Usage: python tests/multihost_worker.py <data_root> <out_npz> <fused|batch|tp|pp>
+Usage: python tests/multihost_worker.py <data_root> <out_npz> \
+    <fused|batch|tp|pp|syncbn>
 
 ``tp`` mode trains tensor-parallel over a (data=4, model=2) mesh that
 spans both processes — fc1/fc2 shards live on model-axis device pairs
@@ -16,8 +17,10 @@ whose data rows split across the process boundary — exercising
 and the cross-process logits psum.  ``pp`` mode pipelines the two stages
 over the same mesh, driving the per-tick activation/cotangent
 ``ppermute`` and the stage-axis gradient psum across the process
-boundary.
-"""
+boundary.  ``syncbn`` trains DP with cross-replica BatchNorm: the
+(sum, sq-sum, count) statistics psum crosses the process boundary every
+step, and the dumped running averages must be bit-identical on both
+processes."""
 
 import sys
 from argparse import Namespace
@@ -45,6 +48,7 @@ def main() -> None:
         seed=1, log_interval=4, dry_run=False, save_model=False,
         fused=(mode == "fused"), data_root=data_root,
         tp=(2 if mode == "tp" else 1), pp=(mode == "pp"),
+        syncbn=(mode == "syncbn"),
     )
     state = fit(args, dist)
 
@@ -71,11 +75,21 @@ def main() -> None:
         process_rank=dist.process_rank, process_count=dist.process_count,
         mask_padding=True,
     )
-    avg_loss, correct = evaluate(
-        make_eval_step(mesh), params, loader, dist
-    )
+    if mode == "syncbn":
+        eval_fn = make_eval_step(mesh, use_bn=True)
+        eval_params = {"params": params, "batch_stats": state.batch_stats}
+    else:
+        eval_fn = make_eval_step(mesh)
+        eval_params = params
+    avg_loss, correct = evaluate(eval_fn, eval_params, loader, dist)
 
-    flat = model_state_dict(jax.tree.map(lambda v: np.asarray(v), params))
+    flat = model_state_dict(
+        jax.tree.map(lambda v: np.asarray(v), params),
+        batch_stats=(
+            jax.tree.map(lambda v: np.asarray(v), state.batch_stats)
+            if mode == "syncbn" else None
+        ),
+    )
     np.savez(
         out_path,
         avg_loss=np.float64(avg_loss),

@@ -80,18 +80,23 @@ def _run_world(tmp_path, mode: str) -> list[dict]:
     return results
 
 
-@pytest.mark.parametrize("mode", ["batch", "fused", "tp", "pp"])
+@pytest.mark.parametrize("mode", ["batch", "fused", "tp", "pp", "syncbn"])
 def test_two_process_world_replica_consistency(tmp_path, mode):
     """batch/fused: pure DP replica consistency.  tp: the (data=4, model=2)
     mesh spans the process boundary — multi-controller shard placement,
     cross-process logits psum, and the gathered params must still be
     identical on both processes.  pp: the same mesh pipelined — per-tick
     activation/cotangent ppermute and the stage-axis grad psum cross the
-    process boundary."""
+    process boundary.  syncbn: the per-step BN statistics psum crosses the
+    boundary, so the dumped running averages (bn*.running_*) must be
+    bit-identical too."""
     r0, r1, logs = _run_world(tmp_path, mode)
-    # Replica/shard consistency: both processes hold bit-identical params.
+    # Replica/shard consistency: both processes hold bit-identical params
+    # (for syncbn this includes the BN scale/bias and running statistics).
     param_keys = [k for k in r0 if k not in ("avg_loss", "correct")]
-    assert len(param_keys) == 8
+    assert len(param_keys) == (16 if mode == "syncbn" else 8)
+    if mode == "syncbn":
+        assert "bn1.running_mean" in param_keys
     for k in param_keys:
         np.testing.assert_array_equal(r0[k], r1[k], err_msg=k)
     assert r0["fc1.weight"].shape == (9216, 128)  # full gathered tensor
