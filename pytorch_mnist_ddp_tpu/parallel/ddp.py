@@ -57,6 +57,16 @@ def make_train_state(params: Any, batch_stats: Any = ()) -> TrainState:
     )
 
 
+def eval_variables(params: Any, batch_stats: Any, use_bn: bool) -> Any:
+    """The first argument for a ``use_bn``-built eval step: BN models
+    evaluate on the full variable dict (params + running averages), others
+    on bare params.  One definition so every caller assembles the same
+    shape."""
+    if use_bn:
+        return {"params": params, "batch_stats": batch_stats}
+    return params
+
+
 def replicate_params(tree: Any, mesh: Mesh) -> Any:
     """Place a pytree fully-replicated on the mesh.  Together with same-key
     init (models/net.py:init_params) this replaces DDP's rank-0 broadcast.

@@ -37,7 +37,7 @@ from ..data.transforms import MNIST_MEAN, MNIST_STD
 from ..models.net import Net
 from ..ops.loss import nll_loss
 from ..ops.pallas_adadelta import adadelta_update_best
-from .ddp import TrainState
+from .ddp import TrainState, eval_variables
 from .mesh import DATA_AXIS
 
 
@@ -73,11 +73,17 @@ def _local_epoch_builder(
     eps: float,
     dropout: bool,
     use_pallas: bool | None,
+    use_bn: bool = False,
 ):
     """Shared body for the per-epoch and whole-run fusions: returns
     ``local_epoch(state, images, labels, epoch, shuffle_key, dropout_key,
     lr) -> (state, losses[num_batches])`` (per-shard, to be run inside
-    ``shard_map``) plus ``num_batches``."""
+    ``shard_map``) plus ``num_batches``.
+
+    ``use_bn``: the scan carry's ``state.batch_stats`` threads the BN
+    running averages through every step; batch statistics psum over the
+    data axis inside the forward and the wrap-filler rows (weight 0) are
+    mask-excluded, exactly like the per-batch step (parallel/ddp.py)."""
     if global_batch % n_shards:
         raise ValueError(f"global batch {global_batch} not divisible by mesh")
     shard_batch = global_batch // n_shards
@@ -110,17 +116,29 @@ def _local_epoch_builder(
             key = jax.random.fold_in(key, shard)
 
             def loss_fn(params):
-                logp = model.apply(
-                    {"params": params}, x, train=dropout, rngs={"dropout": key}
-                )
-                return nll_loss(logp, y, w, reduction="mean")
+                if use_bn:
+                    logp, mutated = model.apply(
+                        {"params": params, "batch_stats": state.batch_stats},
+                        x, train=True, dropout=dropout, mask=w,
+                        rngs={"dropout": key}, mutable=["batch_stats"],
+                    )
+                    new_stats = mutated["batch_stats"]
+                else:
+                    logp = model.apply(
+                        {"params": params}, x, train=dropout,
+                        rngs={"dropout": key},
+                    )
+                    new_stats = state.batch_stats
+                return nll_loss(logp, y, w, reduction="mean"), new_stats
 
-            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
             grads = jax.lax.pmean(grads, DATA_AXIS)
             params, opt = adadelta_update_best(
                 state.params, grads, state.opt, lr, rho, eps, use_pallas=use_pallas
             )
-            return TrainState(params, opt, state.step + 1), loss
+            return TrainState(params, opt, state.step + 1, new_stats), loss
 
         state, losses = jax.lax.scan(
             one_step,
@@ -180,9 +198,12 @@ def _local_eval_builder(
     global_batch: int,
     n_shards: int,
     compute_dtype,
+    use_bn: bool = False,
 ):
     """Shared eval body: returns ``local_eval(params, images, labels) ->
-    psum'd [loss_sum, correct]`` to be run inside ``shard_map``."""
+    psum'd [loss_sum, correct]`` to be run inside ``shard_map``.  With
+    ``use_bn``, ``params`` is the full variable dict (running averages
+    normalize, torch ``model.eval()`` semantics)."""
     if global_batch % n_shards:
         raise ValueError(f"global batch {global_batch} not divisible by mesh")
     shard_batch = global_batch // n_shards
@@ -193,6 +214,7 @@ def _local_eval_builder(
         idx = jnp.arange(padded) % dataset_size  # wrap; wrapped tail masked below
         valid = (jnp.arange(padded) < dataset_size).astype(jnp.float32)
         shard = jax.lax.axis_index(DATA_AXIS)
+        variables_of = (lambda p: p) if use_bn else (lambda p: {"params": p})
 
         def one_batch(carry, batch):
             loss_sum, correct = carry
@@ -201,7 +223,7 @@ def _local_eval_builder(
             v = jax.lax.dynamic_slice_in_dim(b_valid, shard * shard_batch, shard_batch)
             x = _normalize_dev(jnp.take(images, i, axis=0), compute_dtype)
             y = jnp.take(labels, i, axis=0)
-            logp = model.apply({"params": params}, x, train=False)
+            logp = model.apply(variables_of(params), x, train=False)
             loss_sum += nll_loss(logp, y, v, reduction="sum")
             correct += ((jnp.argmax(logp, axis=1) == y) * v).sum()
             return (loss_sum, correct), None
@@ -257,6 +279,7 @@ def make_fused_run(
     dropout: bool = True,
     use_pallas: bool | None = None,
     from_key: bool = False,
+    use_bn: bool = False,
 ):
     """Whole-run fusion: EVERY epoch's training scan plus its full-test-set
     eval as ONE jitted device call.
@@ -281,32 +304,41 @@ def make_fused_run(
     """
     from ..ops.adadelta import adadelta_init
 
-    model = Net(compute_dtype=compute_dtype)
+    model = Net(
+        compute_dtype=compute_dtype, use_bn=use_bn,
+        bn_axis=DATA_AXIS if use_bn else None,
+    )
     n_shards = mesh.shape[DATA_AXIS]
     local_epoch, num_batches = _local_epoch_builder(
         model, train_size, global_batch, n_shards,
-        compute_dtype, rho, eps, dropout, use_pallas,
+        compute_dtype, rho, eps, dropout, use_pallas, use_bn=use_bn,
     )
     local_eval = _local_eval_builder(
-        model, test_size, eval_batch, n_shards, compute_dtype
+        model, test_size, eval_batch, n_shards, compute_dtype, use_bn=use_bn
     )
 
     def local_run(state, tr_x, tr_y, te_x, te_y, shuffle_key, dropout_key, lrs):
         if from_key:
             # ``state`` is the init PRNG key; same stream as
             # models/net.py:init_params, so both entries are bit-identical.
-            params = model.init(
+            variables = model.init(
                 {"params": state}, jnp.zeros((1, 28, 28, 1), jnp.float32),
                 train=False,
-            )["params"]
-            state = TrainState(params, adadelta_init(params), jnp.int32(0))
+            )
+            state = TrainState(
+                variables["params"], adadelta_init(variables["params"]),
+                jnp.int32(0), variables["batch_stats"] if use_bn else (),
+            )
 
         def one_epoch(state, epoch_and_lr):
             epoch, lr = epoch_and_lr
             state, losses = local_epoch(
                 state, tr_x, tr_y, epoch, shuffle_key, dropout_key, lr
             )
-            totals = local_eval(state.params, te_x, te_y)
+            totals = local_eval(
+                eval_variables(state.params, state.batch_stats, use_bn),
+                te_x, te_y,
+            )
             return state, (losses, totals)
 
         state, (losses, evals) = jax.lax.scan(

@@ -19,6 +19,7 @@ from .models.net import init_params
 from .ops.schedule import step_lr
 from .parallel.ddp import (
     TrainState,
+    eval_variables,
     make_eval_step,
     make_train_state,
     make_train_step,
@@ -164,12 +165,10 @@ def _fit_body(
     if num_model > 1 and not dist.distributed:
         raise ValueError("--tp/--pp need a multi-device mesh (use the launcher)")
     # --syncbn (cross-replica BatchNorm, the torch.nn.SyncBatchNorm
-    # equivalent) rides the per-batch DP step only.
+    # equivalent) rides the DP paths, per-batch and fused.
     syncbn = bool(getattr(args, "syncbn", False))
-    if syncbn and bool(getattr(args, "fused", False)):
-        raise ValueError("--syncbn rides the per-batch DP path; drop --fused")
     if syncbn and num_model > 1:
-        raise ValueError("--syncbn rides the per-batch DP path; drop --tp/--pp")
+        raise ValueError("--syncbn rides the DP paths; drop --tp/--pp")
 
     if dist.distributed:
         # Multi-host: the mesh spans every device in the world (JAX's global
@@ -239,7 +238,7 @@ def _fit_body(
         run_fn, num_batches = make_fused_run(
             mesh, len(train_set), len(test_set), global_batch, eval_batch,
             args.epochs, compute_dtype=compute_dtype, use_pallas=use_pallas,
-            from_key=True,
+            from_key=True, use_bn=syncbn,
         )
         # Host-computed StepLR values: bit-identical to the per-epoch paths.
         lrs = jnp.asarray(
@@ -377,11 +376,12 @@ def _fit_body(
             )
             if stats is not None and dist.is_chief:
                 print(stats.summary_line(epoch))
-            eval_vars = (
-                {"params": state.params, "batch_stats": state.batch_stats}
-                if syncbn else state.params
+            _, correct = evaluate(
+                eval_fn,
+                eval_variables(state.params, state.batch_stats, syncbn),
+                test_loader,
+                dist,
             )
-            _, correct = evaluate(eval_fn, eval_vars, test_loader, dist)
             if timings is not None:
                 acc = correct / len(test_set)
                 timings.setdefault("epoch1_test_accuracy", acc)

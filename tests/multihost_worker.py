@@ -75,13 +75,15 @@ def main() -> None:
         process_rank=dist.process_rank, process_count=dist.process_count,
         mask_padding=True,
     )
-    if mode == "syncbn":
-        eval_fn = make_eval_step(mesh, use_bn=True)
-        eval_params = {"params": params, "batch_stats": state.batch_stats}
-    else:
-        eval_fn = make_eval_step(mesh)
-        eval_params = params
-    avg_loss, correct = evaluate(eval_fn, eval_params, loader, dist)
+    from pytorch_mnist_ddp_tpu.parallel.ddp import eval_variables
+
+    bn = mode == "syncbn"
+    avg_loss, correct = evaluate(
+        make_eval_step(mesh, use_bn=bn),
+        eval_variables(params, state.batch_stats, bn),
+        loader,
+        dist,
+    )
 
     flat = model_state_dict(
         jax.tree.map(lambda v: np.asarray(v), params),

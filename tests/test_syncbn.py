@@ -288,8 +288,77 @@ def test_syncbn_cli_dry_run(tmp_path):
     assert "Test set: Average loss:" in proc.stdout
 
 
+def test_fused_syncbn_matches_per_batch(devices):
+    """--syncbn --fused: the whole-run fusion threads batch_stats through
+    the scan carry.  Same permutation fed to both paths (dropout off) ->
+    identical params, running stats, and eval totals to float tolerance."""
+    from pytorch_mnist_ddp_tpu.data.transforms import normalize
+    from pytorch_mnist_ddp_tpu.parallel.fused import (
+        device_put_dataset,
+        make_fused_run,
+    )
+
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, (64, 28, 28), np.uint8)
+    labels = rng.randint(0, 10, 64).astype(np.uint8)
+
+    mesh = make_mesh(num_data=8, devices=devices)
+    x, y = device_put_dataset(images, labels, mesh)
+    tx, ty = device_put_dataset(images[:32], labels[:32], mesh)
+
+    v = init_variables(jax.random.PRNGKey(0), use_bn=True)
+    run_fn, num_batches = make_fused_run(
+        mesh, 64, 32, global_batch=32, eval_batch=32, epochs=1,
+        dropout=False, use_bn=True,
+    )
+    assert num_batches == 2
+    sf = replicate_params(make_train_state(v["params"], v["batch_stats"]), mesh)
+    shuffle_key = jax.random.PRNGKey(5)
+    sf, losses, evals = run_fn(
+        sf, x, y, tx, ty, shuffle_key, jax.random.PRNGKey(6),
+        jnp.asarray([1.0], jnp.float32),
+    )
+
+    # reproduce the device-side permutation on host, drive the per-batch step
+    perm = np.asarray(
+        jax.random.permutation(jax.random.fold_in(shuffle_key, 1), 64)
+    )
+    step = make_train_step(mesh, dropout=False, use_bn=True)
+    v2 = init_variables(jax.random.PRNGKey(0), use_bn=True)
+    sp = replicate_params(make_train_state(v2["params"], v2["batch_stats"]), mesh)
+    for b in range(2):
+        take = perm[b * 32 : (b + 1) * 32]
+        xb = jnp.asarray(normalize(images[take]))
+        yb = jnp.asarray(labels[take].astype(np.int32))
+        sp, _ = step(
+            sp, xb, yb, jnp.ones((32,), jnp.float32),
+            jax.random.PRNGKey(6), jnp.float32(1.0),
+        )
+
+    for a, b in zip(jax.tree.leaves(sf.params), jax.tree.leaves(sp.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-4
+        )
+    for a, b in zip(
+        jax.tree.leaves(sf.batch_stats), jax.tree.leaves(sp.batch_stats)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-4
+        )
+    # fused per-epoch eval totals match the per-batch BN eval on the same set
+    eval_fn = make_eval_step(mesh, use_bn=True)
+    xe = jnp.asarray(normalize(images[:32]))
+    ye = jnp.asarray(labels[:32].astype(np.int32))
+    totals = np.asarray(
+        eval_fn(
+            {"params": sp.params, "batch_stats": sp.batch_stats},
+            xe, ye, jnp.ones((32,), jnp.float32),
+        )
+    )
+    np.testing.assert_allclose(np.asarray(evals)[0], totals, rtol=1e-3)
+
+
 @pytest.mark.parametrize("bad", [
-    dict(fused=True),
     dict(tp=2),
     dict(pp=True),
 ])
