@@ -1,1 +1,7 @@
-from .net import Net, init_params, torch_reset_uniform
+from .net import (
+    Net,
+    SyncBatchNorm,
+    init_params,
+    init_variables,
+    torch_reset_uniform,
+)

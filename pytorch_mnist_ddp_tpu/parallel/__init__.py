@@ -1,4 +1,11 @@
 from .sampler import epoch_indices, per_rank_count
 from .mesh import make_mesh, data_sharding, replicated_sharding
 from .distributed import init_distributed_mode, DistState
-from .ddp import make_train_step, make_eval_step, replicate_params
+from .ddp import (
+    TrainState,
+    eval_variables,
+    make_eval_step,
+    make_train_state,
+    make_train_step,
+    replicate_params,
+)

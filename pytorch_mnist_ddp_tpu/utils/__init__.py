@@ -5,4 +5,10 @@ from .logging import (
     distributed_init_banner,
     total_time_line,
 )
-from .checkpoint import save_state_dict, load_state_dict, model_state_dict
+from .checkpoint import (
+    save_state_dict,
+    load_state_dict,
+    model_state_dict,
+    params_from_state_dict,
+    variables_from_state_dict,
+)

@@ -1,0 +1,73 @@
+"""Print a SHA-256 of the benchmark program's StableHLO.
+
+The driver's round-end ``python bench.py`` must hit a warm persistent XLA
+cache (``~/.cache/tpu_mnist_ddp/xla``) or it pays the ~19 s one-time
+compile inside the recorded wall clock.  Cache entries key on the compiled
+program, so any commit that changes the fused run's HLO silently
+invalidates them (round-1 postmortem: a last-minute RNG flip did exactly
+that).
+
+This tool makes the check cheap without TPU access: StableHLO lowering is
+platform-independent at this level, so if the hash printed here matches
+the hash at the commit that last warmed the cache, the TPU cache is still
+valid.  The tool hashes the tree it is RUN FROM (``os.getcwd()`` leads the
+import path), so compare across commits with::
+
+    python tools/bench_program_hash.py           # current working tree
+    git worktree add /tmp/old <commit>
+    cp tools/bench_program_hash.py /tmp/old/tools/  # if absent there
+    (cd /tmp/old && python tools/bench_program_hash.py)
+
+The protocol (batch/eval sizes, epochs, PRNG) is imported from
+``bench.PROTOCOL`` — the single source bench.py's own defaults use — so
+the hashed program cannot drift from the one the benchmark compiles.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+
+
+def main() -> None:
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.getcwd())
+    from bench import PROTOCOL, TEST_SET_SIZE, TRAIN_SET_SIZE
+
+    jax.config.update("jax_default_prng_impl", PROTOCOL["prng_impl"])
+    import jax.numpy as jnp
+
+    from pytorch_mnist_ddp_tpu.parallel.fused import make_fused_run
+    from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(num_data=1, devices=jax.devices()[:1])
+    run_fn, _ = make_fused_run(
+        mesh, TRAIN_SET_SIZE, TEST_SET_SIZE,
+        global_batch=PROTOCOL["batch_size"],
+        eval_batch=PROTOCOL["test_batch_size"],
+        epochs=PROTOCOL["epochs"],
+        from_key=True,
+    )
+    key = jax.random.PRNGKey(1)
+    args = (
+        key,
+        jnp.zeros((TRAIN_SET_SIZE, 28, 28), jnp.uint8),
+        jnp.zeros((TRAIN_SET_SIZE,), jnp.int32),
+        jnp.zeros((TEST_SET_SIZE, 28, 28), jnp.uint8),
+        jnp.zeros((TEST_SET_SIZE,), jnp.int32),
+        key,
+        key,
+        jnp.ones((PROTOCOL["epochs"],), jnp.float32),
+    )
+    text = run_fn.lower(*args).as_text()
+    print(hashlib.sha256(text.encode()).hexdigest())
+
+
+if __name__ == "__main__":
+    main()
