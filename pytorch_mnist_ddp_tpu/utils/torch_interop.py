@@ -133,3 +133,14 @@ def params_from_torch_checkpoint(path: str) -> dict[str, Any]:
     from .checkpoint import params_from_state_dict
 
     return params_from_state_dict(load_torch_checkpoint(path))
+
+
+def variables_from_torch_checkpoint(path: str) -> dict[str, Any]:
+    """Like :func:`params_from_torch_checkpoint` but keeps BN running
+    statistics too: returns the full Flax variable dict
+    (``{"params": ...}`` plus ``{"batch_stats": ...}`` when the checkpoint
+    carries ``running_mean``/``running_var`` entries — e.g. one saved by a
+    ``--syncbn`` run, or by a torch model using BatchNorm)."""
+    from .checkpoint import variables_from_state_dict
+
+    return variables_from_state_dict(load_torch_checkpoint(path))

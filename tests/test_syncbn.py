@@ -269,6 +269,34 @@ def test_bn_checkpoint_roundtrip(tmp_path):
     )
 
 
+def test_bn_torch_checkpoint_import(tmp_path):
+    """One-call torch import keeps the running stats: a model restored via
+    variables_from_torch_checkpoint evaluates identically to the original
+    variables."""
+    pytest.importorskip("torch")
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import (
+        model_state_dict,
+        save_state_dict,
+    )
+    from pytorch_mnist_ddp_tpu.utils.torch_interop import (
+        variables_from_torch_checkpoint,
+    )
+
+    v = init_variables(jax.random.PRNGKey(5), use_bn=True)
+    path = str(tmp_path / "bn_torch.pt")
+    save_state_dict(
+        model_state_dict(v["params"], batch_stats=v["batch_stats"]),
+        path, format="torch",
+    )
+    restored = variables_from_torch_checkpoint(path)
+    x, _, _ = _global_batch(n=4)
+    out_orig = Net(use_bn=True).apply(v, x, train=False)
+    out_back = Net(use_bn=True).apply(restored, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out_back), np.asarray(out_orig), rtol=1e-5, atol=1e-6
+    )
+
+
 def test_syncbn_cli_dry_run(tmp_path):
     from tests.test_e2e import _write_idx
 
