@@ -185,6 +185,10 @@ def main() -> None:
     p.add_argument("--bf16", action="store_true",
                    help="benchmark the bfloat16 compute path (recorded in "
                         "the JSON; the default headline stays fp32)")
+    p.add_argument("--syncbn", action="store_true",
+                   help="benchmark the cross-replica BatchNorm model "
+                        "(recorded in the JSON; not the headline — the "
+                        "reference Net has no BN)")
     p.add_argument("--probe-attempts", type=int, default=None,
                    help="cap backend-probe attempts (default: full "
                         f"{1 + len(PROBE_BACKOFFS_S)}-attempt schedule, "
@@ -252,6 +256,7 @@ def main() -> None:
         save_model=False,
         fused=True,
         bf16=args.bf16,
+        syncbn=args.syncbn,
         data_root="./data",
     )
     if len(devices) > 1:
@@ -302,6 +307,7 @@ def main() -> None:
         "prng_impl": prng_impl,
         "compute_dtype": "bfloat16" if args.bf16 else "float32",
         "cache": cache_state,
+        "syncbn": bool(args.syncbn),
         # "idx" (real MNIST files) or "synthetic" (air-gapped fallback):
         # says which task produced the accuracy fields below.
         "dataset": timings.get("dataset", "unknown"),
@@ -334,8 +340,9 @@ def main() -> None:
         not args.quick
         and not args.allow_cpu
         and not args.bf16
-        and args.epochs == 20
-        and args.batch_size == 200
+        and not args.syncbn
+        and args.epochs == PROTOCOL["epochs"]
+        and args.batch_size == PROTOCOL["batch_size"]
         and not (
             prev is not None
             and prev.get("dataset") == "idx"
