@@ -59,3 +59,23 @@ def test_probe_schedule_capping():
     assert bench._probe_schedule(1) == (0,)
     assert bench._probe_schedule(0) == (0,)
     assert bench._probe_schedule(2) == (0, bench.PROBE_BACKOFFS_S[0])
+
+
+def test_bench_program_hash_tool():
+    """tools/bench_program_hash.py must keep running (it is the round-end
+    warm-cache check): emits exactly one 64-hex line, deterministically."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    outs = []
+    for _ in range(2):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "bench_program_hash.py")],
+            capture_output=True, text=True, cwd=repo, timeout=300, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        outs.append(proc.stdout.strip())
+    assert len(outs[0]) == 64 and set(outs[0]) <= set("0123456789abcdef")
+    assert outs[0] == outs[1], "hash not deterministic"
