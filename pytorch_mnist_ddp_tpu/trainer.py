@@ -15,7 +15,7 @@ import numpy as np
 
 from .data.loader import DataLoader
 from .data.mnist import MNIST
-from .models.net import init_params
+from .models.net import init_params, init_variables
 from .ops.schedule import step_lr
 from .parallel.ddp import (
     TrainState,
@@ -302,8 +302,6 @@ def _fit_body(
                 )
     else:
         if syncbn:
-            from .models.net import init_variables
-
             variables = init_variables(keys["init"], use_bn=True)
             params = variables["params"]
             bn_stats = variables["batch_stats"]
