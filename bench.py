@@ -189,6 +189,14 @@ def main() -> None:
                    help="benchmark the cross-replica BatchNorm model "
                         "(recorded in the JSON; not the headline — the "
                         "reference Net has no BN)")
+    p.add_argument("--train-limit", type=int, default=0,
+                   help="smoke only: truncate train/test sets to N samples "
+                        "so the full bench path can be driven end-to-end on "
+                        "CPU; never recorded as a headline number")
+    p.add_argument("--pallas-opt", action="store_true",
+                   help="benchmark the fused Pallas optimizer kernel path "
+                        "(recorded in the JSON; not the headline until it "
+                        "measures faster)")
     p.add_argument("--probe-attempts", type=int, default=None,
                    help="cap backend-probe attempts (default: full "
                         f"{1 + len(PROBE_BACKOFFS_S)}-attempt schedule, "
@@ -257,6 +265,8 @@ def main() -> None:
         fused=True,
         bf16=args.bf16,
         syncbn=args.syncbn,
+        pallas_opt=args.pallas_opt,
+        train_limit=args.train_limit,
         data_root="./data",
     )
     if len(devices) > 1:
@@ -293,6 +303,10 @@ def main() -> None:
         else "cold" if new_entries
         else "warm"
     )
+    # Actual dataset sizes (differ from the protocol only under the
+    # --train-limit smoke): all throughput/MFU math below follows them.
+    train_size = int(timings.get("train_size", TRAIN_SET_SIZE))
+    test_size = int(timings.get("test_size", TEST_SET_SIZE))
     result = {
         "metric": metric,
         "value": round(elapsed, 2),
@@ -301,13 +315,15 @@ def main() -> None:
         # BASELINE.md scaling-table metric (train images processed per
         # second per chip; the reference's 73.6 s best ≈ 4077 on 4 GPUs).
         "images_per_sec_per_chip": round(
-            TRAIN_SET_SIZE * args.epochs / elapsed / len(devices), 1
+            train_size * args.epochs / elapsed / len(devices), 1
         ),
         "n_chips": len(devices),
         "prng_impl": prng_impl,
         "compute_dtype": "bfloat16" if args.bf16 else "float32",
         "cache": cache_state,
         "syncbn": bool(args.syncbn),
+        "pallas_opt": bool(args.pallas_opt),
+        "train_limit": args.train_limit or None,
         # "idx" (real MNIST files) or "synthetic" (air-gapped fallback):
         # says which task produced the accuracy fields below.
         "dataset": timings.get("dataset", "unknown"),
@@ -320,6 +336,29 @@ def main() -> None:
         result["run_s"] = round(timings["run_s"], 2)
         result["compile_s"] = round(timings.get("compile_s", 0.0), 2)
         result["data_s"] = round(timings.get("data_s", 0.0), 2)
+        # Steady-state throughput: same metric as images_per_sec_per_chip
+        # but over run_s (compiled-run execution only), so a cold run's
+        # ~19 s one-time compile doesn't understate it ~3x and a warm run
+        # doesn't silently inflate the comparison (round-2 verdict weak #2).
+        if timings["run_s"] > 0:
+            result["images_per_sec_per_chip_run"] = round(
+                train_size * args.epochs / timings["run_s"] / len(devices), 1
+            )
+            # Analytic-FLOPs MFU over the same window, against the chip's
+            # published bf16 peak (utils/flops.py documents the count and
+            # the dtype convention).  Comparable across rounds and chips.
+            from pytorch_mnist_ddp_tpu.utils.flops import (
+                run_flops, tpu_peak_flops_per_chip,
+            )
+
+            flops = run_flops(train_size, test_size, args.epochs)
+            peak = tpu_peak_flops_per_chip(devices[0].device_kind)
+            result["model_tflops"] = round(flops / 1e12, 2)
+            if peak is not None:
+                result["peak_bf16_tflops_per_chip"] = round(peak / 1e12, 1)
+                result["mfu"] = round(
+                    flops / timings["run_s"] / (peak * len(devices)), 4
+                )
     if "final_test_accuracy" in timings:
         # BASELINE.json's accuracy axis (>=99% target), recorded with the
         # wall clock so neither can regress unnoticed.  The synthetic task
@@ -341,6 +380,8 @@ def main() -> None:
         and not args.allow_cpu
         and not args.bf16
         and not args.syncbn
+        and not args.pallas_opt
+        and not args.train_limit
         and args.epochs == PROTOCOL["epochs"]
         and args.batch_size == PROTOCOL["batch_size"]
         and not (

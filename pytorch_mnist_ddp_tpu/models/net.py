@@ -138,6 +138,10 @@ class SyncBatchNorm(nn.Module):
             mean = s1 / n
             var = jnp.maximum(s2 / n - mean * mean, 0.0)
             if not self.is_initializing():
+                # n==1 clamp: torch divides by zero here (inf/NaN running
+                # var); we yield 0 instead — a deliberate, unreachable
+                # (loaders never emit a 1-sample global batch) deviation
+                # from the otherwise torch-exact stats (round-2 advisor).
                 unbiased = var * (n / jnp.maximum(n - 1.0, 1.0))
                 ra_mean.value = (
                     (1.0 - self.momentum) * ra_mean.value + self.momentum * mean
@@ -223,8 +227,12 @@ class Net(nn.Module):
             10, name="fc2", dtype=self.compute_dtype,
             kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(128),
         )(x)
-        # fp32 log_softmax regardless of compute dtype: NLL accuracy matters.
-        return jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+        # log_softmax in at least fp32 regardless of compute dtype (NLL
+        # accuracy matters); promote_types keeps an f64 trace f64 so the
+        # float64 trajectory-parity test isn't truncated at the tail.
+        return jax.nn.log_softmax(
+            x.astype(jnp.promote_types(x.dtype, jnp.float32)), axis=-1
+        )
 
 
 def raw_conv_stack(params: dict, x: jax.Array) -> jax.Array:

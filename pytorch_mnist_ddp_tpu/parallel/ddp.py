@@ -50,9 +50,18 @@ class TrainState(NamedTuple):
     batch_stats: Any = ()
 
 
-def make_train_state(params: Any, batch_stats: Any = ()) -> TrainState:
+def make_train_state(
+    params: Any, batch_stats: Any = (), use_pallas: bool | None = None
+) -> TrainState:
+    """``use_pallas`` mirrors the train step's flag: when the Pallas
+    optimizer kernel will actually run (ops/pallas_adadelta.py:
+    pallas_opt_active), the Adadelta accumulators are created in the
+    kernel's persistent padded-flat layout so no per-step ravel exists."""
+    from ..ops.pallas_adadelta import adadelta_init_flat, pallas_opt_active
+
+    init = adadelta_init_flat if pallas_opt_active(use_pallas) else adadelta_init
     return TrainState(
-        params=params, opt=adadelta_init(params), step=jnp.int32(0),
+        params=params, opt=init(params), step=jnp.int32(0),
         batch_stats=batch_stats,
     )
 

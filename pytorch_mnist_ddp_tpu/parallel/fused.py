@@ -302,7 +302,14 @@ def make_fused_run(
     so a cold process reaches the hot loop with one device dispatch total —
     no separate init program to compile/load, no parameter upload.
     """
-    from ..ops.adadelta import adadelta_init
+    from ..ops.adadelta import adadelta_init as _tree_init
+    from ..ops.pallas_adadelta import adadelta_init_flat, pallas_opt_active
+
+    # Same layout decision the step's update dispatch makes: the kernel's
+    # persistent padded-flat accumulators iff the kernel will actually run.
+    adadelta_init = (
+        adadelta_init_flat if pallas_opt_active(use_pallas) else _tree_init
+    )
 
     model = Net(
         compute_dtype=compute_dtype, use_bn=use_bn,

@@ -156,12 +156,17 @@ def load_state_dict(path: str) -> dict[str, np.ndarray]:
         raise
 
 
-def _param_leaf_name(torch_leaf: str, value: np.ndarray) -> str:
+def _param_leaf_name(module: str, torch_leaf: str, value: np.ndarray) -> str:
     """Torch leaf name -> flax param leaf name.  ``weight`` is ambiguous:
-    conv/dense kernels (ndim >= 2) map to ``kernel``, BatchNorm's per-
-    channel vector (ndim 1) to ``scale``."""
+    BatchNorm modules (named ``bn*``, models/net.py) carry a per-channel
+    vector that maps to flax's ``scale``; every other ``weight`` is a
+    conv/dense ``kernel``.  Keyed on the module name AND ndim — a future
+    1-D non-BN weight (LayerNorm-style) must not be silently misrouted
+    into ``scale`` (round-2 advisor finding)."""
     if torch_leaf == "weight":
-        return "scale" if np.ndim(value) == 1 else "kernel"
+        if module.startswith("bn") and np.ndim(value) == 1:
+            return "scale"
+        return "kernel"
     return torch_leaf
 
 
@@ -193,7 +198,8 @@ def variables_from_state_dict(
         if leaf in _STATS_RENAME_INV:
             dest, leaf = stats, _STATS_RENAME_INV[leaf]
         else:
-            dest, leaf = params, _param_leaf_name(leaf, value)
+            module = parts[-2] if len(parts) > 1 else ""
+            dest, leaf = params, _param_leaf_name(module, leaf, value)
         node = dest
         for p in parts[:-1]:
             node = node.setdefault(p, {})

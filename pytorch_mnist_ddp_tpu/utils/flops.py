@@ -1,0 +1,85 @@
+"""Analytic FLOPs model for the reference CNN and the benchmark protocol.
+
+Counts matmul/conv multiply-accumulates only (2 FLOPs per MAC) — the MXU
+work that MFU conventionally measures.  Elementwise ops (relu, dropout,
+log_softmax, BN affine) and the optimizer update are excluded: together
+they are <1% of the conv/dense FLOPs at benchmark shapes and XLA fuses
+them into the surrounding matmuls anyway.
+
+Layer shapes (models/net.py; reference mnist.py:11-34): 28x28x1 input,
+conv1 3x3 VALID -> 26x26x32, conv2 3x3 VALID -> 24x24x64, maxpool ->
+12x12x64 = 9216, fc1 -> 128, fc2 -> 10.
+
+The training-step multiplier is the standard 3x forward (forward + grad
+wrt weights + grad wrt activations, each approximately one forward's
+MACs).  This slightly overcounts — conv1's grad-wrt-input is dead (the
+image is not a parameter) — making the derived MFU conservative-high by
+~0.4%; accepted for simplicity.
+
+``tpu_peak_flops_per_chip`` maps ``jax.Device.device_kind`` strings to
+published peak bf16 matmul throughput.  MFU is reported against the bf16
+peak regardless of compute dtype (the MXU's native width; an fp32 run's
+MFU is therefore an underestimate of how well it uses the fp32 path),
+with the peak recorded alongside so the denominator is auditable.
+"""
+
+from __future__ import annotations
+
+# (out_h, out_w, out_c, kernel_macs_per_output) for each conv; (in, out)
+# for each dense layer.
+_CONVS = (
+    (26, 26, 32, 3 * 3 * 1),
+    (24, 24, 64, 3 * 3 * 32),
+)
+_DENSES = (
+    (9216, 128),
+    (128, 10),
+)
+
+# Published peak bf16 TFLOP/s per chip, keyed by substrings of
+# jax.Device.device_kind (lowercased).  Order matters: first match wins.
+_PEAK_BF16_TFLOPS = (
+    ("v5 lite", 197.0),  # v5e ("TPU v5 lite")
+    ("v5e", 197.0),
+    ("v5p", 459.0),
+    ("v6 lite", 918.0),  # Trillium / v6e
+    ("v6e", 918.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def forward_flops_per_sample() -> int:
+    """Matmul/conv FLOPs for one sample's forward pass (~24 MFLOPs)."""
+    total = 0
+    for h, w, c, macs in _CONVS:
+        total += 2 * h * w * c * macs
+    for fan_in, fan_out in _DENSES:
+        total += 2 * fan_in * fan_out
+    return total
+
+
+def train_step_flops_per_sample() -> int:
+    """Forward + backward (3x forward, see module docstring)."""
+    return 3 * forward_flops_per_sample()
+
+
+def run_flops(train_samples: int, test_samples: int, epochs: int) -> int:
+    """Total model FLOPs for the benchmark run: ``epochs`` passes of
+    training over ``train_samples`` plus one eval forward pass over
+    ``test_samples`` per epoch (trainer.py fused run structure)."""
+    per_epoch = (
+        train_samples * train_step_flops_per_sample()
+        + test_samples * forward_flops_per_sample()
+    )
+    return epochs * per_epoch
+
+
+def tpu_peak_flops_per_chip(device_kind: str) -> float | None:
+    """Peak bf16 FLOP/s for ``device_kind``, or None if unrecognized."""
+    kind = device_kind.lower()
+    for substr, tflops in _PEAK_BF16_TFLOPS:
+        if substr in kind:
+            return tflops * 1e12
+    return None

@@ -26,6 +26,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # OS-process / convergence tier (see pytest.ini)
+
 import jax
 import jax.numpy as jnp
 

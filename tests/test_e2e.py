@@ -11,6 +11,8 @@ from argparse import Namespace
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # OS-process / convergence tier (see pytest.ini)
+
 import jax
 
 from pytorch_mnist_ddp_tpu.data.mnist import synthetic_mnist
@@ -98,9 +100,13 @@ def test_fit_fused_populates_timings(tmp_path, capsys, devices):
     capsys.readouterr()
     assert set(timings) == {
         "data_s", "compile_s", "run_s", "dataset",
+        "train_size", "test_size",
         "epoch1_test_accuracy", "final_test_accuracy",
     }
     assert timings.pop("dataset") == "idx"  # _write_idx provides real files
+    # Actual sizes (bench.py's throughput/MFU denominators) follow the
+    # dataset, not the 60k protocol constant.
+    assert timings.pop("train_size") == 512 and timings.pop("test_size") == 256
     assert timings["data_s"] > 0 and timings["compile_s"] > 0
     assert timings["run_s"] > 0
     assert 0.0 <= timings["final_test_accuracy"] <= 1.0

@@ -134,6 +134,7 @@ def test_fused_tiny_dataset_large_batch(devices):
     assert np.isfinite(np.asarray(losses)).all()
 
 
+@pytest.mark.slow  # compile-heavy; full tier only (pytest.ini)
 def test_fused_run_matches_per_epoch_fusion(devices):
     """Whole-run fusion (make_fused_run) must reproduce the per-epoch fused
     loop exactly: same per-step losses, same eval totals, same final params."""
@@ -207,6 +208,7 @@ def test_fused_masks_final_partial_batch(devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-5)
 
 
+@pytest.mark.slow  # compile-heavy; full tier only (pytest.ini)
 def test_fused_run_from_key_matches_external_init(devices):
     """from_key=True (param init inside the compiled run) must be
     bit-identical to initializing via init_params and passing the state."""
@@ -236,6 +238,7 @@ def test_fused_run_from_key_matches_external_init(devices):
     assert int(sb.step) == int(sa.step)
 
 
+@pytest.mark.slow  # compile-heavy; full tier only (pytest.ini)
 def test_fused_run_with_rbg_keys_matches_per_epoch(devices):
     """bench.py flips the default PRNG to rbg; the fused machinery must be
     generator-agnostic.  Under rbg keys the whole-run fusion still matches

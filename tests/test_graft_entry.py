@@ -13,6 +13,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Generous wall budget: a clean dryrun_multichip(8) is ~20-40 s including
@@ -21,6 +23,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DRYRUN_BUDGET_S = 300
 
 
+@pytest.mark.slow  # compile-heavy; full tier only (pytest.ini)
 def test_dryrun_multichip_survives_hostile_env():
     """dryrun_multichip must complete on virtual CPU devices even when the
     environment actively points at an accelerator tunnel and requests no

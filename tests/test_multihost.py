@@ -21,6 +21,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # OS-process / convergence tier (see pytest.ini)
+
 from test_e2e import _write_idx
 
 _WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")

@@ -17,9 +17,13 @@ from pytorch_mnist_ddp_tpu.ops.adadelta import (
     adadelta_update,
 )
 from pytorch_mnist_ddp_tpu.ops.pallas_adadelta import (
+    adadelta_init_flat,
     adadelta_update_best,
+    adadelta_update_flat,
     adadelta_update_pallas,
     fused_adadelta_flat,
+    is_flat_state,
+    pallas_opt_active,
 )
 
 
@@ -99,6 +103,66 @@ def test_lr_is_traced_not_baked():
     np.testing.assert_allclose(np.asarray(out07), np.asarray(ref07), rtol=1e-5, atol=1e-6)
 
 
+def test_flat_state_update_matches_plain_on_model_params():
+    """The persistent-layout kernel (round-2 verdict item 7: accumulators
+    live as padded [rows,128] buffers across steps, no per-step ravel of
+    params or accumulators) produces the same params trajectory as the
+    plain update, for several chained steps."""
+    params = init_params(jax.random.PRNGKey(0))
+    grads = jax.tree.map(
+        lambda p: jnp.asarray(
+            np.random.RandomState(1).randn(*p.shape).astype(np.float32) * 0.01
+        ),
+        params,
+    )
+    fstate = adadelta_init_flat(params)
+    assert is_flat_state(fstate) and not is_flat_state(adadelta_init(params))
+    tstate = adadelta_init(params)
+    p_f, p_t = params, params
+    for step in range(3):
+        p_f, fstate = adadelta_update_flat(
+            p_f, grads, fstate, 0.7, interpret=True
+        )
+        p_t, tstate = adadelta_update(p_t, grads, tstate, 0.7)
+    for a, b in zip(jax.tree.leaves(p_f), jax.tree.leaves(p_t), strict=True):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+    # Accumulators round-trip through the padded layout without drift.
+    from jax.flatten_util import ravel_pytree
+
+    flat_sq = np.asarray(fstate.square_avg).reshape(-1)
+    ref_sq, _ = ravel_pytree(tstate.square_avg)
+    np.testing.assert_allclose(
+        flat_sq[: ref_sq.shape[0]], np.asarray(ref_sq), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_pallas_opt_active_gating(monkeypatch):
+    """Init sites and the update dispatch share one backend gate: inactive
+    on CPU unless the interpret test hook is set, so the CLI can never
+    build a flat state the plain update would then choke on."""
+    monkeypatch.delenv("TPU_MNIST_PALLAS_INTERPRET", raising=False)
+    assert not pallas_opt_active(True)   # cpu backend, no hook
+    assert not pallas_opt_active(None)
+    monkeypatch.setenv("TPU_MNIST_PALLAS_INTERPRET", "1")
+    assert pallas_opt_active(True)
+    assert not pallas_opt_active(False)
+
+
+def test_bare_2d_param_state_is_not_misrouted():
+    """A plain AdadeltaState over a single bare 2-D weight (a valid pytree
+    for every adadelta_* API) must NOT be mistaken for the kernel's flat
+    layout — dispatch keys on the FlatAdadeltaState type, not on shape
+    (round-3 review finding)."""
+    w = {"w": jnp.ones((3, 5), jnp.float32)}
+    g = {"w": jnp.full((3, 5), 0.5, jnp.float32)}
+    state = adadelta_init(w["w"])  # square_avg is a bare (3,5) array
+    assert not is_flat_state(state)
+    p_best, _ = adadelta_update_best(w["w"], g["w"], state, 0.7)
+    p_plain, _ = adadelta_update(w["w"], g["w"], state, 0.7)
+    np.testing.assert_array_equal(np.asarray(p_best), np.asarray(p_plain))
+
+
 def test_dispatch_default_is_plain():
     """adadelta_update_best defaults to the plain update (the measured-best
     path at this model scale) and switches to pallas only on request."""
@@ -133,7 +197,11 @@ def test_train_step_with_pallas_matches_plain(monkeypatch):
     results = []
     for use_pallas in (False, True):
         params = init_params(jax.random.PRNGKey(0))
-        state = replicate_params(make_train_state(params), mesh)
+        # use_pallas plumbs to the state init too: the pallas leg runs the
+        # persistent-flat-layout kernel end-to-end through shard_map.
+        state = replicate_params(
+            make_train_state(params, use_pallas=use_pallas), mesh
+        )
         step = make_train_step(mesh, dropout=False, use_pallas=use_pallas)
         for _ in range(3):
             state, losses = step(

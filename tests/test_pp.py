@@ -8,6 +8,7 @@ activation hand-off, and AD's reverse pipeline are the identity transform.
 """
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -92,6 +93,7 @@ def test_pp_requires_two_stages(devices):
         make_pp_train_step(make_mesh(), num_micro=2)  # 8x1 mesh: no stages
 
 
+@pytest.mark.slow  # compile-heavy; full tier only (pytest.ini)
 def test_pp_trains_with_dropout(devices):
     """Dropout pipelines too (rematerialized masks replay in the manual
     backward schedule): the loss falls over a few steps."""
@@ -110,6 +112,7 @@ def test_pp_trains_with_dropout(devices):
     assert float(jnp.mean(losses)) < first
 
 
+@pytest.mark.slow  # compile-heavy; full tier only (pytest.ini)
 def test_pp_dropout_grads_match_manual_reference(devices):
     """The hand-written backward schedule under dropout is checked against
     plain jax.grad of an UNPIPELINED replica of the same math: identical

@@ -3,6 +3,8 @@ profiling'): the trace context manager produces an XProf capture, StepStats
 aggregates sanely, and the CLI flags thread through fit()."""
 
 import glob
+
+import pytest
 import os
 
 import numpy as np
@@ -46,6 +48,7 @@ def test_step_stats_counts_single_step():
     assert s.summary_line(1).startswith("Step stats epoch 1: 1 steps")
 
 
+@pytest.mark.slow  # compile-heavy; full tier only (pytest.ini)
 def test_fit_with_profile_and_step_stats(tmp_path, capsys):
     """--profile + --step-stats through the real per-batch fit() path."""
     from argparse import Namespace

@@ -66,6 +66,7 @@ def _run_steps(num_shards, devices, steps=3):
     return state, totals
 
 
+@pytest.mark.slow  # compile-heavy; full tier only (pytest.ini)
 def test_syncbn_sharded_matches_global_batch(devices):
     """8-way sharded SyncBN == single-device global-batch BN.  The margins
     matter: synced runs agree to ~1e-3 (params) / ~4e-5 (stats) after 3
@@ -297,6 +298,7 @@ def test_bn_torch_checkpoint_import(tmp_path):
     )
 
 
+@pytest.mark.slow  # compile-heavy; full tier only (pytest.ini)
 def test_syncbn_cli_dry_run(tmp_path):
     from tests.test_e2e import _write_idx
 

@@ -25,14 +25,29 @@ the hashed program cannot drift from the one the benchmark compiles.
 
 from __future__ import annotations
 
+import argparse
 import hashlib
 import os
 import sys
 
 
 def main() -> None:
+    # --num-devices must match the bench topology: bench.py builds its mesh
+    # from all visible devices, and a different mesh lowers different
+    # StableHLO.  Default 1 = this host's single tunneled chip; on a
+    # multi-chip host pass the chip count or the warm-cache check can
+    # false-pass/false-fail (round-2 advisor finding).
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-devices", type=int, default=1)
+    opts = ap.parse_args()
+
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
+    if opts.num_devices > 1:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={opts.num_devices}"
+        ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -46,7 +61,8 @@ def main() -> None:
     from pytorch_mnist_ddp_tpu.parallel.fused import make_fused_run
     from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
 
-    mesh = make_mesh(num_data=1, devices=jax.devices()[:1])
+    n = opts.num_devices
+    mesh = make_mesh(num_data=n, devices=jax.devices()[:n])
     run_fn, _ = make_fused_run(
         mesh, TRAIN_SET_SIZE, TEST_SET_SIZE,
         global_batch=PROTOCOL["batch_size"],
