@@ -92,7 +92,8 @@ class SyncBatchNorm(nn.Module):
     the running average blends the UNBIASED one (Bessel ``n/(n-1)`` —
     torch's documented running-var behavior) with weight
     ``BN_TORCH_MOMENTUM``; eval normalizes with the running averages.
-    Statistics are always computed in float32.
+    Statistics are computed in at least float32 (f64 traces stay f64 for
+    the trajectory-parity test); running averages are stored float32.
     """
 
     momentum: float = BN_TORCH_MOMENTUM
@@ -119,18 +120,27 @@ class SyncBatchNorm(nn.Module):
         )
 
         if train:
-            x32 = x.astype(jnp.float32)
+            # Statistics in at least f32 (bf16 inputs promote); promote_types
+            # keeps an f64 trace f64 for the trajectory-parity test — under
+            # the default f32 config every cast below is a no-op and the
+            # lowered program is unchanged.
+            stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
+            x32 = x.astype(stat_dtype)
             reduce_axes = tuple(range(x.ndim - 1))  # all but channels
             if mask is None:
-                n = jnp.float32(np.prod([x.shape[a] for a in reduce_axes]))
+                n = jnp.asarray(
+                    np.prod([x.shape[a] for a in reduce_axes]), stat_dtype
+                )
                 s1 = x32.sum(reduce_axes)
                 s2 = (x32 * x32).sum(reduce_axes)
             else:
-                m = mask.astype(jnp.float32).reshape(
+                m = mask.astype(stat_dtype).reshape(
                     mask.shape + (1,) * (x.ndim - mask.ndim)
                 )
                 spatial = np.prod(x.shape[1:-1], dtype=np.float64)
-                n = mask.astype(jnp.float32).sum() * jnp.float32(spatial)
+                n = mask.astype(stat_dtype).sum() * jnp.asarray(
+                    spatial, stat_dtype
+                )
                 s1 = (x32 * m).sum(reduce_axes)
                 s2 = (x32 * x32 * m).sum(reduce_axes)
             if self.axis_name is not None:
@@ -143,17 +153,21 @@ class SyncBatchNorm(nn.Module):
                 # (loaders never emit a 1-sample global batch) deviation
                 # from the otherwise torch-exact stats (round-2 advisor).
                 unbiased = var * (n / jnp.maximum(n - 1.0, 1.0))
+                # Running averages are STORED f32 regardless of stat_dtype
+                # (keeps the carried batch_stats dtype invariant; eval-time
+                # normalization is f32 either way).
                 ra_mean.value = (
                     (1.0 - self.momentum) * ra_mean.value + self.momentum * mean
-                )
+                ).astype(jnp.float32)
                 ra_var.value = (
                     (1.0 - self.momentum) * ra_var.value
                     + self.momentum * unbiased
-                )
+                ).astype(jnp.float32)
         else:
             mean, var = ra_mean.value, ra_var.value
 
-        y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.epsilon)
+        y = (x.astype(jnp.promote_types(x.dtype, jnp.float32)) - mean) \
+            * jax.lax.rsqrt(var + self.epsilon)
         y = y * scale + bias
         return y.astype(self.compute_dtype)
 

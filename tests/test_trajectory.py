@@ -99,14 +99,26 @@ def _torch_reference_trajectory(init_state: dict, xs, ys, lr: float):
 
     dtype = torch.float64 if xs.dtype == np.float64 else torch.float32
     model = TorchNet().to(dtype)
+    return _run_torch_recurrence(model, init_state, xs, ys, lr)
+
+
+def _run_torch_recurrence(model, init_state: dict, xs, ys, lr: float):
+    """Shared torch-side driver (used by the plain and BN legs, so the two
+    torch references cannot drift apart): load ``init_state`` into
+    ``model``, then run the reference loop — zero_grad, forward, nll_loss,
+    backward, Adadelta step (mnist.py:37-51) — over the batches.
+    torch.optim.Adadelta defaults (rho=0.9, eps=1e-6) are the reference's
+    configuration; only lr is passed (mnist.py:124)."""
+    import torch
+    import torch.nn.functional as F
+
+    dtype = next(model.parameters()).dtype
     with torch.no_grad():
         for key, value in init_state.items():
             mod, leaf = key.rsplit(".", 1)
             getattr(getattr(model, mod), leaf).copy_(
                 torch.tensor(value).to(dtype)
             )
-    # torch.optim.Adadelta defaults (rho=0.9, eps=1e-6) are the reference's
-    # configuration; only lr is passed (mnist.py:124).
     optimizer = torch.optim.Adadelta(model.parameters(), lr=lr)
 
     losses = []
@@ -171,6 +183,94 @@ def test_trajectory_matches_torch_f64(x64_mode):
     torch_out = _torch_reference_trajectory(torch_init, xs, ys, lr=1.0)
     ours = _ours_trajectory(params, xs, ys, 1.0, num_devices=1)
     _assert_trajectory_close(ours, *torch_out, rtol=1e-8, atol=1e-10)
+
+
+@pytest.mark.slow  # compile-heavy; full tier only (pytest.ini)
+def test_bn_trajectory_matches_torch_f64(x64_mode):
+    """SyncBN leg at float64, 12 steps: pins the BatchNorm *backward*
+    (gradients through the count-weighted psum'd batch statistics,
+    models/net.py:SyncBatchNorm) plus the running-average recurrence
+    against ``torch.nn.BatchNorm2d`` in train mode — the one backward path
+    the non-BN legs don't touch.  Params/losses to 1e-8 (f64 throughout);
+    running stats to 1e-6 (ours are STORED f32 by design)."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+    import torch.nn.functional as F
+
+    from pytorch_mnist_ddp_tpu.models.net import BN_EPS, init_variables
+
+    k_steps = 12
+    variables = init_variables(jax.random.PRNGKey(11), use_bn=True)
+    params, stats = variables["params"], variables["batch_stats"]
+    torch_init = state_dict_to_torch_layout(
+        model_state_dict(params, batch_stats=stats)
+    )
+    xs, ys = _make_batches(np.float64)
+    xs, ys = xs[:k_steps], ys[:k_steps]
+
+    class TorchBNNet(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = tnn.Conv2d(1, 32, 3, 1)
+            self.bn1 = tnn.BatchNorm2d(32, eps=BN_EPS)
+            self.conv2 = tnn.Conv2d(32, 64, 3, 1)
+            self.bn2 = tnn.BatchNorm2d(64, eps=BN_EPS)
+            self.fc1 = tnn.Linear(9216, 128)
+            self.fc2 = tnn.Linear(128, 10)
+
+        def forward(self, x):
+            x = F.relu(self.bn1(self.conv1(x)))
+            x = F.relu(self.bn2(self.conv2(x)))
+            x = F.max_pool2d(x, 2)
+            x = torch.flatten(x, 1)
+            x = F.relu(self.fc1(x))
+            x = self.fc2(x)
+            return F.log_softmax(x, dim=1)
+
+    model = TorchBNNet().double()
+    model.train()  # BN batch statistics + running-average updates active
+    torch_losses, torch_final = _run_torch_recurrence(
+        model, torch_init, xs, ys, lr=1.0
+    )
+
+    # Ours: the DP train step with use_bn (dropout off), 1-device mesh —
+    # the psum'd statistics path with a world of one.
+    mesh = make_mesh(num_data=1, devices=jax.devices()[:1])
+    step_fn = make_train_step(
+        mesh, compute_dtype=jnp.float64, dropout=False, use_bn=True
+    )
+    params64 = jax.tree.map(
+        lambda v: jnp.asarray(np.asarray(v), jnp.float64), params
+    )
+    state = replicate_params(make_train_state(params64, stats), mesh)
+    w = jnp.ones((BATCH,), jnp.float64)
+    key = jax.random.PRNGKey(0)
+    our_losses = []
+    for x, y in zip(xs, ys):
+        state, step_losses = step_fn(
+            state, jnp.asarray(x), jnp.asarray(y), w, key,
+            jnp.asarray(1.0, jnp.float64),
+        )
+        our_losses.append(float(jnp.mean(step_losses)))
+
+    np.testing.assert_allclose(our_losses, torch_losses, rtol=1e-8, atol=1e-10)
+    assert our_losses[-1] != our_losses[0]
+    our_final = state_dict_to_torch_layout(
+        model_state_dict(
+            jax.tree.map(np.asarray, jax.device_get(state.params)),
+            batch_stats=jax.tree.map(np.asarray, jax.device_get(state.batch_stats)),
+            num_batches=k_steps,  # torch's per-BN num_batches_tracked counter
+        )
+    )
+    assert set(our_final) == set(torch_final)
+    for key in sorted(torch_final):
+        stats_leaf = key.endswith("running_mean") or key.endswith("running_var")
+        np.testing.assert_allclose(
+            our_final[key], torch_final[key],
+            rtol=1e-6 if stats_leaf else 1e-8,
+            atol=1e-7 if stats_leaf else 1e-10,
+            err_msg=f"divergence in {key} after {k_steps} steps",
+        )
 
 
 def test_trajectory_matches_torch_f32_dp8():
