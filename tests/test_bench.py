@@ -61,6 +61,38 @@ def test_probe_schedule_capping():
     assert bench._probe_schedule(2) == (0, bench.PROBE_BACKOFFS_S[0])
 
 
+def test_tunnel_watch_script_stays_valid():
+    """tools/tunnel_watch.sh must keep running unattended for hours: bash
+    syntax must parse, and every bench.py flag it passes must still exist
+    (a renamed flag would make the watcher burn a rare tunnel window on
+    argparse errors)."""
+    import re
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "tools", "tunnel_watch.sh")
+    proc = subprocess.run(["bash", "-n", script], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+
+    with open(script) as f:
+        flags = set(re.findall(r"--[a-z][a-z0-9-]+", f.read()))
+
+    def declared_flags(path):
+        with open(path) as f:
+            return set(re.findall(r'add_argument\("(--[a-z0-9-]+)"', f.read()))
+
+    import bench as bench_mod
+
+    # The watcher drives two CLIs: bench.py (bench + variant rows) and
+    # mnist_ddp.py (step-stats/profile captures, parser built in mnist.py).
+    # Every flag it passes must exist in at least one of them.
+    known = declared_flags(bench_mod.__file__)
+    known |= declared_flags(os.path.join(repo, "mnist.py"))
+    known |= declared_flags(os.path.join(repo, "mnist_ddp.py"))
+    missing = flags - known
+    assert not missing, f"watcher passes unknown CLI flags: {missing}"
+
+
 def test_bench_program_hash_tool():
     """tools/bench_program_hash.py must keep running (it is the round-end
     warm-cache check): emits exactly one 64-hex line, deterministically."""
