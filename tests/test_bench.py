@@ -93,6 +93,55 @@ def test_tunnel_watch_script_stays_valid():
     assert not missing, f"watcher passes unknown CLI flags: {missing}"
 
 
+@pytest.mark.slow  # full bench subprocess on CPU (~2 min)
+def test_bench_end_to_end_cpu_smoke():
+    """Drive bench.py's whole path — probe, fused run, JSON assembly — as
+    a subprocess on the CPU backend with --train-limit, and pin the JSON
+    contract the driver and the round artifacts depend on (including the
+    round-3 run_s-based throughput fields and the no-snapshot rule for
+    smoke configs)."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    # Strip the conftest's 8-virtual-device forcing: this smoke measures
+    # the single-device bench path (8-way shard_map of the fused scan on
+    # one physical CPU is ~8x slower and times the subprocess out).
+    env["XLA_FLAGS"] = ""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--quick",
+         "--allow-cpu", "--train-limit", "192", "--probe-attempts", "1",
+         # Keep bench's own watchdog UNDER the subprocess timeout so a
+         # slow box produces the structured-failure JSON (with stderr we
+         # can show), never a bare TimeoutExpired.
+         "--run-timeout", "300"],
+        capture_output=True, text=True, cwd=repo, timeout=420, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"stdout must be ONE JSON line, got: {lines}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "mnist_2epoch_wall_clock"
+    assert out["value"] > 0 and out["train_limit"] == 192
+    assert out["dataset"] in ("synthetic", "idx")
+    # run_s attribution + steady-state throughput (round-2 verdict item 3).
+    assert 0 < out["device_run_share"] <= 1
+    assert out["images_per_sec_per_chip_run"] > 0
+    assert out["model_tflops"] > 0
+    assert "mfu" not in out  # cpu device_kind has no published peak
+    # Smoke configs must never overwrite the hardware last-known-good:
+    # whatever snapshot exists must be a full-protocol record, not ours.
+    # (Content check, not a before/after diff — a concurrent legitimate
+    # full-config bench may rewrite the file while this test runs.)
+    snap_path = os.path.join(repo, "bench_last_good.json")
+    if os.path.exists(snap_path):
+        with open(snap_path) as f:
+            snap = json.load(f)
+        assert snap["metric"] == "mnist_20epoch_wall_clock"
+        assert not snap.get("train_limit")
+
+
 def test_bench_program_hash_tool():
     """tools/bench_program_hash.py must keep running (it is the round-end
     warm-cache check): emits exactly one 64-hex line, deterministically."""

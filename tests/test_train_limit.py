@@ -1,0 +1,45 @@
+"""--train-limit (bench.py's CPU-smoke truncation) semantics in fit()."""
+
+import numpy as np
+import pytest
+
+from pytorch_mnist_ddp_tpu.data.mnist import synthetic_mnist
+from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
+from pytorch_mnist_ddp_tpu.trainer import fit
+
+from test_e2e import _args, _write_idx
+
+pytestmark = pytest.mark.slow  # two fused-program compiles (~25 s each)
+
+
+def test_train_limit_truncates_both_sets(tmp_path, capsys, devices):
+    """fit() with train_limit caps train AND test sets before any device
+    work, and the recorded timings sizes follow the truncation (bench.py's
+    throughput/MFU denominators read them)."""
+    root = _write_idx(tmp_path)  # 512 train / 256 test
+    args = _args(root, batch_size=8, fused=True, log_interval=10_000_000)
+    args.train_limit = 64
+    dist = DistState(
+        distributed=True, process_rank=0, process_count=1,
+        world_size=8, devices=list(devices),
+    )
+    timings = {}
+    fit(args, dist, timings=timings)
+    out = capsys.readouterr().out
+    assert timings["train_size"] == 64 and timings["test_size"] == 64
+    # The printed epoch header reflects the truncated dataset length.
+    assert "/64 (" in out
+
+
+def test_train_limit_zero_is_no_op(tmp_path, capsys, devices):
+    root = _write_idx(tmp_path)
+    args = _args(root, batch_size=8, fused=True, log_interval=10_000_000)
+    args.train_limit = 0
+    dist = DistState(
+        distributed=True, process_rank=0, process_count=1,
+        world_size=8, devices=list(devices),
+    )
+    timings = {}
+    fit(args, dist, timings=timings)
+    capsys.readouterr()
+    assert timings["train_size"] == 512 and timings["test_size"] == 256
