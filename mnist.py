@@ -35,6 +35,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="batches between train log lines (default: 10)")
     p.add_argument("--save-model", action="store_true", default=False,
                    help="save the final model checkpoint")
+    p.add_argument("--resume", type=str, default=None, metavar="PATH",
+                   help="load model parameters (and BN running statistics, "
+                        "if present) from a saved checkpoint (.pt or .npz) "
+                        "and continue training; the optimizer starts fresh "
+                        "(the checkpoint format stores only the model, "
+                        "like the reference's)")
     p.add_argument("--fused", action="store_true", default=False,
                    help="run the whole multi-epoch training as one device "
                         "call over an HBM-resident dataset (fastest; same "

@@ -27,11 +27,9 @@ Eval is fused the same way: scan over test batches accumulating
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..data.transforms import MNIST_MEAN, MNIST_STD
 from ..models.net import Net

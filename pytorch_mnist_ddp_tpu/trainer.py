@@ -27,9 +27,78 @@ from .parallel.ddp import (
 )
 from .parallel.distributed import DistState
 from .parallel.mesh import DATA_AXIS, make_mesh
-from .utils.checkpoint import model_state_dict, save_state_dict
+from .utils.checkpoint import load_variables, model_state_dict, save_state_dict
 from .utils.logging import test_summary_lines, train_log_line
 from .utils.rng import root_key, split_streams
+
+
+def _load_resume_variables(path: str, syncbn: bool, init_key) -> tuple:
+    """Load a ``--resume`` checkpoint and return ``(params, bn_stats,
+    step0)`` shaped for the CURRENT model configuration.
+
+    The reference checkpoint format stores only the model (SURVEY.md
+    §3.5), so the optimizer restarts fresh — torch-faithful, since the
+    reference has no resume at all.  ``step0`` seeds ``TrainState.step``
+    from the checkpoint's ``num_batches_tracked`` (BN checkpoints only;
+    0 otherwise), so a resumed-then-saved ``--syncbn`` checkpoint keeps
+    torch's CUMULATIVE batch counter rather than restarting it.
+
+    The checkpoint's architecture must match the requested one: resuming
+    a BN-bearing checkpoint without ``--syncbn`` (or vice versa) fails
+    fast here, before any device work, instead of as a missing-param
+    apply error mid-run.  A BN checkpoint saved without running stats
+    (params only) starts the running averages from BN's init values.
+
+    Multi-controller worlds load the file independently on every process
+    (``--save-model`` wrote it chief-only — a non-shared filesystem fails
+    loudly with FileNotFoundError on the other hosts), and a digest of
+    the raw tensors is cross-checked over all processes: differing local
+    copies at PATH would otherwise assemble silently divergent replicas
+    through ``replicate_params``'s identical-by-construction contract."""
+    import hashlib
+
+    from .utils.checkpoint import load_state_dict, variables_from_state_dict
+
+    flat = load_state_dict(path)
+    if jax.process_count() > 1:
+        digest = hashlib.sha256()
+        for key in sorted(flat):
+            digest.update(key.encode())
+            digest.update(np.ascontiguousarray(flat[key]).tobytes())
+        from jax.experimental import multihost_utils
+
+        digests = multihost_utils.process_allgather(
+            np.frombuffer(digest.digest()[:8], dtype=np.uint8)
+        )
+        if not bool(np.all(digests == digests[0])):
+            raise ValueError(
+                f"--resume checkpoint {path!r} differs across processes "
+                "(per-host copies are not identical); distribute one "
+                "consistent file to every host before resuming"
+            )
+    variables = variables_from_state_dict(flat)
+    params = variables["params"]
+    has_bn = "bn1" in params
+    if syncbn and not has_bn:
+        raise ValueError(
+            f"--resume checkpoint {path!r} has no BatchNorm parameters; "
+            "drop --syncbn or resume a checkpoint saved by a --syncbn run"
+        )
+    if has_bn and not syncbn:
+        raise ValueError(
+            f"--resume checkpoint {path!r} carries BatchNorm parameters; "
+            "add --syncbn (a mnist_ddp.py flag) to resume it"
+        )
+    step0 = 0
+    for key, value in flat.items():
+        if key.split(".")[-1] == "num_batches_tracked":
+            step0 = max(step0, int(np.asarray(value).ravel()[0]))
+    if not syncbn:
+        return params, (), step0
+    bn_stats = variables.get("batch_stats")
+    if bn_stats is None:
+        bn_stats = init_variables(init_key, use_bn=True)["batch_stats"]
+    return params, bn_stats, step0
 
 
 def train_one_epoch(
@@ -249,18 +318,33 @@ def _fit_body(
         # from_key: param init happens inside the compiled run — a cold
         # process reaches the hot loop in ONE device dispatch, with no
         # separate init program (same RNG stream as init_params, so the
-        # result is bit-identical to the per-epoch path).
+        # result is bit-identical to the per-epoch path).  A --resume run
+        # instead feeds the checkpoint's state in as the carry (the
+        # from_key=False variant, whose leading argument is the state).
+        resume_path = getattr(args, "resume", None)
         run_fn, num_batches = make_fused_run(
             mesh, len(train_set), len(test_set), global_batch, eval_batch,
             args.epochs, compute_dtype=compute_dtype, use_pallas=use_pallas,
-            from_key=True, use_bn=syncbn,
+            from_key=resume_path is None, use_bn=syncbn,
         )
+        if resume_path is None:
+            lead = keys["init"]
+        else:
+            r_params, r_stats, r_step = _load_resume_variables(
+                resume_path, syncbn, keys["init"]
+            )
+            lead = replicate_params(
+                make_train_state(
+                    r_params, r_stats, use_pallas=use_pallas
+                )._replace(step=jnp.int32(r_step)),
+                mesh,
+            )
         # Host-computed StepLR values: bit-identical to the per-epoch paths.
         lrs = jnp.asarray(
             [lr_fn(e) for e in range(1, args.epochs + 1)], jnp.float32
         )
         run_args = (
-            keys["init"], tr_x, tr_y, te_x, te_y,
+            lead, tr_x, tr_y, te_x, te_y,
             keys["shuffle"], keys["dropout"], lrs,
         )
         if timings is not None:
@@ -316,7 +400,13 @@ def _fit_body(
                     )
                 )
     else:
-        if syncbn:
+        resume_path = getattr(args, "resume", None)
+        resume_step = 0
+        if resume_path is not None:
+            params, bn_stats, resume_step = _load_resume_variables(
+                resume_path, syncbn, keys["init"]
+            )
+        elif syncbn:
             variables = init_variables(keys["init"], use_bn=True)
             params = variables["params"]
             bn_stats = variables["batch_stats"]
@@ -329,7 +419,10 @@ def _fit_body(
             state = shard_state(make_train_state(params), mesh)
         else:
             state = replicate_params(
-                make_train_state(params, bn_stats, use_pallas=use_pallas), mesh
+                make_train_state(
+                    params, bn_stats, use_pallas=use_pallas
+                )._replace(step=jnp.int32(resume_step)),
+                mesh,
             )
         train_loader = DataLoader(
             train_set.images,

@@ -170,6 +170,14 @@ def _param_leaf_name(module: str, torch_leaf: str, value: np.ndarray) -> str:
     return torch_leaf
 
 
+def load_variables(path: str) -> dict[str, Any]:
+    """One call, either checkpoint format (torch zip / legacy pickle /
+    npz) -> the full Flax variable dict: ``{"params": ...}`` plus
+    ``{"batch_stats": ...}`` when the file carries BN running statistics.
+    The ``--resume`` entry point (trainer.py)."""
+    return variables_from_state_dict(load_state_dict(path))
+
+
 def params_from_state_dict(state: Mapping[str, np.ndarray]) -> dict[str, Any]:
     """Rebuild a nested Flax param tree from a flat torch-style state dict,
     accepting (and stripping) the ``module.`` prefix quirk.  BN running

@@ -1,0 +1,146 @@
+"""--resume: continue training from a saved checkpoint (trainer.py).
+
+Beyond-reference capability (the reference only saves, SURVEY.md §3.5):
+a checkpoint written by a run — torch-format ``.pt`` with the layout
+conversions, BN stats included for ``--syncbn`` runs — can seed a new
+run's parameters.  The optimizer restarts fresh by design (the checkpoint
+format stores only the model)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
+from pytorch_mnist_ddp_tpu.trainer import fit
+from pytorch_mnist_ddp_tpu.utils.checkpoint import load_variables
+
+from test_e2e import _args, _write_idx
+
+
+def _dist(devices):
+    return DistState(
+        distributed=True, process_rank=0, process_count=1,
+        world_size=8, devices=list(devices),
+    )
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def test_resume_loads_exact_params(tmp_path, capsys, devices):
+    """epochs=0 resume is a pure load: the state's params must round-trip
+    the checkpoint (through the torch-layout conversions) bit-exactly."""
+    root = _write_idx(tmp_path)
+    save_path = str(tmp_path / "ckpt.pt")
+    args = _args(root, batch_size=8, epochs=1, save_model=True,
+                 log_interval=10_000_000)
+    state = fit(args, _dist(devices), save_path=save_path)
+    trained = jax.device_get(state.params)
+
+    args2 = _args(root, batch_size=8, epochs=0)
+    args2.resume = save_path
+    state2 = fit(args2, _dist(devices), save_path=None)
+    capsys.readouterr()
+    assert _leaves_equal(jax.device_get(state2.params), trained)
+
+
+@pytest.mark.slow  # second full fit
+def test_resume_continues_training(tmp_path, capsys, devices):
+    """A resumed epoch actually trains: params move from the loaded point
+    and the training/eval output is produced."""
+    root = _write_idx(tmp_path)
+    save_path = str(tmp_path / "ckpt.pt")
+    args = _args(root, batch_size=8, epochs=1, save_model=True,
+                 log_interval=10_000_000)
+    fit(args, _dist(devices), save_path=save_path)
+    loaded = load_variables(save_path)["params"]
+
+    args2 = _args(root, batch_size=8, epochs=1)
+    args2.resume = save_path
+    state2 = fit(args2, _dist(devices), save_path=None)
+    out = capsys.readouterr().out
+    assert "Test set:" in out
+    assert not _leaves_equal(jax.device_get(state2.params), loaded)
+
+
+@pytest.mark.slow  # fused-program compile (~25 s)
+def test_resume_through_fused_run(tmp_path, capsys, devices):
+    """The fused whole-run path resumes too: from_key=False feeds the
+    checkpoint state in as the scan carry (trainer.py fused branch)."""
+    root = _write_idx(tmp_path)
+    save_path = str(tmp_path / "ckpt.pt")
+    args = _args(root, batch_size=8, epochs=1, save_model=True,
+                 log_interval=10_000_000)
+    fit(args, _dist(devices), save_path=save_path)
+    loaded = load_variables(save_path)["params"]
+
+    args2 = _args(root, batch_size=8, epochs=1, fused=True,
+                  log_interval=10_000_000)
+    args2.resume = save_path
+    state2 = fit(args2, _dist(devices), save_path=None)
+    out = capsys.readouterr().out
+    assert "Test set:" in out
+    assert not _leaves_equal(jax.device_get(state2.params), loaded)
+
+
+def test_resume_bn_mismatch_fails_fast(tmp_path, capsys, devices):
+    """Architecture mismatches are rejected before any device work."""
+    root = _write_idx(tmp_path)
+    save_path = str(tmp_path / "plain.pt")
+    args = _args(root, batch_size=8, epochs=1, save_model=True,
+                 log_interval=10_000_000)
+    fit(args, _dist(devices), save_path=save_path)
+    capsys.readouterr()
+
+    args2 = _args(root, batch_size=8, epochs=1, syncbn=True)
+    args2.resume = save_path
+    with pytest.raises(ValueError, match="no BatchNorm"):
+        fit(args2, _dist(devices), save_path=None)
+
+
+@pytest.mark.slow  # three fits incl. BN compiles
+def test_resume_syncbn_roundtrips_running_stats(tmp_path, capsys, devices):
+    """A --syncbn checkpoint resumes with its BN running statistics (not
+    re-initialized), and resuming it without --syncbn is rejected."""
+    root = _write_idx(tmp_path)
+    save_path = str(tmp_path / "bn.pt")
+    args = _args(root, batch_size=8, epochs=1, save_model=True, syncbn=True,
+                 log_interval=10_000_000)
+    state = fit(args, _dist(devices), save_path=save_path)
+    trained_stats = jax.device_get(state.batch_stats)
+
+    args2 = _args(root, batch_size=8, epochs=0, syncbn=True)
+    args2.resume = save_path
+    state2 = fit(args2, _dist(devices), save_path=None)
+    capsys.readouterr()
+    assert _leaves_equal(jax.device_get(state2.batch_stats), trained_stats)
+
+    args3 = _args(root, batch_size=8, epochs=1)
+    args3.resume = save_path
+    with pytest.raises(ValueError, match="carries BatchNorm"):
+        fit(args3, _dist(devices), save_path=None)
+
+    # num_batches_tracked stays CUMULATIVE through save -> resume -> save
+    # (torch uses it for momentum=None moving averages): 512 samples /
+    # 64-global-batch = 8 steps per epoch, so the re-saved counter is 16.
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import load_state_dict
+
+    save2 = str(tmp_path / "bn2.pt")
+    args4 = _args(root, batch_size=8, epochs=1, syncbn=True, save_model=True,
+                  log_interval=10_000_000)
+    args4.resume = save_path
+    fit(args4, _dist(devices), save_path=save2)
+    capsys.readouterr()
+    def counter(path):
+        flat = load_state_dict(path)
+        # DDP-mode saves carry the module. key-prefix quirk.
+        key = next(k for k in flat if k.endswith("bn1.num_batches_tracked"))
+        return int(flat[key].ravel()[0])
+
+    assert counter(save_path) == 8
+    assert counter(save2) == 16

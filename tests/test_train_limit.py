@@ -1,9 +1,7 @@
 """--train-limit (bench.py's CPU-smoke truncation) semantics in fit()."""
 
-import numpy as np
 import pytest
 
-from pytorch_mnist_ddp_tpu.data.mnist import synthetic_mnist
 from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
 from pytorch_mnist_ddp_tpu.trainer import fit
 
