@@ -8,7 +8,15 @@ locally, 8 globally.  World formation goes through the real entry path —
 final params + eval totals for the parent to cross-check.
 
 Usage: python tests/multihost_worker.py <data_root> <out_npz> \
-    <fused|batch|tp|pp|syncbn>
+    <fused|batch|tp|pp|syncbn|resume|resume-divergent>
+
+``resume`` modes exercise ``--resume`` across the process boundary: each
+rank loads its OWN per-host copy ``<data_root>/ckpt_rank<r>.pt`` — the
+documented multi-host deployment shape ("distribute one consistent file
+to every host").  The parent seeds those files identical (``resume`` —
+the cross-process digest must agree on separately-loaded copies) or
+different (``resume-divergent`` — the digest guard must refuse to
+assemble divergent replicas; the parent asserts the nonzero exit).
 
 ``tp`` mode trains tensor-parallel over a (data=4, model=2) mesh that
 spans both processes — fc1/fc2 shards live on model-axis device pairs
@@ -43,12 +51,17 @@ def main() -> None:
     assert dist.distributed and dist.process_count == 2, dist
     assert dist.world_size == 8, dist
 
+    import os
+
+    resume = None
+    if mode.startswith("resume"):
+        resume = os.path.join(data_root, f"ckpt_rank{dist.process_rank}.pt")
     args = Namespace(
         batch_size=8, test_batch_size=16, epochs=2, lr=1.0, gamma=0.7,
         seed=1, log_interval=4, dry_run=False, save_model=False,
         fused=(mode == "fused"), data_root=data_root,
         tp=(2 if mode == "tp" else 1), pp=(mode == "pp"),
-        syncbn=(mode == "syncbn"),
+        syncbn=(mode == "syncbn"), resume=resume,
     )
     state = fit(args, dist)
 

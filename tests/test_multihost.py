@@ -34,7 +34,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_world(tmp_path, mode: str) -> list[dict]:
+def _run_world(tmp_path, mode: str, expect_error: str | None = None) -> list:
     root = _write_idx(tmp_path)
     port = _free_port()
     procs, outs = [], []
@@ -73,6 +73,12 @@ def _run_world(tmp_path, mode: str) -> list[dict]:
                 q.kill()
             raise
         logs.append(stdout)
+    if expect_error is not None:
+        # Failure-path worlds: every process must refuse (nonzero exit)
+        # with the expected message — not hang, not half-succeed.
+        assert all(p.returncode != 0 for p in procs), "\n====\n".join(logs)
+        assert any(expect_error in log for log in logs), "\n====\n".join(logs)
+        return logs
     assert all(p.returncode == 0 for p in procs), "\n====\n".join(logs)
     results = []
     for out in outs:
@@ -80,6 +86,50 @@ def _run_world(tmp_path, mode: str) -> list[dict]:
             results.append({k: z[k] for k in z.files})
     results.append(logs)
     return results
+
+
+def _write_rank_checkpoints(tmp_path, identical: bool) -> None:
+    """Pre-seed per-rank checkpoint files for the resume modes: the same
+    params for both ranks (identical=True) or different-seed params."""
+    import jax
+
+    from pytorch_mnist_ddp_tpu.models.net import init_params
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import (
+        model_state_dict,
+        save_state_dict,
+    )
+
+    for rank, seed in ((0, 5), (1, 5 if identical else 9)):
+        sd = model_state_dict(
+            jax.tree.map(np.asarray, init_params(jax.random.PRNGKey(seed)))
+        )
+        save_state_dict(sd, str(tmp_path / f"ckpt_rank{rank}.pt"))
+
+
+def test_two_process_resume_consistency(tmp_path):
+    """--resume in a 2-process world: each rank loads its OWN identical
+    per-host checkpoint copy, the cross-process digest agrees on the
+    separately-loaded files, training proceeds, and the final replicas
+    are bit-identical."""
+    _write_rank_checkpoints(tmp_path, identical=True)
+    r0, r1, logs = _run_world(tmp_path, "resume")
+    param_keys = [k for k in r0 if k not in ("avg_loss", "correct")]
+    assert len(param_keys) == 8
+    for k in param_keys:
+        np.testing.assert_array_equal(r0[k], r1[k], err_msg=k)
+    assert r0["correct"] == r1["correct"]
+
+
+def test_two_process_resume_divergent_files_refused(tmp_path):
+    """Differing per-host copies at the --resume path must be refused by
+    the cross-process digest guard (trainer._load_resume_variables) —
+    otherwise replicate_params would silently assemble divergent
+    replicas from them."""
+    _write_rank_checkpoints(tmp_path, identical=False)
+    _run_world(
+        tmp_path, "resume-divergent",
+        expect_error="differs across processes",
+    )
 
 
 @pytest.mark.parametrize("mode", ["batch", "fused", "tp", "pp", "syncbn"])
