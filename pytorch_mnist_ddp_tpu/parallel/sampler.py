@@ -57,7 +57,12 @@ def epoch_indices(
     num_samples = -(-n // world_size)  # ceil
     total = num_samples * world_size
     if total > n:
-        indices = np.concatenate([indices, indices[: total - n]])
+        # Pad by repeating the permutation CYCLICALLY (np.resize), exactly
+        # torch's DistributedSampler padding.  A single concatenation of
+        # indices[:total-n] under-fills whenever the padding exceeds n
+        # (world_size > 2n) — found by the hypothesis contract test with
+        # n=1, world_size=3.
+        indices = np.resize(indices, total)
     positions = np.arange(rank, total, world_size)
     if return_valid:
         return indices[positions], positions < n

@@ -1,0 +1,167 @@
+"""Property-based tests (hypothesis) for the pure, invariant-rich parts.
+
+The example-based suites pin parity at specific shapes; these fuzz the
+CONTRACTS over the whole input space the components claim to support:
+
+- sampler: the DistributedSampler contract (disjoint cover, padding,
+  epoch reshuffle determinism) for arbitrary (n, world_size, epoch);
+- Adadelta: torch-update parity at arbitrary shapes/hyperparameters;
+- Pallas padding geometry: lane/sublane/block alignment for any size;
+- checkpoint layout conversion: torch-layout round-trip is the identity.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.ops.adadelta import AdadeltaState, adadelta_update
+from pytorch_mnist_ddp_tpu.ops.pallas_adadelta import _LANES, _pad_rows
+from pytorch_mnist_ddp_tpu.parallel.sampler import epoch_indices, per_rank_count
+from pytorch_mnist_ddp_tpu.utils.torch_interop import (
+    state_dict_from_torch_layout,
+    state_dict_to_torch_layout,
+)
+
+# jax dispatch makes per-example runtime nontrivial; keep example counts
+# modest and disable hypothesis' per-example deadline (first-call compile
+# would trip it spuriously).
+_SETTINGS = dict(max_examples=30, deadline=None)
+
+
+@settings(**_SETTINGS)
+@given(
+    n=st.integers(1, 500),
+    world_size=st.integers(1, 9),
+    epoch=st.integers(0, 5),
+    seed=st.integers(0, 3),
+    shuffle=st.booleans(),
+)
+def test_sampler_contract(n, world_size, epoch, seed, shuffle):
+    """torch DistributedSampler semantics for ANY configuration: every
+    rank draws ceil(n/world) indices, ranks jointly cover every real
+    index, padding wraps from the same permutation, and the epoch/seed
+    pair fully determines the draw."""
+    per_rank = per_rank_count(n, world_size)
+    all_idx = []
+    for rank in range(world_size):
+        idx = epoch_indices(
+            n, world_size, rank, epoch=epoch, seed=seed, shuffle=shuffle
+        )
+        again = epoch_indices(
+            n, world_size, rank, epoch=epoch, seed=seed, shuffle=shuffle
+        )
+        np.testing.assert_array_equal(idx, again)  # deterministic
+        assert idx.shape == (per_rank,)
+        assert ((0 <= idx) & (idx < n)).all()
+        all_idx.append(idx)
+    stacked = np.concatenate(all_idx)
+    assert stacked.shape == (per_rank * world_size,)
+    # Every real sample is drawn at least once (cover), and the padded
+    # total exceeds n by exactly the wrap amount.
+    assert len(np.unique(stacked)) == n
+    if not shuffle and world_size == 1:
+        np.testing.assert_array_equal(stacked, np.arange(n))
+
+
+@settings(**_SETTINGS)
+@given(
+    # n >= 16: below that, two epochs' permutations can legitimately
+    # collide (and would only dilute the tested space as vacuous passes).
+    n=st.integers(16, 400),
+    world_size=st.integers(2, 8),
+    seed=st.integers(0, 3),
+)
+def test_sampler_epochs_reshuffle(n, world_size, seed):
+    """set_epoch semantics: different epochs give different permutations
+    (for any n big enough that a collision is essentially impossible)."""
+    a = np.concatenate([
+        epoch_indices(n, world_size, r, epoch=0, seed=seed)
+        for r in range(world_size)
+    ])
+    b = np.concatenate([
+        epoch_indices(n, world_size, r, epoch=1, seed=seed)
+        for r in range(world_size)
+    ])
+    assert not np.array_equal(a, b)
+
+
+@settings(**_SETTINGS)
+@given(
+    shape=st.sampled_from([(3,), (2, 5), (4, 3, 2), (17,), (1, 1)]),
+    lr=st.floats(1e-3, 2.0),
+    rho=st.floats(0.5, 0.99),
+    steps=st.integers(1, 4),
+    seed=st.integers(0, 100),
+)
+def test_adadelta_matches_torch_anywhere(shape, lr, rho, steps, seed):
+    """torch.optim.Adadelta parity at arbitrary shapes, lr, rho, and step
+    counts — not just the benchmark configuration."""
+    import torch
+
+    rng = np.random.RandomState(seed)
+    p0 = rng.randn(*shape).astype(np.float32)
+    grads = [rng.randn(*shape).astype(np.float32) for _ in range(steps)]
+
+    tp = torch.nn.Parameter(torch.tensor(p0))
+    opt = torch.optim.Adadelta([tp], lr=lr, rho=rho, eps=1e-6)
+    for g in grads:
+        opt.zero_grad()
+        tp.grad = torch.tensor(g)
+        opt.step()
+
+    params = {"w": jnp.asarray(p0)}
+    state = AdadeltaState(
+        square_avg={"w": jnp.zeros(shape, jnp.float32)},
+        acc_delta={"w": jnp.zeros(shape, jnp.float32)},
+    )
+    for g in grads:
+        params, state = adadelta_update(
+            params, {"w": jnp.asarray(g)}, state, lr, rho=rho, eps=1e-6
+        )
+    np.testing.assert_allclose(
+        np.asarray(params["w"]), tp.detach().numpy(), rtol=2e-5, atol=2e-6
+    )
+
+
+@settings(**_SETTINGS)
+@given(n=st.integers(1, 3_000_000))
+def test_pad_rows_geometry(n):
+    """For any parameter count: rows hold all n values, rows are sublane
+    (8) aligned, and the block height tiles the row count exactly."""
+    rows, block_rows = _pad_rows(n)
+    assert rows * _LANES >= n
+    assert rows % 8 == 0
+    assert rows % block_rows == 0
+    from pytorch_mnist_ddp_tpu.ops.pallas_adadelta import _BLOCK_ROWS
+
+    assert block_rows <= _BLOCK_ROWS
+    # No gratuitous padding: at most one spare block beyond what n needs.
+    assert (rows - block_rows) * _LANES < max(n, 1) or rows == block_rows
+
+
+@settings(**_SETTINGS)
+@given(seed=st.integers(0, 1000))
+def test_torch_layout_roundtrip_identity(seed):
+    """state_dict_to_torch_layout ∘ state_dict_from_torch_layout == id
+    for a Net-shaped state dict with random contents (kernels, biases,
+    the fc1 permutation, BN vectors)."""
+    rng = np.random.RandomState(seed)
+    ours = {
+        "conv1.weight": rng.randn(3, 3, 1, 32).astype(np.float32),
+        "conv1.bias": rng.randn(32).astype(np.float32),
+        "conv2.weight": rng.randn(3, 3, 32, 64).astype(np.float32),
+        "bn1.weight": rng.randn(32).astype(np.float32),
+        "fc1.weight": rng.randn(9216, 128).astype(np.float32),
+        "fc2.weight": rng.randn(128, 10).astype(np.float32),
+        "module.fc1.weight": rng.randn(9216, 128).astype(np.float32),
+    }
+    torch_side = state_dict_to_torch_layout(ours)
+    back = state_dict_from_torch_layout(torch_side)
+    assert set(back) == set(ours)
+    for key, value in ours.items():
+        np.testing.assert_array_equal(back[key], value, err_msg=key)
+    # And the conversion actually transposes (it is not the identity).
+    assert torch_side["conv1.weight"].shape == (32, 1, 3, 3)
+    assert torch_side["fc1.weight"].shape == (128, 9216)
