@@ -142,6 +142,73 @@ def test_pad_rows_geometry(n):
 
 
 @settings(**_SETTINGS)
+@given(
+    batch=st.integers(1, 32),
+    classes=st.integers(2, 12),
+    n_pad=st.integers(0, 8),
+    reduction=st.sampled_from(["mean", "sum"]),
+    seed=st.integers(0, 1000),
+)
+def test_nll_loss_matches_torch_with_padding(batch, classes, n_pad, reduction, seed):
+    """ops.loss.nll_loss over ANY (batch, classes) with 0/1 padding
+    weights equals torch's F.nll_loss over only the real rows — the
+    static-shape padding must be arithmetically invisible."""
+    import torch
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(seed)
+    n_pad = min(n_pad, batch - 1)
+    logits = rng.randn(batch, classes).astype(np.float32)
+    log_probs = logits - np.log(
+        np.exp(logits).sum(axis=1, keepdims=True)
+    )
+    targets = rng.randint(0, classes, batch).astype(np.int32)
+    weights = np.ones(batch, np.float32)
+    if n_pad:
+        weights[-n_pad:] = 0.0
+
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+
+    ours = float(
+        nll_loss(
+            jnp.asarray(log_probs), jnp.asarray(targets),
+            jnp.asarray(weights), reduction=reduction,
+        )
+    )
+    real = batch - n_pad
+    theirs = float(
+        F.nll_loss(
+            torch.tensor(log_probs[:real]),
+            torch.tensor(targets[:real]).long(),
+            reduction=reduction,
+        )
+    )
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-6)
+
+
+@settings(**_SETTINGS)
+@given(
+    n=st.integers(1, 16),
+    seed=st.integers(0, 1000),
+)
+def test_normalize_matches_torchvision_semantics(n, seed):
+    """data.transforms.normalize equals ToTensor (u8/255) followed by
+    Normalize((0.1307,), (0.3081,)) for arbitrary uint8 images."""
+    from pytorch_mnist_ddp_tpu.data.transforms import (
+        MNIST_MEAN,
+        MNIST_STD,
+        normalize,
+    )
+
+    rng = np.random.RandomState(seed)
+    images = rng.randint(0, 256, (n, 28, 28), dtype=np.uint8)
+    out = normalize(images)
+    assert out.shape == (n, 28, 28, 1) and out.dtype == np.float32
+    expected = (images.astype(np.float64) / 255.0 - MNIST_MEAN) / MNIST_STD
+    np.testing.assert_allclose(out[..., 0], expected, rtol=1e-5, atol=1e-5)
+
+
+@settings(**_SETTINGS)
 @given(seed=st.integers(0, 1000))
 def test_torch_layout_roundtrip_identity(seed):
     """state_dict_to_torch_layout ∘ state_dict_from_torch_layout == id
