@@ -32,3 +32,16 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
     return devs
+
+
+def cpu_subprocess_env(force_single_device: bool = True) -> dict:
+    """Environment for CPU-backend subprocess tests, in ONE place: strips
+    the accelerator-tunnel hook (a set PALLAS_AXON_POOL_IPS makes jax
+    init block on the dead tunnel), selects the CPU platform, and (by
+    default) clears this conftest's 8-virtual-device XLA_FLAGS so the
+    child sees one device."""
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    if force_single_device:
+        env["XLA_FLAGS"] = ""
+    return env

@@ -102,13 +102,12 @@ def test_bench_end_to_end_cpu_smoke():
     smoke configs)."""
     import subprocess
 
+    from conftest import cpu_subprocess_env
+
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
-    env["JAX_PLATFORMS"] = "cpu"
-    # Strip the conftest's 8-virtual-device forcing: this smoke measures
-    # the single-device bench path (8-way shard_map of the fused scan on
-    # one physical CPU is ~8x slower and times the subprocess out).
-    env["XLA_FLAGS"] = ""
+    # Single-device env: 8-way shard_map of the fused scan on one physical
+    # CPU is ~8x slower and times the subprocess out.
+    env = cpu_subprocess_env()
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "bench.py"), "--quick",
          "--allow-cpu", "--train-limit", "192", "--probe-attempts", "1",
@@ -147,9 +146,13 @@ def test_bench_program_hash_tool():
     warm-cache check): emits exactly one 64-hex line, deterministically."""
     import subprocess
 
+    from conftest import cpu_subprocess_env
+
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
-    env["JAX_PLATFORMS"] = "cpu"
+    # Keep the ambient XLA_FLAGS: the hash tool pins its own 1-device
+    # mesh, and this preserves the environment the determinism check has
+    # always hashed under.
+    env = cpu_subprocess_env(force_single_device=False)
     outs = []
     for _ in range(2):
         proc = subprocess.run(
