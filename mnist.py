@@ -41,6 +41,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "and continue training; the optimizer starts fresh "
                         "(the checkpoint format stores only the model, "
                         "like the reference's)")
+    p.add_argument("--save-state", type=str, default=None, metavar="PATH",
+                   help="save the FULL training state (params, Adadelta "
+                        "accumulators, step/epoch counters, BN stats) at "
+                        "the end of the run; --resume-state continues from "
+                        "it bit-identically")
+    p.add_argument("--resume-state", type=str, default=None, metavar="PATH",
+                   help="restore a --save-state archive and train --epochs "
+                        "MORE epochs, continuing the LR schedule, shuffle "
+                        "stream, and epoch numbering exactly where the "
+                        "saved run stopped")
     p.add_argument("--fused", action="store_true", default=False,
                    help="run the whole multi-epoch training as one device "
                         "call over an HBM-resident dataset (fastest; same "

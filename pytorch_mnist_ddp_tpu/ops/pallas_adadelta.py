@@ -189,6 +189,35 @@ def is_flat_state(state: Any) -> bool:
     return isinstance(state, FlatAdadeltaState)
 
 
+def ensure_opt_layout(opt: Any, params: Any, use_pallas: bool | None):
+    """Convert Adadelta accumulators between the per-leaf pytree and the
+    kernel's padded-flat layout to match what THIS run will execute
+    (``pallas_opt_active``).  The layouts hold the same values — a
+    ``--resume-state`` archive saved under one backend/flag combination
+    must not commit a different backend to the saver's layout (e.g. a
+    flat archive from a TPU ``--pallas-opt`` run silently dragging a CPU
+    resume into interpret-mode kernels)."""
+    want_flat = pallas_opt_active(use_pallas)
+    if is_flat_state(opt) == want_flat:
+        return opt
+    flat_p, unravel = ravel_pytree(params)
+    n = flat_p.shape[0]
+    if want_flat:
+        rows, _ = _pad_rows(n)
+
+        def to2d(tree):
+            v, _ = ravel_pytree(tree)
+            return jnp.pad(v, (0, rows * _LANES - n)).reshape(rows, _LANES)
+
+        return FlatAdadeltaState(
+            square_avg=to2d(opt.square_avg), acc_delta=to2d(opt.acc_delta)
+        )
+    return AdadeltaState(
+        square_avg=unravel(jnp.asarray(opt.square_avg).reshape(-1)[:n]),
+        acc_delta=unravel(jnp.asarray(opt.acc_delta).reshape(-1)[:n]),
+    )
+
+
 def adadelta_update_flat(
     params: Any,
     grads: Any,

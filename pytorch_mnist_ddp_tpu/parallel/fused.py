@@ -278,9 +278,14 @@ def make_fused_run(
     use_pallas: bool | None = None,
     from_key: bool = False,
     use_bn: bool = False,
+    start_epoch: int = 1,
 ):
     """Whole-run fusion: EVERY epoch's training scan plus its full-test-set
     eval as ONE jitted device call.
+
+    ``start_epoch`` (default 1 — same lowered program as always) offsets
+    the scanned epoch numbers so a ``--resume-state`` continuation keeps
+    the epoch-seeded shuffle stream exactly where the saved run left it.
 
     The reference pays a host round trip per *batch* (mnist_ddp.py:67-79);
     the per-epoch fusion above cuts that to one per epoch; this cuts it to
@@ -347,7 +352,8 @@ def make_fused_run(
             return state, (losses, totals)
 
         state, (losses, evals) = jax.lax.scan(
-            one_epoch, state, (jnp.arange(1, epochs + 1), lrs)
+            one_epoch, state,
+            (jnp.arange(start_epoch, start_epoch + epochs), lrs),
         )
         # all_gather the per-shard loss traces so the output is fully
         # replicated: every process can then read them with a plain local

@@ -32,6 +32,31 @@ from .utils.logging import test_summary_lines, train_log_line
 from .utils.rng import root_key, split_streams
 
 
+def _assert_checkpoint_consistent(path: str) -> None:
+    """Multi-controller guard: every process loads its LOCAL copy of a
+    resume file, and replicate_params assumes those copies are identical
+    by construction — so cross-check a digest of the raw file bytes over
+    all processes and refuse divergent copies (the single-process case is
+    a no-op)."""
+    if jax.process_count() <= 1:
+        return
+    import hashlib
+
+    with open(path, "rb") as f:
+        digest = hashlib.sha256(f.read()).digest()
+    from jax.experimental import multihost_utils
+
+    digests = multihost_utils.process_allgather(
+        np.frombuffer(digest[:8], dtype=np.uint8)
+    )
+    if not bool(np.all(digests == digests[0])):
+        raise ValueError(
+            f"resume file {path!r} differs across processes (per-host "
+            "copies are not identical); distribute one consistent file "
+            "to every host before resuming"
+        )
+
+
 def _load_resume_variables(path: str, syncbn: bool, init_key) -> tuple:
     """Load a ``--resume`` checkpoint and return ``(params, bn_stats,
     step0)`` shaped for the CURRENT model configuration.
@@ -238,6 +263,48 @@ def _fit_body(
     syncbn = bool(getattr(args, "syncbn", False))
     if syncbn and num_model > 1:
         raise ValueError("--syncbn rides the DP paths; drop --tp/--pp")
+    # Full-state continuation (--save-state / --resume-state): the whole
+    # TrainState travels, so the continued run is bit-identical to an
+    # uninterrupted one (utils/checkpoint.save_train_state).
+    resume_state_path = getattr(args, "resume_state", None)
+    save_state_path = getattr(args, "save_state", None)
+    if resume_state_path and getattr(args, "resume", None):
+        raise ValueError(
+            "--resume (model-only checkpoint) and --resume-state (full "
+            "training state) are mutually exclusive"
+        )
+    if (resume_state_path or save_state_path) and num_model > 1:
+        raise ValueError(
+            "--save-state/--resume-state ride the DP paths; drop --tp/--pp"
+        )
+    epoch0 = 0
+    loaded_state = None
+    if resume_state_path:
+        from .ops.pallas_adadelta import ensure_opt_layout
+        from .utils.checkpoint import load_train_state
+
+        # Same silent-divergence hazard as --resume (see
+        # _assert_checkpoint_consistent): per-host archive copies must be
+        # identical before replicate_params trusts them.
+        _assert_checkpoint_consistent(resume_state_path)
+        loaded_state, epoch0 = load_train_state(resume_state_path)
+        # The archive's optimizer layout follows the SAVING run's backend/
+        # flags; convert to what THIS run executes (a flat TPU archive
+        # must not drag a CPU resume into interpret-mode kernels).
+        loaded_state = loaded_state._replace(
+            opt=ensure_opt_layout(
+                loaded_state.opt, loaded_state.params,
+                bool(getattr(args, "pallas_opt", False)),
+            )
+        )
+        if bool(loaded_state.batch_stats) != syncbn:
+            raise ValueError(
+                f"--resume-state {resume_state_path!r} was saved "
+                f"{'with' if loaded_state.batch_stats else 'without'} "
+                "BatchNorm state; "
+                + ("add" if loaded_state.batch_stats else "drop")
+                + " --syncbn to match"
+            )
 
     if dist.distributed:
         # Multi-host: the mesh spans every device in the world (JAX's global
@@ -325,9 +392,12 @@ def _fit_body(
         run_fn, num_batches = make_fused_run(
             mesh, len(train_set), len(test_set), global_batch, eval_batch,
             args.epochs, compute_dtype=compute_dtype, use_pallas=use_pallas,
-            from_key=resume_path is None, use_bn=syncbn,
+            from_key=resume_path is None and loaded_state is None,
+            use_bn=syncbn, start_epoch=epoch0 + 1,
         )
-        if resume_path is None:
+        if loaded_state is not None:
+            lead = replicate_params(loaded_state, mesh)
+        elif resume_path is None:
             lead = keys["init"]
         else:
             r_params, r_stats, r_step = _load_resume_variables(
@@ -339,9 +409,11 @@ def _fit_body(
                 )._replace(step=jnp.int32(r_step)),
                 mesh,
             )
-        # Host-computed StepLR values: bit-identical to the per-epoch paths.
+        # Host-computed StepLR values: bit-identical to the per-epoch
+        # paths; a continuation picks the schedule up at epoch0+1.
         lrs = jnp.asarray(
-            [lr_fn(e) for e in range(1, args.epochs + 1)], jnp.float32
+            [lr_fn(e) for e in range(epoch0 + 1, epoch0 + args.epochs + 1)],
+            jnp.float32,
         )
         run_args = (
             lead, tr_x, tr_y, te_x, te_y,
@@ -381,7 +453,8 @@ def _fit_body(
             # on host so no chief-only device program is enqueued.)
             losses_host = (np.asarray(losses) if losses_np is None else losses_np)[:, :, 0]
             evals_host = np.asarray(evals) if evals_np is None else evals_np
-            for epoch in range(1, args.epochs + 1):
+            for epoch in range(epoch0 + 1, epoch0 + args.epochs + 1):
+                row = epoch - epoch0 - 1
                 for batch_idx in range(0, num_batches, args.log_interval):
                     samples = dist.world_size * batch_idx * args.batch_size
                     if not dist.distributed:
@@ -389,20 +462,22 @@ def _fit_body(
                     print(
                         train_log_line(
                             epoch, samples, len(train_set), batch_idx,
-                            num_batches, float(losses_host[epoch - 1, batch_idx]),
+                            num_batches, float(losses_host[row, batch_idx]),
                         )
                     )
                 print(
                     test_summary_lines(
-                        float(evals_host[epoch - 1, 0]) / len(test_set),
-                        int(evals_host[epoch - 1, 1]),
+                        float(evals_host[row, 0]) / len(test_set),
+                        int(evals_host[row, 1]),
                         len(test_set),
                     )
                 )
     else:
         resume_path = getattr(args, "resume", None)
         resume_step = 0
-        if resume_path is not None:
+        if loaded_state is not None:
+            params, bn_stats = None, None  # full state replaces init below
+        elif resume_path is not None:
             params, bn_stats, resume_step = _load_resume_variables(
                 resume_path, syncbn, keys["init"]
             )
@@ -417,6 +492,8 @@ def _fit_body(
             from .parallel.tp import make_tp_eval_step, make_tp_train_step, shard_state
 
             state = shard_state(make_train_state(params), mesh)
+        elif loaded_state is not None:
+            state = replicate_params(loaded_state, mesh)
         else:
             state = replicate_params(
                 make_train_state(
@@ -467,7 +544,7 @@ def _fit_body(
                 mesh, compute_dtype=compute_dtype, use_bn=syncbn
             )
         want_stats = bool(getattr(args, "step_stats", False))
-        for epoch in range(1, args.epochs + 1):
+        for epoch in range(epoch0 + 1, epoch0 + args.epochs + 1):
             stats = StepStats() if want_stats else None
             state = train_one_epoch(
                 step_fn,
@@ -517,4 +594,14 @@ def _fit_body(
                 num_batches=int(np.asarray(state.step)) if syncbn else None,
             )
             save_state_dict(sd, save_path)
+    if save_state_path:
+        from .utils.checkpoint import save_train_state
+
+        if dist.is_chief:
+            # Epochs completed = where the next continuation picks up the
+            # schedule/shuffle/numbering.
+            save_train_state(
+                jax.device_get(state), save_state_path,
+                epoch=epoch0 + args.epochs,
+            )
     return state

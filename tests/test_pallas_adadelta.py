@@ -137,6 +137,35 @@ def test_flat_state_update_matches_plain_on_model_params():
     )
 
 
+def test_ensure_opt_layout_roundtrip():
+    """Layout conversion (resume-state across backends/flags) is exact in
+    both directions and a no-op when layouts already match."""
+    from pytorch_mnist_ddp_tpu.ops.pallas_adadelta import ensure_opt_layout
+
+    params = init_params(jax.random.PRNGKey(2))
+    grads = jax.tree.map(
+        lambda p: jnp.full(p.shape, 1e-2, p.dtype), params
+    )
+    _, tree_state = adadelta_update(params, grads, adadelta_init(params), 0.7)
+    # Tree -> flat -> tree: bit-exact values (pad rows are zeros).
+    import os
+
+    os.environ["TPU_MNIST_PALLAS_INTERPRET"] = "1"
+    try:
+        flat = ensure_opt_layout(tree_state, params, use_pallas=True)
+        assert is_flat_state(flat)
+        assert ensure_opt_layout(flat, params, use_pallas=True) is flat
+        back = ensure_opt_layout(flat, params, use_pallas=False)
+    finally:
+        del os.environ["TPU_MNIST_PALLAS_INTERPRET"]
+    assert not is_flat_state(back)
+    for a, b in zip(
+        jax.tree.leaves(back), jax.tree.leaves(tree_state), strict=True
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert ensure_opt_layout(tree_state, params, use_pallas=False) is tree_state
+
+
 def test_pallas_opt_active_gating(monkeypatch):
     """Init sites and the update dispatch share one backend gate: inactive
     on CPU unless the interpret test hook is set, so the CLI can never

@@ -68,6 +68,103 @@ def test_resume_continues_training(tmp_path, capsys, devices):
     assert not _leaves_equal(jax.device_get(state2.params), loaded)
 
 
+@pytest.mark.slow  # three full fits
+def test_save_state_resume_state_bit_identical(tmp_path, capsys, devices):
+    """THE continuation guarantee (utils/checkpoint.save_train_state):
+    1 epoch + --save-state, then --resume-state + 1 epoch, equals an
+    uninterrupted 2-epoch run BIT-FOR-BIT — params AND Adadelta
+    accumulators — because the optimizer state, step counter, LR
+    schedule, and epoch-seeded shuffle all travel with the archive."""
+    root = _write_idx(tmp_path)
+
+    args_full = _args(root, batch_size=8, epochs=2, log_interval=10_000_000)
+    full = fit(args_full, _dist(devices), save_path=None)
+
+    state_path = str(tmp_path / "state.npz")
+    args_a = _args(root, batch_size=8, epochs=1, log_interval=10_000_000)
+    args_a.save_state = state_path
+    fit(args_a, _dist(devices), save_path=None)
+    args_b = _args(root, batch_size=8, epochs=1, log_interval=10_000_000)
+    args_b.resume_state = state_path
+    resumed = fit(args_b, _dist(devices), save_path=None)
+    out = capsys.readouterr().out
+    # Continuation keeps the epoch numbering: the resumed run logs as
+    # epoch 2, never restarting at 1.
+    assert "Train Epoch: 2 " in out
+
+    assert _leaves_equal(
+        jax.device_get(resumed.params), jax.device_get(full.params)
+    )
+    assert _leaves_equal(
+        jax.device_get(resumed.opt), jax.device_get(full.opt)
+    )
+    assert int(resumed.step) == int(full.step)
+
+
+def test_resume_state_rejects_wrong_archive(tmp_path, capsys, devices):
+    """A model-only checkpoint fed to --resume-state must fail fast with
+    a message pointing at --resume, not crash downstream."""
+    root = _write_idx(tmp_path)
+    model_path = str(tmp_path / "model.pt")
+    args = _args(root, batch_size=8, epochs=1, save_model=True,
+                 log_interval=10_000_000)
+    fit(args, _dist(devices), save_path=model_path)
+    capsys.readouterr()
+    args2 = _args(root, batch_size=8, epochs=1)
+    args2.resume_state = model_path
+    with pytest.raises(ValueError, match="save-state archive"):
+        fit(args2, _dist(devices), save_path=None)
+
+
+def test_resume_state_syncbn_mismatch_fails_fast(tmp_path, capsys, devices):
+    root = _write_idx(tmp_path)
+    state_path = str(tmp_path / "state.npz")
+    args = _args(root, batch_size=8, epochs=1, log_interval=10_000_000)
+    args.save_state = state_path
+    fit(args, _dist(devices), save_path=None)
+    capsys.readouterr()
+    args2 = _args(root, batch_size=8, epochs=1, syncbn=True)
+    args2.resume_state = state_path
+    with pytest.raises(ValueError, match="drop --syncbn"):
+        fit(args2, _dist(devices), save_path=None)
+    args3 = _args(root, batch_size=8, epochs=1)
+    args3.resume_state = state_path
+    args3.resume = state_path
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        fit(args3, _dist(devices), save_path=None)
+
+
+@pytest.mark.slow  # two fused-program compiles
+def test_save_state_resume_state_bit_identical_fused(tmp_path, capsys, devices):
+    """The same continuation guarantee through the fused whole-run path:
+    the resumed scan starts at start_epoch=2, so shuffle keys, LR values,
+    and dropout streams line up with the uninterrupted 2-epoch program."""
+    root = _write_idx(tmp_path)
+
+    args_full = _args(root, batch_size=8, epochs=2, fused=True,
+                      log_interval=10_000_000)
+    full = fit(args_full, _dist(devices), save_path=None)
+
+    state_path = str(tmp_path / "state.npz")
+    args_a = _args(root, batch_size=8, epochs=1, fused=True,
+                   log_interval=10_000_000)
+    args_a.save_state = state_path
+    fit(args_a, _dist(devices), save_path=None)
+    args_b = _args(root, batch_size=8, epochs=1, fused=True,
+                   log_interval=10_000_000)
+    args_b.resume_state = state_path
+    resumed = fit(args_b, _dist(devices), save_path=None)
+    out = capsys.readouterr().out
+    assert "Train Epoch: 2 " in out
+
+    assert _leaves_equal(
+        jax.device_get(resumed.params), jax.device_get(full.params)
+    )
+    assert _leaves_equal(
+        jax.device_get(resumed.opt), jax.device_get(full.opt)
+    )
+
+
 @pytest.mark.slow  # fused-program compile (~25 s)
 def test_resume_through_fused_run(tmp_path, capsys, devices):
     """The fused whole-run path resumes too: from_key=False feeds the
