@@ -17,6 +17,9 @@ to every host").  The parent seeds those files identical (``resume`` —
 the cross-process digest must agree on separately-loaded copies) or
 different (``resume-divergent`` — the digest guard must refuse to
 assemble divergent replicas; the parent asserts the nonzero exit).
+``rstate`` / ``rstate-divergent`` do the same for ``--resume-state``
+full-state archives (``state_rank<r>.npz``), exercising the file-bytes
+digest in trainer._assert_checkpoint_consistent.
 
 ``tp`` mode trains tensor-parallel over a (data=4, model=2) mesh that
 spans both processes — fc1/fc2 shards live on model-axis device pairs
@@ -53,15 +56,19 @@ def main() -> None:
 
     import os
 
-    resume = None
+    resume = resume_state = None
     if mode.startswith("resume"):
         resume = os.path.join(data_root, f"ckpt_rank{dist.process_rank}.pt")
+    elif mode.startswith("rstate"):
+        resume_state = os.path.join(
+            data_root, f"state_rank{dist.process_rank}.npz"
+        )
     args = Namespace(
         batch_size=8, test_batch_size=16, epochs=2, lr=1.0, gamma=0.7,
         seed=1, log_interval=4, dry_run=False, save_model=False,
         fused=(mode == "fused"), data_root=data_root,
         tp=(2 if mode == "tp" else 1), pp=(mode == "pp"),
-        syncbn=(mode == "syncbn"), resume=resume,
+        syncbn=(mode == "syncbn"), resume=resume, resume_state=resume_state,
     )
     state = fit(args, dist)
 
