@@ -8,7 +8,16 @@ from .logging import (
 from .checkpoint import (
     save_state_dict,
     load_state_dict,
+    load_variables,
     model_state_dict,
     params_from_state_dict,
     variables_from_state_dict,
+    save_train_state,
+    load_train_state,
+)
+from .flops import (
+    forward_flops_per_sample,
+    train_step_flops_per_sample,
+    run_flops,
+    tpu_peak_flops_per_chip,
 )

@@ -8,7 +8,7 @@ locally, 8 globally.  World formation goes through the real entry path —
 final params + eval totals for the parent to cross-check.
 
 Usage: python tests/multihost_worker.py <data_root> <out_npz> \
-    <fused|batch|tp|pp|syncbn|resume|resume-divergent>
+    <fused|batch|tp|pp|syncbn|resume|resume-divergent|rstate|rstate-divergent>
 
 ``resume`` modes exercise ``--resume`` across the process boundary: each
 rank loads its OWN per-host copy ``<data_root>/ckpt_rank<r>.pt`` — the
