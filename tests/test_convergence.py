@@ -75,10 +75,13 @@ def test_small_subset_curve_increases_sub100(devices):
 
     assert all(a < 100.0 for a in accs), f"synthetic task saturated: {accs}"
     assert accs[0] < 97.0, f"epoch-1 accuracy suspiciously high: {accs}"
-    # learnable: clear climb over 5 epochs (calibrated curve on this exact
-    # config: 38.1 48.2 64.3 68.4 74.6 — margins are wide on purpose)
-    assert accs[-1] > accs[0] + 15.0, f"no learning progress: {accs}"
-    assert accs[-1] > 55.0, f"final subset accuracy too low: {accs}"
+    # learnable: clear climb over 5 epochs.  Calibrated curve on this
+    # exact config: 38.1 48.2 64.3 68.4 74.6 — bounds sit ~10 points
+    # under it (round-2 verdict weak #4 asked for tighter than the
+    # original +15/55 margins; anything tighter than this would couple
+    # the suite to XLA-version numerics).
+    assert accs[-1] > accs[0] + 25.0, f"no learning progress: {accs}"
+    assert accs[-1] > 65.0, f"final subset accuracy too low: {accs}"
 
 
 @pytest.mark.skipif(
