@@ -18,8 +18,13 @@ set -u
 cd "$(dirname "$0")/.."
 REPO="$PWD"
 OUT="$REPO"
-POLL_S=${POLL_S:-300}
+# Windows can be VERY short (observed 2026-07-31: ~80 s, vs round 2's 8 min).
+# Poll fast — the probe itself costs up to 95 s when the tunnel is down, so
+# the effective cycle is ~2.5 min — and bound every bench run so a tunnel
+# drop mid-run cannot wedge the watcher past the next window.
+POLL_S=${POLL_S:-60}
 POST_WINDOW_SLEEP_S=${POST_WINDOW_SLEEP_S:-900}
+BENCH_TIMEOUT_S=${BENCH_TIMEOUT_S:-240}
 
 stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 
@@ -31,11 +36,22 @@ probe() {
 run_bench() { # $1 = tag, rest = extra bench.py args
     local tag="$1"; shift
     echo "[$(stamp)] bench $tag start"
-    python "$REPO/bench.py" --probe-attempts 1 "$@" \
+    # Two layers of bounding: bench.py's own watchdog (structured failure
+    # JSON) and an outer `timeout` in case the watchdog thread itself is
+    # starved by a dead tunnel.  The watchdog timer starts after the backend
+    # probe (itself up to ~90 s), so the outer bound must cover probe +
+    # watchdog + margin or it would SIGTERM bench.py before the watchdog
+    # can write the structured failure record.
+    timeout $((BENCH_TIMEOUT_S + 180)) \
+        python "$REPO/bench.py" --probe-attempts 1 --run-timeout "$BENCH_TIMEOUT_S" "$@" \
         >"$OUT/bench_r3_${tag}.json" 2>"$OUT/bench_r3_${tag}.err"
     local rc=$?
     echo "[$(stamp)] bench $tag rc=$rc: $(cat "$OUT/bench_r3_${tag}.json" 2>/dev/null | head -c 400)"
     return $rc
+}
+
+is_warm() { # $1 = tag; true if that run's JSON recorded a warm cache
+    grep -q '"cache": "warm"' "$OUT/bench_r3_$1.json" 2>/dev/null
 }
 
 echo "[$(stamp)] watcher up, polling every ${POLL_S}s"
@@ -43,7 +59,17 @@ while true; do
     if probe; then
         echo "[$(stamp)] TUNNEL UP — double-bench"
         run_bench warmup || { sleep "$POLL_S"; continue; }
-        run_bench warm   || { sleep "$POLL_S"; continue; }
+        # The persistent XLA cache survives between windows: once ANY run has
+        # compiled the headline program, the next window's FIRST run is
+        # already warm.  Promote it and spend the remaining window on the
+        # variant rows instead of burning ~40 s re-measuring.
+        if is_warm warmup; then
+            echo "[$(stamp)] warmup ran warm — promoting to warm record"
+            cp "$OUT/bench_r3_warmup.json" "$OUT/bench_r3_warm.json"
+            cp "$OUT/bench_r3_warmup.err" "$OUT/bench_r3_warm.err"
+        else
+            run_bench warm || { sleep "$POLL_S"; continue; }
+        fi
         # Variant rows only after the headline record is safe.
         run_bench bf16 --bf16 || true
         run_bench syncbn --syncbn || true
