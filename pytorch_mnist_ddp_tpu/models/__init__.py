@@ -5,3 +5,4 @@ from .net import (
     init_variables,
     torch_reset_uniform,
 )
+from .vit import ViTConfig, init_vit_params, vit_forward

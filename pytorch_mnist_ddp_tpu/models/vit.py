@@ -1,0 +1,172 @@
+"""A small Vision Transformer for MNIST — the attention-based model family.
+
+The reference repo's only model is the fixed 28x28 CNN (reference
+mnist.py:11-34); it has no attention and therefore no sequence axis
+(SURVEY.md §5).  This family exists for the framework's long-context
+story: a real token sequence for ``parallel/sp.py``'s ring attention to
+shard, and a host for the MoE/expert-parallel block (models/moe.py).
+
+Written in raw-param style (plain pytree + pure functions, the
+parallel/tp.py idiom) rather than Flax: the sequence-parallel path must
+slice tokens by mesh position and swap the attention implementation, and
+sharing the SAME functions between the single-device and sharded forwards
+is what makes the parity tests airtight — there is no second copy to
+drift.
+
+Architecture (pre-LN ViT):
+  patchify(p=7) -> [b, 16, 49] -> linear embed + learned pos-embed ->
+  depth x [LN -> MHA -> +residual -> LN -> MLP(gelu) -> +residual] ->
+  final LN -> mean-pool over tokens -> linear head -> log_softmax.
+
+16 tokens (28/7 = 4 per side) keeps the token count divisible by 2/4/8-way
+seq meshes with no padding; the class is still read out through the same
+nll_loss path as the CNN (ops/loss.py), so the trainer/eval plumbing is
+shared unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import full_attention
+
+
+class ViTConfig(NamedTuple):
+    image_size: int = 28
+    channels: int = 1
+    patch_size: int = 7
+    dim: int = 64
+    depth: int = 2
+    heads: int = 4
+    mlp_dim: int = 128
+    num_classes: int = 10
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def num_tokens(self) -> int:
+        return self.grid * self.grid
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+
+def _dense_init(key, fan_in: int, shape) -> jax.Array:
+    """U(-1/sqrt(fan_in), +1/sqrt(fan_in)) — the models/net.py torch-style
+    scheme, reused so the two families share one init convention."""
+    bound = 1.0 / jnp.sqrt(jnp.asarray(fan_in, jnp.float32))
+    return jax.random.uniform(key, shape, jnp.float32, -bound, bound)
+
+
+def _dense_params(key, d_in: int, d_out: int) -> dict:
+    kk, kb = jax.random.split(key)
+    return {
+        "kernel": _dense_init(kk, d_in, (d_in, d_out)),
+        "bias": _dense_init(kb, d_in, (d_out,)),
+    }
+
+
+def _ln_params(dim: int) -> dict:
+    return {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}
+
+
+def init_vit_params(key: jax.Array, cfg: ViTConfig = ViTConfig()) -> dict:
+    """Build the ViT param pytree.  Blocks live under ``blocks/<i>`` so the
+    tree maps cleanly onto PartitionSpecs and checkpoint schemas."""
+    keys = jax.random.split(key, 3 + cfg.depth)
+    params: dict[str, Any] = {
+        "embed": _dense_params(keys[0], cfg.patch_dim, cfg.dim),
+        "pos_embed": 0.02
+        * jax.random.normal(keys[1], (cfg.num_tokens, cfg.dim)),
+        "head": _dense_params(keys[2], cfg.dim, cfg.num_classes),
+        "ln_f": _ln_params(cfg.dim),
+        "blocks": {},
+    }
+    for i in range(cfg.depth):
+        kq, kp, k1, k2 = jax.random.split(keys[3 + i], 4)
+        params["blocks"][str(i)] = {
+            "ln1": _ln_params(cfg.dim),
+            "qkv": _dense_params(kq, cfg.dim, 3 * cfg.dim),
+            "proj": _dense_params(kp, cfg.dim, cfg.dim),
+            "ln2": _ln_params(cfg.dim),
+            "mlp_in": _dense_params(k1, cfg.dim, cfg.mlp_dim),
+            "mlp_out": _dense_params(k2, cfg.mlp_dim, cfg.dim),
+        }
+    return params
+
+
+def patchify(x: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[b, H, W, C] -> [b, tokens, patch_dim], row-major over the patch
+    grid (token order is the contract pos_embed and seq-sharding rely on).
+    """
+    b = x.shape[0]
+    g, p = cfg.grid, cfg.patch_size
+    x = x.reshape(b, g, p, g, p, cfg.channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, g * g, cfg.patch_dim)
+
+
+def layer_norm(x: jax.Array, p: dict, eps: float = 1e-6) -> jax.Array:
+    mu = x.mean(axis=-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def dense(x: jax.Array, p: dict) -> jax.Array:
+    return x @ p["kernel"] + p["bias"]
+
+
+AttentionFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+def apply_block(
+    bp: dict, x: jax.Array, cfg: ViTConfig, attention_fn: AttentionFn
+) -> jax.Array:
+    """One pre-LN transformer block.  ``x`` is ``[b, t, dim]`` — t may be
+    the full token count or a sequence shard; everything here except the
+    injected ``attention_fn`` is per-token, which is exactly why sequence
+    parallelism only has to solve attention."""
+    b, t, _ = x.shape
+    h = layer_norm(x, bp["ln1"])
+    qkv = dense(h, bp["qkv"]).reshape(b, t, 3, cfg.heads, cfg.head_dim)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    attn = attention_fn(q, k, v).reshape(b, t, cfg.dim)
+    x = x + dense(attn, bp["proj"])
+    h = layer_norm(x, bp["ln2"])
+    h = jax.nn.gelu(dense(h, bp["mlp_in"]))
+    return x + dense(h, bp["mlp_out"])
+
+
+def tokens_to_logp(
+    params: dict, pooled: jax.Array
+) -> jax.Array:
+    """Mean-pooled features -> log-probs (float32 log_softmax, the same
+    numeric contract as models/net.py)."""
+    logits = dense(pooled, params["head"])
+    return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+
+def vit_forward(
+    params: dict,
+    x: jax.Array,
+    cfg: ViTConfig = ViTConfig(),
+    attention_fn: AttentionFn = full_attention,
+) -> jax.Array:
+    """Single-device forward: ``[b, 28, 28, 1]`` images -> ``[b, classes]``
+    log-probs.  The sharded forward (parallel/sp.py) composes these same
+    helpers over a token slice."""
+    tokens = dense(patchify(x, cfg), params["embed"]) + params["pos_embed"]
+    for i in range(cfg.depth):
+        tokens = apply_block(params["blocks"][str(i)], tokens, cfg, attention_fn)
+    tokens = layer_norm(tokens, params["ln_f"])
+    return tokens_to_logp(params, tokens.mean(axis=1))

@@ -1,3 +1,4 @@
 from .adadelta import adadelta_init, adadelta_update, AdadeltaState
 from .schedule import step_lr
 from .loss import nll_loss
+from .attention import full_attention

@@ -1,0 +1,114 @@
+"""Scaled-dot-product attention: dense reference + blockwise-online form.
+
+The reference repo has no attention anywhere (SURVEY.md §5 "Long-context /
+sequence parallelism: N/A" — its only model is the fixed 28x28 CNN,
+reference mnist_ddp.py:46).  This module exists for the framework's
+beyond-parity long-context story: the blockwise online-softmax update is
+the building block `parallel/sp.py` rotates around the device ring
+(ring attention), and the dense form is the numerics oracle the sharded
+path is tested against.
+
+Layouts: `q/k/v` are `[batch, tokens, heads, head_dim]` (token axis second
+so sequence sharding splits dim 1); scores are computed in `[batch, heads,
+q_tokens, k_tokens]`.  All softmax accumulation happens in float32
+regardless of input dtype — on TPU the matmuls can run bf16 while the
+running (max, normalizer, accumulator) triple stays exact enough to match
+the dense oracle.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # additive mask value; finite so (masked - max) stays finite
+
+
+class BlockAcc(NamedTuple):
+    """Online-softmax running state for one query block.
+
+    m: running row max            [batch, heads, q_tokens]
+    l: running normalizer         [batch, heads, q_tokens]
+    o: unnormalized output accum  [batch, heads, q_tokens, head_dim]
+    """
+
+    m: jax.Array
+    l: jax.Array
+    o: jax.Array
+
+
+def init_block_acc(
+    batch: int, heads: int, q_tokens: int, head_dim: int
+) -> BlockAcc:
+    return BlockAcc(
+        m=jnp.full((batch, heads, q_tokens), NEG_INF, jnp.float32),
+        l=jnp.zeros((batch, heads, q_tokens), jnp.float32),
+        o=jnp.zeros((batch, heads, q_tokens, head_dim), jnp.float32),
+    )
+
+
+def block_update(
+    acc: BlockAcc,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: jax.Array | None = None,
+) -> BlockAcc:
+    """Fold one (k, v) block into the online-softmax accumulator.
+
+    The classic flash/blockwise recurrence: rescale the previous (l, o) by
+    ``exp(m_old - m_new)`` and add this block's contribution.  Processing
+    blocks in ANY order yields the same result as dense softmax, which is
+    what lets ring attention start each device at a different ring offset.
+
+    q:        [b, tq, h, d]   (the local, never-moving query block)
+    k, v:     [b, tk, h, d]   (the visiting key/value block)
+    kv_mask:  [b, tk] bool/0-1, False = padding token (excluded exactly)
+    """
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, NEG_INF)
+    m_new = jnp.maximum(acc.m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    if kv_mask is not None:
+        # exp(NEG_INF - m) underflows to 0 already, but make the exclusion
+        # exact even when every score in the row is masked (m == NEG_INF).
+        p = jnp.where(kv_mask[:, None, None, :], p, 0.0)
+    corr = jnp.exp(acc.m - m_new)
+    l_new = acc.l * corr + p.sum(axis=-1)
+    o_new = acc.o * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32)
+    )
+    return BlockAcc(m=m_new, l=l_new, o=o_new)
+
+
+def finalize_block_acc(acc: BlockAcc, dtype: jnp.dtype) -> jax.Array:
+    """Normalize the accumulator into attention output `[b, tq, h, d]`.
+
+    Rows whose every key was masked have l == 0; emit 0 for them (they are
+    padding queries whose output is dropped downstream anyway) instead of
+    0/0 NaN, which would poison grads through unselected branches.
+    """
+    l = acc.l[..., None]
+    out = jnp.where(l > 0, acc.o / jnp.where(l > 0, l, 1.0), 0.0)
+    return out.transpose(0, 2, 1, 3).astype(dtype)
+
+
+def full_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Dense single-device attention — the numerics oracle.
+
+    Written AS one block_update so the blockwise path and the oracle share
+    every numerical decision (scale, f32 accumulation, mask semantics);
+    tests then pin ring == full to tight tolerances.
+    """
+    b, _, h, d = q.shape
+    acc = block_update(init_block_acc(b, h, q.shape[1], d), q, k, v, kv_mask)
+    return finalize_block_acc(acc, q.dtype)

@@ -1,5 +1,12 @@
 from .sampler import epoch_indices, per_rank_count
 from .mesh import make_mesh, data_sharding, replicated_sharding
+from .sp import (
+    SEQ_AXIS,
+    make_sp_eval_step,
+    make_sp_mesh,
+    make_sp_train_step,
+    ring_attention,
+)
 from .distributed import init_distributed_mode, DistState
 from .ddp import (
     TrainState,

@@ -1,0 +1,262 @@
+"""Sequence/context parallelism: ring attention over a ``seq`` mesh axis.
+
+The reference has no sequence dimension at all (SURVEY.md §5 "Long-context
+/ sequence parallelism: N/A" — 28x28 images, no attention), so this module
+is beyond-parity capability: the framework's long-context answer.  Tokens
+are sharded over a ``seq`` axis; each device keeps its query block pinned
+and the (key, value) blocks travel the ring with ``ppermute``, one hop per
+step, folding into the online-softmax accumulator (ops/attention.py) until
+every device has seen every block.  Communication is neighbor-only — the
+pattern ICI is built for — and overlaps with the per-block compute under
+XLA's latency-hiding scheduler; memory per device stays O(T/S) while the
+attended context is the full T.
+
+The same mesh carries data parallelism on its first axis, so the 2-D
+``(data, seq)`` step scales batch and sequence independently.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..ops.attention import (
+    block_update,
+    finalize_block_acc,
+    init_block_acc,
+)
+from .mesh import DATA_AXIS
+
+SEQ_AXIS = "seq"
+
+
+def make_sp_mesh(
+    num_data: int | None = None,
+    num_seq: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ``(data, seq)`` mesh.  Data outermost (same rationale as
+    parallel/mesh.py): the seq ring's every-step ppermute hops ride the
+    adjacent, fastest ICI links."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_data is None:
+        if len(devices) % num_seq:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by seq={num_seq}"
+            )
+        num_data = len(devices) // num_seq
+    need = num_data * num_seq
+    if need > len(devices):
+        raise ValueError(
+            f"requested {num_data}x{num_seq} mesh but only "
+            f"{len(devices)} devices are available"
+        )
+    grid = np.asarray(devices[:need]).reshape(num_data, num_seq)
+    return Mesh(grid, (DATA_AXIS, SEQ_AXIS))
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    kv_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Exact attention over the full (sharded) sequence via a k/v ring.
+
+    Call inside ``shard_map`` with the token axis sharded over
+    ``axis_name``.  ``q/k/v`` are the LOCAL blocks ``[b, T/S, h, d]``;
+    ``kv_mask`` (optional ``[b, T/S]``, False = padding) travels the ring
+    with its block so masked tokens are excluded wherever they visit.
+
+    Exactness: ``block_update`` is order-invariant, so each device folding
+    the S blocks in its own ring order reproduces dense softmax over all T
+    tokens — parity with ``ops.attention.full_attention`` is pinned by
+    tests/test_sp.py.  One jnp-stacked carry keeps the scan body a single
+    fused (matmul + rescale + ppermute) program per hop.
+    """
+    size = jax.lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    # Fold the resident block first, then size-1 rotate-then-fold hops: no
+    # hop is ever wasted (a rotate-after-fold loop of length `size` would
+    # ship one final k/v exchange whose result is discarded — and a scan
+    # body is one shared compiled program, so XLA cannot DCE it from just
+    # the last iteration).
+    acc = block_update(init_block_acc(b, h, t_local, d), q, k, v, kv_mask)
+
+    # The scan body makes every carry component device-varying over the
+    # ring axis AND over whatever axes the inputs already vary on (e.g. the
+    # data axis of a 2-D (data, seq) mesh), so a component that starts
+    # replicated must be cast varying up front to the UNION of those axes
+    # or the carry is not type-stable under VMA tracking.  Axes a leaf
+    # already varies on must be skipped: the cast is strictly
+    # invariant->variant.
+    target_vma = (
+        {axis_name}
+        | jax.typeof(q).vma
+        | jax.typeof(k).vma
+        | jax.typeof(v).vma
+        | (set() if kv_mask is None else jax.typeof(kv_mask).vma)
+    )
+
+    def ensure_varying(leaf):
+        missing = tuple(sorted(target_vma - set(jax.typeof(leaf).vma)))
+        if not missing:
+            return leaf
+        return jax.lax.pcast(leaf, missing, to="varying")
+
+    if kv_mask is None:
+        # Unmasked fast path: no mask travels the ring and block_update
+        # skips both masking passes entirely.
+        def hop(carry, _):
+            acc, k, v = carry
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+            acc = block_update(acc, q, k, v, None)
+            return (acc, k, v), None
+
+        (acc, _, _), _ = jax.lax.scan(
+            hop, jax.tree.map(ensure_varying, (acc, k, v)), None,
+            length=size - 1,
+        )
+    else:
+        def hop(carry, _):
+            acc, k, v, mask = carry
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+            mask = jax.lax.ppermute(mask, axis_name, perm)
+            acc = block_update(acc, q, k, v, mask)
+            return (acc, k, v, mask), None
+
+        (acc, _, _, _), _ = jax.lax.scan(
+            hop, jax.tree.map(ensure_varying, (acc, k, v, kv_mask)),
+            None, length=size - 1,
+        )
+    return finalize_block_acc(acc, q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel ViT training: the 2-D (data, seq) step.
+# ---------------------------------------------------------------------------
+
+
+def _check_token_divisibility(cfg, mesh: Mesh) -> None:
+    """A non-divisible token count would silently drop the trailing
+    ``num_tokens % num_seq`` tokens from every shard's slice (and skew the
+    mean-pool denominator) — fail loudly at step-construction time."""
+    num_seq = mesh.shape[SEQ_AXIS]
+    if cfg.num_tokens % num_seq:
+        raise ValueError(
+            f"num_tokens={cfg.num_tokens} not divisible by the seq axis "
+            f"({num_seq}); pick a patch grid divisible by the mesh"
+        )
+
+
+def _sp_vit_forward(params: dict, x: jax.Array, cfg) -> jax.Array:
+    """The ViT forward over a TOKEN SHARD, inside shard_map.
+
+    ``x`` is the local data-shard of images, replicated over ``seq``; this
+    device embeds only its ``T/S`` token slice (patch rows and pos-embed
+    rows selected by mesh position), runs every per-token op locally, and
+    attends over the full sequence through the ring.  The mean-pool is a
+    token-sum psum over ``seq`` — after it, logits/loss are seq-invariant.
+    Composes the SAME helpers as models/vit.py's single-device forward.
+    """
+    from ..models.vit import (
+        apply_block,
+        dense,
+        layer_norm,
+        patchify,
+        tokens_to_logp,
+    )
+
+    num_seq = jax.lax.axis_size(SEQ_AXIS)
+    t_local = cfg.num_tokens // num_seq
+    start = jax.lax.axis_index(SEQ_AXIS) * t_local
+
+    patches = jax.lax.dynamic_slice_in_dim(
+        patchify(x, cfg), start, t_local, axis=1
+    )
+    pos = jax.lax.dynamic_slice_in_dim(
+        params["pos_embed"], start, t_local, axis=0
+    )
+    tokens = dense(patches, params["embed"]) + pos
+    for i in range(cfg.depth):
+        tokens = apply_block(
+            params["blocks"][str(i)], tokens, cfg,
+            lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS),
+        )
+    tokens = layer_norm(tokens, params["ln_f"])
+    pooled = jax.lax.psum(tokens.sum(axis=1), SEQ_AXIS) / cfg.num_tokens
+    return tokens_to_logp(params, pooled)
+
+
+def make_sp_train_step(mesh: Mesh, cfg, rho: float = 0.9, eps: float = 1e-6):
+    """Build the jitted 2-D (data x seq) ViT train step.
+
+    ``step_fn(state, x, y, w, lr) -> (state, losses)`` with ``state`` a
+    fully-replicated ddp.TrainState over ViT params, ``x/y/w`` sharded over
+    ``data``, ``losses`` one local loss per data shard.  Gradient
+    semantics mirror parallel/tp.py: under VMA tracking the transpose
+    already psums each param's cotangent over both mesh axes (the seq-axis
+    sum IS the full-sequence gradient — each shard contributes distinct
+    tokens), so what arrives is the data-axis SUM of local-mean grads;
+    divide by the data degree for DDP mean semantics.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.adadelta import adadelta_update
+    from ..ops.loss import nll_loss
+    from .ddp import TrainState
+
+    _check_token_divisibility(cfg, mesh)
+    num_data = mesh.shape[DATA_AXIS]
+
+    def local_step(state: TrainState, x, y, w, lr):
+        def loss_fn(params):
+            logp = _sp_vit_forward(params, x, cfg)
+            return nll_loss(logp, y, w, reduction="mean")
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        grads = jax.tree.map(lambda g: g / num_data, grads)
+        params, opt = adadelta_update(
+            state.params, grads, state.opt, lr, rho, eps
+        )
+        return TrainState(params, opt, state.step + 1), loss[None]
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(P(), P(DATA_AXIS)),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_sp_eval_step(mesh: Mesh, cfg):
+    """Jitted (data x seq) eval step: ring-attention forward + the psum'd
+    (loss_sum, correct) totals of ddp.make_eval_step — identical printed
+    numbers, full-mesh participation."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.loss import nll_loss
+
+    _check_token_divisibility(cfg, mesh)
+
+    def local_eval(params, x, y, w):
+        logp = _sp_vit_forward(params, x, cfg)
+        loss_sum = nll_loss(logp, y, w, reduction="sum")
+        correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
+        return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
+
+    sharded = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    )
+    return jax.jit(sharded)

@@ -1,0 +1,273 @@
+"""Sequence parallelism: ring attention + the (data, seq) ViT step.
+
+Strategy (SURVEY.md §4 style): the sharded path is pinned against the
+single-device oracle on the 8-virtual-device CPU mesh — ring attention vs
+dense attention, and the full 2-D SP train step vs the plain single-device
+training recurrence on identical init/batches.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pytorch_mnist_ddp_tpu.models.vit import (
+    ViTConfig,
+    init_vit_params,
+    patchify,
+    vit_forward,
+)
+from pytorch_mnist_ddp_tpu.ops.attention import full_attention
+from pytorch_mnist_ddp_tpu.parallel.sp import (
+    SEQ_AXIS,
+    make_sp_eval_step,
+    make_sp_mesh,
+    make_sp_train_step,
+    ring_attention,
+)
+
+CFG = ViTConfig()
+
+
+def _qkv(key, b=2, t=16, h=4, d=8):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, t, h, d)),
+        jax.random.normal(kk, (b, t, h, d)),
+        jax.random.normal(kv, (b, t, h, d)),
+    )
+
+
+def test_full_attention_matches_naive_softmax():
+    """full_attention (the blockwise oracle) against an INDEPENDENT dense
+    softmax formulation — so the shared-code parity tests below are
+    anchored to textbook attention, not to themselves."""
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    expect = jnp.einsum(
+        "bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v
+    )
+    got = full_attention(q, k, v)
+    np.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-6)
+
+
+def test_full_attention_mask_excludes_padding():
+    """Masked keys must not influence any output row: attention over
+    [real | garbage] with the garbage masked equals attention over the
+    real prefix alone."""
+    q, k, v = _qkv(jax.random.PRNGKey(1), t=12)
+    t_real = 8
+    k_noise = k.at[:, t_real:].set(1e3)
+    v_noise = v.at[:, t_real:].set(-1e3)
+    mask = jnp.arange(12) < t_real
+    mask = jnp.broadcast_to(mask, (2, 12))
+    got = full_attention(q, k_noise, v_noise, kv_mask=mask)
+    expect = full_attention(
+        q[:, :], k[:, :t_real], v[:, :t_real]
+    )
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("num_seq", [2, 4, 8])
+def test_ring_attention_matches_full(devices, num_seq):
+    """The load-bearing SP parity: ring attention over an N-way seq mesh
+    equals dense attention over the gathered sequence."""
+    mesh = make_sp_mesh(num_data=1, num_seq=num_seq, devices=devices[:num_seq])
+    q, k, v = _qkv(jax.random.PRNGKey(2), b=2, t=16, h=4, d=8)
+
+    ring = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS),
+            mesh=mesh,
+            in_specs=(P(None, SEQ_AXIS),) * 3,
+            out_specs=P(None, SEQ_AXIS),
+        )
+    )
+    np.testing.assert_allclose(
+        ring(q, k, v), full_attention(q, k, v), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_attention_mask_travels_the_ring(devices):
+    """A padding mask sharded with its kv blocks must exclude the padded
+    tokens from every device's accumulation, not just the owner's."""
+    mesh = make_sp_mesh(num_data=1, num_seq=4, devices=devices[:4])
+    q, k, v = _qkv(jax.random.PRNGKey(3), b=2, t=16)
+    mask = jnp.broadcast_to(jnp.arange(16) < 13, (2, 16))
+
+    ring = jax.jit(
+        jax.shard_map(
+            lambda q, k, v, m: ring_attention(q, k, v, SEQ_AXIS, kv_mask=m),
+            mesh=mesh,
+            in_specs=(P(None, SEQ_AXIS),) * 4,
+            out_specs=P(None, SEQ_AXIS),
+        )
+    )
+    np.testing.assert_allclose(
+        ring(q, k, v, mask),
+        full_attention(q, k, v, kv_mask=mask),
+        rtol=2e-5,
+        atol=2e-5,
+    )
+
+
+def test_vit_forward_shapes_and_determinism():
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 28, 28, 1))
+    logp = vit_forward(params, x, CFG)
+    assert logp.shape == (4, CFG.num_classes)
+    # log-probs: rows sum to 1 in prob space
+    np.testing.assert_allclose(
+        jnp.exp(logp).sum(axis=1), np.ones(4), rtol=1e-5
+    )
+    np.testing.assert_array_equal(logp, vit_forward(params, x, CFG))
+
+
+def test_patchify_token_order_contract():
+    """Token t is patch (row t//4, col t%4): pos_embed and the seq-shard
+    slicing both assume this row-major grid order."""
+    x = jnp.arange(28 * 28, dtype=jnp.float32).reshape(1, 28, 28, 1)
+    patches = patchify(x, CFG)
+    assert patches.shape == (1, 16, 49)
+    # token 5 = grid (1, 1): rows 7..13, cols 7..13
+    expect = x[0, 7:14, 7:14, 0].reshape(-1)
+    np.testing.assert_array_equal(patches[0, 5], expect)
+
+
+def test_sp_forward_matches_single_device(devices):
+    """The sharded (data=2, seq=4) forward equals the single-device ViT
+    forward on the same params/batch."""
+    mesh = make_sp_mesh(num_data=2, num_seq=4, devices=devices)
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28, 1))
+
+    from pytorch_mnist_ddp_tpu.parallel.sp import _sp_vit_forward
+
+    sp_fwd = jax.jit(
+        jax.shard_map(
+            lambda p, x: _sp_vit_forward(p, x, CFG),
+            mesh=mesh,
+            in_specs=(P(), P("data")),
+            out_specs=P("data"),
+        )
+    )
+    np.testing.assert_allclose(
+        sp_fwd(params, x), vit_forward(params, x, CFG), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_sp_train_step_matches_single_device(devices):
+    """Five SP train steps on the (2 data x 4 seq) mesh track the plain
+    single-device recurrence (same init, same batches, Adadelta) — the
+    gradient psums over BOTH axes must reproduce exact full-batch
+    full-sequence gradients."""
+    from pytorch_mnist_ddp_tpu.ops.adadelta import adadelta_update
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+    from pytorch_mnist_ddp_tpu.parallel.ddp import (
+        make_train_state,
+        replicate_params,
+    )
+
+    mesh = make_sp_mesh(num_data=2, num_seq=4, devices=devices)
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    ref_params = jax.tree.map(jnp.array, params)
+
+    state = replicate_params(make_train_state(params), mesh)
+    step = make_sp_train_step(mesh, CFG)
+
+    @jax.jit
+    def ref_step(params, opt, x, y, w, lr):
+        def loss_fn(p):
+            return nll_loss(vit_forward(p, x, CFG), y, w, reduction="mean")
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt = adadelta_update(params, grads, opt, lr, 0.9, 1e-6)
+        return params, opt, loss
+
+    from pytorch_mnist_ddp_tpu.ops.adadelta import adadelta_init
+
+    ref_opt = adadelta_init(ref_params)
+    rng = np.random.RandomState(0)
+    for i in range(5):
+        x = jnp.asarray(rng.randn(8, 28, 28, 1), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 10, 8), jnp.int32)
+        w = jnp.ones((8,), jnp.float32)
+        state, losses = step(state, x, y, w, jnp.float32(1.0))
+        ref_params, ref_opt, ref_loss = ref_step(
+            ref_params, ref_opt, x, y, w, jnp.float32(1.0)
+        )
+        # per-data-shard local losses average to the global mean loss
+        np.testing.assert_allclose(
+            np.mean(losses), ref_loss, rtol=2e-5, atol=2e-5
+        )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=3e-4, atol=3e-5),
+        jax.device_get(state.params),
+        jax.device_get(ref_params),
+    )
+
+
+def test_sp_eval_step_totals(devices):
+    """(loss_sum, correct) totals from the SP eval step equal the
+    single-device computation, padding rows excluded."""
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+    from pytorch_mnist_ddp_tpu.parallel.ddp import replicate_params
+
+    mesh = make_sp_mesh(num_data=2, num_seq=4, devices=devices)
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28, 1))
+    y = jnp.asarray(np.random.RandomState(0).randint(0, 10, 8), jnp.int32)
+    w = jnp.asarray([1, 1, 1, 1, 1, 1, 0, 0], jnp.float32)  # 2 padding rows
+
+    ev = make_sp_eval_step(mesh, CFG)
+    totals = ev(replicate_params(params, mesh), x, y, w)
+
+    logp = vit_forward(params, x, CFG)
+    expect_loss = nll_loss(logp, y, w, reduction="sum")
+    expect_correct = float(((jnp.argmax(logp, axis=1) == y) * w).sum())
+    np.testing.assert_allclose(totals[0], expect_loss, rtol=2e-5)
+    assert float(totals[1]) == expect_correct
+
+
+def test_sp_rejects_non_divisible_token_count(devices):
+    """16 tokens over a 3-way seq axis would silently drop a token; the
+    step builders must refuse it."""
+    mesh = make_sp_mesh(num_data=1, num_seq=3, devices=devices[:3])
+    with pytest.raises(ValueError, match="not divisible"):
+        make_sp_train_step(mesh, CFG)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_sp_eval_step(mesh, CFG)
+
+
+def test_vit_trains_on_toy_task():
+    """A few single-device Adadelta steps on a fixed toy batch must cut
+    the loss substantially — the family is trainable, not just well-shaped."""
+    from pytorch_mnist_ddp_tpu.ops.adadelta import (
+        adadelta_init,
+        adadelta_update,
+    )
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    opt = adadelta_init(params)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(32, 28, 28, 1), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, 32), jnp.int32)
+    w = jnp.ones((32,), jnp.float32)
+
+    @jax.jit
+    def step(params, opt):
+        def loss_fn(p):
+            return nll_loss(vit_forward(p, x, CFG), y, w, reduction="mean")
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt = adadelta_update(params, grads, opt, 1.0, 0.9, 1e-6)
+        return params, opt, loss
+
+    first = None
+    for _ in range(30):
+        params, opt, loss = step(params, opt)
+        first = float(loss) if first is None else first
+    assert float(loss) < 0.5 * first, (first, float(loss))
