@@ -5,4 +5,5 @@ from .net import (
     init_variables,
     torch_reset_uniform,
 )
-from .vit import ViTConfig, init_vit_params, vit_forward
+from .vit import ViTConfig, init_vit_params, vit_forward, vit_moe_forward
+from .moe import init_moe_params, moe_mlp_dense

@@ -1,0 +1,127 @@
+"""Mixture-of-Experts MLP: switch-style top-1 routing with capacity.
+
+The reference has no MoE anywhere (SURVEY.md §2c "Expert parallel (EP/MoE):
+No"), so this is beyond-parity capability, the host layer for
+``parallel/ep.py``'s expert parallelism.  The ViT family (models/vit.py,
+``ViTConfig.num_experts > 0``) swaps its dense block-MLP for this layer.
+
+Routing (Switch Transformer recipe):
+- gate: linear ``[dim -> E]``, softmax; each token goes to its argmax
+  expert, weighted by that expert's probability;
+- capacity: each expert accepts at most ``C`` tokens (static shape —
+  everything downstream is fixed-size einsum, the form XLA/MXU want);
+  overflow tokens are dropped (their MLP output is 0, the residual
+  carries them);
+- aux load-balance loss: ``E * sum_e f_e * P_e`` (fraction routed x mean
+  gate prob), the standard differentiable pressure toward uniform load —
+  without it top-1 routing collapses onto one expert.
+
+The dense path here is the numerics oracle: ``parallel/ep.py`` runs the
+same dispatch/combine einsums with the expert dim sharded and two
+``all_to_all`` hops, and is pinned against this in tests/test_moe.py.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .vit import ViTConfig, _dense_params
+
+
+class MoeOut(NamedTuple):
+    y: jax.Array        # [..., dim] expert-MLP output (0 for dropped tokens)
+    aux_loss: jax.Array  # scalar load-balance loss
+
+
+def init_moe_params(key: jax.Array, cfg: ViTConfig) -> dict:
+    """Per-block MoE params: gate + stacked expert FFN weights.
+
+    Expert weights are ``[E, d_in, d_out]`` stacks so the expert dim can be
+    sharded (parallel/ep.py) or batched through one einsum (dense path).
+    Each expert gets the same U(-1/sqrt(fan_in)) scheme as the dense MLP.
+    """
+    kg, ki, ko = jax.random.split(key, 3)
+    e, d, f = cfg.num_experts, cfg.dim, cfg.mlp_dim
+
+    def stack(key, d_in, d_out):
+        keys = jax.random.split(key, e)
+        return jnp.stack(
+            [_dense_params(k, d_in, d_out)["kernel"] for k in keys]
+        )
+
+    return {
+        "gate": _dense_params(kg, d, e),
+        "w_in": stack(ki, d, f),    # [E, dim, mlp_dim]
+        "b_in": jnp.zeros((e, f)),
+        "w_out": stack(ko, f, d),   # [E, mlp_dim, dim]
+        "b_out": jnp.zeros((e, d)),
+    }
+
+
+def capacity_for(num_tokens: int, cfg: ViTConfig) -> int:
+    """Static per-expert capacity for a routing group of ``num_tokens``."""
+    import math
+
+    return max(
+        1, math.ceil(num_tokens * cfg.capacity_factor / cfg.num_experts)
+    )
+
+
+def gate_and_dispatch(
+    gate_params: dict, x: jax.Array, cfg: ViTConfig, capacity: int
+):
+    """Top-1 routing for a flat token group ``x: [G, dim]``.
+
+    Returns ``(dispatch, combine, aux)``:
+      dispatch ``[G, E, C]`` — 0/1, token g occupies slot c of expert e;
+      combine  ``[G, E, C]`` — dispatch x gate probability;
+      aux      scalar load-balance loss.
+    Slots fill in token order (cumsum position), torch-free and exactly
+    reproducible across the dense and expert-parallel paths.
+    """
+    logits = x @ gate_params["kernel"] + gate_params["bias"]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [G, E]
+    expert_idx = jnp.argmax(probs, axis=-1)                      # [G]
+    onehot = jax.nn.one_hot(expert_idx, cfg.num_experts, dtype=probs.dtype)
+    # Position of each token within its selected expert's queue (pos rows
+    # are zero outside the selected expert, so the sum extracts it).
+    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot          # [G, E]
+    sel_pos = pos.sum(axis=-1)                                    # [G]
+    # one_hot of an out-of-range index is all-zero, which IS the capacity
+    # drop: tokens past slot C-1 get no dispatch row.
+    dispatch = (
+        onehot[:, :, None]
+        * jax.nn.one_hot(
+            sel_pos.astype(jnp.int32), capacity, dtype=probs.dtype
+        )[:, None, :]
+    )
+    gate_prob = probs.max(axis=-1)
+    combine = dispatch * gate_prob[:, None, None]
+    # Switch aux loss: fraction-of-tokens f_e dot mean-prob P_e, scaled E.
+    f = onehot.mean(axis=0)
+    p = probs.mean(axis=0)
+    aux = cfg.num_experts * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+def expert_ffn(mp: dict, xin: jax.Array) -> jax.Array:
+    """Batched expert MLP: ``xin [E, C, dim] -> [E, C, dim]`` through each
+    expert's own weights — one einsum pair, E matmuls on the MXU."""
+    h = jnp.einsum("ecd,edf->ecf", xin, mp["w_in"]) + mp["b_in"][:, None, :]
+    h = jax.nn.gelu(h)
+    return jnp.einsum("ecf,efd->ecd", h, mp["w_out"]) + mp["b_out"][:, None, :]
+
+
+def moe_mlp_dense(mp: dict, x: jax.Array, cfg: ViTConfig) -> MoeOut:
+    """Single-device MoE MLP over ``x: [b, t, dim]`` — the oracle path."""
+    b, t, d = x.shape
+    flat = x.reshape(b * t, d)
+    cap = capacity_for(b * t, cfg)
+    dispatch, combine, aux = gate_and_dispatch(mp["gate"], flat, cfg, cap)
+    xin = jnp.einsum("gec,gd->ecd", dispatch, flat)
+    out = expert_ffn(mp, xin)
+    y = jnp.einsum("gec,ecd->gd", combine, out)
+    return MoeOut(y.reshape(b, t, d).astype(x.dtype), aux)

@@ -43,6 +43,9 @@ class ViTConfig(NamedTuple):
     heads: int = 4
     mlp_dim: int = 128
     num_classes: int = 10
+    # MoE variant (models/moe.py): 0 experts = the dense MLP above.
+    num_experts: int = 0
+    capacity_factor: float = 2.0
 
     @property
     def grid(self) -> int:
@@ -94,14 +97,20 @@ def init_vit_params(key: jax.Array, cfg: ViTConfig = ViTConfig()) -> dict:
     }
     for i in range(cfg.depth):
         kq, kp, k1, k2 = jax.random.split(keys[3 + i], 4)
-        params["blocks"][str(i)] = {
+        block = {
             "ln1": _ln_params(cfg.dim),
             "qkv": _dense_params(kq, cfg.dim, 3 * cfg.dim),
             "proj": _dense_params(kp, cfg.dim, cfg.dim),
             "ln2": _ln_params(cfg.dim),
-            "mlp_in": _dense_params(k1, cfg.dim, cfg.mlp_dim),
-            "mlp_out": _dense_params(k2, cfg.mlp_dim, cfg.dim),
         }
+        if cfg.num_experts > 0:
+            from .moe import init_moe_params
+
+            block["moe"] = init_moe_params(k1, cfg)
+        else:
+            block["mlp_in"] = _dense_params(k1, cfg.dim, cfg.mlp_dim)
+            block["mlp_out"] = _dense_params(k2, cfg.mlp_dim, cfg.dim)
+        params["blocks"][str(i)] = block
     return params
 
 
@@ -129,6 +138,20 @@ def dense(x: jax.Array, p: dict) -> jax.Array:
 AttentionFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
 
 
+def _attn_sublayer(
+    bp: dict, x: jax.Array, cfg: ViTConfig, attention_fn: AttentionFn
+) -> jax.Array:
+    """ln1 -> qkv -> attention -> proj residual — THE shared attention
+    sublayer for both block variants (dense-MLP and MoE), so a change to
+    the attention path can never fork between them."""
+    b, t, _ = x.shape
+    h = layer_norm(x, bp["ln1"])
+    qkv = dense(h, bp["qkv"]).reshape(b, t, 3, cfg.heads, cfg.head_dim)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    attn = attention_fn(q, k, v).reshape(b, t, cfg.dim)
+    return x + dense(attn, bp["proj"])
+
+
 def apply_block(
     bp: dict, x: jax.Array, cfg: ViTConfig, attention_fn: AttentionFn
 ) -> jax.Array:
@@ -136,12 +159,7 @@ def apply_block(
     the full token count or a sequence shard; everything here except the
     injected ``attention_fn`` is per-token, which is exactly why sequence
     parallelism only has to solve attention."""
-    b, t, _ = x.shape
-    h = layer_norm(x, bp["ln1"])
-    qkv = dense(h, bp["qkv"]).reshape(b, t, 3, cfg.heads, cfg.head_dim)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    attn = attention_fn(q, k, v).reshape(b, t, cfg.dim)
-    x = x + dense(attn, bp["proj"])
+    x = _attn_sublayer(bp, x, cfg, attention_fn)
     h = layer_norm(x, bp["ln2"])
     h = jax.nn.gelu(dense(h, bp["mlp_in"]))
     return x + dense(h, bp["mlp_out"])
@@ -156,6 +174,21 @@ def tokens_to_logp(
     return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
 
 
+def _vit_trunk(
+    params: dict, x: jax.Array, cfg: ViTConfig, block_fn
+) -> tuple[jax.Array, jax.Array]:
+    """Embed -> blocks -> final LN -> mean-pool -> log-probs, with
+    ``block_fn(bp, tokens) -> (tokens, aux)`` — THE shared skeleton for
+    the dense and MoE forwards (aux is 0 for dense blocks)."""
+    tokens = dense(patchify(x, cfg), params["embed"]) + params["pos_embed"]
+    aux_total = jnp.float32(0.0)
+    for i in range(cfg.depth):
+        tokens, aux = block_fn(params["blocks"][str(i)], tokens)
+        aux_total = aux_total + aux
+    tokens = layer_norm(tokens, params["ln_f"])
+    return tokens_to_logp(params, tokens.mean(axis=1)), aux_total
+
+
 def vit_forward(
     params: dict,
     x: jax.Array,
@@ -165,8 +198,51 @@ def vit_forward(
     """Single-device forward: ``[b, 28, 28, 1]`` images -> ``[b, classes]``
     log-probs.  The sharded forward (parallel/sp.py) composes these same
     helpers over a token slice."""
-    tokens = dense(patchify(x, cfg), params["embed"]) + params["pos_embed"]
-    for i in range(cfg.depth):
-        tokens = apply_block(params["blocks"][str(i)], tokens, cfg, attention_fn)
-    tokens = layer_norm(tokens, params["ln_f"])
-    return tokens_to_logp(params, tokens.mean(axis=1))
+    logp, _ = _vit_trunk(
+        params, x, cfg,
+        lambda bp, t: (apply_block(bp, t, cfg, attention_fn), 0.0),
+    )
+    return logp
+
+
+MoeFn = Callable[[dict, jax.Array], Any]  # (moe_params, [b,t,d]) -> MoeOut
+
+
+def apply_block_moe(
+    bp: dict,
+    x: jax.Array,
+    cfg: ViTConfig,
+    attention_fn: AttentionFn,
+    moe_fn: MoeFn,
+):
+    """The MoE variant of ``apply_block``: same attention sublayer, the
+    dense MLP replaced by the injected expert layer.  Returns
+    ``(x, aux_loss)`` — the load-balance aux accumulates across blocks."""
+    x = _attn_sublayer(bp, x, cfg, attention_fn)
+    h = layer_norm(x, bp["ln2"])
+    out = moe_fn(bp["moe"], h)
+    return x + out.y, out.aux_loss
+
+
+def vit_moe_forward(
+    params: dict,
+    x: jax.Array,
+    cfg: ViTConfig,
+    attention_fn: AttentionFn = full_attention,
+    moe_fn: MoeFn | None = None,
+):
+    """MoE-ViT forward -> ``(log_probs, aux_loss)``; ``aux_loss`` is the
+    mean load-balance loss over blocks, for the trainer to weight into the
+    objective.  Default ``moe_fn`` is the single-device dense-dispatch
+    oracle (models/moe.py); parallel/ep.py injects the expert-parallel
+    all_to_all version."""
+    if moe_fn is None:
+        from .moe import moe_mlp_dense
+
+        moe_fn = lambda mp, h: moe_mlp_dense(mp, h, cfg)  # noqa: E731
+
+    logp, aux_total = _vit_trunk(
+        params, x, cfg,
+        lambda bp, t: apply_block_moe(bp, t, cfg, attention_fn, moe_fn),
+    )
+    return logp, aux_total / cfg.depth

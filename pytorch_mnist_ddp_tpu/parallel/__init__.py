@@ -7,6 +7,12 @@ from .sp import (
     make_sp_train_step,
     ring_attention,
 )
+from .ep import (
+    make_ep_eval_step,
+    make_ep_train_step,
+    moe_mlp_ep,
+    shard_ep_state,
+)
 from .distributed import init_distributed_mode, DistState
 from .ddp import (
     TrainState,

@@ -1,0 +1,229 @@
+"""Expert parallelism: MoE experts sharded over the mesh, all_to_all routing.
+
+The reference has no MoE (SURVEY.md §2c "EP: No") — beyond-parity
+capability completing the framework's parallelism matrix (dp/tp/pp/sp/ep).
+
+Layout: the expert dim of every stacked expert weight (models/moe.py,
+``[E, ...]``) is sharded over the existing ``data`` mesh axis — the
+standard "EP rides the DP axis" deployment, no third axis needed.  Each
+device routes its LOCAL tokens (switch top-1, per-shard capacity), then:
+
+  1. ``all_to_all`` #1: dispatch einsum packs ``[E, C, d]`` expert inputs,
+     device-major over E, and the exchange delivers ``[E/S, S*C, d]`` —
+     every device now holds every token routed to ITS experts;
+  2. the batched expert FFN runs on local expert weights (E/S matmul
+     pairs on the MXU);
+  3. ``all_to_all`` #2 returns outputs to the token owners, and the
+     combine einsum scatters them back (weighted by gate prob).
+
+Capacity is per routing group (the per-device token shard), so the drop
+pattern matches what a real multi-chip MoE sees; with enough capacity no
+token drops and the output is bit-comparable to the dense oracle —
+that's the parity pin in tests/test_moe.py.
+
+Gradients: expert-sharded params stay local (their grads are produced on
+the owning device from the gathered tokens; the backward of all_to_all is
+the reverse all_to_all), replicated params get the VMA-inserted psum —
+both arrive as the data-axis SUM of local-mean grads, so everything is
+divided by the data degree, exactly like parallel/tp.py / sp.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.moe import MoeOut, capacity_for, expert_ffn, gate_and_dispatch
+from ..models.vit import ViTConfig, vit_moe_forward
+from .mesh import DATA_AXIS
+
+AUX_LOSS_WEIGHT = 0.01  # standard Switch-style weighting of the balance loss
+
+
+def _check_expert_divisibility(cfg: ViTConfig, mesh: Mesh) -> None:
+    num = mesh.shape[DATA_AXIS]
+    if cfg.num_experts <= 0:
+        raise ValueError("expert parallelism needs cfg.num_experts > 0")
+    if cfg.num_experts % num:
+        raise ValueError(
+            f"num_experts={cfg.num_experts} not divisible by the expert "
+            f"axis ({num})"
+        )
+
+
+def moe_mlp_ep(
+    mp: dict, x: jax.Array, cfg: ViTConfig, axis_name: str = DATA_AXIS
+) -> MoeOut:
+    """The expert-parallel MoE MLP, inside shard_map.
+
+    ``x`` is the local token shard ``[b_local, t, d]``; ``mp`` holds the
+    FULL gate (replicated) but only the LOCAL slice of each expert stack
+    (``[E/S, ...]``, sharded by ep_param_specs).  Routing math is
+    models/moe.py's (same gate_and_dispatch / expert_ffn); only the two
+    all_to_all hops are new.
+    """
+    size = jax.lax.axis_size(axis_name)
+    b, t, d = x.shape
+    flat = x.reshape(b * t, d)
+    cap = capacity_for(b * t, cfg)
+    dispatch, combine, aux = gate_and_dispatch(mp["gate"], flat, cfg, cap)
+
+    # Pack per-expert inputs, device-major over the E dim (the global
+    # expert order IS device-major because the stacks are sharded on dim 0).
+    xin = jnp.einsum("gec,gd->ecd", dispatch, flat)        # [E, C, d]
+    # Exchange #1: chunk e-block j -> device j; receive source-major.
+    xin = jax.lax.all_to_all(
+        xin, axis_name, split_axis=0, concat_axis=1, tiled=True
+    )                                                      # [E/S, S*C, d]
+    out = expert_ffn(mp, xin)                              # [E/S, S*C, d]
+    # Exchange #2: return outputs to their token owners.
+    out = jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=0, tiled=True
+    )                                                      # [E, C, d]
+    y = jnp.einsum("gec,ecd->gd", combine, out)
+    # The local aux is this shard's load-balance term; psum-mean it so
+    # every device carries the same scalar (and the grad contribution is
+    # the global mean's, matching the dense oracle's single-group form).
+    aux = jax.lax.pmean(aux, axis_name)
+    return MoeOut(y.reshape(b, t, d).astype(x.dtype), aux)
+
+
+def ep_param_specs(cfg: ViTConfig) -> dict:
+    """PartitionSpecs for the MoE-ViT param tree: expert stacks sharded on
+    their leading E dim over the data axis, everything else replicated."""
+    moe = {
+        "gate": {"kernel": P(), "bias": P()},
+        "w_in": P(DATA_AXIS),
+        "b_in": P(DATA_AXIS),
+        "w_out": P(DATA_AXIS),
+        "b_out": P(DATA_AXIS),
+    }
+    dense2 = {"kernel": P(), "bias": P()}
+    ln = {"scale": P(), "bias": P()}
+    return {
+        "embed": dict(dense2),
+        "pos_embed": P(),
+        "head": dict(dense2),
+        "ln_f": dict(ln),
+        "blocks": {
+            str(i): {
+                "ln1": dict(ln),
+                "qkv": dict(dense2),
+                "proj": dict(dense2),
+                "ln2": dict(ln),
+                "moe": moe,
+            }
+            for i in range(cfg.depth)
+        },
+    }
+
+
+def ep_state_specs(cfg: ViTConfig):
+    """Specs for the full TrainState: Adadelta accumulators shard exactly
+    like their params.  ONE definition, used by both the placement helper
+    and the jitted step's in/out specs — they can never drift apart."""
+    from ..ops.adadelta import AdadeltaState
+    from .ddp import TrainState
+
+    ps = ep_param_specs(cfg)
+    return TrainState(
+        params=ps, opt=AdadeltaState(square_avg=ps, acc_delta=ps), step=P()
+    )
+
+
+def shard_ep_state(state, mesh: Mesh, cfg: ViTConfig):
+    """Place a host TrainState (MoE-ViT params + Adadelta accumulators)
+    onto the mesh with expert shardings (same placement recipe as
+    parallel/tp.py:shard_state)."""
+    import numpy as np
+
+    specs = ep_state_specs(cfg)
+    if all(d.process_index == jax.process_index() for d in mesh.devices.flat):
+        return jax.tree.map(
+            lambda v, spec: jax.device_put(v, NamedSharding(mesh, spec)),
+            state,
+            specs,
+        )
+
+    def place(v, spec):
+        host = np.asarray(v)
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx, host=host: host[idx]
+        )
+
+    return jax.tree.map(place, state, specs)
+
+
+def make_ep_train_step(
+    mesh: Mesh,
+    cfg: ViTConfig,
+    rho: float = 0.9,
+    eps: float = 1e-6,
+    aux_weight: float = AUX_LOSS_WEIGHT,
+):
+    """Build the jitted expert-parallel MoE-ViT train step.
+
+    ``step_fn(state, x, y, w, lr) -> (state, losses)``: ``state`` sharded
+    per ``shard_ep_state``, ``x/y/w`` over ``data``; the objective is
+    ``nll + aux_weight * balance_loss``, ``losses`` reports the nll part
+    (one local loss per data shard, the reference's logging semantic).
+    """
+    from ..ops.adadelta import adadelta_update
+    from ..ops.loss import nll_loss
+    from .ddp import TrainState
+
+    _check_expert_divisibility(cfg, mesh)
+    num_data = mesh.shape[DATA_AXIS]
+    state_specs = ep_state_specs(cfg)
+
+    def local_step(state: TrainState, x, y, w, lr):
+        def loss_fn(params):
+            logp, aux = vit_moe_forward(
+                params, x, cfg,
+                moe_fn=lambda mp, h: moe_mlp_ep(mp, h, cfg),
+            )
+            nll = nll_loss(logp, y, w, reduction="mean")
+            return nll + aux_weight * aux, nll
+
+        (_, nll), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        grads = jax.tree.map(lambda g: g / num_data, grads)
+        params, opt = adadelta_update(
+            state.params, grads, state.opt, lr, rho, eps
+        )
+        return TrainState(params, opt, state.step + 1), nll[None]
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_specs, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(state_specs, P(DATA_AXIS)),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_ep_eval_step(mesh: Mesh, cfg: ViTConfig):
+    """Jitted EP eval step: expert-parallel forward + the psum'd
+    (loss_sum, correct) totals every eval path in the framework shares."""
+    from ..ops.loss import nll_loss
+
+    _check_expert_divisibility(cfg, mesh)
+
+    def local_eval(params, x, y, w):
+        logp, _ = vit_moe_forward(
+            params, x, cfg, moe_fn=lambda mp, h: moe_mlp_ep(mp, h, cfg)
+        )
+        loss_sum = nll_loss(logp, y, w, reduction="sum")
+        correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
+        return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
+
+    sharded = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(ep_param_specs(cfg), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    )
+    return jax.jit(sharded)

@@ -28,32 +28,42 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def make_2d_mesh(
+    num_data: int | None,
+    num_minor: int,
+    minor_axis: str,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Shared builder for every ``(data, <minor>)`` mesh in the framework
+    (model/tp, seq/sp).  ``num_data=None`` uses every remaining device on
+    the data axis.  The data axis is outermost so neighboring devices
+    (fastest ICI links) form the minor-axis groups — model shards and seq
+    rings ride the adjacent hops, gradient allreduce the longer rings."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_data is None:
+        if len(devices) % num_minor:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by "
+                f"{minor_axis}={num_minor}"
+            )
+        num_data = len(devices) // num_minor
+    need = num_data * num_minor
+    if need > len(devices):
+        raise ValueError(
+            f"requested {num_data}x{num_minor} mesh but only "
+            f"{len(devices)} devices are available"
+        )
+    grid = np.asarray(devices[:need]).reshape(num_data, num_minor)
+    return Mesh(grid, (DATA_AXIS, minor_axis))
+
+
 def make_mesh(
     num_data: int | None = None,
     num_model: int = 1,
     devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """Build a ``(data, model)`` mesh over the given (default: all) devices.
-
-    ``num_data=None`` uses every remaining device on the data axis.  The
-    data axis is outermost so neighboring devices (fastest ICI links) form
-    the model groups and gradient allreduce rides the longer rings.
-    """
-    devices = list(devices if devices is not None else jax.devices())
-    if num_data is None:
-        if len(devices) % num_model:
-            raise ValueError(
-                f"{len(devices)} devices not divisible by model={num_model}"
-            )
-        num_data = len(devices) // num_model
-    need = num_data * num_model
-    if need > len(devices):
-        raise ValueError(
-            f"requested {num_data}x{num_model} mesh but only "
-            f"{len(devices)} devices are available"
-        )
-    grid = np.asarray(devices[:need]).reshape(num_data, num_model)
-    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+    """Build the standard ``(data, model)`` mesh (see ``make_2d_mesh``)."""
+    return make_2d_mesh(num_data, num_model, MODEL_AXIS, devices)
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

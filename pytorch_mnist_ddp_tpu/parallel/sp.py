@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh
 
 from ..ops.attention import (
@@ -27,7 +26,7 @@ from ..ops.attention import (
     finalize_block_acc,
     init_block_acc,
 )
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, make_2d_mesh
 
 SEQ_AXIS = "seq"
 
@@ -37,24 +36,9 @@ def make_sp_mesh(
     num_seq: int = 1,
     devices=None,
 ) -> Mesh:
-    """Build a ``(data, seq)`` mesh.  Data outermost (same rationale as
-    parallel/mesh.py): the seq ring's every-step ppermute hops ride the
-    adjacent, fastest ICI links."""
-    devices = list(devices if devices is not None else jax.devices())
-    if num_data is None:
-        if len(devices) % num_seq:
-            raise ValueError(
-                f"{len(devices)} devices not divisible by seq={num_seq}"
-            )
-        num_data = len(devices) // num_seq
-    need = num_data * num_seq
-    if need > len(devices):
-        raise ValueError(
-            f"requested {num_data}x{num_seq} mesh but only "
-            f"{len(devices)} devices are available"
-        )
-    grid = np.asarray(devices[:need]).reshape(num_data, num_seq)
-    return Mesh(grid, (DATA_AXIS, SEQ_AXIS))
+    """Build a ``(data, seq)`` mesh: the seq ring's every-hop ppermutes
+    ride the adjacent, fastest ICI links (see mesh.make_2d_mesh)."""
+    return make_2d_mesh(num_data, num_seq, SEQ_AXIS, devices)
 
 
 def ring_attention(
