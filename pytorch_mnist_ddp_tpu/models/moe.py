@@ -16,9 +16,14 @@ Routing (Switch Transformer recipe):
   gate prob), the standard differentiable pressure toward uniform load —
   without it top-1 routing collapses onto one expert.
 
-The dense path here is the numerics oracle: ``parallel/ep.py`` runs the
-same dispatch/combine einsums with the expert dim sharded and two
-``all_to_all`` hops, and is pinned against this in tests/test_moe.py.
+Routing runs in SCATTER form (``route`` -> three O(G) vectors +
+``scatter_to_slots``/``gather_from_slots``): the classic one-hot
+``[G, E, C]`` dispatch tensor is quadratic in the token-group size and
+blows up at eval-sized groups.  ``parallel/ep.py`` shares these exact
+functions with the expert dim sharded and two ``all_to_all`` hops.  The
+einsum formulation survives as ``moe_mlp_dense_einsum`` — the
+INDEPENDENT numerics oracle both production paths are pinned against in
+tests/test_moe.py.
 """
 
 from __future__ import annotations
@@ -107,6 +112,63 @@ def gate_and_dispatch(
     return dispatch, combine, aux
 
 
+def route(gate_params: dict, x: jax.Array, cfg: ViTConfig, capacity: int):
+    """Top-1 routing in scatter form — the production path.
+
+    The one-hot ``[G, E, C]`` dispatch tensor of ``gate_and_dispatch`` is
+    O(G^2 * capacity_factor) memory (at a 16k-token eval group it is
+    gigabytes); this form carries the same routing as three O(G) vectors:
+
+    Returns ``(slot, kept, gate_prob, aux)``:
+      slot      ``[G]`` int32 — flat destination ``e*C + pos`` for kept
+                tokens, the one-past-the-end dummy slot ``E*C`` for dropped;
+      kept      ``[G]`` bool;
+      gate_prob ``[G]`` — the selected expert's probability;
+      aux       scalar load-balance loss.
+    """
+    logits = x @ gate_params["kernel"] + gate_params["bias"]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [G, E]
+    expert_idx = jnp.argmax(probs, axis=-1)                      # [G]
+    onehot = jax.nn.one_hot(expert_idx, cfg.num_experts, dtype=probs.dtype)
+    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot
+    sel_pos = pos.sum(axis=-1).astype(jnp.int32)
+    kept = sel_pos < capacity
+    slot = jnp.where(
+        kept,
+        expert_idx.astype(jnp.int32) * capacity + sel_pos,
+        cfg.num_experts * capacity,
+    )
+    f = onehot.mean(axis=0)
+    p = probs.mean(axis=0)
+    aux = cfg.num_experts * jnp.sum(f * p)
+    return slot, kept, probs.max(axis=-1), aux
+
+
+def scatter_to_slots(
+    flat: jax.Array, slot: jax.Array, kept: jax.Array, cfg: ViTConfig,
+    capacity: int,
+) -> jax.Array:
+    """Pack tokens into their expert slots: ``[G, d] -> [E, C, d]``.
+    Dropped tokens land in the dummy slot row, which is cut off."""
+    d = flat.shape[-1]
+    buf = jnp.zeros((cfg.num_experts * capacity + 1, d), flat.dtype)
+    buf = buf.at[slot].add(flat * kept[:, None].astype(flat.dtype))
+    return buf[:-1].reshape(cfg.num_experts, capacity, d)
+
+
+def gather_from_slots(
+    out: jax.Array, slot: jax.Array, kept: jax.Array, gate_prob: jax.Array
+) -> jax.Array:
+    """Unpack expert outputs back to token order, weighted by the gate:
+    ``[E, C, d] -> [G, d]`` (dropped tokens read the appended zero row)."""
+    e, c, d = out.shape
+    flat_out = jnp.concatenate(
+        [out.reshape(e * c, d), jnp.zeros((1, d), out.dtype)]
+    )
+    weight = (gate_prob * kept).astype(out.dtype)
+    return flat_out[slot] * weight[:, None]
+
+
 def expert_ffn(mp: dict, xin: jax.Array) -> jax.Array:
     """Batched expert MLP: ``xin [E, C, dim] -> [E, C, dim]`` through each
     expert's own weights — one einsum pair, E matmuls on the MXU."""
@@ -116,7 +178,21 @@ def expert_ffn(mp: dict, xin: jax.Array) -> jax.Array:
 
 
 def moe_mlp_dense(mp: dict, x: jax.Array, cfg: ViTConfig) -> MoeOut:
-    """Single-device MoE MLP over ``x: [b, t, dim]`` — the oracle path."""
+    """Single-device MoE MLP over ``x: [b, t, dim]`` (scatter routing)."""
+    b, t, d = x.shape
+    flat = x.reshape(b * t, d)
+    cap = capacity_for(b * t, cfg)
+    slot, kept, gate_prob, aux = route(mp["gate"], flat, cfg, cap)
+    xin = scatter_to_slots(flat, slot, kept, cfg, cap)
+    out = expert_ffn(mp, xin)
+    y = gather_from_slots(out, slot, kept, gate_prob)
+    return MoeOut(y.reshape(b, t, d).astype(x.dtype), aux)
+
+
+def moe_mlp_dense_einsum(mp: dict, x: jax.Array, cfg: ViTConfig) -> MoeOut:
+    """The one-hot einsum formulation — kept as the independent numerics
+    oracle for the scatter path (tests only: its ``[G, E, C]`` dispatch
+    tensor is quadratic in the token-group size)."""
     b, t, d = x.shape
     flat = x.reshape(b * t, d)
     cap = capacity_for(b * t, cfg)

@@ -8,13 +8,14 @@ Layout: the expert dim of every stacked expert weight (models/moe.py,
 standard "EP rides the DP axis" deployment, no third axis needed.  Each
 device routes its LOCAL tokens (switch top-1, per-shard capacity), then:
 
-  1. ``all_to_all`` #1: dispatch einsum packs ``[E, C, d]`` expert inputs,
-     device-major over E, and the exchange delivers ``[E/S, S*C, d]`` —
-     every device now holds every token routed to ITS experts;
+  1. ``all_to_all`` #1: the scatter-form dispatch packs ``[E, C, d]``
+     expert inputs, device-major over E, and the exchange delivers
+     ``[E/S, S*C, d]`` — every device now holds every token routed to
+     ITS experts;
   2. the batched expert FFN runs on local expert weights (E/S matmul
      pairs on the MXU);
-  3. ``all_to_all`` #2 returns outputs to the token owners, and the
-     combine einsum scatters them back (weighted by gate prob).
+  3. ``all_to_all`` #2 returns outputs to the token owners, and the slot
+     gather scatters them back (weighted by gate prob).
 
 Capacity is per routing group (the per-device token shard), so the drop
 pattern matches what a real multi-chip MoE sees; with enough capacity no
@@ -34,7 +35,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.moe import MoeOut, capacity_for, expert_ffn, gate_and_dispatch
+from ..models.moe import (
+    MoeOut,
+    capacity_for,
+    expert_ffn,
+    gather_from_slots,
+    route,
+    scatter_to_slots,
+)
 from ..models.vit import ViTConfig, vit_moe_forward
 from .mesh import DATA_AXIS
 
@@ -60,18 +68,18 @@ def moe_mlp_ep(
     ``x`` is the local token shard ``[b_local, t, d]``; ``mp`` holds the
     FULL gate (replicated) but only the LOCAL slice of each expert stack
     (``[E/S, ...]``, sharded by ep_param_specs).  Routing math is
-    models/moe.py's (same gate_and_dispatch / expert_ffn); only the two
-    all_to_all hops are new.
+    models/moe.py's scatter form (same route / scatter_to_slots /
+    gather_from_slots / expert_ffn); only the two all_to_all hops are new.
     """
-    size = jax.lax.axis_size(axis_name)
     b, t, d = x.shape
     flat = x.reshape(b * t, d)
     cap = capacity_for(b * t, cfg)
-    dispatch, combine, aux = gate_and_dispatch(mp["gate"], flat, cfg, cap)
+    slot, kept, gate_prob, aux = route(mp["gate"], flat, cfg, cap)
 
-    # Pack per-expert inputs, device-major over the E dim (the global
-    # expert order IS device-major because the stacks are sharded on dim 0).
-    xin = jnp.einsum("gec,gd->ecd", dispatch, flat)        # [E, C, d]
+    # Pack per-expert inputs (scatter form — no [G, E, C] tensor), device-
+    # major over the E dim (the global expert order IS device-major
+    # because the stacks are sharded on dim 0).
+    xin = scatter_to_slots(flat, slot, kept, cfg, cap)     # [E, C, d]
     # Exchange #1: chunk e-block j -> device j; receive source-major.
     xin = jax.lax.all_to_all(
         xin, axis_name, split_axis=0, concat_axis=1, tiled=True
@@ -81,7 +89,7 @@ def moe_mlp_ep(
     out = jax.lax.all_to_all(
         out, axis_name, split_axis=1, concat_axis=0, tiled=True
     )                                                      # [E, C, d]
-    y = jnp.einsum("gec,ecd->gd", combine, out)
+    y = gather_from_slots(out, slot, kept, gate_prob)
     # The local aux is this shard's load-balance term; psum-mean it so
     # every device carries the same scalar (and the grad contribution is
     # the global mean's, matching the dense oracle's single-group form).

@@ -110,6 +110,22 @@ def test_moe_ep_matches_dense(devices, num_devices):
     np.testing.assert_allclose(got[1], expect_aux, rtol=2e-5)
 
 
+def test_moe_scatter_matches_einsum_oracle():
+    """The production scatter/gather routing equals the one-hot einsum
+    formulation — including under capacity drops (the scatter dummy slot
+    and the einsum's zeroed dispatch rows must agree)."""
+    from pytorch_mnist_ddp_tpu.models.moe import moe_mlp_dense_einsum
+
+    for cf in (4.0, 0.25):  # no-drop and heavy-drop regimes
+        cfg = ViTConfig(num_experts=4, capacity_factor=cf)
+        mp = init_moe_params(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, cfg.dim))
+        got = moe_mlp_dense(mp, x, cfg)
+        expect = moe_mlp_dense_einsum(mp, x, cfg)
+        np.testing.assert_allclose(got.y, expect.y, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(got.aux_loss, expect.aux_loss, rtol=1e-6)
+
+
 def test_vit_moe_forward_shapes():
     params = init_vit_params(jax.random.PRNGKey(0), CFG)
     assert "moe" in params["blocks"]["0"]

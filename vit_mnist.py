@@ -1,0 +1,188 @@
+"""ViT-family MNIST training CLI — the attention model family's entrypoint.
+
+Beyond-parity surface (the reference has exactly one model, its CNN —
+reference mnist.py:11-34); this CLI drives models/vit.py on the same data
+pipeline, printed formats, StepLR schedule, and Adadelta optimizer as the
+parity CLIs, and exposes the long-context/distributed modes:
+
+  python vit_mnist.py --epochs 5                 # single device
+  python vit_mnist.py --sp 4                     # ring-attention sequence
+                                                 # parallel over (data, seq)
+  python vit_mnist.py --experts 8                # switch-MoE with expert
+                                                 # parallelism (all_to_all)
+
+``--sp`` and ``--experts`` are library parallel modes (parallel/sp.py,
+parallel/ep.py) — both shard over every visible device; ``--sp N`` uses an
+``(ndev/N) x N`` (data, seq) mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="TPU-native ViT MNIST example")
+    p.add_argument("--batch-size", type=int, default=64, metavar="N")
+    p.add_argument("--test-batch-size", type=int, default=1000, metavar="N")
+    p.add_argument("--epochs", type=int, default=14, metavar="N")
+    p.add_argument("--lr", type=float, default=1.0, metavar="LR")
+    p.add_argument("--gamma", type=float, default=0.7, metavar="M")
+    p.add_argument("--seed", type=int, default=1, metavar="S")
+    p.add_argument("--log-interval", type=int, default=10, metavar="N")
+    p.add_argument("--no-cuda", "--no-accel", dest="no_accel",
+                   action="store_true", default=False)
+    p.add_argument("--dry-run", action="store_true", default=False,
+                   help="run a single batch per epoch")
+    p.add_argument("--data-root", type=str, default="./data")
+    p.add_argument("--sp", type=int, default=1, metavar="S",
+                   help="sequence-parallel degree: ring attention over an "
+                        "S-way seq axis (parallel/sp.py)")
+    p.add_argument("--experts", type=int, default=0, metavar="E",
+                   help="switch-MoE with E experts, expert-parallel over "
+                        "the data axis (models/moe.py + parallel/ep.py); "
+                        "mutually exclusive with --sp")
+    p.add_argument("--depth", type=int, default=2, metavar="N",
+                   help="transformer blocks (default: 2)")
+    p.add_argument("--dim", type=int, default=64, metavar="D",
+                   help="token embedding width (default: 64)")
+    return p
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    if args.sp > 1 and args.experts > 0:
+        raise SystemExit("--sp and --experts are mutually exclusive")
+
+    import jax
+
+    if args.no_accel:
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_mnist_ddp_tpu.data.loader import DataLoader
+    from pytorch_mnist_ddp_tpu.data.mnist import load_mnist_arrays
+    from pytorch_mnist_ddp_tpu.models.vit import (
+        ViTConfig,
+        init_vit_params,
+        vit_forward,
+    )
+    from pytorch_mnist_ddp_tpu.ops.adadelta import adadelta_update
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+    from pytorch_mnist_ddp_tpu.ops.schedule import step_lr
+    from pytorch_mnist_ddp_tpu.parallel.ddp import (
+        make_train_state,
+        replicate_params,
+    )
+    from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+    from pytorch_mnist_ddp_tpu.utils.compile_cache import enable_persistent_cache
+    from pytorch_mnist_ddp_tpu.utils.logging import (
+        test_summary_lines,
+        total_time_line,
+        train_log_line,
+    )
+
+    enable_persistent_cache()
+    start = time.time()
+
+    cfg = ViTConfig(depth=args.depth, dim=args.dim,
+                    num_experts=args.experts)
+    params = init_vit_params(jax.random.PRNGKey(args.seed), cfg)
+
+    if args.sp > 1:
+        from pytorch_mnist_ddp_tpu.parallel.sp import (
+            make_sp_eval_step,
+            make_sp_mesh,
+            make_sp_train_step,
+        )
+
+        mesh = make_sp_mesh(num_data=None, num_seq=args.sp)
+        state = replicate_params(make_train_state(params), mesh)
+        train_step = make_sp_train_step(mesh, cfg)
+        eval_step = make_sp_eval_step(mesh, cfg)
+        eval_params = lambda s: s.params  # noqa: E731
+    elif args.experts > 0:
+        from pytorch_mnist_ddp_tpu.parallel.ep import (
+            make_ep_eval_step,
+            make_ep_train_step,
+            shard_ep_state,
+        )
+
+        mesh = make_mesh(num_model=1)
+        state = shard_ep_state(make_train_state(params), mesh, cfg)
+        train_step = make_ep_train_step(mesh, cfg)
+        eval_step = make_ep_eval_step(mesh, cfg)
+        eval_params = lambda s: s.params  # noqa: E731
+    else:
+        mesh = make_mesh(num_data=1, devices=jax.devices()[:1])
+        state = replicate_params(make_train_state(params), mesh)
+
+        @jax.jit
+        def train_step(state, x, y, w, lr):
+            def loss_fn(p):
+                logp = vit_forward(p, x, cfg)
+                return nll_loss(logp, y, w, reduction="mean")
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            p2, opt = adadelta_update(
+                state.params, grads, state.opt, lr, 0.9, 1e-6
+            )
+            return state._replace(
+                params=p2, opt=opt, step=state.step + 1
+            ), loss[None]
+
+        @jax.jit
+        def eval_step(params, x, y, w):
+            logp = vit_forward(params, x, cfg)
+            loss_sum = nll_loss(logp, y, w, reduction="sum")
+            correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
+            return jnp.stack([loss_sum, correct])
+
+        eval_params = lambda s: s.params  # noqa: E731
+
+    tr_x, tr_y = load_mnist_arrays(args.data_root, "train")
+    te_x, te_y = load_mnist_arrays(args.data_root, "test", download=False)
+
+    n_shards = mesh.shape["data"]
+    global_batch = args.batch_size * n_shards
+    train_loader = DataLoader(
+        tr_x, tr_y, global_batch, mesh=mesh, shuffle=True, seed=args.seed
+    )
+    test_loader = DataLoader(
+        te_x, te_y, args.test_batch_size * n_shards, mesh=mesh,
+        shuffle=False, mask_padding=True,
+    )
+    lr_for_epoch = step_lr(args.lr, args.gamma)
+
+    for epoch in range(1, args.epochs + 1):
+        lr = jnp.float32(lr_for_epoch(epoch))
+        num_batches = len(train_loader)
+        for batch_idx, (x, y, w) in enumerate(train_loader.epoch(epoch)):
+            state, losses = train_step(state, x, y, w, lr)
+            if batch_idx % args.log_interval == 0:
+                local_loss = float(
+                    np.asarray(losses.addressable_shards[0].data)[0]
+                )
+                print(train_log_line(
+                    epoch, batch_idx * global_batch, len(tr_x),
+                    batch_idx, num_batches, local_loss,
+                ))
+            if args.dry_run:
+                break
+        totals = np.zeros(2)
+        for x, y, w in test_loader.epoch(0):
+            totals += np.asarray(eval_step(eval_params(state), x, y, w))
+            if args.dry_run:
+                break
+        print(test_summary_lines(
+            totals[0] / len(te_x), int(totals[1]), len(te_x)
+        ))
+
+    print(total_time_line(time.time() - start))
+
+
+if __name__ == "__main__":
+    main()
