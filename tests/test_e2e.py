@@ -204,6 +204,33 @@ def test_cli_dry_run_subprocess(tmp_path, script, extra):
         assert "Total cost time:" in proc.stdout
 
 
+@pytest.mark.parametrize("extra", [
+    [],                  # single device
+    ["--sp", "4"],       # ring-attention sequence parallel (2 data x 4 seq)
+    ["--experts", "8"],  # expert-parallel switch-MoE over 8 devices
+])
+def test_vit_cli_dry_run_subprocess(tmp_path, extra):
+    """The ViT family CLI end-to-end in each parallel mode: flags parse,
+    the mode's mesh builds on the 8-virtual-device world (inherited
+    XLA_FLAGS), and the shared print formats come out."""
+    import os
+    root = _write_idx(tmp_path)
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MNIST_DATA_DIR"] = root
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "vit_mnist.py"), "--dry-run",
+         "--epochs", "1", "--batch-size", "16", "--test-batch-size", "32",
+         *extra],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path), timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "Train Epoch: 1 [0/512 (0%)]" in proc.stdout
+    assert "Test set: Average loss:" in proc.stdout
+    assert "Total cost time:" in proc.stdout
+
+
 @pytest.mark.parametrize("extra,banner_world", [
     (["--tp", "2"], 8),
     (["--pp", "--pp-microbatches", "2"], 8),

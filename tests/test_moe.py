@@ -141,6 +141,7 @@ def test_vit_moe_forward_shapes():
     assert float(aux) > 0
 
 
+@pytest.mark.slow  # compile-heavy (sharded-state train step); full tier only
 def test_ep_train_step_runs_and_descends(devices):
     """Four EP train steps on a 4-way expert/data mesh: state shards per
     spec, the nll part descends on a fixed batch, and the expert stacks

@@ -158,6 +158,7 @@ def test_sp_forward_matches_single_device(devices):
     )
 
 
+@pytest.mark.slow  # compile-heavy (2-D mesh train step); full tier only
 def test_sp_train_step_matches_single_device(devices):
     """Five SP train steps on the (2 data x 4 seq) mesh track the plain
     single-device recurrence (same init, same batches, Adadelta) — the
@@ -229,6 +230,27 @@ def test_sp_eval_step_totals(devices):
     expect_correct = float(((jnp.argmax(logp, axis=1) == y) * w).sum())
     np.testing.assert_allclose(totals[0], expect_loss, rtol=2e-5)
     assert float(totals[1]) == expect_correct
+
+
+def test_ring_attention_long_sequence(devices):
+    """The long-context case the ring exists for: a 1024-token sequence
+    over 8 devices — each device holds a 128-token block (O(T/S) memory)
+    yet attends over the full kilotoken context, exactly matching dense
+    attention computed over the gathered sequence."""
+    mesh = make_sp_mesh(num_data=1, num_seq=8, devices=devices)
+    q, k, v = _qkv(jax.random.PRNGKey(4), b=1, t=1024, h=2, d=16)
+
+    ring = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS),
+            mesh=mesh,
+            in_specs=(P(None, SEQ_AXIS),) * 3,
+            out_specs=P(None, SEQ_AXIS),
+        )
+    )
+    np.testing.assert_allclose(
+        ring(q, k, v), full_attention(q, k, v), rtol=3e-5, atol=3e-5
+    )
 
 
 def test_sp_rejects_non_divisible_token_count(devices):
