@@ -168,6 +168,24 @@ def _flatten_raw(tree: Mapping[str, Any], prefix: str = "") -> dict[str, np.ndar
     return out
 
 
+def save_params_tree(tree: Mapping[str, Any], path: str) -> None:
+    """Save an arbitrary nested param pytree as an npz archive with dotted
+    keys, no renaming — the generic checkpoint form for model families
+    without a torch counterpart (e.g. the ViT family, vit_mnist.py
+    ``--save-model``).  Exact inverse: :func:`load_params_tree`."""
+    _atomic_npz_write(_flatten_raw(tree), path)
+
+
+def load_params_tree(path: str) -> dict[str, Any]:
+    """Inverse of :func:`save_params_tree`."""
+    try:
+        with np.load(path) as archive:
+            flat = {k: archive[k] for k in archive.files}
+    except (OSError, ValueError) as e:
+        raise ValueError(f"{path!r} is not an npz params archive: {e}") from e
+    return _unflatten(flat, "")
+
+
 def _unflatten(flat: Mapping[str, np.ndarray], prefix: str) -> dict[str, Any]:
     out: dict[str, Any] = {}
     for key, value in flat.items():

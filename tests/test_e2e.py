@@ -231,6 +231,45 @@ def test_vit_cli_dry_run_subprocess(tmp_path, extra):
     assert "Total cost time:" in proc.stdout
 
 
+def test_vit_cli_save_and_resume(tmp_path):
+    """--save-model writes a load_params_tree archive and --resume
+    restores it (shape-checked); a wrong-architecture resume fails fast."""
+    import os
+    root = _write_idx(tmp_path)
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MNIST_DATA_DIR"] = root
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = [sys.executable, os.path.join(repo, "vit_mnist.py"), "--dry-run",
+            "--epochs", "1", "--batch-size", "16", "--test-batch-size", "32"]
+    proc = subprocess.run(
+        base + ["--save-model"], capture_output=True, text=True, env=env,
+        cwd=str(tmp_path), timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    ckpt = tmp_path / "vit_mnist.npz"
+    assert ckpt.exists()
+
+    from pytorch_mnist_ddp_tpu.models.vit import ViTConfig, init_vit_params
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import load_params_tree
+    loaded = load_params_tree(str(ckpt))
+    ref = init_vit_params(jax.random.PRNGKey(0), ViTConfig())
+    assert jax.tree.structure(loaded) == jax.tree.structure(ref)
+
+    proc = subprocess.run(
+        base + ["--resume", str(ckpt)], capture_output=True, text=True,
+        env=env, cwd=str(tmp_path), timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+    proc = subprocess.run(
+        base + ["--resume", str(ckpt), "--dim", "32"], capture_output=True,
+        text=True, env=env, cwd=str(tmp_path), timeout=300,
+    )
+    assert proc.returncode != 0
+    assert "does not match" in proc.stderr + proc.stdout
+
+
 @pytest.mark.parametrize("extra,banner_world", [
     (["--tp", "2"], 8),
     (["--pp", "--pp-microbatches", "2"], 8),

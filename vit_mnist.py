@@ -47,6 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="transformer blocks (default: 2)")
     p.add_argument("--dim", type=int, default=64, metavar="D",
                    help="token embedding width (default: 64)")
+    p.add_argument("--save-model", action="store_true", default=False,
+                   help="save the final params to vit_mnist.npz "
+                        "(utils.checkpoint.save_params_tree)")
+    p.add_argument("--resume", type=str, default=None, metavar="PATH",
+                   help="initialize params from a vit_mnist.npz archive "
+                        "instead of random init (optimizer starts fresh)")
     return p
 
 
@@ -91,6 +97,23 @@ def main() -> None:
     cfg = ViTConfig(depth=args.depth, dim=args.dim,
                     num_experts=args.experts)
     params = init_vit_params(jax.random.PRNGKey(args.seed), cfg)
+    if args.resume:
+        from pytorch_mnist_ddp_tpu.utils.checkpoint import load_params_tree
+
+        loaded = load_params_tree(args.resume)
+
+        # Fail fast on architecture mismatch: tree.map raises on structure
+        # drift; leaf shapes are checked explicitly.
+        def _check(init, got):
+            got = np.asarray(got)
+            if got.shape != init.shape:
+                raise SystemExit(
+                    f"--resume checkpoint shape {got.shape} does not match "
+                    f"this config's {init.shape}"
+                )
+            return got.astype(init.dtype)
+
+        params = jax.tree.map(_check, params, loaded)
 
     if args.sp > 1:
         from pytorch_mnist_ddp_tpu.parallel.sp import (
@@ -180,6 +203,17 @@ def main() -> None:
         print(test_summary_lines(
             totals[0] / len(te_x), int(totals[1]), len(te_x)
         ))
+
+    if args.save_model:
+        from pytorch_mnist_ddp_tpu.parallel.tp import gather_replicated
+        from pytorch_mnist_ddp_tpu.utils.checkpoint import save_params_tree
+
+        # gather_replicated is a no-op reshard for replicated trees and the
+        # expert all-gather for EP-sharded stacks.
+        host_params = jax.device_get(
+            gather_replicated(eval_params(state), mesh)
+        )
+        save_params_tree(host_params, "vit_mnist.npz")
 
     print(total_time_line(time.time() - start))
 
