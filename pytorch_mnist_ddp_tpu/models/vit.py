@@ -46,6 +46,10 @@ class ViTConfig(NamedTuple):
     # MoE variant (models/moe.py): 0 experts = the dense MLP above.
     num_experts: int = 0
     capacity_factor: float = 2.0
+    # bfloat16 activations/matmuls (MXU-native width); params, routing
+    # softmax, attention accumulation, and the log_softmax tail stay fp32 —
+    # the same plumbing contract as the CNN family's --bf16.
+    bf16: bool = False
 
     @property
     def grid(self) -> int:
@@ -126,13 +130,20 @@ def patchify(x: jax.Array, cfg: ViTConfig) -> jax.Array:
 
 
 def layer_norm(x: jax.Array, p: dict, eps: float = 1e-6) -> jax.Array:
-    mu = x.mean(axis=-1, keepdims=True)
-    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    """Statistics in fp32 (bf16 mean/var loses too much), output in the
+    activation dtype."""
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    return out.astype(x.dtype)
 
 
 def dense(x: jax.Array, p: dict) -> jax.Array:
-    return x @ p["kernel"] + p["bias"]
+    """Matmul in the activation dtype: params are stored fp32 and cast at
+    use, so a bf16 activation stream feeds the MXU at native width while
+    the optimizer state stays exact."""
+    return x @ p["kernel"].astype(x.dtype) + p["bias"].astype(x.dtype)
 
 
 AttentionFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
@@ -180,13 +191,18 @@ def _vit_trunk(
     """Embed -> blocks -> final LN -> mean-pool -> log-probs, with
     ``block_fn(bp, tokens) -> (tokens, aux)`` — THE shared skeleton for
     the dense and MoE forwards (aux is 0 for dense blocks)."""
-    tokens = dense(patchify(x, cfg), params["embed"]) + params["pos_embed"]
+    dt = jnp.bfloat16 if cfg.bf16 else x.dtype
+    patches = patchify(x, cfg).astype(dt)
+    tokens = dense(patches, params["embed"]) + params["pos_embed"].astype(dt)
     aux_total = jnp.float32(0.0)
     for i in range(cfg.depth):
         tokens, aux = block_fn(params["blocks"][str(i)], tokens)
         aux_total = aux_total + aux
     tokens = layer_norm(tokens, params["ln_f"])
-    return tokens_to_logp(params, tokens.mean(axis=1)), aux_total
+    # Pool in fp32: 16 tokens is a short sum, but the head/log_softmax
+    # tail is the numerics-sensitive part of the contract.
+    pooled = tokens.astype(jnp.float32).mean(axis=1)
+    return tokens_to_logp(params, pooled), aux_total
 
 
 def vit_forward(

@@ -162,12 +162,13 @@ def _sp_vit_forward(params: dict, x: jax.Array, cfg) -> jax.Array:
     t_local = cfg.num_tokens // num_seq
     start = jax.lax.axis_index(SEQ_AXIS) * t_local
 
+    dt = jnp.bfloat16 if cfg.bf16 else x.dtype
     patches = jax.lax.dynamic_slice_in_dim(
         patchify(x, cfg), start, t_local, axis=1
-    )
+    ).astype(dt)
     pos = jax.lax.dynamic_slice_in_dim(
         params["pos_embed"], start, t_local, axis=0
-    )
+    ).astype(dt)
     tokens = dense(patches, params["embed"]) + pos
     for i in range(cfg.depth):
         tokens = apply_block(
@@ -175,7 +176,12 @@ def _sp_vit_forward(params: dict, x: jax.Array, cfg) -> jax.Array:
             lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS),
         )
     tokens = layer_norm(tokens, params["ln_f"])
-    pooled = jax.lax.psum(tokens.sum(axis=1), SEQ_AXIS) / cfg.num_tokens
+    # fp32 pool (the same head/log_softmax numerics contract as the
+    # single-device trunk).
+    pooled = (
+        jax.lax.psum(tokens.astype(jnp.float32).sum(axis=1), SEQ_AXIS)
+        / cfg.num_tokens
+    )
     return tokens_to_logp(params, pooled)
 
 

@@ -263,6 +263,41 @@ def test_sp_rejects_non_divisible_token_count(devices):
         make_sp_eval_step(mesh, CFG)
 
 
+def test_vit_bf16_forward_close_to_fp32():
+    """cfg.bf16: log-probs stay fp32 (the tail contract) and track the
+    fp32 forward — and the SP path honors the same dtype plumbing."""
+    cfg16 = ViTConfig(bf16=True)
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 28, 28, 1))
+    lp32 = vit_forward(params, x, CFG)
+    lp16 = vit_forward(params, x, cfg16)
+    assert lp16.dtype == jnp.float32
+    np.testing.assert_allclose(lp16, lp32, atol=0.15)
+    # probabilities still normalized after the fp32 tail
+    np.testing.assert_allclose(jnp.exp(lp16).sum(axis=1), np.ones(4), rtol=1e-5)
+
+
+def test_sp_bf16_forward_matches_single_device(devices):
+    from pytorch_mnist_ddp_tpu.parallel.sp import _sp_vit_forward
+
+    cfg16 = ViTConfig(bf16=True)
+    mesh = make_sp_mesh(num_data=2, num_seq=4, devices=devices)
+    params = init_vit_params(jax.random.PRNGKey(0), cfg16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28, 1))
+    sp_fwd = jax.jit(
+        jax.shard_map(
+            lambda p, x: _sp_vit_forward(p, x, cfg16),
+            mesh=mesh,
+            in_specs=(P(), P("data")),
+            out_specs=P("data"),
+        )
+    )
+    # bf16 compute reorders roundings between the paths; modest tolerance.
+    np.testing.assert_allclose(
+        sp_fwd(params, x), vit_forward(params, x, cfg16), atol=0.08
+    )
+
+
 def test_vit_trains_on_toy_task():
     """A few single-device Adadelta steps on a fixed toy batch must cut
     the loss substantially — the family is trainable, not just well-shaped."""

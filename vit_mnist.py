@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="transformer blocks (default: 2)")
     p.add_argument("--dim", type=int, default=64, metavar="D",
                    help="token embedding width (default: 64)")
+    p.add_argument("--bf16", action="store_true", default=False,
+                   help="bfloat16 activations/matmuls (params, routing, "
+                        "attention accumulation, and log_softmax stay fp32)")
     p.add_argument("--save-model", action="store_true", default=False,
                    help="save the final params to vit_mnist.npz "
                         "(utils.checkpoint.save_params_tree)")
@@ -95,7 +98,7 @@ def main() -> None:
     start = time.time()
 
     cfg = ViTConfig(depth=args.depth, dim=args.dim,
-                    num_experts=args.experts)
+                    num_experts=args.experts, bf16=args.bf16)
     params = init_vit_params(jax.random.PRNGKey(args.seed), cfg)
     if args.resume:
         from pytorch_mnist_ddp_tpu.utils.checkpoint import load_params_tree
