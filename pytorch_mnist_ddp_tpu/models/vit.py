@@ -157,8 +157,13 @@ def _attn_sublayer(
     the attention path can never fork between them."""
     b, t, _ = x.shape
     h = layer_norm(x, bp["ln1"])
-    qkv = dense(h, bp["qkv"]).reshape(b, t, 3, cfg.heads, cfg.head_dim)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    # Head-major qkv layout [t, heads, 3, head_dim]: a contiguous split of
+    # the projection's output features over M | heads gives each shard
+    # whole heads with their own q/k/v — what makes the qkv kernel
+    # column-parallel over the model axis (parallel/tp_vit.py) without any
+    # re-layout at shard time.
+    qkv = dense(h, bp["qkv"]).reshape(b, t, cfg.heads, 3, cfg.head_dim)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
     attn = attention_fn(q, k, v).reshape(b, t, cfg.dim)
     return x + dense(attn, bp["proj"])
 

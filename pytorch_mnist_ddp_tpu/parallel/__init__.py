@@ -13,6 +13,17 @@ from .ep import (
     moe_mlp_ep,
     shard_ep_state,
 )
+from .tp_vit import (
+    make_vit_tp_eval_step,
+    make_vit_tp_train_step,
+    shard_vit_tp_state,
+)
+from .sp3 import (
+    make_3d_mesh,
+    make_sp3_eval_step,
+    make_sp3_train_step,
+    shard_sp3_state,
+)
 from .distributed import init_distributed_mode, DistState
 from .ddp import (
     TrainState,

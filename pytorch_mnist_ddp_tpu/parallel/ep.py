@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.moe import (
     MoeOut,
@@ -44,7 +44,7 @@ from ..models.moe import (
     scatter_to_slots,
 )
 from ..models.vit import ViTConfig, vit_moe_forward
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, place_tree
 
 AUX_LOSS_WEIGHT = 0.01  # standard Switch-style weighting of the balance loss
 
@@ -142,26 +142,8 @@ def ep_state_specs(cfg: ViTConfig):
 
 def shard_ep_state(state, mesh: Mesh, cfg: ViTConfig):
     """Place a host TrainState (MoE-ViT params + Adadelta accumulators)
-    onto the mesh with expert shardings (same placement recipe as
-    parallel/tp.py:shard_state)."""
-    import numpy as np
-
-    specs = ep_state_specs(cfg)
-    if all(d.process_index == jax.process_index() for d in mesh.devices.flat):
-        return jax.tree.map(
-            lambda v, spec: jax.device_put(v, NamedSharding(mesh, spec)),
-            state,
-            specs,
-        )
-
-    def place(v, spec):
-        host = np.asarray(v)
-        sharding = NamedSharding(mesh, spec)
-        return jax.make_array_from_callback(
-            host.shape, sharding, lambda idx, host=host: host[idx]
-        )
-
-    return jax.tree.map(place, state, specs)
+    onto the mesh with expert shardings (mesh.place_tree recipe)."""
+    return place_tree(state, ep_state_specs(cfg), mesh)
 
 
 def make_ep_train_step(

@@ -28,33 +28,50 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def make_nd_mesh(
+    num_data: int | None,
+    minors: Sequence[tuple[str, int]],
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Shared builder for every ``(data, *minors)`` mesh in the framework
+    (model/tp, seq/sp, and the 3-D seq x model composition).
+    ``num_data=None`` uses every remaining device on the data axis.  The
+    data axis is outermost and later minors are innermost, so neighboring
+    devices (fastest ICI links) form the innermost-axis groups — model
+    shards ride the adjacent hops, seq rings the next-nearest, gradient
+    allreduce the longest rings."""
+    devices = list(devices if devices is not None else jax.devices())
+    names = [name for name, _ in minors]
+    sizes = [size for _, size in minors]
+    minor = 1
+    for size in sizes:
+        minor *= size
+    if num_data is None:
+        if len(devices) % minor:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by "
+                + "*".join(f"{n}={s}" for n, s in minors)
+            )
+        num_data = len(devices) // minor
+    need = num_data * minor
+    if need > len(devices):
+        shape = "x".join(str(s) for s in (num_data, *sizes))
+        raise ValueError(
+            f"requested {shape} mesh but only "
+            f"{len(devices)} devices are available"
+        )
+    grid = np.asarray(devices[:need]).reshape(num_data, *sizes)
+    return Mesh(grid, (DATA_AXIS, *names))
+
+
 def make_2d_mesh(
     num_data: int | None,
     num_minor: int,
     minor_axis: str,
     devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """Shared builder for every ``(data, <minor>)`` mesh in the framework
-    (model/tp, seq/sp).  ``num_data=None`` uses every remaining device on
-    the data axis.  The data axis is outermost so neighboring devices
-    (fastest ICI links) form the minor-axis groups — model shards and seq
-    rings ride the adjacent hops, gradient allreduce the longer rings."""
-    devices = list(devices if devices is not None else jax.devices())
-    if num_data is None:
-        if len(devices) % num_minor:
-            raise ValueError(
-                f"{len(devices)} devices not divisible by "
-                f"{minor_axis}={num_minor}"
-            )
-        num_data = len(devices) // num_minor
-    need = num_data * num_minor
-    if need > len(devices):
-        raise ValueError(
-            f"requested {num_data}x{num_minor} mesh but only "
-            f"{len(devices)} devices are available"
-        )
-    grid = np.asarray(devices[:need]).reshape(num_data, num_minor)
-    return Mesh(grid, (DATA_AXIS, minor_axis))
+    """The ``(data, <minor>)`` special case of :func:`make_nd_mesh`."""
+    return make_nd_mesh(num_data, [(minor_axis, num_minor)], devices)
 
 
 def make_mesh(
@@ -64,6 +81,34 @@ def make_mesh(
 ) -> Mesh:
     """Build the standard ``(data, model)`` mesh (see ``make_2d_mesh``)."""
     return make_2d_mesh(num_data, num_model, MODEL_AXIS, devices)
+
+
+def place_tree(tree, specs, mesh: Mesh):
+    """Place a host-side pytree onto ``mesh`` with per-leaf PartitionSpecs.
+
+    Single-controller worlds ``device_put`` each leaf.  Multi-controller
+    worlds can't place onto non-addressable devices; there, every process
+    holds the full (identical, same-PRNG) value — the DP replication story
+    of ``ddp.replicate_params`` — and each contributes its addressable
+    shards via ``make_array_from_callback``, which slices the local piece
+    per shard index.  Shard-identical state by construction, no broadcast.
+    Shared by every sharded-state layout (parallel/tp.py, ep.py, tp_vit.py).
+    """
+    if all(d.process_index == jax.process_index() for d in mesh.devices.flat):
+        return jax.tree.map(
+            lambda v, spec: jax.device_put(v, NamedSharding(mesh, spec)),
+            tree,
+            specs,
+        )
+
+    def place(v, spec):
+        host = np.asarray(v)
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx, host=host: host[idx]
+        )
+
+    return jax.tree.map(place, tree, specs)
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,169 @@
+"""3-D ViT parallelism: data x sequence x tensor in ONE jitted step.
+
+parallel/sp.py shards tokens (ring attention), parallel/tp_vit.py shards
+heads and MLP features (Megatron blocks).  The two factorizations are
+orthogonal — SP splits attention's token axis, TP its head axis — so they
+compose into a ``(data, seq, model)`` mesh with no new collective kinds:
+
+- batch over ``data`` (grad psum, the DDP story),
+- tokens over ``seq``  (k/v ``ppermute`` ring per hop, pool psum),
+- heads + MLP features over ``model`` (two row-parallel psums per block).
+
+Each device holds ``T/S`` tokens of ``H/M`` heads and computes its
+``[b/D, T/S]`` query block against every k/v block of its own heads as the
+ring rotates.  This is the mesh shape real long-context transformer
+deployments run (DP for throughput, SP for sequence length, TP for model
+width); here it is exercised end-to-end on the 8-virtual-device CPU mesh
+and in the driver's multichip dryrun.
+
+Gradient semantics: unchanged — under VMA tracking every param cotangent
+arrives psum'd over the axes the param is invariant on, i.e. the SUM over
+``data`` of local-mean grads (seq/model reductions are part of the same
+transpose); divide by the data degree for DDP mean semantics.  Parity is
+pinned by tests/test_sp3.py against the single-device ViT recurrence.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.vit import ViTConfig, dense, layer_norm, patchify, tokens_to_logp
+from ..ops.adadelta import adadelta_update
+from ..ops.loss import nll_loss
+from .ddp import TrainState
+from .mesh import DATA_AXIS, MODEL_AXIS, make_nd_mesh
+from .sp import SEQ_AXIS, _check_token_divisibility, ring_attention
+from .tp_vit import (
+    _check_head_divisibility,
+    _tp_block,
+    shard_vit_tp_state,
+    vit_tp_param_specs,
+    vit_tp_state_specs,
+)
+
+__all__ = [
+    "make_3d_mesh",
+    "make_sp3_train_step",
+    "make_sp3_eval_step",
+    "shard_sp3_state",
+]
+
+
+def make_3d_mesh(
+    num_data: int | None = None,
+    num_seq: int = 1,
+    num_model: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build the ``(data, seq, model)`` mesh via :func:`mesh.make_nd_mesh`:
+    ``model`` innermost so the per-block row-parallel psums ride adjacent
+    ICI links, the seq ring's every-hop ppermutes the next-nearest, and
+    the once-per-step gradient allreduce the longest rings."""
+    return make_nd_mesh(
+        num_data, [(SEQ_AXIS, num_seq), (MODEL_AXIS, num_model)], devices
+    )
+
+
+def shard_sp3_state(state: TrainState, mesh: Mesh, cfg: ViTConfig):
+    """Place a host TrainState onto the 3-D mesh: the TP shardings apply
+    verbatim (tokens are an activation axis — params never shard over
+    ``seq``, so the specs are tp_vit's with ``seq`` unused)."""
+    return shard_vit_tp_state(state, mesh, cfg)
+
+
+def _sp3_vit_forward(params: dict, x: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """The ViT forward over a (token, head) shard, inside shard_map.
+
+    ``x`` is the local data-shard of images (replicated over seq/model);
+    this device embeds its ``T/S`` token slice (sp.py's slicing), projects
+    its ``H/M`` heads (tp_vit's column split), rides the seq ring for
+    attention, and completes proj/mlp_out with model-axis psums."""
+    num_seq = jax.lax.axis_size(SEQ_AXIS)
+    heads_local = cfg.heads // jax.lax.axis_size(MODEL_AXIS)
+    t_local = cfg.num_tokens // num_seq
+    start = jax.lax.axis_index(SEQ_AXIS) * t_local
+
+    dt = jnp.bfloat16 if cfg.bf16 else x.dtype
+    patches = jax.lax.dynamic_slice_in_dim(
+        patchify(x, cfg), start, t_local, axis=1
+    ).astype(dt)
+    pos = jax.lax.dynamic_slice_in_dim(
+        params["pos_embed"], start, t_local, axis=0
+    ).astype(dt)
+    tokens = dense(patches, params["embed"]) + pos
+    for i in range(cfg.depth):
+        tokens = _tp_block(
+            params["blocks"][str(i)], tokens, cfg, heads_local,
+            attention_fn=lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS),
+        )
+    tokens = layer_norm(tokens, params["ln_f"])
+    pooled = (
+        jax.lax.psum(tokens.astype(jnp.float32).sum(axis=1), SEQ_AXIS)
+        / cfg.num_tokens
+    )
+    return tokens_to_logp(params, pooled)
+
+
+def _check(cfg: ViTConfig, mesh: Mesh) -> None:
+    _check_token_divisibility(cfg, mesh)
+    _check_head_divisibility(cfg, mesh)
+
+
+def make_sp3_train_step(
+    mesh: Mesh, cfg: ViTConfig, rho: float = 0.9, eps: float = 1e-6
+):
+    """Build the jitted 3-D (data x seq x model) ViT train step.
+
+    ``step_fn(state, x, y, w, lr) -> (state, losses)`` with ``state``
+    sharded per tp_vit's specs, ``x/y/w`` sharded over ``data``, ``losses``
+    one local loss per data shard."""
+    _check(cfg, mesh)
+    num_data = mesh.shape[DATA_AXIS]
+    state_specs = vit_tp_state_specs(cfg)
+
+    def local_step(state: TrainState, x, y, w, lr):
+        def loss_fn(params):
+            logp = _sp3_vit_forward(params, x, cfg)
+            return nll_loss(logp, y, w, reduction="mean")
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        grads = jax.tree.map(lambda g: g / num_data, grads)
+        params, opt = adadelta_update(
+            state.params, grads, state.opt, lr, rho, eps
+        )
+        return TrainState(params, opt, state.step + 1), loss[None]
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_specs, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(state_specs, P(DATA_AXIS)),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_sp3_eval_step(mesh: Mesh, cfg: ViTConfig):
+    """Jitted 3-D eval step: the (token, head)-sharded forward + the
+    psum'd (loss_sum, correct) totals every eval path shares."""
+    _check(cfg, mesh)
+
+    def local_eval(params, x, y, w):
+        logp = _sp3_vit_forward(params, x, cfg)
+        loss_sum = nll_loss(logp, y, w, reduction="sum")
+        correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
+        return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
+
+    sharded = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(
+            vit_tp_param_specs(cfg),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+        ),
+        out_specs=P(),
+    )
+    return jax.jit(sharded)

@@ -39,7 +39,7 @@ from ..models.net import DROPOUT1_RATE, DROPOUT2_RATE, raw_conv_stack
 from ..ops.adadelta import AdadeltaState, adadelta_update
 from ..ops.loss import nll_loss
 from .ddp import TrainState
-from .mesh import DATA_AXIS, MODEL_AXIS
+from .mesh import DATA_AXIS, MODEL_AXIS, place_tree
 
 
 def param_specs() -> dict:
@@ -64,33 +64,9 @@ def state_specs() -> Any:
 
 def shard_state(state: TrainState, mesh: Mesh) -> TrainState:
     """Place a (host/replicated) TrainState onto the 2-D mesh with TP
-    shardings.
-
-    Single-controller worlds ``device_put`` each leaf.  Multi-controller
-    worlds can't place onto non-addressable devices; there, every process
-    holds the full (identical, same-PRNG) value — the DP replication story
-    of ``ddp.replicate_params`` — and each contributes its addressable
-    shards via ``make_array_from_callback``, which slices the local piece
-    per shard index.  Shard-identical state by construction, no broadcast.
-    """
-    import numpy as np
-
-    specs = state_specs()
-    if all(d.process_index == jax.process_index() for d in mesh.devices.flat):
-        return jax.tree.map(
-            lambda v, spec: jax.device_put(v, NamedSharding(mesh, spec)),
-            state,
-            specs,
-        )
-
-    def place(v, spec):
-        host = np.asarray(v)
-        sharding = NamedSharding(mesh, spec)
-        return jax.make_array_from_callback(
-            host.shape, sharding, lambda idx, host=host: host[idx]
-        )
-
-    return jax.tree.map(place, state, specs)
+    shardings (mesh.place_tree recipe: device_put single-controller,
+    per-shard make_array_from_callback multi-controller)."""
+    return place_tree(state, state_specs(), mesh)
 
 
 def _tp_forward(params: dict, x: jax.Array, train: bool, key: jax.Array) -> jax.Array:

@@ -1,0 +1,214 @@
+"""Tensor parallelism for the ViT family: Megatron-style sharded blocks.
+
+The reference is data-parallel only (SURVEY.md §2c); parallel/tp.py makes
+the ``model`` mesh axis real for the CNN's classifier MLP.  This module
+extends that axis to the attention family — the layout every transformer
+framework ships as "tensor parallelism":
+
+- **qkv column-parallel**: the projection kernel ``[dim, heads*3*head_dim]``
+  splits over ``model`` on its output features.  The head-major qkv layout
+  (models/vit.py:_attn_sublayer) makes a contiguous split land whole heads
+  — each shard computes attention for its ``heads/M`` heads with zero
+  communication (softmax is per-head).
+- **proj row-parallel**: kernel ``[dim, dim]`` splits on its input dim,
+  which is exactly the head-major flatten of the local attention output;
+  ONE ``psum`` over ``model`` completes the residual branch.
+- **MLP**: ``mlp_in`` column-parallel (gelu is feature-elementwise, no
+  comm), ``mlp_out`` row-parallel — the second and last ``psum``.
+- embed / pos_embed / LayerNorms / classifier head stay replicated (tiny,
+  and LN needs full-width statistics anyway).
+
+Two psums per block per direction — the canonical Megatron count.  The
+transpose rule turns each forward psum into identity on the partial-sum
+path and each replicated-param use into a model-axis grad psum, so
+gradient semantics arrive exactly as in parallel/tp.py: the data-axis SUM
+of local-mean grads, divided here by the data degree for DDP mean
+semantics.  The Adadelta update runs on local shards (elementwise, sharded
+state exact).
+
+Composes with the ``data`` axis as a 2-D ``(data, model)`` mesh, and with
+sequence parallelism as the 3-D ``(data, seq, model)`` step in
+parallel/sp3.py — forward math, init, loss, and update are the same
+functions the single-device ViT path uses; parity is pinned by
+tests/test_tp_vit.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.vit import ViTConfig, dense, layer_norm, patchify, tokens_to_logp
+from ..ops.adadelta import AdadeltaState, adadelta_update
+from ..ops.attention import full_attention
+from ..ops.loss import nll_loss
+from .ddp import TrainState
+from .mesh import DATA_AXIS, MODEL_AXIS, place_tree
+
+
+def _check_head_divisibility(cfg: ViTConfig, mesh: Mesh) -> None:
+    num_model = mesh.shape[MODEL_AXIS]
+    if cfg.heads % num_model:
+        raise ValueError(
+            f"heads={cfg.heads} not divisible by the model axis "
+            f"({num_model}); attention shards by whole heads"
+        )
+    if cfg.mlp_dim % num_model:
+        raise ValueError(
+            f"mlp_dim={cfg.mlp_dim} not divisible by the model axis "
+            f"({num_model})"
+        )
+
+
+def vit_tp_param_specs(cfg: ViTConfig) -> dict:
+    """PartitionSpecs for the ViT param tree under (data, model) sharding:
+    qkv/mlp_in column-parallel, proj/mlp_out row-parallel, rest replicated.
+    """
+    col = {"kernel": P(None, MODEL_AXIS), "bias": P(MODEL_AXIS)}
+    # Row-parallel bias is added once, after the psum — replicated.
+    row = {"kernel": P(MODEL_AXIS, None), "bias": P()}
+    rep = {"kernel": P(), "bias": P()}
+    ln = {"scale": P(), "bias": P()}
+    return {
+        "embed": dict(rep),
+        "pos_embed": P(),
+        "head": dict(rep),
+        "ln_f": dict(ln),
+        "blocks": {
+            str(i): {
+                "ln1": dict(ln),
+                "qkv": dict(col),
+                "proj": dict(row),
+                "ln2": dict(ln),
+                "mlp_in": dict(col),
+                "mlp_out": dict(row),
+            }
+            for i in range(cfg.depth)
+        },
+    }
+
+
+def vit_tp_state_specs(cfg: ViTConfig):
+    """Specs for the full TrainState: Adadelta accumulators shard exactly
+    like their params (one definition for placement AND step specs)."""
+    ps = vit_tp_param_specs(cfg)
+    return TrainState(
+        params=ps, opt=AdadeltaState(square_avg=ps, acc_delta=ps), step=P()
+    )
+
+
+def shard_vit_tp_state(state: TrainState, mesh: Mesh, cfg: ViTConfig):
+    """Place a host TrainState onto the mesh with ViT-TP shardings
+    (mesh.place_tree recipe)."""
+    return place_tree(state, vit_tp_state_specs(cfg), mesh)
+
+
+def _row(x: jax.Array, p: dict) -> jax.Array:
+    """Row-parallel dense: local partial product, completed by one psum
+    over ``model``; the replicated bias is added after the reduction."""
+    part = x @ p["kernel"].astype(x.dtype)
+    return jax.lax.psum(part, MODEL_AXIS) + p["bias"].astype(x.dtype)
+
+
+def _tp_block(
+    bp: dict,
+    x: jax.Array,
+    cfg: ViTConfig,
+    heads_local: int,
+    attention_fn=full_attention,
+):
+    """One pre-LN transformer block over a model shard: local heads, local
+    MLP features, two psums (proj, mlp_out).  ``attention_fn`` is injected
+    exactly as in models/vit.py — parallel/sp3.py passes ring attention to
+    run this same block over a (token, head) shard."""
+    b, t, _ = x.shape
+    h = layer_norm(x, bp["ln1"])
+    # Column-parallel layers reuse models/vit.py dense(): the local
+    # kernel/bias shard IS just a narrower dense layer.
+    qkv = dense(h, bp["qkv"]).reshape(b, t, heads_local, 3, cfg.head_dim)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    attn = attention_fn(q, k, v).reshape(b, t, heads_local * cfg.head_dim)
+    x = x + _row(attn, bp["proj"])
+    h = layer_norm(x, bp["ln2"])
+    h = jax.nn.gelu(dense(h, bp["mlp_in"]))
+    return x + _row(h, bp["mlp_out"])
+
+
+def _tp_vit_forward(params: dict, x: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """The ViT forward over a MODEL shard, inside shard_map: every token is
+    local (no seq sharding); weights of the sharded layers are local
+    slices.  Composes the same patchify/layer_norm/pool/head contract as
+    models/vit.py's single-device trunk."""
+    heads_local = cfg.heads // jax.lax.axis_size(MODEL_AXIS)
+    dt = jnp.bfloat16 if cfg.bf16 else x.dtype
+    patches = patchify(x, cfg).astype(dt)
+    tokens = dense(patches, params["embed"]) + params["pos_embed"].astype(dt)
+    for i in range(cfg.depth):
+        tokens = _tp_block(params["blocks"][str(i)], tokens, cfg, heads_local)
+    tokens = layer_norm(tokens, params["ln_f"])
+    pooled = tokens.astype(jnp.float32).mean(axis=1)
+    return tokens_to_logp(params, pooled)
+
+
+def make_vit_tp_train_step(
+    mesh: Mesh, cfg: ViTConfig, rho: float = 0.9, eps: float = 1e-6
+):
+    """Build the jitted 2-D (data x model) ViT train step.
+
+    ``step_fn(state, x, y, w, lr) -> (state, losses)`` with ``state``
+    sharded per ``vit_tp_state_specs``, ``x/y/w`` sharded over ``data``,
+    ``losses`` one local loss per data shard.  Grad semantics as in
+    parallel/tp.py: VMA-inserted psums deliver the data-axis SUM of
+    local-mean grads (and the model-axis reduction for replicated params);
+    divide by the data degree for DDP mean semantics.
+    """
+    _check_head_divisibility(cfg, mesh)
+    num_data = mesh.shape[DATA_AXIS]
+    state_specs = vit_tp_state_specs(cfg)
+
+    def local_step(state: TrainState, x, y, w, lr):
+        def loss_fn(params):
+            logp = _tp_vit_forward(params, x, cfg)
+            return nll_loss(logp, y, w, reduction="mean")
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        grads = jax.tree.map(lambda g: g / num_data, grads)
+        params, opt = adadelta_update(
+            state.params, grads, state.opt, lr, rho, eps
+        )
+        return TrainState(params, opt, state.step + 1), loss[None]
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_specs, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(state_specs, P(DATA_AXIS)),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_vit_tp_eval_step(mesh: Mesh, cfg: ViTConfig):
+    """Jitted (data x model) eval step: TP forward + the psum'd
+    (loss_sum, correct) totals every eval path in the framework shares —
+    params stay model-sharded through evaluation."""
+    _check_head_divisibility(cfg, mesh)
+
+    def local_eval(params, x, y, w):
+        logp = _tp_vit_forward(params, x, cfg)
+        loss_sum = nll_loss(logp, y, w, reduction="sum")
+        correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
+        return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
+
+    sharded = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(
+            vit_tp_param_specs(cfg),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+        ),
+        out_specs=P(),
+    )
+    return jax.jit(sharded)

@@ -168,21 +168,41 @@ def _flatten_raw(tree: Mapping[str, Any], prefix: str = "") -> dict[str, np.ndar
     return out
 
 
+# Params-tree archive format version.  2 = head-major qkv layout
+# ([t, heads, 3, head_dim], models/vit.py:_attn_sublayer) — the kernel
+# SHAPE is unchanged from the v1 (qkv-major) layout, so a shape check
+# cannot catch a stale archive; the version tag is what prevents silently
+# resuming from per-head-scrambled attention weights.
+PARAMS_TREE_FORMAT = 2
+
+
 def save_params_tree(tree: Mapping[str, Any], path: str) -> None:
     """Save an arbitrary nested param pytree as an npz archive with dotted
     keys, no renaming — the generic checkpoint form for model families
     without a torch counterpart (e.g. the ViT family, vit_mnist.py
     ``--save-model``).  Exact inverse: :func:`load_params_tree`."""
-    _atomic_npz_write(_flatten_raw(tree), path)
+    flat = dict(_flatten_raw(tree))
+    flat["__format__"] = np.int64(PARAMS_TREE_FORMAT)
+    _atomic_npz_write(flat, path)
 
 
 def load_params_tree(path: str) -> dict[str, Any]:
-    """Inverse of :func:`save_params_tree`."""
+    """Inverse of :func:`save_params_tree`.  Refuses archives that contain
+    attention weights but predate the head-major qkv layout (format < 2):
+    their qkv kernels parse into the same shapes with every head's q/k/v
+    scrambled, which no downstream check can detect."""
     try:
         with np.load(path) as archive:
             flat = {k: archive[k] for k in archive.files}
     except (OSError, ValueError) as e:
         raise ValueError(f"{path!r} is not an npz params archive: {e}") from e
+    fmt = int(flat.pop("__format__", 1))
+    if fmt < 2 and any(key.split(".")[-2:-1] == ["qkv"] for key in flat):
+        raise ValueError(
+            f"{path!r} is a format-{fmt} archive with qkv weights saved in "
+            "the pre-head-major layout; it cannot be loaded (same shapes, "
+            "scrambled heads) — re-save it from the run that produced it"
+        )
     return _unflatten(flat, "")
 
 

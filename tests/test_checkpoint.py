@@ -82,3 +82,42 @@ def test_params_from_state_dict_inverts(tmp_path):
         assert len(flat_a) == len(flat_b)
         for a, b in zip(flat_a, flat_b):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_params_tree_roundtrip_and_qkv_format_guard(tmp_path):
+    """save_params_tree/load_params_tree invert exactly and carry the
+    format tag; a pre-head-major (format-1) archive containing qkv weights
+    must be REFUSED — its kernels parse into identical shapes with every
+    head's q/k/v scrambled, so no shape check downstream can catch it."""
+    import pytest
+
+    from pytorch_mnist_ddp_tpu.models.vit import ViTConfig, init_vit_params
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import (
+        load_params_tree,
+        save_params_tree,
+    )
+
+    params = init_vit_params(jax.random.PRNGKey(0), ViTConfig())
+    path = str(tmp_path / "vit.npz")
+    save_params_tree(params, path)
+    loaded = load_params_tree(path)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        params, loaded,
+    )
+
+    # Strip the format tag -> a legacy archive; qkv presence must refuse.
+    with np.load(path) as archive:
+        flat = {k: archive[k] for k in archive.files if k != "__format__"}
+    legacy = str(tmp_path / "legacy.npz")
+    np.savez(legacy, **flat)
+    with pytest.raises(ValueError, match="head-major"):
+        load_params_tree(legacy)
+
+    # A legacy archive WITHOUT attention weights stays loadable (the CNN
+    # families never had a layout change).
+    no_qkv = {k: v for k, v in flat.items() if ".qkv." not in k}
+    plain = str(tmp_path / "plain.npz")
+    np.savez(plain, **no_qkv)
+    tree = load_params_tree(plain)
+    assert "embed" in tree and "qkv" not in tree["blocks"]["0"]

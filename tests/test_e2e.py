@@ -207,6 +207,8 @@ def test_cli_dry_run_subprocess(tmp_path, script, extra):
 @pytest.mark.parametrize("extra", [
     [],                  # single device
     ["--sp", "4"],       # ring-attention sequence parallel (2 data x 4 seq)
+    ["--tp", "4"],       # Megatron head/MLP sharding (2 data x 4 model)
+    ["--sp", "2", "--tp", "2"],  # 3-D (2 data x 2 seq x 2 model)
     ["--experts", "8"],  # expert-parallel switch-MoE over 8 devices
 ])
 def test_vit_cli_dry_run_subprocess(tmp_path, extra):

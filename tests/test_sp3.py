@@ -1,0 +1,135 @@
+"""3-D (data x seq x model) ViT parallelism vs the single-device oracle.
+
+The composition test tier: SP and TP are each pinned against the oracle in
+their own suites (test_sp.py, test_tp_vit.py); here the 2x2x2 mesh runs
+both factorizations simultaneously — every collective kind in the
+framework (grad psum, k/v ppermute ring, row-parallel psum, pool psum) in
+one program — and must still match the plain forward/recurrence exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pytorch_mnist_ddp_tpu.models.vit import (
+    ViTConfig,
+    init_vit_params,
+    vit_forward,
+)
+from pytorch_mnist_ddp_tpu.parallel.ddp import make_train_state
+from pytorch_mnist_ddp_tpu.parallel.sp3 import (
+    _sp3_vit_forward,
+    make_3d_mesh,
+    make_sp3_eval_step,
+    make_sp3_train_step,
+    shard_sp3_state,
+)
+from pytorch_mnist_ddp_tpu.parallel.tp_vit import vit_tp_param_specs
+
+CFG = ViTConfig()
+
+
+def test_sp3_forward_matches_single_device(devices):
+    """The (2 data x 2 seq x 2 model) forward — 4-token 2-head shards per
+    device — equals the single-device ViT forward."""
+    mesh = make_3d_mesh(num_data=2, num_seq=2, num_model=2, devices=devices)
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28, 1))
+
+    sharded_params = shard_sp3_state(
+        make_train_state(params), mesh, CFG
+    ).params
+    fwd = jax.jit(
+        jax.shard_map(
+            lambda p, x: _sp3_vit_forward(p, x, CFG),
+            mesh=mesh,
+            in_specs=(vit_tp_param_specs(CFG), P("data")),
+            out_specs=P("data"),
+        )
+    )
+    np.testing.assert_allclose(
+        fwd(sharded_params, x), vit_forward(params, x, CFG),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+@pytest.mark.slow  # compile-heavy (3-D mesh train step); full tier only
+def test_sp3_train_step_matches_single_device(devices):
+    """Five 3-D train steps track the single-device recurrence: the ring,
+    both row-parallel psums, the pool psum, and the VMA grad reductions
+    over three axes must compose into exact full-batch gradients."""
+    from pytorch_mnist_ddp_tpu.ops.adadelta import (
+        adadelta_init,
+        adadelta_update,
+    )
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+    from pytorch_mnist_ddp_tpu.parallel.tp import gather_replicated
+
+    mesh = make_3d_mesh(num_data=2, num_seq=2, num_model=2, devices=devices)
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    ref_params = jax.tree.map(jnp.array, params)
+
+    state = shard_sp3_state(make_train_state(params), mesh, CFG)
+    step = make_sp3_train_step(mesh, CFG)
+
+    @jax.jit
+    def ref_step(params, opt, x, y, w, lr):
+        def loss_fn(p):
+            return nll_loss(vit_forward(p, x, CFG), y, w, reduction="mean")
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt = adadelta_update(params, grads, opt, lr, 0.9, 1e-6)
+        return params, opt, loss
+
+    ref_opt = adadelta_init(ref_params)
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        x = jnp.asarray(rng.randn(8, 28, 28, 1), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 10, 8), jnp.int32)
+        w = jnp.ones((8,), jnp.float32)
+        state, losses = step(state, x, y, w, jnp.float32(1.0))
+        ref_params, ref_opt, ref_loss = ref_step(
+            ref_params, ref_opt, x, y, w, jnp.float32(1.0)
+        )
+        np.testing.assert_allclose(
+            np.mean(losses), ref_loss, rtol=2e-5, atol=2e-5
+        )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=3e-4, atol=3e-5),
+        jax.device_get(gather_replicated(state.params, mesh)),
+        jax.device_get(ref_params),
+    )
+
+
+def test_sp3_eval_step_totals(devices):
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+
+    mesh = make_3d_mesh(num_data=2, num_seq=2, num_model=2, devices=devices)
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28, 1))
+    y = jnp.asarray(np.random.RandomState(0).randint(0, 10, 8), jnp.int32)
+    w = jnp.asarray([1, 1, 1, 1, 1, 1, 0, 0], jnp.float32)
+
+    sharded_params = shard_sp3_state(
+        make_train_state(params), mesh, CFG
+    ).params
+    totals = make_sp3_eval_step(mesh, CFG)(sharded_params, x, y, w)
+
+    logp = vit_forward(params, x, CFG)
+    np.testing.assert_allclose(
+        totals[0], nll_loss(logp, y, w, reduction="sum"), rtol=2e-5
+    )
+    assert float(totals[1]) == float(((jnp.argmax(logp, axis=1) == y) * w).sum())
+
+
+def test_sp3_mesh_divisibility_guards(devices):
+    """Non-divisible token or head counts must be refused, and an
+    oversubscribed mesh request must fail loudly."""
+    mesh = make_3d_mesh(num_data=1, num_seq=1, num_model=3,
+                        devices=devices[:3])
+    with pytest.raises(ValueError, match="not divisible"):
+        make_sp3_train_step(mesh, CFG)
+    with pytest.raises(ValueError, match="only"):
+        make_3d_mesh(num_data=4, num_seq=2, num_model=2, devices=devices)

@@ -1,0 +1,173 @@
+"""ViT tensor parallelism: Megatron-style (data, model) sharded blocks.
+
+Strategy (SURVEY.md §4 style): the sharded path is pinned against the
+single-device oracle on the 8-virtual-device CPU mesh — the TP forward vs
+``vit_forward``, the full 2-D train step vs the plain single-device
+training recurrence on identical init/batches, and the eval totals with
+padding rows.  Head-major qkv layout makes the column split land whole
+heads; these tests are what keep that contract honest.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pytorch_mnist_ddp_tpu.models.vit import (
+    ViTConfig,
+    init_vit_params,
+    vit_forward,
+)
+from pytorch_mnist_ddp_tpu.parallel.ddp import make_train_state
+from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+from pytorch_mnist_ddp_tpu.parallel.tp_vit import (
+    _tp_vit_forward,
+    make_vit_tp_eval_step,
+    make_vit_tp_train_step,
+    shard_vit_tp_state,
+    vit_tp_param_specs,
+)
+
+CFG = ViTConfig()
+
+
+def _tp_forward_fn(mesh, cfg):
+    return jax.jit(
+        jax.shard_map(
+            lambda p, x: _tp_vit_forward(p, x, cfg),
+            mesh=mesh,
+            in_specs=(vit_tp_param_specs(cfg), P("data")),
+            out_specs=P("data"),
+        )
+    )
+
+
+@pytest.mark.parametrize("num_model", [2, 4])
+def test_tp_forward_matches_single_device(devices, num_model):
+    """The load-bearing TP parity: the model-sharded forward (whole-head
+    qkv shards, two psums per block) equals the single-device ViT forward
+    on the same params/batch."""
+    mesh = make_mesh(num_data=8 // num_model, num_model=num_model,
+                     devices=devices)
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28, 1))
+
+    sharded_params = shard_vit_tp_state(
+        make_train_state(params), mesh, CFG
+    ).params
+    got = _tp_forward_fn(mesh, CFG)(sharded_params, x)
+    np.testing.assert_allclose(
+        got, vit_forward(params, x, CFG), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_tp_forward_bf16_matches_single_device(devices):
+    cfg16 = ViTConfig(bf16=True)
+    mesh = make_mesh(num_data=2, num_model=4, devices=devices)
+    params = init_vit_params(jax.random.PRNGKey(0), cfg16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28, 1))
+    sharded_params = shard_vit_tp_state(
+        make_train_state(params), mesh, cfg16
+    ).params
+    got = _tp_forward_fn(mesh, cfg16)(sharded_params, x)
+    # bf16 compute reorders roundings between the paths; modest tolerance.
+    np.testing.assert_allclose(got, vit_forward(params, x, cfg16), atol=0.08)
+
+
+@pytest.mark.slow  # compile-heavy (2-D mesh train step); full tier only
+def test_tp_train_step_matches_single_device(devices):
+    """Five TP train steps on the (2 data x 4 model) mesh track the plain
+    single-device recurrence (same init, same batches, Adadelta): the
+    row-parallel psums and the VMA grad reductions must reproduce exact
+    full-batch gradients, and the SHARDED Adadelta state must evolve
+    exactly like the replicated one."""
+    from pytorch_mnist_ddp_tpu.ops.adadelta import (
+        adadelta_init,
+        adadelta_update,
+    )
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+    from pytorch_mnist_ddp_tpu.parallel.tp import gather_replicated
+
+    mesh = make_mesh(num_data=2, num_model=4, devices=devices)
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    ref_params = jax.tree.map(jnp.array, params)
+
+    state = shard_vit_tp_state(make_train_state(params), mesh, CFG)
+    step = make_vit_tp_train_step(mesh, CFG)
+
+    @jax.jit
+    def ref_step(params, opt, x, y, w, lr):
+        def loss_fn(p):
+            return nll_loss(vit_forward(p, x, CFG), y, w, reduction="mean")
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt = adadelta_update(params, grads, opt, lr, 0.9, 1e-6)
+        return params, opt, loss
+
+    ref_opt = adadelta_init(ref_params)
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        x = jnp.asarray(rng.randn(8, 28, 28, 1), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 10, 8), jnp.int32)
+        w = jnp.ones((8,), jnp.float32)
+        state, losses = step(state, x, y, w, jnp.float32(1.0))
+        ref_params, ref_opt, ref_loss = ref_step(
+            ref_params, ref_opt, x, y, w, jnp.float32(1.0)
+        )
+        np.testing.assert_allclose(
+            np.mean(losses), ref_loss, rtol=2e-5, atol=2e-5
+        )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=3e-4, atol=3e-5),
+        jax.device_get(gather_replicated(state.params, mesh)),
+        jax.device_get(ref_params),
+    )
+
+
+def test_tp_eval_step_totals(devices):
+    """(loss_sum, correct) totals from the TP eval step equal the
+    single-device computation, padding rows excluded — params stay
+    model-sharded throughout."""
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+
+    mesh = make_mesh(num_data=2, num_model=4, devices=devices)
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28, 1))
+    y = jnp.asarray(np.random.RandomState(0).randint(0, 10, 8), jnp.int32)
+    w = jnp.asarray([1, 1, 1, 1, 1, 1, 0, 0], jnp.float32)  # 2 padding rows
+
+    sharded_params = shard_vit_tp_state(
+        make_train_state(params), mesh, CFG
+    ).params
+    totals = make_vit_tp_eval_step(mesh, CFG)(sharded_params, x, y, w)
+
+    logp = vit_forward(params, x, CFG)
+    expect_loss = nll_loss(logp, y, w, reduction="sum")
+    expect_correct = float(((jnp.argmax(logp, axis=1) == y) * w).sum())
+    np.testing.assert_allclose(totals[0], expect_loss, rtol=2e-5)
+    assert float(totals[1]) == expect_correct
+
+
+def test_tp_rejects_non_divisible_heads(devices):
+    """4 heads over a 3-way model axis cannot shard by whole heads; the
+    step builders must refuse it."""
+    mesh = make_mesh(num_data=1, num_model=3, devices=devices[:3])
+    with pytest.raises(ValueError, match="not divisible"):
+        make_vit_tp_train_step(mesh, CFG)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_vit_tp_eval_step(mesh, CFG)
+
+
+def test_tp_state_shards_are_actual_slices(devices):
+    """The placed qkv kernel really is model-sharded (each device holds a
+    [dim, 3*dim/M] slice) and the Adadelta accumulators follow it."""
+    mesh = make_mesh(num_data=2, num_model=4, devices=devices)
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    state = shard_vit_tp_state(make_train_state(params), mesh, CFG)
+
+    qkv = state.params["blocks"]["0"]["qkv"]["kernel"]
+    shard = qkv.addressable_shards[0]
+    assert shard.data.shape == (CFG.dim, 3 * CFG.dim // 4)
+    sq = state.opt.square_avg["blocks"]["0"]["qkv"]["kernel"]
+    assert sq.sharding == qkv.sharding

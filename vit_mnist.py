@@ -8,12 +8,15 @@ parity CLIs, and exposes the long-context/distributed modes:
   python vit_mnist.py --epochs 5                 # single device
   python vit_mnist.py --sp 4                     # ring-attention sequence
                                                  # parallel over (data, seq)
+  python vit_mnist.py --tp 4                     # Megatron head/MLP sharding
+                                                 # over (data, model)
+  python vit_mnist.py --sp 2 --tp 2              # 3-D (data, seq, model)
   python vit_mnist.py --experts 8                # switch-MoE with expert
                                                  # parallelism (all_to_all)
 
-``--sp`` and ``--experts`` are library parallel modes (parallel/sp.py,
-parallel/ep.py) — both shard over every visible device; ``--sp N`` uses an
-``(ndev/N) x N`` (data, seq) mesh.
+``--sp`` / ``--tp`` / ``--experts`` are library parallel modes
+(parallel/sp.py, tp_vit.py, sp3.py, ep.py) — all shard over every visible
+device; the data axis absorbs whatever the minor axes don't use.
 """
 
 from __future__ import annotations
@@ -38,11 +41,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-root", type=str, default="./data")
     p.add_argument("--sp", type=int, default=1, metavar="S",
                    help="sequence-parallel degree: ring attention over an "
-                        "S-way seq axis (parallel/sp.py)")
+                        "S-way seq axis (parallel/sp.py); composes with "
+                        "--tp into the 3-D (data, seq, model) step")
+    p.add_argument("--tp", type=int, default=1, metavar="M",
+                   help="tensor-parallel degree: Megatron-style head/MLP "
+                        "sharding over an M-way model axis "
+                        "(parallel/tp_vit.py); composes with --sp")
     p.add_argument("--experts", type=int, default=0, metavar="E",
                    help="switch-MoE with E experts, expert-parallel over "
                         "the data axis (models/moe.py + parallel/ep.py); "
-                        "mutually exclusive with --sp")
+                        "mutually exclusive with --sp/--tp")
     p.add_argument("--depth", type=int, default=2, metavar="N",
                    help="transformer blocks (default: 2)")
     p.add_argument("--dim", type=int, default=64, metavar="D",
@@ -61,8 +69,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main() -> None:
     args = build_parser().parse_args()
-    if args.sp > 1 and args.experts > 0:
-        raise SystemExit("--sp and --experts are mutually exclusive")
+    if args.experts > 0 and (args.sp > 1 or args.tp > 1):
+        raise SystemExit("--experts is mutually exclusive with --sp/--tp")
 
     import jax
 
@@ -118,7 +126,31 @@ def main() -> None:
 
         params = jax.tree.map(_check, params, loaded)
 
-    if args.sp > 1:
+    if args.sp > 1 and args.tp > 1:
+        from pytorch_mnist_ddp_tpu.parallel.sp3 import (
+            make_3d_mesh,
+            make_sp3_eval_step,
+            make_sp3_train_step,
+            shard_sp3_state,
+        )
+
+        mesh = make_3d_mesh(num_data=None, num_seq=args.sp,
+                            num_model=args.tp)
+        state = shard_sp3_state(make_train_state(params), mesh, cfg)
+        train_step = make_sp3_train_step(mesh, cfg)
+        eval_step = make_sp3_eval_step(mesh, cfg)
+    elif args.tp > 1:
+        from pytorch_mnist_ddp_tpu.parallel.tp_vit import (
+            make_vit_tp_eval_step,
+            make_vit_tp_train_step,
+            shard_vit_tp_state,
+        )
+
+        mesh = make_mesh(num_data=None, num_model=args.tp)
+        state = shard_vit_tp_state(make_train_state(params), mesh, cfg)
+        train_step = make_vit_tp_train_step(mesh, cfg)
+        eval_step = make_vit_tp_eval_step(mesh, cfg)
+    elif args.sp > 1:
         from pytorch_mnist_ddp_tpu.parallel.sp import (
             make_sp_eval_step,
             make_sp_mesh,
@@ -129,7 +161,6 @@ def main() -> None:
         state = replicate_params(make_train_state(params), mesh)
         train_step = make_sp_train_step(mesh, cfg)
         eval_step = make_sp_eval_step(mesh, cfg)
-        eval_params = lambda s: s.params  # noqa: E731
     elif args.experts > 0:
         from pytorch_mnist_ddp_tpu.parallel.ep import (
             make_ep_eval_step,
@@ -141,7 +172,6 @@ def main() -> None:
         state = shard_ep_state(make_train_state(params), mesh, cfg)
         train_step = make_ep_train_step(mesh, cfg)
         eval_step = make_ep_eval_step(mesh, cfg)
-        eval_params = lambda s: s.params  # noqa: E731
     else:
         mesh = make_mesh(num_data=1, devices=jax.devices()[:1])
         state = replicate_params(make_train_state(params), mesh)
@@ -167,7 +197,9 @@ def main() -> None:
             correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
             return jnp.stack([loss_sum, correct])
 
-        eval_params = lambda s: s.params  # noqa: E731
+
+    # Every mode evaluates on its (possibly sharded) live params.
+    eval_params = lambda s: s.params  # noqa: E731
 
     tr_x, tr_y = load_mnist_arrays(args.data_root, "train")
     te_x, te_y = load_mnist_arrays(args.data_root, "test", download=False)
