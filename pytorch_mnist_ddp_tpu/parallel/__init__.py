@@ -24,6 +24,10 @@ from .sp3 import (
     make_sp3_train_step,
     shard_sp3_state,
 )
+from .pp_vit import (
+    make_vit_eval_step,
+    make_vit_pp_train_step,
+)
 from .distributed import init_distributed_mode, DistState
 from .ddp import (
     TrainState,

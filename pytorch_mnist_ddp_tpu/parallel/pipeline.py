@@ -1,0 +1,196 @@
+"""The generic 2-stage GPipe schedule: microbatched ppermute + custom_vjp.
+
+parallel/pp.py introduced this schedule for the reference CNN (conv stage
+-> dense stage); parallel/pp_vit.py pipelines the ViT's transformer blocks
+with it.  The schedule itself is model-agnostic — what moves between
+devices is "the stage-boundary activation", whatever its shape — so it
+lives here once, parameterized by the two stage bodies:
+
+- ``stage0_fn(params, x_mb, key, j) -> act``: the first half of the model
+  on microbatch ``j`` (``key`` is the caller's dropout key; stateless
+  models ignore it);
+- ``stage1_fn(params, act, y_mb, w_mb, key, j) -> loss_sum``: the second
+  half through the weighted NLL SUM for microbatch ``j``.
+
+Schedule (NUM_STAGES = 2, ``num_micro`` microbatches, driven by
+``lax.scan`` with one ``lax.ppermute`` hop per tick):
+
+- **forward** (``num_micro + 1`` ticks): stage 0 runs microbatch ``t``
+  while stage 1 consumes the activation sent at ``t - 1`` and accumulates
+  the loss; arriving activations are stashed for the backward pass.
+- **backward** (``num_micro + 1`` ticks, reverse order): stage 1 re-runs
+  its microbatch body under ``jax.vjp`` (rematerialization — the same
+  ``j``-folded keys, so dropout masks replay exactly), accumulates its
+  param grads, and ppermutes the activation cotangent back; stage 0
+  consumes it one tick later.
+
+Each device executes ONLY its own stage's FLOPs: stage selection is a
+runtime ``lax.cond`` on the device's stage-axis index.  Transposing such
+a ``cond`` nested in this scan+ppermute SIGABRTs the XLA:CPU runtime
+(jaxlib in this image), which is why the backward schedule is hand-written
+under ``jax.custom_vjp`` — autodiff never transposes anything, and the
+pipeline's collective pattern stays fully explicit: the per-tick
+activation/cotangent ppermute plus one stage-axis ``psum`` of the
+(disjoint) per-stage grad trees.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import MODEL_AXIS
+
+STAGE_AXIS = MODEL_AXIS  # the reserved second mesh axis doubles as stages
+NUM_STAGES = 2
+
+
+def _float0_zeros(v: jax.Array):
+    """Cotangent for a non-differentiable (integer) primal."""
+    return np.zeros(v.shape, jax.dtypes.float0)
+
+
+def make_pipeline_loss(stage0_fn, stage1_fn, num_micro: int):
+    """Build ``pipeline_loss(params, x_mbs, y_mbs, w_mbs, key) ->
+    loss_sum`` — the scheduled, ``custom_vjp``-differentiable 2-stage
+    pipeline over one data shard, for use inside ``shard_map`` with
+    ``check_vma=False``.
+
+    ``x_mbs/y_mbs/w_mbs`` are ``[num_micro, mb, ...]``; the returned loss
+    is the stage-psum'd SUM over the shard (callers divide by their own
+    weight total).  The stage-boundary activation's shape/dtype is
+    discovered from ``stage0_fn`` via ``jax.eval_shape`` — bf16 boundaries
+    travel at their native width.
+    """
+    if num_micro < 1:
+        raise ValueError(f"num_micro must be >= 1, got {num_micro}")
+    ring = [(i, (i + 1) % NUM_STAGES) for i in range(NUM_STAGES)]
+    ring_rev = [(dst, src) for src, dst in ring]
+    ticks = num_micro + NUM_STAGES - 1
+
+    def _act_zeros(params, x_mbs, key):
+        a = jax.eval_shape(
+            lambda p, x, k: stage0_fn(p, x, k, 0), params, x_mbs[0], key
+        )
+        return jnp.zeros(a.shape, a.dtype)
+
+    def _pipeline_forward(params, x_mbs, y_mbs, w_mbs, key):
+        """Returns (stage-psum'd loss SUM over this data shard, stashed
+        arriving activations [ticks, mb, ...])."""
+        stage = jax.lax.axis_index(STAGE_AXIS)
+        zero_act = _act_zeros(params, x_mbs, key)
+
+        def tick(carry, t):
+            in_flight = carry  # activation that arrived at this device
+
+            # stage 0 forwards microbatch t; the activity test lives in the
+            # cond PREDICATE, so idle ticks take the zeros branch for free
+            # (the cond is never transposed — custom_vjp below).
+            t0 = jnp.clip(t, 0, num_micro - 1)
+            x_mb = jax.lax.dynamic_index_in_dim(x_mbs, t0, keepdims=False)
+            out = jax.lax.cond(
+                jnp.logical_and(stage == 0, t < num_micro),
+                lambda: stage0_fn(params, x_mb, key, t0),
+                lambda: zero_act,
+            )
+
+            # stage 1 consumes the block sent at tick t-1 (idle at t=0
+            # takes the zero branch).
+            t1 = jnp.clip(t - 1, 0, num_micro - 1)
+            y_mb = jax.lax.dynamic_index_in_dim(y_mbs, t1, keepdims=False)
+            w_mb = jax.lax.dynamic_index_in_dim(w_mbs, t1, keepdims=False)
+            part = jax.lax.cond(
+                jnp.logical_and(stage == 1, t >= 1),
+                lambda: stage1_fn(params, in_flight, y_mb, w_mb, key, t1),
+                lambda: jnp.float32(0.0),
+            )
+
+            moved = jax.lax.ppermute(out, STAGE_AXIS, ring)
+            return moved, (part, in_flight)
+
+        _, (parts, stash) = jax.lax.scan(tick, zero_act, jnp.arange(ticks))
+        return jax.lax.psum(parts.sum(), STAGE_AXIS), stash
+
+    @jax.custom_vjp
+    def pipeline_loss(params, x_mbs, y_mbs, w_mbs, key):
+        loss_sum, _ = _pipeline_forward(params, x_mbs, y_mbs, w_mbs, key)
+        return loss_sum
+
+    def pipeline_loss_fwd(params, x_mbs, y_mbs, w_mbs, key):
+        loss_sum, stash = _pipeline_forward(params, x_mbs, y_mbs, w_mbs, key)
+        return loss_sum, (params, x_mbs, y_mbs, w_mbs, key, stash)
+
+    def pipeline_loss_bwd(res, g):
+        """The reverse schedule, hand-written (never a cond transpose).
+
+        Tick s: stage 1 rematerializes microbatch ``num_micro - 1 - s``
+        under ``jax.vjp`` (grads for its params + the activation
+        cotangent, scaled by ``g``), ppermutes the cotangent back; stage 0
+        consumes it at tick ``s + 1``.  Param-grad trees are disjoint per
+        stage; one stage-axis psum at the end makes every device hold the
+        full gradient."""
+        params, x_mbs, y_mbs, w_mbs, key, stash = res
+        stage = jax.lax.axis_index(STAGE_AXIS)
+        zero_grads = jax.tree.map(jnp.zeros_like, params)
+        zero_ga = _act_zeros(params, x_mbs, key)
+
+        def tick(carry, s):
+            g_act_in, acc = carry
+
+            def s1_body():
+                # stage 1: microbatch j arrived at forward tick j+1
+                j = jnp.clip(num_micro - 1 - s, 0, num_micro - 1)
+                act = jax.lax.dynamic_index_in_dim(stash, j + 1, keepdims=False)
+                y_mb = jax.lax.dynamic_index_in_dim(y_mbs, j, keepdims=False)
+                w_mb = jax.lax.dynamic_index_in_dim(w_mbs, j, keepdims=False)
+                _, vjp = jax.vjp(
+                    lambda p, a: stage1_fn(p, a, y_mb, w_mb, key, j),
+                    params, act,
+                )
+                gp, ga = vjp(g)
+                return gp, ga
+
+            def s0_body():
+                # stage 0: the cotangent arriving at tick s is for the
+                # microbatch stage 1 processed at tick s-1
+                j = jnp.clip(num_micro - s, 0, num_micro - 1)
+                x_mb = jax.lax.dynamic_index_in_dim(x_mbs, j, keepdims=False)
+                _, vjp = jax.vjp(
+                    lambda p: stage0_fn(p, x_mb, key, j), params
+                )
+                gp, = vjp(g_act_in)
+                return gp, zero_ga
+
+            def idle():
+                return zero_grads, zero_ga
+
+            # Activity in the PREDICATES: each device's idle tick takes the
+            # free zeros branch instead of computing-then-masking.
+            gp, ga = jax.lax.cond(
+                jnp.logical_and(stage == 1, s < num_micro),
+                s1_body,
+                lambda: jax.lax.cond(
+                    jnp.logical_and(stage == 0, s >= 1), s0_body, idle
+                ),
+            )
+            acc = jax.tree.map(jnp.add, acc, gp)
+            moved = jax.lax.ppermute(ga, STAGE_AXIS, ring_rev)
+            return (moved, acc), None
+
+        (_, acc), _ = jax.lax.scan(
+            tick, (zero_ga, zero_grads), jnp.arange(ticks)
+        )
+        # Disjoint per-stage trees -> full gradient everywhere.
+        acc = jax.lax.psum(acc, STAGE_AXIS)
+        return (
+            acc,
+            jnp.zeros_like(x_mbs),
+            _float0_zeros(y_mbs),
+            jnp.zeros_like(w_mbs),
+            _float0_zeros(key),
+        )
+
+    pipeline_loss.defvjp(pipeline_loss_fwd, pipeline_loss_bwd)
+    return pipeline_loss

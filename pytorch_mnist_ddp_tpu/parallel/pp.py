@@ -1,4 +1,4 @@
-"""Pipeline parallelism over the mesh's second axis (SURVEY.md §2c).
+"""Pipeline parallelism for the reference CNN (SURVEY.md §2c).
 
 The reference has no pipeline parallelism (single ``Net.forward``); this
 module gives the reserved mesh axis a GPipe-style **stage** decomposition
@@ -9,29 +9,10 @@ of the reference CNN:
 - **stage 1**: fc1 -> relu -> dropout(.5) -> fc2 -> log_softmax ->
   weighted NLL
 
-The per-data-shard batch is split into ``num_micro`` microbatches.  Both
-passes are explicit schedules driven by ``lax.scan``, with one
-``lax.ppermute`` hop per tick (the ICI neighbor link):
-
-- **forward** (``num_micro + 1`` ticks): stage 0 runs microbatch ``t``
-  while stage 1 consumes the activation sent at ``t - 1`` and accumulates
-  the loss; arriving activations are stashed for the backward pass.
-- **backward** (``num_micro + 1`` ticks, reverse order): stage 1 re-runs
-  its microbatch body under ``jax.vjp`` (rematerialization — same folded
-  dropout keys, so masks replay exactly), accumulates its param grads,
-  and ppermutes the activation cotangent back; stage 1's ppermute partner
-  consumes it one tick later for the conv backward.
-
-Each device executes ONLY its own stage's FLOPs: stage selection is a
-runtime ``lax.cond`` on the device's stage-axis index — the idiomatic
-SPMD form.  Transposing such a ``cond`` nested in this scan+ppermute
-SIGABRTs the XLA:CPU runtime (jaxlib in this image), which is why the
-round-1 version burned 2x masked FLOPs instead; the fix here is
-``jax.custom_vjp``: the backward schedule is hand-written primal-style
-code, so autodiff never transposes anything.  This also makes the
-pipeline's collective pattern fully explicit — the only cross-device
-traffic is the per-tick activation/cotangent ppermute and one stage-axis
-``psum`` of the (disjoint) per-stage grad trees.
+The microbatched ppermute schedule and its hand-written ``custom_vjp``
+backward live in parallel/pipeline.py (shared with the ViT pipeline,
+parallel/pp_vit.py); this module supplies the CNN's two stage bodies and
+the train-step wrapper.
 
 Params stay replicated in HBM (1.2M params; duplication is noise at this
 scale) but the *work* is stage-partitioned, and the gradient psum over
@@ -44,8 +25,6 @@ differs from DP's per-shard masks, as with TP's per-shard masks).
 
 from __future__ import annotations
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
@@ -54,16 +33,10 @@ from ..models.net import DROPOUT1_RATE, DROPOUT2_RATE, raw_conv_stack
 from ..ops.adadelta import adadelta_update
 from ..ops.loss import nll_loss
 from .ddp import TrainState
-from .mesh import DATA_AXIS, MODEL_AXIS
+from .mesh import DATA_AXIS
+from .pipeline import NUM_STAGES, STAGE_AXIS, make_pipeline_loss
 
-STAGE_AXIS = MODEL_AXIS  # the reserved second mesh axis doubles as stages
-NUM_STAGES = 2
 _FLAT = 9216  # stage-boundary activation width (64 * 12 * 12)
-
-
-def _float0_zeros(v: jax.Array):
-    """Cotangent for a non-differentiable (integer) primal."""
-    return np.zeros(v.shape, jax.dtypes.float0)
 
 
 def _stage0_fwd(params: dict, x: jax.Array, key: jax.Array, train: bool) -> jax.Array:
@@ -118,142 +91,16 @@ def make_pp_train_step(
             f"pipeline needs a {NUM_STAGES}-wide '{STAGE_AXIS}' axis, got "
             f"{mesh.shape[STAGE_AXIS]}"
         )
-    if num_micro < 1:
-        raise ValueError(f"num_micro must be >= 1, got {num_micro}")
-    num_data = mesh.shape[DATA_AXIS]
-    ring = [(i, (i + 1) % NUM_STAGES) for i in range(NUM_STAGES)]
-    ring_rev = [(dst, src) for src, dst in ring]
 
-    def _pipeline_forward(params, x_mbs, y_mbs, w_mbs, key):
-        """The scheduled forward: returns (stage-psum'd loss SUM over this
-        data shard, stashed arriving activations [ticks, mb, 9216])."""
-        stage = jax.lax.axis_index(STAGE_AXIS)
-        mb = x_mbs.shape[1]
+    def stage0(params, x_mb, key, j):
+        k0, _ = _mb_keys(key, j)
+        return _stage0_fwd(params, x_mb, k0, dropout)
 
-        def tick(carry, t):
-            in_flight = carry  # activation that arrived at this device
+    def stage1(params, act, y_mb, w_mb, key, j):
+        _, k1 = _mb_keys(key, j)
+        return _stage1_loss_sum(params, act, y_mb, w_mb, k1, dropout)
 
-            # stage 0 forwards microbatch t; the activity test lives in the
-            # cond PREDICATE, so idle ticks take the zeros branch for free
-            # (the cond is never transposed — custom_vjp below — so this
-            # costs nothing in AD).
-            t0 = jnp.clip(t, 0, num_micro - 1)
-            x_mb = jax.lax.dynamic_index_in_dim(x_mbs, t0, keepdims=False)
-            k0, _ = _mb_keys(key, t0)
-            out = jax.lax.cond(
-                jnp.logical_and(stage == 0, t < num_micro),
-                lambda: _stage0_fwd(params, x_mb, k0, dropout),
-                lambda: jnp.zeros((mb, _FLAT), x_mb.dtype),
-            )
-
-            # stage 1 consumes the block sent at tick t-1 (idle at t=0
-            # takes the zero branch).
-            t1 = jnp.clip(t - 1, 0, num_micro - 1)
-            y_mb = jax.lax.dynamic_index_in_dim(y_mbs, t1, keepdims=False)
-            w_mb = jax.lax.dynamic_index_in_dim(w_mbs, t1, keepdims=False)
-            _, k1 = _mb_keys(key, t1)
-            part = jax.lax.cond(
-                jnp.logical_and(stage == 1, t >= 1),
-                lambda: _stage1_loss_sum(
-                    params, in_flight, y_mb, w_mb, k1, dropout
-                ),
-                lambda: jnp.float32(0.0),
-            )
-
-            moved = jax.lax.ppermute(out, STAGE_AXIS, ring)
-            return moved, (part, in_flight)
-
-        zero = jnp.zeros((mb, _FLAT), x_mbs.dtype)
-        _, (parts, stash) = jax.lax.scan(
-            tick, zero, jnp.arange(num_micro + NUM_STAGES - 1)
-        )
-        return jax.lax.psum(parts.sum(), STAGE_AXIS), stash
-
-    @jax.custom_vjp
-    def pipeline_loss(params, x_mbs, y_mbs, w_mbs, key):
-        loss_sum, _ = _pipeline_forward(params, x_mbs, y_mbs, w_mbs, key)
-        return loss_sum
-
-    def pipeline_loss_fwd(params, x_mbs, y_mbs, w_mbs, key):
-        loss_sum, stash = _pipeline_forward(params, x_mbs, y_mbs, w_mbs, key)
-        return loss_sum, (params, x_mbs, y_mbs, w_mbs, key, stash)
-
-    def pipeline_loss_bwd(res, g):
-        """The reverse schedule, hand-written (never a cond transpose).
-
-        Tick s: stage 1 rematerializes microbatch ``num_micro - 1 - s``
-        under ``jax.vjp`` (grads for its params + the activation
-        cotangent, scaled by ``g``), ppermutes the cotangent back; stage 0
-        consumes it at tick ``s + 1`` for the conv backward.  Param-grad
-        trees are disjoint per stage; one stage-axis psum at the end makes
-        every device hold the full gradient."""
-        params, x_mbs, y_mbs, w_mbs, key, stash = res
-        stage = jax.lax.axis_index(STAGE_AXIS)
-        mb = x_mbs.shape[1]
-        zero_grads = jax.tree.map(jnp.zeros_like, params)
-
-        def tick(carry, s):
-            g_act_in, acc = carry
-            zero_ga = jnp.zeros((mb, _FLAT), x_mbs.dtype)
-
-            def s1_body():
-                # stage 1: microbatch j arrived at forward tick j+1
-                j = jnp.clip(num_micro - 1 - s, 0, num_micro - 1)
-                act = jax.lax.dynamic_index_in_dim(stash, j + 1, keepdims=False)
-                y_mb = jax.lax.dynamic_index_in_dim(y_mbs, j, keepdims=False)
-                w_mb = jax.lax.dynamic_index_in_dim(w_mbs, j, keepdims=False)
-                _, k1 = _mb_keys(key, j)
-                _, vjp = jax.vjp(
-                    lambda p, a: _stage1_loss_sum(p, a, y_mb, w_mb, k1, dropout),
-                    params, act,
-                )
-                gp, ga = vjp(g)
-                return gp, ga
-
-            def s0_body():
-                # stage 0: the cotangent arriving at tick s is for the
-                # microbatch stage 1 processed at tick s-1
-                j = jnp.clip(num_micro - s, 0, num_micro - 1)
-                x_mb = jax.lax.dynamic_index_in_dim(x_mbs, j, keepdims=False)
-                k0, _ = _mb_keys(key, j)
-                _, vjp = jax.vjp(
-                    lambda p: _stage0_fwd(p, x_mb, k0, dropout), params
-                )
-                gp, = vjp(g_act_in)
-                return gp, zero_ga
-
-            def idle():
-                return zero_grads, zero_ga
-
-            # Activity in the PREDICATES: each device's idle tick takes the
-            # free zeros branch instead of computing-then-masking.
-            gp, ga = jax.lax.cond(
-                jnp.logical_and(stage == 1, s < num_micro),
-                s1_body,
-                lambda: jax.lax.cond(
-                    jnp.logical_and(stage == 0, s >= 1), s0_body, idle
-                ),
-            )
-            acc = jax.tree.map(jnp.add, acc, gp)
-            moved = jax.lax.ppermute(ga, STAGE_AXIS, ring_rev)
-            return (moved, acc), None
-
-        zero_act = jnp.zeros((mb, _FLAT), x_mbs.dtype)
-        (_, acc), _ = jax.lax.scan(
-            tick, (zero_act, zero_grads),
-            jnp.arange(num_micro + NUM_STAGES - 1),
-        )
-        # Disjoint per-stage trees -> full gradient everywhere.
-        acc = jax.lax.psum(acc, STAGE_AXIS)
-        return (
-            acc,
-            jnp.zeros_like(x_mbs),
-            _float0_zeros(y_mbs),
-            jnp.zeros_like(w_mbs),
-            _float0_zeros(key),
-        )
-
-    pipeline_loss.defvjp(pipeline_loss_fwd, pipeline_loss_bwd)
+    pipeline_loss = make_pipeline_loss(stage0, stage1, num_micro)
 
     def local_step(state: TrainState, x, y, w, dropout_key, lr):
         n = x.shape[0]

@@ -1,0 +1,163 @@
+"""Pipeline parallelism for the ViT family: transformer blocks as stages.
+
+The textbook transformer pipeline — depth splits across stages, the
+``[mb, tokens, dim]`` token activations travel the stage boundary:
+
+- **stage 0**: patchify -> embed + pos-embed -> blocks[0 : depth/2]
+- **stage 1**: blocks[depth/2 :] -> final LN -> mean-pool -> head ->
+  weighted NLL
+
+The microbatched ppermute schedule and its hand-written ``custom_vjp``
+backward come from parallel/pipeline.py (shared with the CNN pipeline,
+parallel/pp.py); this module supplies the ViT stage bodies, composed from
+the same models/vit.py helpers the single-device forward uses, so parity
+(tests/test_pp_vit.py) is exact — the family has no dropout, hence no
+mask-geometry caveat.  Under ``cfg.bf16`` the stage boundary travels at
+bfloat16 (the engine discovers the activation aval via ``eval_shape``).
+
+With tp_vit/sp3/ep, this completes the ViT family's parallelism matrix:
+dp (vit_mnist.py default over the data axis), tp, sp, pp, ep.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.vit import (
+    ViTConfig,
+    apply_block,
+    dense,
+    layer_norm,
+    patchify,
+    tokens_to_logp,
+)
+from ..ops.adadelta import adadelta_update
+from ..ops.attention import full_attention
+from ..ops.loss import nll_loss
+from .ddp import TrainState
+from .mesh import DATA_AXIS
+from .pipeline import NUM_STAGES, STAGE_AXIS, make_pipeline_loss
+
+
+def _check_depth(cfg: ViTConfig) -> int:
+    if cfg.depth < NUM_STAGES:
+        raise ValueError(
+            f"pipeline needs depth >= {NUM_STAGES} blocks, got {cfg.depth}"
+        )
+    return cfg.depth // NUM_STAGES
+
+
+def _stage0_fwd(params: dict, x: jax.Array, cfg: ViTConfig, split: int):
+    """embed + the first ``split`` blocks: [mb, 28, 28, 1] ->
+    [mb, tokens, dim] (bf16 under cfg.bf16 — the boundary dtype)."""
+    dt = jnp.bfloat16 if cfg.bf16 else x.dtype
+    patches = patchify(x, cfg).astype(dt)
+    tokens = dense(patches, params["embed"]) + params["pos_embed"].astype(dt)
+    for i in range(split):
+        tokens = apply_block(
+            params["blocks"][str(i)], tokens, cfg, full_attention
+        )
+    return tokens
+
+
+def _stage1_loss_sum(
+    params: dict, tokens: jax.Array, y: jax.Array, w: jax.Array,
+    cfg: ViTConfig, split: int,
+) -> jax.Array:
+    """Remaining blocks + LN + pool + head + weighted NLL SUM."""
+    for i in range(split, cfg.depth):
+        tokens = apply_block(
+            params["blocks"][str(i)], tokens, cfg, full_attention
+        )
+    tokens = layer_norm(tokens, params["ln_f"])
+    pooled = tokens.astype(jnp.float32).mean(axis=1)
+    logp = tokens_to_logp(params, pooled)
+    return nll_loss(logp, y, w, reduction="sum")
+
+
+def make_vit_pp_train_step(
+    mesh: Mesh,
+    cfg: ViTConfig,
+    num_micro: int = 2,
+    rho: float = 0.9,
+    eps: float = 1e-6,
+):
+    """Build the jitted (data x stage) pipelined ViT train step.
+
+    ``step_fn(state, x, y, w, lr) -> (state, losses)`` with ``state``
+    fully replicated, ``x/y/w`` sharded over ``data``, ``losses`` one
+    local mean loss per data shard (the vit_mnist.py step signature).
+    """
+    if mesh.shape[STAGE_AXIS] != NUM_STAGES:
+        raise ValueError(
+            f"pipeline needs a {NUM_STAGES}-wide '{STAGE_AXIS}' axis, got "
+            f"{mesh.shape[STAGE_AXIS]}"
+        )
+    split = _check_depth(cfg)
+
+    def stage0(params, x_mb, key, j):
+        return _stage0_fwd(params, x_mb, cfg, split)
+
+    def stage1(params, act, y_mb, w_mb, key, j):
+        return _stage1_loss_sum(params, act, y_mb, w_mb, cfg, split)
+
+    pipeline_loss = make_pipeline_loss(stage0, stage1, num_micro)
+
+    def local_step(state: TrainState, x, y, w, lr):
+        n = x.shape[0]
+        if n % num_micro:
+            raise ValueError(
+                f"shard batch {n} not divisible by {num_micro} microbatches"
+            )
+        mb = n // num_micro
+        x_mbs = x.reshape(num_micro, mb, *x.shape[1:])
+        y_mbs = y.reshape(num_micro, mb)
+        w_mbs = w.reshape(num_micro, mb)
+        denom = jnp.maximum(w.sum(), 1.0)
+        # The ViT has no dropout; the engine's key slot is a dummy.
+        key = jax.random.PRNGKey(0)
+
+        def loss_fn(params):
+            return pipeline_loss(params, x_mbs, y_mbs, w_mbs, key) / denom
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        params, opt = adadelta_update(
+            state.params, grads, state.opt, lr, rho, eps
+        )
+        return TrainState(params, opt, state.step + 1), loss[None]
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(P(), P(DATA_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_vit_eval_step(mesh: Mesh, cfg: ViTConfig):
+    """Jitted data-parallel ViT eval step for any mesh with a ``data``
+    axis (params replicated — the --pp eval path, mirroring the CNN's
+    make_eval_step-under-pp): single-device forward on the local data
+    shard + the psum'd (loss_sum, correct) totals every eval path shares.
+    """
+    from ..models.vit import vit_forward
+
+    def local_eval(params, x, y, w):
+        logp = vit_forward(params, x, cfg)
+        loss_sum = nll_loss(logp, y, w, reduction="sum")
+        correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
+        return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
+
+    sharded = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

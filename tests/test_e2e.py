@@ -209,6 +209,7 @@ def test_cli_dry_run_subprocess(tmp_path, script, extra):
     ["--sp", "4"],       # ring-attention sequence parallel (2 data x 4 seq)
     ["--tp", "4"],       # Megatron head/MLP sharding (2 data x 4 model)
     ["--sp", "2", "--tp", "2"],  # 3-D (2 data x 2 seq x 2 model)
+    ["--pp"],            # 2-stage block pipeline (4 data x 2 stage)
     ["--experts", "8"],  # expert-parallel switch-MoE over 8 devices
 ])
 def test_vit_cli_dry_run_subprocess(tmp_path, extra):

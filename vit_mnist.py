@@ -11,12 +11,13 @@ parity CLIs, and exposes the long-context/distributed modes:
   python vit_mnist.py --tp 4                     # Megatron head/MLP sharding
                                                  # over (data, model)
   python vit_mnist.py --sp 2 --tp 2              # 3-D (data, seq, model)
+  python vit_mnist.py --pp                       # 2-stage block pipeline
   python vit_mnist.py --experts 8                # switch-MoE with expert
                                                  # parallelism (all_to_all)
 
-``--sp`` / ``--tp`` / ``--experts`` are library parallel modes
-(parallel/sp.py, tp_vit.py, sp3.py, ep.py) — all shard over every visible
-device; the data axis absorbs whatever the minor axes don't use.
+``--sp`` / ``--tp`` / ``--pp`` / ``--experts`` are library parallel modes
+(parallel/sp.py, tp_vit.py, sp3.py, pp_vit.py, ep.py) — all shard over
+every visible device; the data axis absorbs what the minor axes don't use.
 """
 
 from __future__ import annotations
@@ -47,10 +48,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tensor-parallel degree: Megatron-style head/MLP "
                         "sharding over an M-way model axis "
                         "(parallel/tp_vit.py); composes with --sp")
+    p.add_argument("--pp", action="store_true", default=False,
+                   help="pipeline the transformer blocks across 2 stages "
+                        "(parallel/pp_vit.py: microbatched ppermute "
+                        "schedule); mutually exclusive with --sp/--tp")
+    p.add_argument("--pp-microbatches", type=int, default=2, metavar="M",
+                   help="microbatches per shard batch in --pp mode")
     p.add_argument("--experts", type=int, default=0, metavar="E",
                    help="switch-MoE with E experts, expert-parallel over "
                         "the data axis (models/moe.py + parallel/ep.py); "
-                        "mutually exclusive with --sp/--tp")
+                        "mutually exclusive with --sp/--tp/--pp")
     p.add_argument("--depth", type=int, default=2, metavar="N",
                    help="transformer blocks (default: 2)")
     p.add_argument("--dim", type=int, default=64, metavar="D",
@@ -69,8 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main() -> None:
     args = build_parser().parse_args()
-    if args.experts > 0 and (args.sp > 1 or args.tp > 1):
-        raise SystemExit("--experts is mutually exclusive with --sp/--tp")
+    if args.experts > 0 and (args.sp > 1 or args.tp > 1 or args.pp):
+        raise SystemExit("--experts is mutually exclusive with --sp/--tp/--pp")
+    if args.pp and (args.sp > 1 or args.tp > 1):
+        raise SystemExit("--pp is mutually exclusive with --sp/--tp")
 
     import jax
 
@@ -150,6 +159,18 @@ def main() -> None:
         state = shard_vit_tp_state(make_train_state(params), mesh, cfg)
         train_step = make_vit_tp_train_step(mesh, cfg)
         eval_step = make_vit_tp_eval_step(mesh, cfg)
+    elif args.pp:
+        from pytorch_mnist_ddp_tpu.parallel.pp_vit import (
+            make_vit_eval_step,
+            make_vit_pp_train_step,
+        )
+
+        mesh = make_mesh(num_data=None, num_model=2)
+        state = replicate_params(make_train_state(params), mesh)
+        train_step = make_vit_pp_train_step(
+            mesh, cfg, num_micro=args.pp_microbatches
+        )
+        eval_step = make_vit_eval_step(mesh, cfg)
     elif args.sp > 1:
         from pytorch_mnist_ddp_tpu.parallel.sp import (
             make_sp_eval_step,
