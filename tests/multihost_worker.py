@@ -43,6 +43,71 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 
+def _vit3d_world(dist, data_root: str, out_path: str) -> None:
+    """The ViT 3-D leg: a (2 data x 2 seq x 2 model) mesh spanning both
+    processes.  Every collective kind crosses the process boundary — the
+    k/v ppermute ring (seq), the row-parallel psums (model), the pool
+    psum (seq), the VMA grad psums (all axes) — and the model-sharded
+    TrainState goes through place_tree's multi-controller
+    ``make_array_from_callback`` path.  Dumps the gathered params + the
+    psum'd eval totals for the parent's bit-identity cross-check."""
+    import jax.numpy as jnp
+
+    from pytorch_mnist_ddp_tpu.data.loader import DataLoader
+    from pytorch_mnist_ddp_tpu.data.mnist import MNIST
+    from pytorch_mnist_ddp_tpu.models.vit import ViTConfig, init_vit_params
+    from pytorch_mnist_ddp_tpu.parallel.ddp import make_train_state
+    from pytorch_mnist_ddp_tpu.parallel.sp3 import (
+        make_3d_mesh,
+        make_sp3_eval_step,
+        make_sp3_train_step,
+        shard_sp3_state,
+    )
+    from pytorch_mnist_ddp_tpu.parallel.tp import gather_replicated
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import _flatten_raw
+
+    cfg = ViTConfig()
+    mesh = make_3d_mesh(num_data=2, num_seq=2, num_model=2,
+                        devices=jax.devices())
+    params = init_vit_params(jax.random.PRNGKey(1), cfg)
+    state = shard_sp3_state(make_train_state(params), mesh, cfg)
+    step = make_sp3_train_step(mesh, cfg)
+    eval_step = make_sp3_eval_step(mesh, cfg)
+
+    train_set = MNIST(root=data_root, train=True)
+    loader = DataLoader(
+        train_set.images, train_set.labels, 16, mesh=mesh, shuffle=True,
+        seed=1, process_rank=dist.process_rank,
+        process_count=dist.process_count,
+    )
+    losses = None
+    for epoch in range(1, 3):
+        for x, y, w in loader.epoch(epoch):
+            state, losses = step(state, x, y, w, jnp.float32(1.0))
+    assert losses is not None
+
+    test_set = MNIST(root=data_root, train=False)
+    test_loader = DataLoader(
+        test_set.images, test_set.labels, 16, mesh=mesh, shuffle=False,
+        process_rank=dist.process_rank, process_count=dist.process_count,
+        mask_padding=True,
+    )
+    totals = np.zeros(2)
+    for x, y, w in test_loader.epoch(0):
+        totals += np.asarray(eval_step(state.params, x, y, w))
+
+    host = jax.tree.map(
+        np.asarray, jax.device_get(gather_replicated(state.params, mesh))
+    )
+    np.savez(
+        out_path,
+        avg_loss=np.float64(totals[0] / len(test_set.images)),
+        correct=np.int64(totals[1]),
+        **_flatten_raw(host),
+    )
+    print(f"worker rank {dist.process_rank} done", flush=True)
+
+
 def main() -> None:
     data_root, out_path, mode = sys.argv[1], sys.argv[2], sys.argv[3]
 
@@ -53,6 +118,10 @@ def main() -> None:
     dist = init_distributed_mode()
     assert dist.distributed and dist.process_count == 2, dist
     assert dist.world_size == 8, dist
+
+    if mode == "vit3d":
+        _vit3d_world(dist, data_root, out_path)
+        return
 
     import os
 

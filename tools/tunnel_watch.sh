@@ -54,20 +54,23 @@ is_warm() { # $1 = tag; true if that run's JSON recorded a warm cache
     grep -q '"cache": "warm"' "$OUT/bench_r3_$1.json" 2>/dev/null
 }
 
-promote_warm() { # $1 = tag; copy to the warm record ONLY if it beats it.
+promote() { # $1 = src tag, $2 = dst tag; copy ONLY if src beats dst.
     # The tunnel's throughput is bimodal (observed 9.3 s and 61.8 s for
-    # the same warm program minutes apart); promoting the latest run let a
-    # slow-mode run clobber the best record, so promotion is min-by-value.
-    python - "$OUT/bench_r3_$1.json" "$OUT/bench_r3_warm.json" <<'EOF'
-import json, shutil, sys
+    # the same warm program minutes apart); latest-wins writes let a
+    # slow-mode run clobber a best record, so every recorded row is
+    # min-by-value.  The .err sidecar travels with its json.
+    python - "$OUT/bench_r3_$1" "$OUT/bench_r3_$2" <<'EOF'
+import json, os, shutil, sys
 src, dst = sys.argv[1], sys.argv[2]
-new = json.load(open(src))["value"]
+new = json.load(open(src + ".json"))["value"]
 try:
-    old = json.load(open(dst))["value"]
+    old = json.load(open(dst + ".json"))["value"]
 except Exception:
     old = None
 if old is None or (new is not None and new < old):
-    shutil.copy(src, dst)
+    shutil.copy(src + ".json", dst + ".json")
+    if os.path.exists(src + ".err"):
+        shutil.copy(src + ".err", dst + ".err")
     print(f"promoted {new} (previous {old})")
 else:
     print(f"kept {old} (new run {new} is slower)")
@@ -84,16 +87,23 @@ while true; do
         # already warm.  Promote it and spend the remaining window on the
         # variant rows instead of burning ~40 s re-measuring.
         if is_warm warmup; then
-            echo "[$(stamp)] warmup ran warm — $(promote_warm warmup)"
+            echo "[$(stamp)] warmup ran warm — $(promote warmup warm)"
         else
-            run_bench warm || { sleep "$POLL_S"; continue; }
+            # Cold first run: bench again (now warm) to a SCRATCH tag and
+            # min-promote — a direct write here could let a slow-mode run
+            # clobber the standing warm record.
+            run_bench warm_run || { sleep "$POLL_S"; continue; }
+            if is_warm warm_run; then
+                echo "[$(stamp)] $(promote warm_run warm)"
+            fi
         fi
-        # Variant rows only after the headline record is safe.
-        run_bench bf16 --bf16 || true
-        run_bench syncbn --syncbn || true
+        # Variant rows only after the headline record is safe; each row is
+        # min-by-value too (scratch tag then promote).
+        run_bench bf16_run --bf16 && echo "[$(stamp)] bf16: $(promote bf16_run bf16)"
+        run_bench syncbn_run --syncbn && echo "[$(stamp)] syncbn: $(promote syncbn_run syncbn)"
         # Pallas-kernel decision data (verdict item 7): full-run row with
         # the flat-state kernel, plus the optimizer-only micro-benchmark.
-        run_bench pallas --pallas-opt || true
+        run_bench pallas_run --pallas-opt && echo "[$(stamp)] pallas: $(promote pallas_run pallas)"
         echo "[$(stamp)] pallas micro-bench"
         python "$REPO/tools/pallas_opt_bench.py" \
             >"$OUT/bench_r3_pallas_micro.json" 2>"$OUT/bench_r3_pallas_micro.err" \
