@@ -61,34 +61,27 @@ def device_put_dataset(images, labels, mesh: Mesh):
     )
 
 
-def _local_epoch_builder(
-    model: Net,
+def _epoch_scan_builder(
     dataset_size: int,
     global_batch: int,
     n_shards: int,
     compute_dtype,
-    rho: float,
-    eps: float,
-    dropout: bool,
-    use_pallas: bool | None,
-    use_bn: bool = False,
+    step_fn,
 ):
-    """Shared body for the per-epoch and whole-run fusions: returns
-    ``local_epoch(state, images, labels, epoch, shuffle_key, dropout_key,
-    lr) -> (state, losses[num_batches])`` (per-shard, to be run inside
-    ``shard_map``) plus ``num_batches``.
-
-    ``use_bn``: the scan carry's ``state.batch_stats`` threads the BN
-    running averages through every step; batch statistics psum over the
-    data axis inside the forward and the wrap-filler rows (weight 0) are
-    mask-excluded, exactly like the per-batch step (parallel/ddp.py)."""
+    """The family-agnostic fused-epoch skeleton: epoch-seeded permutation
+    with wrap-fill masking, per-shard batch slicing + on-device normalize,
+    one ``lax.scan`` over the steps.  ``step_fn(state, x, y, w, shard,
+    dropout_key, lr) -> (state, loss)`` is the family-specific body
+    (forward + grads + update); fused_vit.py injects the ViT's.  Shared so
+    the sampling/masking semantics cannot diverge between families.
+    Returns ``(local_epoch, num_batches)``."""
     if global_batch % n_shards:
         raise ValueError(f"global batch {global_batch} not divisible by mesh")
     shard_batch = global_batch // n_shards
     num_batches = -(-dataset_size // global_batch)
     padded = num_batches * global_batch
 
-    def local_epoch(state: TrainState, images, labels, epoch, shuffle_key, dropout_key, lr):
+    def local_epoch(state, images, labels, epoch, shuffle_key, dropout_key, lr):
         # Epoch-seeded permutation; wrap to fill the final batch, with the
         # wrapped filler masked out (weight 0) like the host loader's
         # final-batch padding.
@@ -100,7 +93,7 @@ def _local_epoch_builder(
         valid = (positions < dataset_size).astype(jnp.float32)
         shard = jax.lax.axis_index(DATA_AXIS)
 
-        def one_step(state: TrainState, batch):
+        def one_step(state, batch):
             step_perm, step_valid = batch  # [global_batch] each
             idx = jax.lax.dynamic_slice_in_dim(
                 step_perm, shard * shard_batch, shard_batch
@@ -110,33 +103,7 @@ def _local_epoch_builder(
             )
             x = _normalize_dev(jnp.take(images, idx, axis=0), compute_dtype)
             y = jnp.take(labels, idx, axis=0)
-            key = jax.random.fold_in(dropout_key, state.step)
-            key = jax.random.fold_in(key, shard)
-
-            def loss_fn(params):
-                if use_bn:
-                    logp, mutated = model.apply(
-                        {"params": params, "batch_stats": state.batch_stats},
-                        x, train=True, dropout=dropout, mask=w,
-                        rngs={"dropout": key}, mutable=["batch_stats"],
-                    )
-                    new_stats = mutated["batch_stats"]
-                else:
-                    logp = model.apply(
-                        {"params": params}, x, train=dropout,
-                        rngs={"dropout": key},
-                    )
-                    new_stats = state.batch_stats
-                return nll_loss(logp, y, w, reduction="mean"), new_stats
-
-            (loss, new_stats), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(state.params)
-            grads = jax.lax.pmean(grads, DATA_AXIS)
-            params, opt = adadelta_update_best(
-                state.params, grads, state.opt, lr, rho, eps, use_pallas=use_pallas
-            )
-            return TrainState(params, opt, state.step + 1, new_stats), loss
+            return step_fn(state, x, y, w, shard, dropout_key, lr)
 
         state, losses = jax.lax.scan(
             one_step,
@@ -149,6 +116,62 @@ def _local_epoch_builder(
         return state, losses
 
     return local_epoch, num_batches
+
+
+def _local_epoch_builder(
+    model: Net,
+    dataset_size: int,
+    global_batch: int,
+    n_shards: int,
+    compute_dtype,
+    rho: float,
+    eps: float,
+    dropout: bool,
+    use_pallas: bool | None,
+    use_bn: bool = False,
+):
+    """The CNN family's fused-epoch body on the shared skeleton: returns
+    ``local_epoch(state, images, labels, epoch, shuffle_key, dropout_key,
+    lr) -> (state, losses[num_batches])`` (per-shard, to be run inside
+    ``shard_map``) plus ``num_batches``.
+
+    ``use_bn``: the scan carry's ``state.batch_stats`` threads the BN
+    running averages through every step; batch statistics psum over the
+    data axis inside the forward and the wrap-filler rows (weight 0) are
+    mask-excluded, exactly like the per-batch step (parallel/ddp.py)."""
+
+    def step_fn(state: TrainState, x, y, w, shard, dropout_key, lr):
+        key = jax.random.fold_in(dropout_key, state.step)
+        key = jax.random.fold_in(key, shard)
+
+        def loss_fn(params):
+            if use_bn:
+                logp, mutated = model.apply(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    x, train=True, dropout=dropout, mask=w,
+                    rngs={"dropout": key}, mutable=["batch_stats"],
+                )
+                new_stats = mutated["batch_stats"]
+            else:
+                logp = model.apply(
+                    {"params": params}, x, train=dropout,
+                    rngs={"dropout": key},
+                )
+                new_stats = state.batch_stats
+            return nll_loss(logp, y, w, reduction="mean"), new_stats
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        params, opt = adadelta_update_best(
+            state.params, grads, state.opt, lr, rho, eps, use_pallas=use_pallas
+        )
+        return TrainState(params, opt, state.step + 1, new_stats), loss
+
+    return _epoch_scan_builder(
+        dataset_size, global_batch, n_shards, compute_dtype, step_fn
+    )
 
 
 def make_fused_train_epoch(
@@ -190,18 +213,18 @@ def make_fused_train_epoch(
     return jax.jit(sharded, donate_argnums=(0,)), num_batches
 
 
-def _local_eval_builder(
-    model: Net,
+def _eval_scan_builder(
     dataset_size: int,
     global_batch: int,
     n_shards: int,
     compute_dtype,
-    use_bn: bool = False,
+    predict,
 ):
-    """Shared eval body: returns ``local_eval(params, images, labels) ->
-    psum'd [loss_sum, correct]`` to be run inside ``shard_map``.  With
-    ``use_bn``, ``params`` is the full variable dict (running averages
-    normalize, torch ``model.eval()`` semantics)."""
+    """The family-agnostic fused-eval skeleton: scan over wrap-padded
+    batches accumulating masked (loss_sum, correct), one psum at the end.
+    ``predict(params, x) -> logp`` is the family-specific forward;
+    fused_vit.py injects the ViT's.  Returns ``local_eval(params, images,
+    labels) -> psum'd [loss_sum, correct]`` for use inside shard_map."""
     if global_batch % n_shards:
         raise ValueError(f"global batch {global_batch} not divisible by mesh")
     shard_batch = global_batch // n_shards
@@ -212,7 +235,6 @@ def _local_eval_builder(
         idx = jnp.arange(padded) % dataset_size  # wrap; wrapped tail masked below
         valid = (jnp.arange(padded) < dataset_size).astype(jnp.float32)
         shard = jax.lax.axis_index(DATA_AXIS)
-        variables_of = (lambda p: p) if use_bn else (lambda p: {"params": p})
 
         def one_batch(carry, batch):
             loss_sum, correct = carry
@@ -221,7 +243,7 @@ def _local_eval_builder(
             v = jax.lax.dynamic_slice_in_dim(b_valid, shard * shard_batch, shard_batch)
             x = _normalize_dev(jnp.take(images, i, axis=0), compute_dtype)
             y = jnp.take(labels, i, axis=0)
-            logp = model.apply(variables_of(params), x, train=False)
+            logp = predict(params, x)
             loss_sum += nll_loss(logp, y, v, reduction="sum")
             correct += ((jnp.argmax(logp, axis=1) == y) * v).sum()
             return (loss_sum, correct), None
@@ -237,6 +259,27 @@ def _local_eval_builder(
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
 
     return local_eval
+
+
+def _local_eval_builder(
+    model: Net,
+    dataset_size: int,
+    global_batch: int,
+    n_shards: int,
+    compute_dtype,
+    use_bn: bool = False,
+):
+    """The CNN family's fused-eval body on the shared skeleton.  With
+    ``use_bn``, ``params`` is the full variable dict (running averages
+    normalize, torch ``model.eval()`` semantics)."""
+    variables_of = (lambda p: p) if use_bn else (lambda p: {"params": p})
+
+    def predict(params, x):
+        return model.apply(variables_of(params), x, train=False)
+
+    return _eval_scan_builder(
+        dataset_size, global_batch, n_shards, compute_dtype, predict
+    )
 
 
 def make_fused_eval(

@@ -1,0 +1,104 @@
+"""Fused device-resident whole-run for the ViT family.
+
+parallel/fused.py gives the CNN family the TPU-first fast path: dataset
+resident in HBM, every epoch a ``lax.scan``, the whole run ONE jitted
+device call (one compile, one dispatch+sync — the property that beats the
+per-step host round trip by ~20x through a high-RTT tunnel, see the
+README bench table and `bench_r3_stepstats.log`).  This module is the
+same shape for the attention family, built on fused.py's shared epoch and
+eval scan skeletons (`_epoch_scan_builder` / `_eval_scan_builder`) — the
+permutation, wrap-fill masking, and batch-slicing semantics are shared BY
+CONSTRUCTION; only the step body (ViT forward + Adadelta, no BN, no
+dropout, no Pallas-flat state) and the whole-run epoch scan live here.
+Parity with the per-batch ViT step is pinned by tests/test_fused_vit.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.vit import ViTConfig, vit_forward
+from ..ops.adadelta import adadelta_update
+from ..ops.loss import nll_loss
+from .ddp import TrainState
+from .fused import (  # shared staging + scan skeletons
+    _epoch_scan_builder,
+    _eval_scan_builder,
+    device_put_dataset,
+)
+from .mesh import DATA_AXIS
+
+__all__ = ["device_put_dataset", "make_fused_vit_run"]
+
+
+def make_fused_vit_run(
+    mesh: Mesh,
+    cfg: ViTConfig,
+    train_size: int,
+    test_size: int,
+    global_batch: int,
+    eval_batch: int,
+    epochs: int,
+    rho: float = 0.9,
+    eps: float = 1e-6,
+    start_epoch: int = 1,
+):
+    """Build the whole-run fusion for the ViT.
+
+    Returns ``(run_fn, num_batches)`` with ``run_fn(state, tr_x, tr_y,
+    te_x, te_y, shuffle_key, lrs) -> (state, losses[epochs, num_batches,
+    n_shards], evals[epochs, 2])`` — the fused.make_fused_run contract
+    minus the dropout key (the family has none).  ``state`` is a
+    replicated ddp.TrainState over ViT params.
+    """
+    n_shards = mesh.shape[DATA_AXIS]
+
+    def step_fn(state: TrainState, x, y, w, shard, dropout_key, lr):
+        def loss_fn(params):
+            logp = vit_forward(params, x, cfg)
+            return nll_loss(logp, y, w, reduction="mean")
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        params, opt = adadelta_update(
+            state.params, grads, state.opt, lr, rho, eps
+        )
+        return TrainState(params, opt, state.step + 1), loss
+
+    local_epoch, num_batches = _epoch_scan_builder(
+        train_size, global_batch, n_shards, jnp.float32, step_fn
+    )
+    local_eval = _eval_scan_builder(
+        test_size, eval_batch, n_shards, jnp.float32,
+        lambda params, x: vit_forward(params, x, cfg),
+    )
+
+    def local_run(state, tr_x, tr_y, te_x, te_y, shuffle_key, lrs):
+        def one_epoch(state, epoch_and_lr):
+            epoch, lr = epoch_and_lr
+            # The skeleton's dropout_key slot is unused by the ViT body.
+            state, losses = local_epoch(
+                state, tr_x, tr_y, epoch, shuffle_key, shuffle_key, lr
+            )
+            totals = local_eval(state.params, te_x, te_y)
+            return state, (losses, totals)
+
+        state, (losses, evals) = jax.lax.scan(
+            one_epoch, state,
+            (jnp.arange(start_epoch, start_epoch + epochs), lrs),
+        )
+        # all_gather the per-shard loss traces (fully-replicated output —
+        # every process reads locally, no chief-only collective).
+        gathered = jax.lax.all_gather(losses, DATA_AXIS)  # [shards, E, B]
+        return state, jnp.moveaxis(gathered, 0, -1), evals
+
+    sharded = jax.shard_map(
+        local_run,
+        mesh=mesh,
+        in_specs=(P(),) * 7,
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,)), num_batches

@@ -324,3 +324,29 @@ def test_launcher_cpu_virtual_devices(tmp_path):
     assert proc.returncode == 0, proc.stderr
     assert "| distributed init (rank 0): env://, local rank:0, world size:4" in proc.stdout
     assert "Train Epoch: 1 [0/512 (0%)]" in proc.stdout
+
+
+def test_vit_cli_fused_subprocess(tmp_path):
+    """vit_mnist.py --fused end-to-end: the whole-run fusion compiles on
+    the 8-virtual-device world, the printed formats match the per-batch
+    path (per-epoch log lines reconstructed from the returned loss
+    traces), and --save-model writes a loadable archive."""
+    import os
+    root = _write_idx(tmp_path)
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MNIST_DATA_DIR"] = root
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "vit_mnist.py"), "--fused",
+         "--epochs", "2", "--batch-size", "8", "--test-batch-size", "16",
+         "--save-model"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "Train Epoch: 1 [0/512 (0%)]" in proc.stdout
+    assert "Train Epoch: 2 [0/512 (0%)]" in proc.stdout
+    assert proc.stdout.count("Test set: Average loss:") == 2
+    assert "Total cost time:" in proc.stdout
+    assert (tmp_path / "vit_mnist.npz").exists()

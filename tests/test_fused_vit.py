@@ -1,0 +1,146 @@
+"""Fused ViT whole-run (parallel/fused_vit.py) vs the per-batch oracle.
+
+Same strategy as tests/test_fused.py for the CNN: reproduce the fused
+path's device-side epoch permutation on the host, drive the plain
+single-device ViT recurrence with the same batches, and require matching
+losses/params — the family has no dropout, so nothing needs to be
+switched off.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_mnist_ddp_tpu.data.transforms import normalize
+from pytorch_mnist_ddp_tpu.models.vit import (
+    ViTConfig,
+    init_vit_params,
+    vit_forward,
+)
+from pytorch_mnist_ddp_tpu.parallel.ddp import (
+    make_train_state,
+    replicate_params,
+)
+from pytorch_mnist_ddp_tpu.parallel.fused_vit import (
+    device_put_dataset,
+    make_fused_vit_run,
+)
+from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+
+CFG = ViTConfig()
+
+
+def _dataset(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        rng.randint(0, 256, (n, 28, 28), dtype=np.uint8),
+        rng.randint(0, 10, n).astype(np.int64),
+    )
+
+
+def test_fused_vit_run_matches_per_batch(devices):
+    """Two fused epochs == the host-driven per-batch recurrence on the
+    reproduced permutation: per-step losses, eval totals, final params."""
+    from pytorch_mnist_ddp_tpu.ops.adadelta import (
+        adadelta_init,
+        adadelta_update,
+    )
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+
+    mesh = make_mesh()
+    images, labels = _dataset(64)
+    te_images, te_labels = _dataset(48, seed=1)
+    tr = device_put_dataset(images, labels, mesh)
+    te = device_put_dataset(te_images, te_labels, mesh)
+
+    run_fn, num_batches = make_fused_vit_run(
+        mesh, CFG, 64, 48, global_batch=32, eval_batch=16, epochs=2
+    )
+    assert num_batches == 2
+    state = replicate_params(
+        make_train_state(init_vit_params(jax.random.PRNGKey(0), CFG)), mesh
+    )
+    shuffle_key = jax.random.PRNGKey(5)
+    lrs = jnp.asarray([1.0, 0.7], jnp.float32)
+    state, losses, evals = run_fn(state, *tr, *te, shuffle_key, lrs)
+    assert losses.shape == (2, 2, 8)
+    assert evals.shape == (2, 2)
+
+    # Host-driven oracle on the SAME permutation stream.
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    opt = adadelta_init(params)
+
+    @jax.jit
+    def step(params, opt, x, y, lr):
+        def loss_fn(p):
+            return nll_loss(
+                vit_forward(p, x, CFG), y, jnp.ones(y.shape[0]),
+                reduction="mean",
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt = adadelta_update(params, grads, opt, lr, 0.9, 1e-6)
+        return params, opt, loss
+
+    expect_losses = []
+    for e, lr in ((1, 1.0), (2, 0.7)):
+        perm = np.asarray(
+            jax.random.permutation(jax.random.fold_in(shuffle_key, e), 64)
+        )
+        for b in range(2):
+            take = perm[b * 32 : (b + 1) * 32]
+            xb = jnp.asarray(normalize(images[take]))
+            yb = jnp.asarray(labels[take].astype(np.int32))
+            params, opt, loss = step(params, opt, xb, yb, jnp.float32(lr))
+            expect_losses.append(float(loss))
+
+    # losses are per-shard LOCAL means (the reference's logging semantic);
+    # their average over equal-size all-valid shards is the global mean
+    # the single-device oracle computes.
+    np.testing.assert_allclose(
+        np.asarray(losses).mean(axis=2).reshape(-1), expect_losses, rtol=1e-4
+    )
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-5
+        )
+    # Eval totals after the final epoch match the oracle's forward.
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss as nll
+
+    logp = vit_forward(params, jnp.asarray(normalize(te_images)), CFG)
+    y = jnp.asarray(te_labels.astype(np.int32))
+    np.testing.assert_allclose(
+        float(evals[-1, 0]),
+        float(nll(logp, y, jnp.ones(48), reduction="sum")),
+        rtol=1e-4,
+    )
+    assert int(evals[-1, 1]) == int((jnp.argmax(logp, axis=1) == y).sum())
+
+
+def test_fused_vit_masks_partial_batches(devices):
+    """Non-divisible train and test sizes: wrapped filler rows carry
+    weight 0 and the eval totals count every real sample exactly once."""
+    mesh = make_mesh()
+    images, labels = _dataset(50)  # 50 % 32 != 0
+    te_images, te_labels = _dataset(21, seed=2)  # 21 % 16 != 0
+    tr = device_put_dataset(images, labels, mesh)
+    te = device_put_dataset(te_images, te_labels, mesh)
+
+    run_fn, num_batches = make_fused_vit_run(
+        mesh, CFG, 50, 21, global_batch=32, eval_batch=16, epochs=1
+    )
+    assert num_batches == 2
+    state = replicate_params(
+        make_train_state(init_vit_params(jax.random.PRNGKey(0), CFG)), mesh
+    )
+    state, losses, evals = run_fn(
+        state, *tr, *te, jax.random.PRNGKey(5),
+        jnp.asarray([1.0], jnp.float32),
+    )
+    logp = vit_forward(
+        jax.tree.map(np.asarray, jax.device_get(state.params)),
+        jnp.asarray(normalize(te_images)), CFG,
+    )
+    y = jnp.asarray(te_labels.astype(np.int32))
+    assert int(evals[0, 1]) == int((jnp.argmax(logp, axis=1) == y).sum())
+    assert 0 <= int(evals[0, 1]) <= 21

@@ -65,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bf16", action="store_true", default=False,
                    help="bfloat16 activations/matmuls (params, routing, "
                         "attention accumulation, and log_softmax stay fp32)")
+    p.add_argument("--fused", action="store_true", default=False,
+                   help="whole-run fusion: HBM-resident dataset, every "
+                        "epoch a device-side scan, ONE jitted call for "
+                        "the entire run (parallel/fused_vit.py); "
+                        "data-parallel only")
     p.add_argument("--save-model", action="store_true", default=False,
                    help="save the final params to vit_mnist.npz "
                         "(utils.checkpoint.save_params_tree)")
@@ -134,6 +139,59 @@ def main() -> None:
             return got.astype(init.dtype)
 
         params = jax.tree.map(_check, params, loaded)
+
+    # Whole-run fusion: like the CNN CLI, --dry-run (a per-batch smoke
+    # semantics) silently falls back to the per-batch path.
+    fused = args.fused and not args.dry_run
+    if args.fused and (args.sp > 1 or args.tp > 1 or args.pp or args.experts > 0):
+        raise SystemExit(
+            "--fused is the data-parallel whole-run; drop --sp/--tp/--pp/"
+            "--experts"
+        )
+    if fused:
+        from pytorch_mnist_ddp_tpu.parallel.fused_vit import (
+            device_put_dataset,
+            make_fused_vit_run,
+        )
+
+        mesh = make_mesh(num_model=1)
+        n_shards = mesh.shape["data"]
+        state = replicate_params(make_train_state(params), mesh)
+        tr_x, tr_y = load_mnist_arrays(args.data_root, "train")
+        te_x, te_y = load_mnist_arrays(args.data_root, "test", download=False)
+        tr_dev = device_put_dataset(tr_x, tr_y, mesh)
+        te_dev = device_put_dataset(te_x, te_y, mesh)
+        global_batch = args.batch_size * n_shards
+        eval_batch = args.test_batch_size * n_shards
+        run_fn, num_batches = make_fused_vit_run(
+            mesh, cfg, len(tr_x), len(te_x), global_batch, eval_batch,
+            args.epochs,
+        )
+        lr_for_epoch = step_lr(args.lr, args.gamma)
+        lrs = jnp.asarray(
+            [lr_for_epoch(e) for e in range(1, args.epochs + 1)], jnp.float32
+        )
+        state, losses, evals = run_fn(
+            state, *tr_dev, *te_dev, jax.random.PRNGKey(args.seed), lrs
+        )
+        losses, evals = np.asarray(losses), np.asarray(evals)
+        for e in range(args.epochs):
+            for b in range(0, num_batches, args.log_interval):
+                print(train_log_line(
+                    e + 1, b * global_batch, len(tr_x), b, num_batches,
+                    float(losses[e, b, 0]),
+                ))
+            print(test_summary_lines(
+                float(evals[e, 0]) / len(te_x), int(evals[e, 1]), len(te_x)
+            ))
+        if args.save_model:
+            from pytorch_mnist_ddp_tpu.utils.checkpoint import save_params_tree
+
+            save_params_tree(
+                jax.device_get(state.params), "vit_mnist.npz"
+            )
+        print(total_time_line(time.time() - start))
+        return
 
     if args.sp > 1 and args.tp > 1:
         from pytorch_mnist_ddp_tpu.parallel.sp3 import (
