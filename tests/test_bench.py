@@ -163,3 +163,32 @@ def test_bench_program_hash_tool():
         outs.append(proc.stdout.strip())
     assert len(outs[0]) == 64 and set(outs[0]) <= set("0123456789abcdef")
     assert outs[0] == outs[1], "hash not deterministic"
+
+
+@pytest.mark.slow  # subprocess fused run on CPU (~1 min)
+def test_vit_bench_tool_cpu_smoke():
+    """tools/vit_bench.py end-to-end on CPU with tiny settings: emits one
+    JSON line honoring the contract the watcher's promotion logic and the
+    round artifacts rely on."""
+    import subprocess
+
+    from conftest import cpu_subprocess_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "vit_bench.py"),
+         "--epochs", "1", "--batch-size", "500", "--timeout", "240"],
+        capture_output=True, text=True, env=cpu_subprocess_env(),
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    row = json.loads(proc.stdout.strip())
+    assert row["metric"] == "vit_mnist_fused_wall_clock"
+    assert row["value"] is not None and row["value"] > 0
+    assert row["model"] == "vit" and row["epochs"] == 1
+    assert 0 <= row["final_test_accuracy"] <= 100
+    # Offline CPU env -> the IDX download fails and the tool must DETECT
+    # the synthetic fallback (not merely emit one of the two literals).
+    assert row["dataset"] == "synthetic"
+    assert row["n_chips"] == 1
+    assert row["global_batch"] == 500

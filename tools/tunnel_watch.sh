@@ -104,6 +104,13 @@ while true; do
         # Pallas-kernel decision data (verdict item 7): full-run row with
         # the flat-state kernel, plus the optimizer-only micro-benchmark.
         run_bench pallas_run --pallas-opt && echo "[$(stamp)] pallas: $(promote pallas_run pallas)"
+        # Beyond-parity family row: the ViT fused whole run (own metric,
+        # own file, same min-by-value promotion).
+        echo "[$(stamp)] vit bench"
+        timeout 360 python "$REPO/tools/vit_bench.py" \
+            >"$OUT/bench_r3_vit_run.json" 2>"$OUT/bench_r3_vit_run.err" \
+            && echo "[$(stamp)] vit: $(promote vit_run vit)" \
+            || echo "[$(stamp)] vit bench failed rc=$?"
         echo "[$(stamp)] pallas micro-bench"
         python "$REPO/tools/pallas_opt_bench.py" \
             >"$OUT/bench_r3_pallas_micro.json" 2>"$OUT/bench_r3_pallas_micro.err" \

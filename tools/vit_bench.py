@@ -1,0 +1,107 @@
+"""ViT-family hardware benchmark: one JSON line from a fused whole run.
+
+The headline bench (bench.py) measures the reference CNN protocol; this
+tool records the beyond-parity attention family on the same protocol
+shape — ``vit_mnist.py --fused --epochs 20 --batch-size 200`` — so the
+family has measured (not just tested) hardware behavior.  Run by
+tools/tunnel_watch.sh in accelerator windows; results land in
+``bench_r3_vit.json`` via the watcher's min-by-value promotion.
+
+Usage: python tools/vit_bench.py [--epochs N] [--batch-size N] [--timeout S]
+Prints ONE JSON line on stdout; exit 1 with an error JSON on failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=200)
+    p.add_argument("--test-batch-size", type=int, default=1000)
+    p.add_argument("--timeout", type=float, default=300.0)
+    args = p.parse_args()
+
+    # Chip count first (own subprocess — this tool never imports jax):
+    # --batch-size is PER SHARD (vit_mnist.py multiplies by the data-axis
+    # width), so the recorded row must say how many chips multiplied it.
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=120,
+        )
+        n_chips = int(probe.stdout.strip().splitlines()[-1])
+    except Exception as e:  # dead tunnel, import error, timeout
+        print(json.dumps({
+            "metric": "vit_mnist_fused_wall_clock", "value": None,
+            "error": f"device probe failed: {e}",
+        }))
+        return 1
+
+    cmd = [
+        sys.executable, os.path.join(REPO, "vit_mnist.py"), "--fused",
+        "--epochs", str(args.epochs), "--batch-size", str(args.batch_size),
+        "--test-batch-size", str(args.test_batch_size),
+    ]
+    start = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=args.timeout
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({
+            "metric": "vit_mnist_fused_wall_clock", "value": None,
+            "error": f"timeout after {args.timeout}s",
+        }))
+        return 1
+    wall = time.time() - start
+    if proc.returncode != 0:
+        print(json.dumps({
+            "metric": "vit_mnist_fused_wall_clock", "value": None,
+            "error": f"exit {proc.returncode}: {proc.stderr[-400:]}",
+        }))
+        return 1
+
+    # The CLI's own wall clock (the reference timer quirk prints seconds
+    # under an "ms" label) is authoritative; subprocess wall is the guard.
+    m = re.search(r"Total cost time:([0-9.]+)", proc.stdout)
+    accs = re.findall(r"Accuracy: (\d+)/(\d+)", proc.stdout)
+    if not m or not accs:
+        print(json.dumps({
+            "metric": "vit_mnist_fused_wall_clock", "value": None,
+            "error": "output missing timer or accuracy lines",
+        }))
+        return 1
+    final = 100.0 * int(accs[-1][0]) / int(accs[-1][1])
+    first = 100.0 * int(accs[0][0]) / int(accs[0][1])
+    print(json.dumps({
+        "metric": "vit_mnist_fused_wall_clock",
+        "value": round(float(m.group(1)), 2),
+        "unit": "s",
+        "model": "vit",
+        "epochs": args.epochs,
+        "n_chips": n_chips,
+        "batch_size_per_shard": args.batch_size,
+        "global_batch": args.batch_size * n_chips,
+        "dataset": "synthetic"
+        if "synthetic MNIST-like data" in (proc.stdout + proc.stderr)
+        else "idx",
+        "subprocess_wall_s": round(wall, 2),
+        "epoch1_test_accuracy": round(first, 2),
+        "final_test_accuracy": round(final, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
