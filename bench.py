@@ -197,6 +197,12 @@ def main() -> None:
                    help="benchmark the fused Pallas optimizer kernel path "
                         "(recorded in the JSON; not the headline until it "
                         "measures faster)")
+    p.add_argument("--zero", action="store_true",
+                   help="benchmark the ZeRO-1 sharded-optimizer DP path "
+                        "(parallel/zero.py; per-batch loop — the sharded "
+                        "state has no fused whole-run program, so pair "
+                        "with --quick in short tunnel windows; recorded "
+                        "in the JSON, never the headline)")
     p.add_argument("--probe-attempts", type=int, default=None,
                    help="cap backend-probe attempts (default: full "
                         f"{1 + len(PROBE_BACKOFFS_S)}-attempt schedule, "
@@ -262,10 +268,11 @@ def main() -> None:
         log_interval=10_000_000,  # silence train lines; epoch evals remain
         dry_run=False,
         save_model=False,
-        fused=True,
+        fused=not args.zero,
         bf16=args.bf16,
         syncbn=args.syncbn,
         pallas_opt=args.pallas_opt,
+        zero=args.zero,
         train_limit=args.train_limit,
         data_root="./data",
     )
@@ -323,6 +330,7 @@ def main() -> None:
         "cache": cache_state,
         "syncbn": bool(args.syncbn),
         "pallas_opt": bool(args.pallas_opt),
+        "zero": bool(args.zero),
         "train_limit": args.train_limit or None,
         # "idx" (real MNIST files) or "synthetic" (air-gapped fallback):
         # says which task produced the accuracy fields below.
@@ -381,6 +389,7 @@ def main() -> None:
         and not args.bf16
         and not args.syncbn
         and not args.pallas_opt
+        and not args.zero
         and not args.train_limit
         and args.epochs == PROTOCOL["epochs"]
         and args.batch_size == PROTOCOL["batch_size"]

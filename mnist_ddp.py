@@ -49,6 +49,11 @@ def main() -> None:
                    help="add BatchNorm after each conv with batch statistics "
                         "synced across the data axis (torch.nn.SyncBatchNorm "
                         "semantics; the scaled-batch config of BASELINE.json)")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO-1 data parallelism: shard the Adadelta state "
+                        "1/N over the data axis (reduce-scatter gradients, "
+                        "shard-local update, all-gather deltas) instead of "
+                        "replicating it; numerics match plain DP")
     args = p.parse_args()
 
     import jax

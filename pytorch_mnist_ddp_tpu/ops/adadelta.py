@@ -37,6 +37,20 @@ def adadelta_init(params: Any) -> AdadeltaState:
     return AdadeltaState(square_avg=zeros, acc_delta=jax.tree.map(jnp.zeros_like, params))
 
 
+def adadelta_delta(g, sq, ac, rho: float, eps: float):
+    """The core recurrence on one (grad, square_avg, acc_delta) triple:
+    returns ``(delta, new_square_avg, new_acc_delta)`` where the caller
+    applies ``p - lr * delta`` (torch accumulates delta WITHOUT lr).
+    The ONE definition of the update math — shared by the per-leaf pytree
+    path below and the ZeRO-1 flat-shard path (parallel/zero.py), so the
+    recurrence cannot drift between optimizer-state layouts.  Any
+    weight-decay gradient adjustment happens before this."""
+    sq = rho * sq + (1.0 - rho) * g * g
+    delta = jnp.sqrt(ac + eps) / jnp.sqrt(sq + eps) * g
+    ac = rho * ac + (1.0 - rho) * delta * delta
+    return delta, sq, ac
+
+
 def adadelta_update(
     params: Any,
     grads: Any,
@@ -51,9 +65,7 @@ def adadelta_update(
     def leaf(p, g, sq, ac):
         if weight_decay:
             g = g + weight_decay * p
-        sq = rho * sq + (1.0 - rho) * g * g
-        delta = jnp.sqrt(ac + eps) / jnp.sqrt(sq + eps) * g
-        ac = rho * ac + (1.0 - rho) * delta * delta
+        delta, sq, ac = adadelta_delta(g, sq, ac, rho, eps)
         return p - lr * delta, sq, ac
 
     flat = jax.tree.map(leaf, params, grads, state.square_avg, state.acc_delta)

@@ -97,6 +97,55 @@ def replicate_params(tree: Any, mesh: Mesh) -> Any:
     )
 
 
+def forward_loss(
+    model: Net,
+    params: Any,
+    batch_stats: Any,
+    x,
+    y,
+    w,
+    key,
+    *,
+    use_bn: bool,
+    dropout: bool,
+) -> tuple[jax.Array, Any]:
+    """The shared per-replica loss body: forward + masked-mean NLL.
+
+    Returns ``(loss, new_batch_stats)``; non-BN models pass
+    ``batch_stats`` through untouched so the return shape is uniform.
+    One definition feeds every replicated-gradient step variant
+    (:func:`make_train_step`, the ZeRO-1 step in parallel/zero.py), so the
+    reference's loss semantics (mnist.py:44-45) cannot drift between them.
+    """
+    variables = {"params": params}
+    if use_bn:
+        # train=True regardless of the dropout flag: BN must use
+        # (and update) batch statistics whenever training, even in
+        # the deterministic-dropout parity configurations.
+        # mask=w: zero-padded samples of the final partial batch
+        # stay out of the (psum'd) batch statistics, matching
+        # torch's real-only smaller last batch.
+        variables["batch_stats"] = batch_stats
+        log_probs, mutated = model.apply(
+            variables, x, train=True, dropout=dropout, mask=w,
+            rngs={"dropout": key}, mutable=["batch_stats"],
+        )
+        return nll_loss(log_probs, y, w, reduction="mean"), mutated["batch_stats"]
+    log_probs = model.apply(variables, x, train=dropout, rngs={"dropout": key})
+    return nll_loss(log_probs, y, w, reduction="mean"), batch_stats
+
+
+def fold_replica_step_key(dropout_key, step) -> jax.Array:
+    """Per-step, per-replica dropout stream folded from the single root
+    seed (reference semantics: one global seed; SURVEY.md N15).  Must be
+    called inside ``shard_map`` (reads ``axis_index`` on the data axis);
+    shared by every DP-family step so the streams are identical across
+    step variants — the ZeRO-1 trajectory is bit-comparable to plain DP
+    even with dropout on."""
+    key = jax.random.fold_in(dropout_key, step)
+    return jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+
+
 def make_train_step(
     mesh: Mesh,
     compute_dtype: jnp.dtype = jnp.float32,
@@ -127,32 +176,13 @@ def make_train_step(
     )
 
     def local_step(state: TrainState, x, y, w, dropout_key, lr):
-        # Per-step, per-replica dropout stream folded from the single root
-        # seed (reference semantics: one global seed; SURVEY.md N15).
-        key = jax.random.fold_in(dropout_key, state.step)
-        key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+        key = fold_replica_step_key(dropout_key, state.step)
 
         def loss_fn(params):
-            variables = {"params": params}
-            if use_bn:
-                # train=True regardless of the dropout flag: BN must use
-                # (and update) batch statistics whenever training, even in
-                # the deterministic-dropout parity configurations.
-                variables["batch_stats"] = state.batch_stats
-                # mask=w: zero-padded samples of the final partial batch
-                # stay out of the (psum'd) batch statistics, matching
-                # torch's real-only smaller last batch.
-                log_probs, mutated = model.apply(
-                    variables, x, train=True, dropout=dropout, mask=w,
-                    rngs={"dropout": key}, mutable=["batch_stats"],
-                )
-                new_stats = mutated["batch_stats"]
-            else:
-                log_probs = model.apply(
-                    variables, x, train=dropout, rngs={"dropout": key}
-                )
-                new_stats = state.batch_stats
-            return nll_loss(log_probs, y, w, reduction="mean"), new_stats
+            return forward_loss(
+                model, params, state.batch_stats, x, y, w, key,
+                use_bn=use_bn, dropout=dropout,
+            )
 
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params

@@ -1,0 +1,256 @@
+"""ZeRO-1 data parallelism: Adadelta state sharded 1/N over the data axis.
+
+Plain DP (parallel/ddp.py) replicates the optimizer state and has every
+replica redundantly apply the identical update — the reference's DDP
+semantics (its allreduce at reference mnist_ddp.py:172-174 synchronizes
+gradients; ``optim.Adadelta`` state is per-rank-replicated).  The ZeRO
+family of optimizations (Rajbhandari et al., stage 1) removes that
+redundancy: each of the N data shards owns 1/N of the optimizer state and
+updates only its slice.  The TPU-native formulation replaces
+"reduce-scatter + per-rank optimizer + all-gather over NCCL" with three
+XLA collectives inside ONE jitted shard_map step:
+
+    grads  --psum_scatter-->  mean-gradient shard        (rides ICI)
+    shard Adadelta update on the local 1/N flat slice    (VPU, no comm)
+    delta  --all_gather--->   full update, applied to the replicated params
+
+Per step this moves exactly the same bytes as plain DP's gradient pmean
+(a pmean IS reduce-scatter + all-gather on ring topologies) while storing
+``2 * P / N`` optimizer floats per chip instead of ``2 * P`` — the win
+that matters when the optimizer state, not the params, bounds model size
+per chip (Adadelta/Adam carry 2x params).  At MNIST scale the saving is
+cosmetic; the point is the framework shape: the same step works unchanged
+at any P and N.
+
+The accumulators live in ONE flat padded f32 vector per buffer (global
+shape ``[chunk * N]``, sharded ``P('data')``), not per-leaf pytrees —
+sharding every leaf 1/N would splinter small tensors below tile
+granularity, whereas one vector scatters into N contiguous lane-aligned
+chunks.  The layout is the 1-D cousin of the Pallas kernel's persistent
+flat state (ops/pallas_adadelta.py:FlatAdadeltaState) and converts
+losslessly to the per-leaf layout for checkpoints
+(:func:`zero_opt_to_per_leaf` / :func:`per_leaf_opt_to_zero_host`), so
+``--save-state`` archives stay portable across ``--zero`` and plain runs.
+
+Numerics: the update math is ops/adadelta.py's exact torch recurrence on
+a mean gradient; only the reduction routing differs (psum_scatter vs
+pmean — same adder trees on the same axis).  The dropout streams reuse
+``ddp.fold_replica_step_key``, so a ZeRO-1 trajectory is directly
+comparable to plain DP's even with dropout on (tests/test_zero.py pins
+both to near-bitwise agreement).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.net import Net
+from ..ops.adadelta import AdadeltaState, adadelta_delta
+from .ddp import TrainState, forward_loss, fold_replica_step_key
+from .mesh import DATA_AXIS, place_tree
+
+
+class ZeroAdadeltaState(NamedTuple):
+    """Adadelta accumulators as flat padded f32 vectors, global shape
+    ``[chunk * num_shards]`` sharded ``P('data')`` — each data shard owns
+    one contiguous ``chunk``-length slice.  A DISTINCT type (like
+    ``FlatAdadeltaState``): layout dispatch keys on ``isinstance``, never
+    on array shape."""
+
+    square_avg: jax.Array
+    acc_delta: jax.Array
+
+
+def zero_chunk(n_params: int, n_shards: int) -> int:
+    """Per-shard slice length: the padded flat vector divides exactly."""
+    return -(-n_params // n_shards)
+
+
+def _flatten_grads(grads: Any, n_shards: int):
+    """Ravel a gradient pytree and zero-pad to ``chunk * n_shards``."""
+    flat, unravel = ravel_pytree(grads)
+    n = flat.shape[0]
+    chunk = zero_chunk(n, n_shards)
+    return jnp.pad(flat, (0, chunk * n_shards - n)), n, unravel
+
+
+def zero_opt_spec() -> ZeroAdadeltaState:
+    """The accumulators' PartitionSpecs (pytree-of-specs form)."""
+    return ZeroAdadeltaState(square_avg=P(DATA_AXIS), acc_delta=P(DATA_AXIS))
+
+
+def zero_state_spec(batch_stats_spec=P()) -> TrainState:
+    """PartitionSpecs for a whole ZeRO-1 ``TrainState``: params/step/BN
+    replicated, optimizer sharded over the data axis."""
+    return TrainState(
+        params=P(), opt=zero_opt_spec(), step=P(), batch_stats=batch_stats_spec
+    )
+
+
+def zero_init(params: Any, mesh: Mesh) -> ZeroAdadeltaState:
+    """Zero-valued sharded accumulators for ``params`` on ``mesh``.
+
+    Built inside ``jit`` with explicit ``out_shardings`` so the zeros are
+    created directly in place on every shard — correct in multi-controller
+    worlds too (all processes enqueue the same program; no host broadcast).
+    """
+    n = sum(int(p.size) for p in jax.tree.leaves(params))
+    total = zero_chunk(n, mesh.shape[DATA_AXIS]) * mesh.shape[DATA_AXIS]
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    make = jax.jit(
+        lambda: ZeroAdadeltaState(
+            square_avg=jnp.zeros(total, jnp.float32),
+            acc_delta=jnp.zeros(total, jnp.float32),
+        ),
+        out_shardings=ZeroAdadeltaState(square_avg=sharding, acc_delta=sharding),
+    )
+    return make()
+
+
+def zero_opt_to_per_leaf(
+    opt: ZeroAdadeltaState, params: Any, mesh: Mesh
+) -> AdadeltaState:
+    """Gather + unravel the sharded flat accumulators into the per-leaf
+    pytree layout (checkpoint portability: ``--save-state`` archives are
+    always written per-leaf, whatever the run executed).
+
+    The gather is a jitted all-replicate enqueued on EVERY process (a
+    chief-only collective would deadlock a multi-controller world; the
+    file write alone is chief-gated, trainer.py), so afterwards each
+    process holds the full accumulators locally."""
+    replicated = jax.jit(
+        lambda v: v, out_shardings=NamedSharding(mesh, P())
+    )(opt)
+    flat_p, unravel = ravel_pytree(params)
+    n = flat_p.shape[0]
+    return AdadeltaState(
+        square_avg=unravel(replicated.square_avg[:n]),
+        acc_delta=unravel(replicated.acc_delta[:n]),
+    )
+
+
+def per_leaf_opt_to_zero_host(opt: AdadeltaState, n_shards: int):
+    """Host-side per-leaf → flat-padded conversion (resume path).  Returns
+    a host ``ZeroAdadeltaState``-shaped tuple of np arrays, ready for
+    :func:`shard_zero_state` placement."""
+    flat_sq, _ = ravel_pytree(opt.square_avg)
+    flat_ac, _ = ravel_pytree(opt.acc_delta)
+    n = flat_sq.shape[0]
+    pad = zero_chunk(n, n_shards) * n_shards - n
+    topad = lambda v: np.pad(np.asarray(v), (0, pad))
+    return ZeroAdadeltaState(
+        square_avg=topad(flat_sq), acc_delta=topad(flat_ac)
+    )
+
+
+def make_zero_train_state(
+    params: Any, mesh: Mesh, batch_stats: Any = (), step0: int = 0
+):
+    """Fresh ZeRO-1 training state: replicated params/step/BN stats,
+    sharded zero accumulators.  ``step0`` seeds the step counter (the
+    ``--resume`` cumulative-batch continuation, trainer.py)."""
+    from .ddp import replicate_params
+
+    placed = replicate_params(
+        TrainState(params=params, opt=(), step=np.int32(step0),
+                   batch_stats=batch_stats),
+        mesh,
+    )
+    return placed._replace(opt=zero_init(params, mesh))
+
+
+def shard_zero_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place a HOST per-leaf ``TrainState`` (e.g. a ``--resume-state``
+    archive) as a ZeRO-1 state: params/step/BN replicated, accumulators
+    converted to the flat sharded layout.  Multi-controller-safe via
+    ``mesh.place_tree``."""
+    n_shards = mesh.shape[DATA_AXIS]
+    host = state._replace(opt=per_leaf_opt_to_zero_host(state.opt, n_shards))
+    # place_tree maps specs leaf-for-leaf (no pytree-prefix broadcast, unlike
+    # shard_map's in_specs), so expand the replicated positions per leaf.
+    specs = host._replace(
+        params=jax.tree.map(lambda _: P(), host.params),
+        opt=zero_opt_spec(),
+        step=P(),
+        batch_stats=jax.tree.map(lambda _: P(), host.batch_stats),
+    )
+    return place_tree(host, specs, mesh)
+
+
+def make_zero_train_step(
+    mesh: Mesh,
+    compute_dtype: jnp.dtype = jnp.float32,
+    rho: float = 0.9,
+    eps: float = 1e-6,
+    dropout: bool = True,
+    use_bn: bool = False,
+):
+    """Build the jitted ZeRO-1 DP train step.
+
+    Same signature and semantics as ``ddp.make_train_step`` —
+    ``step_fn(state, x, y, w, dropout_key, lr) -> (state, losses)`` — with
+    ``state.opt`` a :class:`ZeroAdadeltaState`.  The returned per-replica
+    local losses and the trained params match plain DP's (the recurrence
+    is identical; only where the accumulators LIVE differs).
+    """
+    n_shards = mesh.shape[DATA_AXIS]
+    model = Net(
+        compute_dtype=compute_dtype, use_bn=use_bn,
+        bn_axis=DATA_AXIS if use_bn else None,
+    )
+
+    def local_step(state: TrainState, x, y, w, dropout_key, lr):
+        key = fold_replica_step_key(dropout_key, state.step)
+
+        def loss_fn(params):
+            return forward_loss(
+                model, params, state.batch_stats, x, y, w, key,
+                use_bn=use_bn, dropout=dropout,
+            )
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        # Reduce-scatter: this shard's slice of the MEAN gradient (the
+        # pmean's first half; sum lands here, the /N makes it DDP's mean).
+        g_pad, n, unravel = _flatten_grads(grads, n_shards)
+        g_shard = (
+            jax.lax.psum_scatter(g_pad, DATA_AXIS, tiled=True) / n_shards
+        )
+        # The torch Adadelta recurrence (the shared ops/adadelta.py
+        # definition) on the local 1/N slice.  Elementwise on a flat
+        # vector: pure VPU work XLA fuses into the collectives around it.
+        delta_shard, sq, ac = adadelta_delta(
+            g_shard, state.opt.square_avg, state.opt.acc_delta, rho, eps
+        )
+        # All-gather the full delta (the pmean's second half) and fold
+        # ``p - lr*delta`` into each leaf at the unravel split — params
+        # themselves never ravel (the Pallas flat-state lesson,
+        # ops/pallas_adadelta.py:adadelta_update_flat).
+        delta = unravel(
+            jax.lax.all_gather(delta_shard, DATA_AXIS, tiled=True)[:n]
+        )
+        params = jax.tree.map(lambda p, d: p - lr * d, state.params, delta)
+        new_state = TrainState(
+            params=params,
+            opt=ZeroAdadeltaState(square_avg=sq, acc_delta=ac),
+            step=state.step + 1,
+            batch_stats=new_stats,
+        )
+        return new_state, loss[None]  # keep a per-shard loss axis
+
+    state_spec = zero_state_spec()
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_spec, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        out_specs=(state_spec, P(DATA_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))

@@ -263,6 +263,21 @@ def _fit_body(
     syncbn = bool(getattr(args, "syncbn", False))
     if syncbn and num_model > 1:
         raise ValueError("--syncbn rides the DP paths; drop --tp/--pp")
+    # --zero (ZeRO-1: Adadelta state sharded over the data axis,
+    # parallel/zero.py) rides the per-batch DP loop; composes with
+    # --syncbn and --bf16, excludes the model-axis modes, the fused
+    # whole-run (replicated-optimizer program), and --pallas-opt (the
+    # kernel's persistent layout is a different sharding of the same
+    # state — one flat-layout owner per run).
+    zero = bool(getattr(args, "zero", False))
+    if zero and num_model > 1:
+        raise ValueError("--zero rides the DP paths; drop --tp/--pp")
+    if zero and bool(getattr(args, "fused", False)):
+        raise ValueError("--fused runs the replicated-optimizer program; "
+                         "drop it for --zero")
+    if zero and bool(getattr(args, "pallas_opt", False)):
+        raise ValueError("--zero and --pallas-opt both re-lay-out the "
+                         "Adadelta state; pick one")
     # Full-state continuation (--save-state / --resume-state): the whole
     # TrainState travels, so the continued run is bit-identical to an
     # uninterrupted one (utils/checkpoint.save_train_state).
@@ -492,6 +507,17 @@ def _fit_body(
             from .parallel.tp import make_tp_eval_step, make_tp_train_step, shard_state
 
             state = shard_state(make_train_state(params), mesh)
+        elif zero:
+            from .parallel.zero import make_zero_train_state, shard_zero_state
+
+            if loaded_state is not None:
+                # The archive's per-leaf accumulators (ensure_opt_layout
+                # above) convert to the flat sharded layout on placement.
+                state = shard_zero_state(loaded_state, mesh)
+            else:
+                state = make_zero_train_state(
+                    params, mesh, bn_stats, step0=resume_step
+                )
         elif loaded_state is not None:
             state = replicate_params(loaded_state, mesh)
         else:
@@ -535,11 +561,23 @@ def _fit_body(
                 mesh, num_micro=int(getattr(args, "pp_microbatches", 2))
             )
             eval_fn = make_eval_step(mesh)
+        elif zero:
+            from .parallel.zero import make_zero_train_step
+
+            # --zero and plain DP share one eval (constructed below):
+            # params are replicated either way; only the train step and
+            # the optimizer-state layout differ.
+            step_fn = make_zero_train_step(
+                mesh, compute_dtype=compute_dtype, use_bn=syncbn
+            )
+            eval_fn = None
         else:
             step_fn = make_train_step(
                 mesh, compute_dtype=compute_dtype, use_pallas=use_pallas,
                 use_bn=syncbn,
             )
+            eval_fn = None
+        if eval_fn is None:
             eval_fn = make_eval_step(
                 mesh, compute_dtype=compute_dtype, use_bn=syncbn
             )
@@ -597,11 +635,21 @@ def _fit_body(
     if save_state_path:
         from .utils.checkpoint import save_train_state
 
+        state_for_save = state
+        if zero:
+            # Archives are always per-leaf (portable across --zero /
+            # plain / --pallas-opt resumes); the gather runs on every
+            # process, only the write below is chief-gated.
+            from .parallel.zero import zero_opt_to_per_leaf
+
+            state_for_save = state._replace(
+                opt=zero_opt_to_per_leaf(state.opt, state.params, mesh)
+            )
         if dist.is_chief:
             # Epochs completed = where the next continuation picks up the
             # schedule/shuffle/numbering.
             save_train_state(
-                jax.device_get(state), save_state_path,
+                jax.device_get(state_for_save), save_state_path,
                 epoch=epoch0 + args.epochs,
             )
     return state

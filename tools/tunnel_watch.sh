@@ -104,10 +104,19 @@ while true; do
         # Pallas-kernel decision data (verdict item 7): full-run row with
         # the flat-state kernel, plus the optimizer-only micro-benchmark.
         run_bench pallas_run --pallas-opt && echo "[$(stamp)] pallas: $(promote pallas_run pallas)"
+        # ZeRO-1 row (parallel/zero.py): per-batch path (the sharded-state
+        # mode has no fused program) is tunnel-dispatch-bound at ~120 ms/
+        # step, so the full 20-epoch protocol (~6000 steps) cannot fit a
+        # short window — record the 2-epoch --quick variant instead.
+        run_bench zero_run --zero --quick && echo "[$(stamp)] zero: $(promote zero_run zero)"
         # Beyond-parity family row: the ViT fused whole run (own metric,
         # own file, same min-by-value promotion).
         echo "[$(stamp)] vit bench"
-        timeout 360 python "$REPO/tools/vit_bench.py" \
+        # Outer bound must cover the tool's own worst case (120 s device
+        # probe + 300 s run watchdog + margin) so the tool's structured
+        # error JSON always gets written before SIGTERM — same rationale
+        # as run_bench's BENCH_TIMEOUT_S+180.
+        timeout 480 python "$REPO/tools/vit_bench.py" \
             >"$OUT/bench_r3_vit_run.json" 2>"$OUT/bench_r3_vit_run.err" \
             && echo "[$(stamp)] vit: $(promote vit_run vit)" \
             || echo "[$(stamp)] vit bench failed rc=$?"
