@@ -28,6 +28,13 @@ from .pp_vit import (
     make_vit_eval_step,
     make_vit_pp_train_step,
 )
+from .zero import (
+    ZeroAdadeltaState,
+    make_zero_train_state,
+    make_zero_train_step,
+    shard_zero_state,
+    zero_opt_to_per_leaf,
+)
 from .distributed import init_distributed_mode, DistState
 from .ddp import (
     TrainState,

@@ -8,7 +8,14 @@ locally, 8 globally.  World formation goes through the real entry path —
 final params + eval totals for the parent to cross-check.
 
 Usage: python tests/multihost_worker.py <data_root> <out_npz> \
-    <fused|batch|tp|pp|syncbn|resume|resume-divergent|rstate|rstate-divergent>
+    <fused|batch|tp|pp|syncbn|zero|resume|resume-divergent|rstate|rstate-divergent>
+
+``zero`` trains ZeRO-1 DP (parallel/zero.py): each process owns 4 of
+the 8 flat optimizer-state shards, the gradient ``psum_scatter`` and
+delta ``all_gather`` cross the process boundary every step, and the
+``zero_init`` jitted sharded-zeros construction exercises the
+multi-controller path.  Replicated params must still end bit-identical
+on both processes.
 
 ``resume`` modes exercise ``--resume`` across the process boundary: each
 rank loads its OWN per-host copy ``<data_root>/ckpt_rank<r>.pt`` — the
@@ -137,7 +144,8 @@ def main() -> None:
         seed=1, log_interval=4, dry_run=False, save_model=False,
         fused=(mode == "fused"), data_root=data_root,
         tp=(2 if mode == "tp" else 1), pp=(mode == "pp"),
-        syncbn=(mode == "syncbn"), resume=resume, resume_state=resume_state,
+        syncbn=(mode == "syncbn"), zero=(mode == "zero"),
+        resume=resume, resume_state=resume_state,
     )
     state = fit(args, dist)
 

@@ -182,7 +182,9 @@ def test_two_process_resume_divergent_files_refused(tmp_path):
     )
 
 
-@pytest.mark.parametrize("mode", ["batch", "fused", "tp", "pp", "syncbn"])
+@pytest.mark.parametrize(
+    "mode", ["batch", "fused", "tp", "pp", "syncbn", "zero"]
+)
 def test_two_process_world_replica_consistency(tmp_path, mode):
     """batch/fused: pure DP replica consistency.  tp: the (data=4, model=2)
     mesh spans the process boundary — multi-controller shard placement,
@@ -191,7 +193,10 @@ def test_two_process_world_replica_consistency(tmp_path, mode):
     activation/cotangent ppermute and the stage-axis grad psum cross the
     process boundary.  syncbn: the per-step BN statistics psum crosses the
     boundary, so the dumped running averages (bn*.running_*) must be
-    bit-identical too."""
+    bit-identical too.  zero: ZeRO-1 — the optimizer-state shards split
+    4/4 across the processes, and the per-step gradient psum_scatter /
+    delta all_gather cross the boundary; replicated params must still
+    end bit-identical."""
     r0, r1, logs = _run_world(tmp_path, mode)
     # Replica/shard consistency: both processes hold bit-identical params
     # (for syncbn this includes the BN scale/bias and running statistics).
