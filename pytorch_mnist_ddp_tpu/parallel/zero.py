@@ -183,6 +183,41 @@ def shard_zero_state(state: TrainState, mesh: Mesh) -> TrainState:
     return place_tree(host, specs, mesh)
 
 
+def zero_update(
+    params: Any,
+    grads: Any,
+    opt: ZeroAdadeltaState,
+    lr,
+    n_shards: int,
+    rho: float = 0.9,
+    eps: float = 1e-6,
+) -> tuple[Any, ZeroAdadeltaState]:
+    """The model-agnostic ZeRO-1 optimizer core.  MUST be called inside a
+    ``shard_map`` over a mesh whose data axis has ``n_shards`` members,
+    with ``grads`` the LOCAL per-shard gradients and ``opt`` the local
+    accumulator slices.
+
+    Three moves: (1) reduce-scatter — this shard's slice of the MEAN
+    gradient (the pmean's first half; the sum lands here, the /N makes it
+    DDP's mean); (2) the shared torch Adadelta recurrence
+    (ops/adadelta.py:adadelta_delta) on the local 1/N flat slice — pure
+    VPU work XLA fuses into the collectives around it; (3) all-gather the
+    full delta (the pmean's second half) and fold ``p - lr*delta`` into
+    each leaf at the unravel split, so params themselves never ravel (the
+    Pallas flat-state lesson, ops/pallas_adadelta.py).  Shared by the CNN
+    step below and the ViT step (:func:`make_zero_vit_train_step`)."""
+    g_pad, n, unravel = _flatten_grads(grads, n_shards)
+    g_shard = jax.lax.psum_scatter(g_pad, DATA_AXIS, tiled=True) / n_shards
+    delta_shard, sq, ac = adadelta_delta(
+        g_shard, opt.square_avg, opt.acc_delta, rho, eps
+    )
+    delta = unravel(
+        jax.lax.all_gather(delta_shard, DATA_AXIS, tiled=True)[:n]
+    )
+    new_params = jax.tree.map(lambda p, d: p - lr * d, params, delta)
+    return new_params, ZeroAdadeltaState(square_avg=sq, acc_delta=ac)
+
+
 def make_zero_train_step(
     mesh: Mesh,
     compute_dtype: jnp.dtype = jnp.float32,
@@ -217,30 +252,11 @@ def make_zero_train_step(
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params
         )
-        # Reduce-scatter: this shard's slice of the MEAN gradient (the
-        # pmean's first half; sum lands here, the /N makes it DDP's mean).
-        g_pad, n, unravel = _flatten_grads(grads, n_shards)
-        g_shard = (
-            jax.lax.psum_scatter(g_pad, DATA_AXIS, tiled=True) / n_shards
+        params, opt = zero_update(
+            state.params, grads, state.opt, lr, n_shards, rho, eps
         )
-        # The torch Adadelta recurrence (the shared ops/adadelta.py
-        # definition) on the local 1/N slice.  Elementwise on a flat
-        # vector: pure VPU work XLA fuses into the collectives around it.
-        delta_shard, sq, ac = adadelta_delta(
-            g_shard, state.opt.square_avg, state.opt.acc_delta, rho, eps
-        )
-        # All-gather the full delta (the pmean's second half) and fold
-        # ``p - lr*delta`` into each leaf at the unravel split — params
-        # themselves never ravel (the Pallas flat-state lesson,
-        # ops/pallas_adadelta.py:adadelta_update_flat).
-        delta = unravel(
-            jax.lax.all_gather(delta_shard, DATA_AXIS, tiled=True)[:n]
-        )
-        params = jax.tree.map(lambda p, d: p - lr * d, state.params, delta)
         new_state = TrainState(
-            params=params,
-            opt=ZeroAdadeltaState(square_avg=sq, acc_delta=ac),
-            step=state.step + 1,
+            params=params, opt=opt, step=state.step + 1,
             batch_stats=new_stats,
         )
         return new_state, loss[None]  # keep a per-shard loss axis
@@ -250,6 +266,44 @@ def make_zero_train_step(
         local_step,
         mesh=mesh,
         in_specs=(state_spec, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        out_specs=(state_spec, P(DATA_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_zero_vit_train_step(mesh: Mesh, cfg, rho: float = 0.9,
+                             eps: float = 1e-6):
+    """ZeRO-1 data-parallel train step for the ViT family
+    (``vit_mnist.py --zero``) — the same :func:`zero_update` core under a
+    different model's loss.  Signature matches the family's other steps:
+    ``step_fn(state, x, y, w, lr) -> (state, losses)`` (the ViT has no
+    dropout, so no key threads through).  Eval reuses the family's shared
+    DP eval (parallel/pp_vit.py:make_vit_eval_step — params replicated)."""
+    from ..models.vit import vit_forward
+    from ..ops.loss import nll_loss
+
+    n_shards = mesh.shape[DATA_AXIS]
+
+    def local_step(state: TrainState, x, y, w, lr):
+        def loss_fn(p):
+            return nll_loss(vit_forward(p, x, cfg), y, w, reduction="mean")
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        params, opt = zero_update(
+            state.params, grads, state.opt, lr, n_shards, rho, eps
+        )
+        new_state = TrainState(
+            params=params, opt=opt, step=state.step + 1,
+            batch_stats=state.batch_stats,
+        )
+        return new_state, loss[None]
+
+    state_spec = zero_state_spec()
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_spec, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=(state_spec, P(DATA_AXIS)),
         check_vma=False,
     )

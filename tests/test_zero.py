@@ -201,6 +201,61 @@ def test_zero_padding_geometry():
             assert chunk * shards - n < shards or chunk * shards - n < chunk
 
 
+def test_zero_vit_matches_single_device(devices):
+    """The model-agnostic core (zero_update) under the ViT loss: 4 sharded
+    steps on the 8-device mesh match the single-device recurrence
+    (vit_forward + per-leaf Adadelta) on the same global batches, and the
+    family's shared DP eval agrees with the single-device totals."""
+    from pytorch_mnist_ddp_tpu.models.vit import (
+        ViTConfig, init_vit_params, vit_forward,
+    )
+    from pytorch_mnist_ddp_tpu.ops.adadelta import adadelta_init, adadelta_update
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+    from pytorch_mnist_ddp_tpu.parallel.pp_vit import make_vit_eval_step
+    from pytorch_mnist_ddp_tpu.parallel.zero import make_zero_vit_train_step
+
+    cfg = ViTConfig()
+    mesh = make_mesh(num_model=1)
+    params = jax.device_get(init_vit_params(jax.random.PRNGKey(2), cfg))
+    copy = lambda t: jax.tree.map(np.array, t)
+
+    s_z = make_zero_train_state(copy(params), mesh)
+    step_z = make_zero_vit_train_step(mesh, cfg)
+
+    ref_p = copy(params)
+    ref_opt = adadelta_init(ref_p)
+    lr = jnp.float32(1.0)
+    for i in range(4):
+        x, y, w = _batch(32, seed=i)
+
+        def loss_fn(p):
+            return nll_loss(vit_forward(p, x, cfg), y, w, reduction="mean")
+
+        grads = jax.grad(loss_fn)(ref_p)
+        ref_p, ref_opt = adadelta_update(ref_p, grads, ref_opt, lr)
+
+        xs, ys, ws = _put(mesh, x, y, w)
+        s_z, losses = step_z(s_z, xs, ys, ws, lr)
+    _assert_trees_equal(ref_p, s_z.params, rtol=2e-5, atol=1e-6)
+    per_leaf = zero_opt_to_per_leaf(s_z.opt, s_z.params, mesh)
+    _assert_trees_equal(ref_opt.square_avg, per_leaf.square_avg,
+                        rtol=2e-5, atol=1e-7)
+
+    # Eval totals: the psum'd family eval on the sharded mesh == the
+    # single-device sums on the same batch.  Oracle computed from the SAME
+    # trained params the sharded eval sees (ref_p is only rtol-2e-5 close;
+    # a near-tie argmax flip between the two trees would be a false alarm).
+    eval_z = make_vit_eval_step(mesh, cfg)
+    x, y, w = _batch(64, seed=9)
+    logp = vit_forward(jax.device_get(s_z.params), x, cfg)
+    want_loss = float(nll_loss(logp, y, w, reduction="sum"))
+    want_correct = float(((jnp.argmax(logp, axis=1) == y) * w).sum())
+    xs, ys, ws = _put(mesh, x, y, w)
+    totals = np.asarray(eval_z(s_z.params, xs, ys, ws))
+    np.testing.assert_allclose(totals[0], want_loss, rtol=1e-5)
+    assert totals[1] == want_correct
+
+
 def test_fit_rejects_zero_flag_conflicts(devices):
     """--zero excludes --fused / --pallas-opt / the model-axis modes."""
     from types import SimpleNamespace

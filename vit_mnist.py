@@ -58,6 +58,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="switch-MoE with E experts, expert-parallel over "
                         "the data axis (models/moe.py + parallel/ep.py); "
                         "mutually exclusive with --sp/--tp/--pp")
+    p.add_argument("--zero", action="store_true", default=False,
+                   help="ZeRO-1 data parallelism over every device: batch "
+                        "sharded on the data axis, Adadelta state sharded "
+                        "1/N (parallel/zero.py); mutually exclusive with "
+                        "--sp/--tp/--pp/--experts/--fused")
     p.add_argument("--depth", type=int, default=2, metavar="N",
                    help="transformer blocks (default: 2)")
     p.add_argument("--dim", type=int, default=64, metavar="D",
@@ -85,6 +90,12 @@ def main() -> None:
         raise SystemExit("--experts is mutually exclusive with --sp/--tp/--pp")
     if args.pp and (args.sp > 1 or args.tp > 1):
         raise SystemExit("--pp is mutually exclusive with --sp/--tp")
+    if args.zero and (args.sp > 1 or args.tp > 1 or args.pp
+                      or args.experts > 0 or args.fused):
+        raise SystemExit(
+            "--zero is plain data parallelism; drop --sp/--tp/--pp/"
+            "--experts/--fused"
+        )
 
     import jax
 
@@ -251,6 +262,17 @@ def main() -> None:
         state = shard_ep_state(make_train_state(params), mesh, cfg)
         train_step = make_ep_train_step(mesh, cfg)
         eval_step = make_ep_eval_step(mesh, cfg)
+    elif args.zero:
+        from pytorch_mnist_ddp_tpu.parallel.pp_vit import make_vit_eval_step
+        from pytorch_mnist_ddp_tpu.parallel.zero import (
+            make_zero_train_state,
+            make_zero_vit_train_step,
+        )
+
+        mesh = make_mesh(num_model=1)
+        state = make_zero_train_state(params, mesh)
+        train_step = make_zero_vit_train_step(mesh, cfg)
+        eval_step = make_vit_eval_step(mesh, cfg)
     else:
         mesh = make_mesh(num_data=1, devices=jax.devices()[:1])
         state = replicate_params(make_train_state(params), mesh)
