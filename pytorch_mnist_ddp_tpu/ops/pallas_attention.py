@@ -1,0 +1,294 @@
+"""Pallas TPU kernel: fused flash-attention forward + blockwise backward.
+
+The framework's attention family (ops/attention.py) computes the
+blockwise online-softmax in plain JAX — XLA materializes the
+``[b, h, tq, tk]`` score tile of each block in HBM between kernels.  This
+module fuses the whole per-(batch*head) attention into ONE Pallas pass:
+scores, the running (max, normalizer) rescale, and the value matmul stay
+in VMEM; HBM sees only q/k/v in and (output, logsumexp) out — the
+flash-attention memory shape, O(t) instead of O(t^2).
+
+The reference repo has no attention at all (SURVEY.md §5: its one model
+is the fixed 28x28 CNN, reference mnist.py:11-34); like ops/attention.py
+this exists for the beyond-parity long-context story, where it is the
+single-device/per-shard building block — ring attention (parallel/sp.py)
+rotates k/v blocks BETWEEN chips, this kernel fuses the math WITHIN one.
+
+Design (mirrors the framework's other kernel, ops/pallas_adadelta.py):
+
+- layout ``[b, t, h, d]`` (the family's convention) folds to
+  ``[b*h, t, d]``; t pads to a block multiple, d pads to the 128-lane
+  boundary — zero-padding is exact for d (zero columns contribute zero
+  dot products) and masked via in-kernel iota comparison for t.
+- grid ``(b*h, q_blocks, k_blocks)``, k innermost ("arbitrary" —
+  sequential), carrying the online-softmax state in VMEM scratch:
+  ``m``/``l`` as ``[bq, 128]`` lane-broadcast f32 (the TPU-native shape
+  for per-row scalars), the output accumulator as ``[bq, dp]`` f32.
+- the kernel also emits per-row ``logsumexp = m + log(l)`` (lane-
+  broadcast, sliced to ``[..., 0]`` by the wrapper): the backward can
+  then reconstruct each probability block EXACTLY — no second online
+  pass — which is what makes the custom-VJP backward a simple
+  ``lax.scan`` over k blocks in plain JAX (O(t) memory, XLA-fused), the
+  standard flash backward split.
+- accumulation is float32 regardless of input dtype (bf16 q/k/v feed the
+  MXU at native width; the softmax stats stay exact) — the same contract
+  as ops/attention.py:block_update, so the dense oracle pins this kernel
+  too (tests/test_flash.py).
+
+Non-TPU backends run the kernel in interpret mode for tests
+(``TPU_MNIST_PALLAS_INTERPRET=1``); the CLI gate (``flash_active``)
+falls back to the dense path rather than ever reaching interpret mode by
+accident — the ops/pallas_adadelta.py dispatch idiom.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import NEG_INF
+
+_LANES = 128
+_MAX_BLOCK = 128  # q/k block rows; small t uses one sublane-aligned block
+
+
+def flash_active(use_flash: bool | None) -> bool:
+    """Would ``--flash`` actually run the kernel on this backend?  Real
+    TPU lowering, or the explicit interpret-mode test hook — the
+    ops/pallas_adadelta.py:pallas_opt_active gate, shared semantics."""
+    return bool(use_flash) and (
+        jax.default_backend() == "tpu"
+        or os.environ.get("TPU_MNIST_PALLAS_INTERPRET") == "1"
+    )
+
+
+def _block(t: int) -> int:
+    """Block rows for a t-token axis: full 128 rows when there is that
+    much sequence, else one sublane-aligned block covering everything."""
+    return _MAX_BLOCK if t >= _MAX_BLOCK else -(-t // 8) * 8
+
+
+def _pad_to(x: jax.Array, axis: int, size: int) -> jax.Array:
+    pad = size - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, t_real: int, block: int, nk: int, scale: float):
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]  # [bq, dp]
+    k = k_ref[0]  # [bk, dp]
+    v = v_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [bq, bk] f32
+    # Mask padded key columns (t padded up to a block multiple): their
+    # zero-filled k rows would otherwise contribute exp(0 - m) mass.
+    cols = kb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(cols < t_real, s, NEG_INF)
+
+    m_prev = m_scr[:]  # [bq, 128] lane-broadcast
+    row_max = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
+    m_new = jnp.maximum(m_prev, jnp.broadcast_to(row_max, m_prev.shape))
+    p = jnp.exp(s - m_new[:, :1])  # masked cols: exp(NEG_INF - m) == 0
+    corr = jnp.exp(m_prev - m_new)  # [bq, 128], lanes identical
+    l_scr[:] = l_scr[:] * corr + jnp.broadcast_to(
+        jnp.sum(p, axis=1, keepdims=True), m_prev.shape
+    )
+    acc_scr[:] = acc_scr[:] * corr[:, :1] + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = m_new
+
+    @pl.when(kb == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = jnp.where(l > 0, acc_scr[:] / safe, 0.0).astype(o_ref.dtype)
+        # logsumexp, lane-broadcast like the scratch stats themselves.
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.where(l_scr[:] > 0, l_scr[:], 1.0))
+
+
+def _flash_fwd(q3, k3, v3, t_real: int, scale: float, interpret: bool):
+    """Kernel driver over folded ``[BH, t_pad, d_pad]`` inputs; returns
+    ``(out [BH, t_pad, d_pad], lse [BH, t_pad] f32)``.  ``scale`` is
+    ``1/sqrt(real head_dim)`` — computed by the wrapper from the
+    UNPADDED d, matching the dense oracle exactly."""
+    bh, tp, dp = q3.shape
+    block = _block(t_real)
+    nq = tp // block
+    nk = tp // block
+    kern = functools.partial(
+        _fwd_kernel, t_real=t_real, block=block, nk=nk, scale=scale
+    )
+    qo_spec = pl.BlockSpec(
+        (1, block, dp), lambda b, qi, ki: (b, qi, 0), memory_space=pltpu.VMEM
+    )
+    kv_spec = pl.BlockSpec(
+        (1, block, dp), lambda b, qi, ki: (b, ki, 0), memory_space=pltpu.VMEM
+    )
+    lse_spec = pl.BlockSpec(
+        (1, block, _LANES), lambda b, qi, ki: (b, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    out, lse = pl.pallas_call(
+        kern,
+        grid=(bh, nq, nk),
+        in_specs=[qo_spec, kv_spec, kv_spec],
+        out_specs=[qo_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tp, dp), q3.dtype),
+            jax.ShapeDtypeStruct((bh, tp, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, _LANES), jnp.float32),  # m
+            pltpu.VMEM((block, _LANES), jnp.float32),  # l
+            pltpu.VMEM((block, dp), jnp.float32),      # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out, lse[:, :, 0]
+
+
+def _fold(x: jax.Array) -> jax.Array:
+    """[b, t, h, d] -> [b*h, t, d]."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _unfold(x3: jax.Array, b: int, h: int) -> jax.Array:
+    """[b*h, t, d] -> [b, t, h, d]."""
+    bh, t, d = x3.shape
+    return x3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _prep(x: jax.Array, tp: int, dp: int) -> jax.Array:
+    return _pad_to(_pad_to(_fold(x), 1, tp), 2, dp)
+
+
+def _bwd_blockwise(q3, k3, v3, out3, lse, g3, t_real: int, scale: float):
+    """Memory-efficient flash backward in plain JAX: one ``lax.scan`` over
+    k blocks reconstructs each probability tile from the kernel's saved
+    logsumexp (``p = exp(s - lse)`` — exact, no second online pass) and
+    accumulates dq while emitting per-block dk/dv.  All math in f32, the
+    dense oracle's contract; XLA fuses the scan body.
+
+    Shapes: folded UNPADDED ``[BH, t, d]``; lse ``[BH, t]``.
+    """
+    bh, t, d = q3.shape
+    block = _block(t)
+    nk = -(-t // block)
+    tp = nk * block
+    kp = _pad_to(k3, 1, tp).reshape(bh, nk, block, d).transpose(1, 0, 2, 3)
+    vp = _pad_to(v3, 1, tp).reshape(bh, nk, block, d).transpose(1, 0, 2, 3)
+    qf = q3.astype(jnp.float32)
+    gf = g3.astype(jnp.float32)
+    # delta_i = sum_d dO_i * O_i — the rowwise correction of the softmax
+    # jacobian (the standard flash backward identity).
+    delta = jnp.sum(gf * out3.astype(jnp.float32), axis=-1)  # [BH, t]
+
+    def body(dq_acc, inputs):
+        kb_idx, kb, vb = inputs  # [], [BH, block, d], [BH, block, d]
+        kf = kb.astype(jnp.float32)
+        vf = vb.astype(jnp.float32)
+        s = scale * jnp.einsum("bqd,bkd->bqk", qf, kf)
+        cols = kb_idx * block + jnp.arange(block)[None, None, :]
+        p = jnp.where(cols < t_real, jnp.exp(s - lse[..., None]), 0.0)
+        dv_b = jnp.einsum("bqk,bqd->bkd", p, gf)
+        dp_ = jnp.einsum("bqd,bkd->bqk", gf, vf)
+        ds = p * (dp_ - delta[..., None])
+        dq_acc = dq_acc + scale * jnp.einsum("bqk,bkd->bqd", ds, kf)
+        dk_b = scale * jnp.einsum("bqk,bqd->bkd", ds, qf)
+        return dq_acc, (dk_b, dv_b)
+
+    dq, (dks, dvs) = jax.lax.scan(
+        body, jnp.zeros_like(qf), (jnp.arange(nk), kp, vp)
+    )
+    dk = dks.transpose(1, 0, 2, 3).reshape(bh, tp, d)[:, :t]
+    dv = dvs.transpose(1, 0, 2, 3).reshape(bh, tp, d)[:, :t]
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Fused flash-attention: drop-in for ``ops.attention.full_attention``
+    (no kv_mask — the ViT family has no token padding; the dense path
+    handles masked cases).  ``q/k/v``: ``[b, t, h, d]``."""
+    out, _ = _flash_fwd_res(q, k, v)
+    return out
+
+
+def _flash_fwd_res(q, k, v):
+    b, t, h, d = q.shape
+    interpret = jax.default_backend() != "tpu"
+    block = _block(t)
+    tp = -(-t // block) * block
+    dp = -(-d // _LANES) * _LANES
+    scale = 1.0 / float(d) ** 0.5
+    out3, lse = _flash_fwd(
+        _prep(q, tp, dp), _prep(k, tp, dp), _prep(v, tp, dp),
+        t_real=t, scale=scale, interpret=interpret,
+    )
+    out = _unfold(out3[:, :t, :d], b, h)
+    return out, lse[:, :t]
+
+
+def _vjp_fwd(q, k, v):
+    out, lse = _flash_fwd_res(q, k, v)
+    return out, (q, k, v, out, lse)
+
+
+def _vjp_bwd(res, g):
+    q, k, v, out, lse = res
+    b, t, h, d = q.shape
+    scale = 1.0 / float(d) ** 0.5
+    dq3, dk3, dv3 = _bwd_blockwise(
+        _fold(q), _fold(k), _fold(v), _fold(out), lse, _fold(g),
+        t_real=t, scale=scale,
+    )
+    cast = lambda x3, ref: _unfold(x3, b, h).astype(ref.dtype)
+    return cast(dq3, q), cast(dk3, k), cast(dv3, v)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def attention_best(use_flash: bool | None = None):
+    """Pick the attention implementation for this run: the Pallas kernel
+    when ``--flash`` is active on a capable backend, else the dense
+    oracle (ops/attention.py).  Returns an ``AttentionFn`` —
+    models/vit.py injects it through the family's shared sublayer."""
+    from .attention import full_attention
+
+    if use_flash and not flash_active(use_flash):
+        import warnings
+
+        warnings.warn(
+            f"--flash requested on backend {jax.default_backend()!r}, "
+            "which would run the kernel in slow interpret mode; using "
+            "the dense attention path instead (set "
+            "TPU_MNIST_PALLAS_INTERPRET=1 to force interpret mode for "
+            "testing)",
+            stacklevel=2,
+        )
+    return flash_attention if flash_active(use_flash) else full_attention

@@ -139,16 +139,21 @@ def make_vit_pp_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_vit_eval_step(mesh: Mesh, cfg: ViTConfig):
+def make_vit_eval_step(mesh: Mesh, cfg: ViTConfig, attention_fn=None):
     """Jitted data-parallel ViT eval step for any mesh with a ``data``
     axis (params replicated — the --pp eval path, mirroring the CNN's
     make_eval_step-under-pp): single-device forward on the local data
     shard + the psum'd (loss_sum, correct) totals every eval path shares.
-    """
+    ``attention_fn`` overrides the dense default (the ``--flash`` kernel,
+    ops/pallas_attention.py)."""
     from ..models.vit import vit_forward
+    from ..ops.attention import full_attention
+
+    if attention_fn is None:
+        attention_fn = full_attention
 
     def local_eval(params, x, y, w):
-        logp = vit_forward(params, x, cfg)
+        logp = vit_forward(params, x, cfg, attention_fn=attention_fn)
         loss_sum = nll_loss(logp, y, w, reduction="sum")
         correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)

@@ -273,7 +273,7 @@ def make_zero_train_step(
 
 
 def make_zero_vit_train_step(mesh: Mesh, cfg, rho: float = 0.9,
-                             eps: float = 1e-6):
+                             eps: float = 1e-6, attention_fn=None):
     """ZeRO-1 data-parallel train step for the ViT family
     (``vit_mnist.py --zero``) — the same :func:`zero_update` core under a
     different model's loss.  Signature matches the family's other steps:
@@ -281,13 +281,19 @@ def make_zero_vit_train_step(mesh: Mesh, cfg, rho: float = 0.9,
     dropout, so no key threads through).  Eval reuses the family's shared
     DP eval (parallel/pp_vit.py:make_vit_eval_step — params replicated)."""
     from ..models.vit import vit_forward
+    from ..ops.attention import full_attention
     from ..ops.loss import nll_loss
 
+    if attention_fn is None:
+        attention_fn = full_attention
     n_shards = mesh.shape[DATA_AXIS]
 
     def local_step(state: TrainState, x, y, w, lr):
         def loss_fn(p):
-            return nll_loss(vit_forward(p, x, cfg), y, w, reduction="mean")
+            return nll_loss(
+                vit_forward(p, x, cfg, attention_fn=attention_fn),
+                y, w, reduction="mean",
+            )
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         params, opt = zero_update(

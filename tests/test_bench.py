@@ -83,12 +83,15 @@ def test_tunnel_watch_script_stays_valid():
 
     import bench as bench_mod
 
-    # The watcher drives two CLIs: bench.py (bench + variant rows) and
-    # mnist_ddp.py (step-stats/profile captures, parser built in mnist.py).
-    # Every flag it passes must exist in at least one of them.
+    # The watcher drives bench.py (bench + variant rows), mnist_ddp.py
+    # (step-stats/profile captures, parser built in mnist.py), and the
+    # tools/ micro-benchmarks.  Every flag it passes must exist in at
+    # least one of them.
     known = declared_flags(bench_mod.__file__)
     known |= declared_flags(os.path.join(repo, "mnist.py"))
     known |= declared_flags(os.path.join(repo, "mnist_ddp.py"))
+    for tool in ("flash_bench.py", "pallas_opt_bench.py", "vit_bench.py"):
+        known |= declared_flags(os.path.join(repo, "tools", tool))
     missing = flags - known
     assert not missing, f"watcher passes unknown CLI flags: {missing}"
 

@@ -1,0 +1,122 @@
+"""The Pallas flash-attention kernel (ops/pallas_attention.py), run in
+interpret mode on CPU (the ops/pallas_adadelta.py test idiom): forward,
+logsumexp, and custom-VJP backward pinned against the dense oracle
+(ops/attention.py:full_attention) — the same oracle that pins ring
+attention, so all three attention paths share one numerical contract."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.ops.attention import full_attention
+from pytorch_mnist_ddp_tpu.ops.pallas_attention import (
+    attention_best,
+    flash_active,
+    flash_attention,
+)
+
+SHAPES = [
+    (2, 16, 4, 16),   # the ViT family's own geometry (16 tokens)
+    (1, 300, 2, 64),  # long + non-divisible t: padding/masking path
+    (2, 128, 2, 32),  # exact single-block boundary
+    (1, 257, 1, 8),   # multi-block q AND k with a 1-row tail
+]
+
+
+def _qkv(shape, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    return tuple(
+        jnp.asarray(rng.randn(*shape).astype(dtype)) for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_forward_matches_dense(shape):
+    q, k, v = _qkv(shape)
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v)),
+        np.asarray(full_attention(q, k, v)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("shape", SHAPES[:2])
+def test_backward_matches_dense(shape):
+    q, k, v = _qkv(shape, seed=1)
+    cot = jnp.asarray(
+        np.random.RandomState(9).randn(*shape).astype(np.float32)
+    )
+    g_ref = jax.grad(
+        lambda q, k, v: (full_attention(q, k, v) * cot).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    g_fl = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v) * cot).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_bf16_inputs_keep_dtype_and_accuracy():
+    """bf16 q/k/v feed the MXU at native width; the f32 softmax stats keep
+    the result within bf16-rounding distance of the f32 dense oracle."""
+    shape = (2, 64, 2, 32)
+    qf, kf, vf = _qkv(shape, seed=2)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (qf, kf, vf))
+    out = flash_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = full_attention(qf, kf, vf)
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(ref), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_jit_and_grad_under_jit():
+    """The kernel traces under jit (the only way it ever runs in the
+    CLIs) and the custom VJP threads through value_and_grad."""
+    q, k, v = _qkv((1, 32, 2, 16), seed=3)
+
+    @jax.jit
+    def loss(q, k, v):
+        return (flash_attention(q, k, v) ** 2).sum()
+
+    val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert np.isfinite(float(val))
+    assert all(bool(jnp.all(jnp.isfinite(g))) for g in grads)
+
+
+def test_vit_forward_with_flash_matches_dense():
+    """The kernel through the family's shared attention sublayer: the
+    whole ViT forward agrees with the dense-attention forward."""
+    from pytorch_mnist_ddp_tpu.models.vit import (
+        ViTConfig, init_vit_params, vit_forward,
+    )
+
+    cfg = ViTConfig()
+    params = init_vit_params(jax.random.PRNGKey(0), cfg)
+    x = jnp.asarray(
+        np.random.RandomState(4).rand(4, 28, 28, 1).astype(np.float32)
+    )
+    logp_dense = vit_forward(params, x, cfg)
+    logp_flash = vit_forward(params, x, cfg, attention_fn=flash_attention)
+    np.testing.assert_allclose(
+        np.asarray(logp_flash), np.asarray(logp_dense), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_dispatch_gate(monkeypatch):
+    """attention_best: kernel only when the backend can lower it for real
+    (or the interpret hook is set); otherwise dense with a warning —
+    interpret mode must never be reachable from the CLI by accident."""
+    monkeypatch.setenv("TPU_MNIST_PALLAS_INTERPRET", "1")
+    assert attention_best(True) is flash_attention
+    assert attention_best(None) is not flash_attention
+    monkeypatch.delenv("TPU_MNIST_PALLAS_INTERPRET")
+    if jax.default_backend() != "tpu":
+        assert not flash_active(True)
+        with pytest.warns(UserWarning, match="interpret"):
+            fn = attention_best(True)
+        assert fn is not flash_attention

@@ -1,0 +1,125 @@
+"""Micro-benchmark: dense attention vs the Pallas flash kernel on TPU.
+
+Times N forward (and optionally forward+backward) passes of
+``ops/attention.py:full_attention`` against
+``ops/pallas_attention.py:flash_attention`` at long-context shapes —
+where the fused kernel's O(t) HBM footprint vs dense's materialized
+[b, h, t, t] score tensor is the design point.  Each variant is one
+jitted ``lax.scan`` over the iterations (dispatch-free comparison, the
+tools/pallas_opt_bench.py harness shape), timed after a warmup, with a
+D2H read inside the window (block_until_ready can return early through
+the remote tunnel).  Prints one JSON line per shape with microseconds
+per call and the HBM bytes the dense path materializes for scores.
+
+Run on real TPU (a tunnel window); CPU+interpret only with --allow-cpu.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (batch, tokens, heads, head_dim): the ViT's own tiny geometry, then
+# long-context shapes where flash is the point.
+SHAPES = [(8, 16, 4, 16), (4, 512, 4, 64), (2, 2048, 4, 64)]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--grad", action="store_true",
+                    help="also time forward+backward")
+    ap.add_argument("--allow-cpu", action="store_true")
+    opts = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    if backend != "tpu" and not opts.allow_cpu:
+        print(json.dumps({"error": f"backend {backend!r}; pass --allow-cpu "
+                          "to run interpret-mode sanity timings"}))
+        sys.exit(1)
+    if backend != "tpu":
+        os.environ["TPU_MNIST_PALLAS_INTERPRET"] = "1"
+
+    from pytorch_mnist_ddp_tpu.ops.attention import full_attention
+    from pytorch_mnist_ddp_tpu.ops.pallas_attention import flash_attention
+
+    def timed(fn, q, k, v, out_to_q=lambda r: r) -> float:
+        """Per-call microseconds over a jitted scan whose carry feeds each
+        call's output back as the next query — the iteration dependence
+        that defeats loop-invariant hoisting, and traced (not closure-
+        captured) operands so nothing constant-folds at compile time.
+        ``out_to_q`` projects fn's result to a q-shaped carry (identity
+        for the forward; dq for the grad variant)."""
+
+        def run(q0, k0, v0):
+            def body(qc, _):
+                return out_to_q(fn(qc, k0, v0)), ()
+
+            final, _ = jax.lax.scan(body, q0, None, length=opts.iters)
+            return final
+
+        jit_run = jax.jit(run)
+        out = jit_run(q, k, v)  # warmup: trace + compile
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = jit_run(q, k, v)
+        float(jax.tree.leaves(out)[0].ravel()[0])  # D2H inside the window
+        return (time.perf_counter() - t0) / opts.iters * 1e6
+
+    rows = []
+    for b, t, h, d in SHAPES:
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (b, t, h, d)
+        q = jax.random.normal(kq, shape, jnp.float32)
+        k = jax.random.normal(kk, shape, jnp.float32)
+        v = jax.random.normal(kv, shape, jnp.float32)
+        row = {
+            "shape": list(shape),
+            "dense_scores_mb": round(b * h * t * t * 4 / 2**20, 1),
+            "dense_us": round(timed(full_attention, q, k, v), 2),
+            "flash_us": round(timed(flash_attention, q, k, v), 2),
+        }
+        if opts.grad:
+            def dense_loss(q, k, v):
+                return (full_attention(q, k, v) ** 2).sum()
+
+            def flash_loss(q, k, v):
+                return (flash_attention(q, k, v) ** 2).sum()
+
+            # Feed dq back as the next q, RMS-normalized so 50 chained
+            # grad calls can't decay/overflow the operands (the normalize
+            # is negligible next to the attention FLOPs).
+            def dq_carry(r):
+                dq = r[0]
+                rms = jnp.sqrt(jnp.mean(dq.astype(jnp.float32) ** 2) + 1e-12)
+                return (dq / rms).astype(dq.dtype)
+
+            row["dense_grad_us"] = round(
+                timed(jax.grad(dense_loss, argnums=(0, 1, 2)), q, k, v,
+                      out_to_q=dq_carry), 2
+            )
+            row["flash_grad_us"] = round(
+                timed(jax.grad(flash_loss, argnums=(0, 1, 2)), q, k, v,
+                      out_to_q=dq_carry), 2
+            )
+        rows.append(row)
+    print(json.dumps({
+        "metric": "attention_call_us",
+        "iters": opts.iters,
+        "backend": backend,
+        "device_kind": jax.devices()[0].device_kind,
+        "rows": rows,
+    }))
+
+
+if __name__ == "__main__":
+    main()

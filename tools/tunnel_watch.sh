@@ -120,6 +120,13 @@ while true; do
             >"$OUT/bench_r3_vit_run.json" 2>"$OUT/bench_r3_vit_run.err" \
             && echo "[$(stamp)] vit: $(promote vit_run vit)" \
             || echo "[$(stamp)] vit bench failed rc=$?"
+        echo "[$(stamp)] flash-attention micro-bench"
+        # 12 compiles (3 shapes x fwd/flash x +grad pairs) through the
+        # tunnel: bound generously.
+        timeout 540 python "$REPO/tools/flash_bench.py" --grad \
+            >"$OUT/bench_r3_flash.json" 2>"$OUT/bench_r3_flash.err" \
+            && echo "[$(stamp)] flash: $(cat "$OUT/bench_r3_flash.json")" \
+            || echo "[$(stamp)] flash bench failed rc=$?"
         echo "[$(stamp)] pallas micro-bench"
         python "$REPO/tools/pallas_opt_bench.py" \
             >"$OUT/bench_r3_pallas_micro.json" 2>"$OUT/bench_r3_pallas_micro.err" \

@@ -63,6 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "sharded on the data axis, Adadelta state sharded "
                         "1/N (parallel/zero.py); mutually exclusive with "
                         "--sp/--tp/--pp/--experts/--fused")
+    p.add_argument("--flash", action="store_true", default=False,
+                   help="fused Pallas flash-attention kernel for the "
+                        "single-device and --zero paths "
+                        "(ops/pallas_attention.py); falls back to the "
+                        "dense path with a warning off-TPU")
     p.add_argument("--depth", type=int, default=2, metavar="N",
                    help="transformer blocks (default: 2)")
     p.add_argument("--dim", type=int, default=64, metavar="D",
@@ -95,6 +100,12 @@ def main() -> None:
         raise SystemExit(
             "--zero is plain data parallelism; drop --sp/--tp/--pp/"
             "--experts/--fused"
+        )
+    if args.flash and (args.sp > 1 or args.tp > 1 or args.pp
+                       or args.experts > 0 or args.fused):
+        raise SystemExit(
+            "--flash rides the single-device and --zero paths; the "
+            "sharded modes compose their own attention"
         )
 
     import jax
@@ -263,24 +274,31 @@ def main() -> None:
         train_step = make_ep_train_step(mesh, cfg)
         eval_step = make_ep_eval_step(mesh, cfg)
     elif args.zero:
+        from pytorch_mnist_ddp_tpu.ops.pallas_attention import attention_best
         from pytorch_mnist_ddp_tpu.parallel.pp_vit import make_vit_eval_step
         from pytorch_mnist_ddp_tpu.parallel.zero import (
             make_zero_train_state,
             make_zero_vit_train_step,
         )
 
+        attention_fn = attention_best(args.flash)
         mesh = make_mesh(num_model=1)
         state = make_zero_train_state(params, mesh)
-        train_step = make_zero_vit_train_step(mesh, cfg)
-        eval_step = make_vit_eval_step(mesh, cfg)
+        train_step = make_zero_vit_train_step(
+            mesh, cfg, attention_fn=attention_fn
+        )
+        eval_step = make_vit_eval_step(mesh, cfg, attention_fn=attention_fn)
     else:
+        from pytorch_mnist_ddp_tpu.ops.pallas_attention import attention_best
+
+        attention_fn = attention_best(args.flash)
         mesh = make_mesh(num_data=1, devices=jax.devices()[:1])
         state = replicate_params(make_train_state(params), mesh)
 
         @jax.jit
         def train_step(state, x, y, w, lr):
             def loss_fn(p):
-                logp = vit_forward(p, x, cfg)
+                logp = vit_forward(p, x, cfg, attention_fn=attention_fn)
                 return nll_loss(logp, y, w, reduction="mean")
 
             loss, grads = jax.value_and_grad(loss_fn)(state.params)
@@ -293,7 +311,7 @@ def main() -> None:
 
         @jax.jit
         def eval_step(params, x, y, w):
-            logp = vit_forward(params, x, cfg)
+            logp = vit_forward(params, x, cfg, attention_fn=attention_fn)
             loss_sum = nll_loss(logp, y, w, reduction="sum")
             correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
             return jnp.stack([loss_sum, correct])
