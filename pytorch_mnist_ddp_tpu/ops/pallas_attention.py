@@ -73,6 +73,26 @@ def _block(t: int) -> int:
     return _MAX_BLOCK if t >= _MAX_BLOCK else -(-t // 8) * 8
 
 
+def flash_pad_len(t: int) -> int:
+    """Token-axis length after padding to a whole number of kernel
+    blocks — what callers that hold kernel-layout state across calls
+    (the ring, parallel/sp.py) must pad to."""
+    block = _block(t)
+    return -(-t // block) * block
+
+
+def flash_lane_pad(d: int) -> int:
+    """Head-dim after padding to the kernel's lane boundary."""
+    return -(-d // _LANES) * _LANES
+
+
+def flash_fold_pad(x: jax.Array, t_pad: int) -> jax.Array:
+    """Public fold+pad into the kernel's ``[b*h, t_pad, d_pad]`` layout —
+    the ONE place the convention lives; external callers (the ring,
+    parallel/sp.py) must not re-derive it."""
+    return _pad_to(_pad_to(_fold(x), 1, t_pad), 2, flash_lane_pad(x.shape[-1]))
+
+
 def _pad_to(x: jax.Array, axis: int, size: int) -> jax.Array:
     pad = size - x.shape[axis]
     if pad == 0:
@@ -80,6 +100,17 @@ def _pad_to(x: jax.Array, axis: int, size: int) -> jax.Array:
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
     return jnp.pad(x, widths)
+
+
+def _out_struct(shape, dtype, *inputs) -> jax.ShapeDtypeStruct:
+    """Output aval for a pallas_call that may run under a VMA-tracking
+    ``shard_map`` (the sequence-parallel steps): the outputs vary on the
+    union of the inputs' mesh axes.  Outside shard_map every vma is
+    empty and this is a plain ShapeDtypeStruct."""
+    vma = frozenset()
+    for x in inputs:
+        vma = vma | jax.typeof(x).vma
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
@@ -154,8 +185,8 @@ def _flash_fwd(q3, k3, v3, t_real: int, scale: float, interpret: bool):
         in_specs=[qo_spec, kv_spec, kv_spec],
         out_specs=[qo_spec, lse_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tp, dp), q3.dtype),
-            jax.ShapeDtypeStruct((bh, tp, _LANES), jnp.float32),
+            _out_struct((bh, tp, dp), q3.dtype, q3, k3, v3),
+            _out_struct((bh, tp, _LANES), jnp.float32, q3, k3, v3),
         ],
         scratch_shapes=[
             pltpu.VMEM((block, _LANES), jnp.float32),  # m
@@ -273,14 +304,186 @@ def _vjp_bwd(res, g):
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-def attention_best(use_flash: bool | None = None):
-    """Pick the attention implementation for this run: the Pallas kernel
-    when ``--flash`` is active on a capable backend, else the dense
-    oracle (ops/attention.py).  Returns an ``AttentionFn`` —
-    models/vit.py injects it through the family's shared sublayer."""
-    from .attention import full_attention
+def _partial_kernel(q_ref, k_ref, v_ref, m0_ref, l0_ref, a0_ref,
+                    m_out, l_out, a_out, m_scr, l_scr, acc_scr,
+                    *, t_kv: int, block: int, nk: int, scale: float):
+    """The accumulator-in/accumulator-out variant of ``_fwd_kernel``: the
+    online-softmax state enters as (m0, l0, a0) instead of the empty
+    accumulator and leaves RAW (no normalization) — the fused building
+    block ring attention folds once per hop (parallel/sp.py).  State
+    layout is the kernel's own: lane-broadcast [tq, 128] m/l, [tq, dp]
+    f32 accumulator."""
+    kb = pl.program_id(2)
 
-    if use_flash and not flash_active(use_flash):
+    @pl.when(kb == 0)
+    def _load():
+        m_scr[:] = m0_ref[0]
+        l_scr[:] = l0_ref[0]
+        acc_scr[:] = a0_ref[0]
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    cols = kb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(cols < t_kv, s, NEG_INF)
+
+    m_prev = m_scr[:]
+    row_max = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, jnp.broadcast_to(row_max, m_prev.shape))
+    p = jnp.exp(s - m_new[:, :1])
+    corr = jnp.exp(m_prev - m_new)
+    l_scr[:] = l_scr[:] * corr + jnp.broadcast_to(
+        jnp.sum(p, axis=1, keepdims=True), m_prev.shape
+    )
+    acc_scr[:] = acc_scr[:] * corr[:, :1] + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = m_new
+
+    @pl.when(kb == nk - 1)
+    def _store():
+        m_out[0] = m_scr[:]
+        l_out[0] = l_scr[:]
+        a_out[0] = acc_scr[:]
+
+
+def _partial_ref(m, l, a, q3, k3, v3, t_kv: int, scale: float):
+    """Pure-JAX twin of ``_partial_kernel`` on the SAME kernel-layout
+    state — the recompute target for the custom-VJP backward (and the
+    parity oracle in tests).  Math identical to
+    ops/attention.py:block_update, re-expressed on lane-broadcast
+    stats."""
+    qf = q3.astype(jnp.float32)
+    kf = k3.astype(jnp.float32)
+    s = scale * jnp.einsum("bqd,bkd->bqk", qf, kf)
+    cols = jnp.arange(s.shape[-1])[None, None, :]
+    s = jnp.where(cols < t_kv, s, NEG_INF)
+    row_max = jnp.max(s, axis=-1, keepdims=True)  # [BH, tq, 1]
+    m_new = jnp.maximum(m, row_max)  # broadcast over the 128 lanes
+    p = jnp.exp(s - m_new[..., :1])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    a_new = a * corr[..., :1] + jnp.einsum(
+        "bqk,bkd->bqd", p, v3.astype(jnp.float32)
+    )
+    return m_new, l_new, a_new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def flash_block_update(m, l, a, q3, k3, v3, t_kv: int, scale: float):
+    """One fused ring-attention hop: fold the visiting (k3, v3) block into
+    the kernel-layout accumulator.  ``q3/k3/v3``: padded folded
+    ``[BH, t_pad, dp]``; state as ``_partial_kernel`` documents.  The
+    backward recomputes through the pure-JAX twin (``_partial_ref``) —
+    O(block) memory, no residual score tensors."""
+    return _flash_partial(m, l, a, q3, k3, v3, t_kv, scale)
+
+
+def _flash_partial(m, l, a, q3, k3, v3, t_kv, scale,
+                   interpret: bool | None = None):
+    """``interpret=None`` (the custom-VJP path): real kernel on TPU,
+    the EXACT pure-JAX twin elsewhere — the Pallas interpreter cannot
+    trace under the VMA tracking the sequence-parallel shard_maps rely
+    on, and ``_partial_ref`` is the same math (pinned against the
+    interpreted kernel in tests/test_flash.py, which forces
+    ``interpret=True`` outside shard_map)."""
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return _partial_ref(m, l, a, q3, k3, v3, t_kv, scale)
+        interpret = False
+    bh, tqp, dp = q3.shape
+    tkp = k3.shape[1]
+    bq = _block(tqp)
+    bk = _block(t_kv)
+    assert tqp % bq == 0 and tkp % bk == 0, (tqp, bq, tkp, bk)
+    nq = tqp // bq
+    nk = tkp // bk
+    kern = functools.partial(
+        _partial_kernel, t_kv=t_kv, block=bk, nk=nk, scale=scale
+    )
+    q_spec = pl.BlockSpec(
+        (1, bq, dp), lambda b, qi, ki: (b, qi, 0), memory_space=pltpu.VMEM
+    )
+    kv_spec = pl.BlockSpec(
+        (1, bk, dp), lambda b, qi, ki: (b, ki, 0), memory_space=pltpu.VMEM
+    )
+    ml_spec = pl.BlockSpec(
+        (1, bq, _LANES), lambda b, qi, ki: (b, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    return pl.pallas_call(
+        kern,
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, ml_spec, ml_spec, q_spec],
+        out_specs=[ml_spec, ml_spec, q_spec],
+        out_shape=[
+            _out_struct((bh, tqp, _LANES), jnp.float32, m, l, a, q3, k3, v3),
+            _out_struct((bh, tqp, _LANES), jnp.float32, m, l, a, q3, k3, v3),
+            _out_struct((bh, tqp, dp), jnp.float32, m, l, a, q3, k3, v3),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, dp), jnp.float32),
+        ],
+        # The state updates in place: (m0, l0, a0) buffers are dead after
+        # the hop and become (m, l, a) out.
+        input_output_aliases={3: 0, 4: 1, 5: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q3, k3, v3, m, l, a)
+
+
+def _partial_vjp_fwd(m, l, a, q3, k3, v3, t_kv, scale):
+    out = _flash_partial(m, l, a, q3, k3, v3, t_kv, scale)
+    return out, (m, l, a, q3, k3, v3)
+
+
+def _partial_vjp_bwd(t_kv, scale, res, cot):
+    m, l, a, q3, k3, v3 = res
+    _, vjp = jax.vjp(
+        lambda m, l, a, q3, k3, v3: _partial_ref(
+            m, l, a, q3, k3, v3, t_kv, scale
+        ),
+        m, l, a, q3, k3, v3,
+    )
+    return vjp(cot)
+
+
+flash_block_update.defvjp(_partial_vjp_fwd, _partial_vjp_bwd)
+
+
+def flash_ring_state(bh: int, tq_pad: int, dp: int):
+    """Empty kernel-layout accumulator for a ring of
+    ``flash_block_update`` hops."""
+    return (
+        jnp.full((bh, tq_pad, _LANES), NEG_INF, jnp.float32),
+        jnp.zeros((bh, tq_pad, _LANES), jnp.float32),
+        jnp.zeros((bh, tq_pad, dp), jnp.float32),
+    )
+
+
+def flash_ring_finalize(m, l, a, b: int, h: int, t: int, d: int, dtype):
+    """Normalize kernel-layout state into attention output
+    ``[b, t, h, d]`` — the finalize_block_acc counterpart (all-masked
+    rows, l == 0, emit 0 not NaN)."""
+    l1 = l[..., :1]
+    out3 = jnp.where(l1 > 0, a / jnp.where(l1 > 0, l1, 1.0), 0.0)
+    return _unfold(out3[:, :t, :d], b, h).astype(dtype)
+
+
+def flash_active_or_warn(use_flash: bool | None) -> bool:
+    """``flash_active`` plus the one shared off-TPU fallback warning —
+    every CLI branch (single-device/--zero via :func:`attention_best`,
+    the --sp ring) reports the inactive-kernel case through here."""
+    active = flash_active(use_flash)
+    if use_flash and not active:
         import warnings
 
         warnings.warn(
@@ -289,6 +492,18 @@ def attention_best(use_flash: bool | None = None):
             "the dense attention path instead (set "
             "TPU_MNIST_PALLAS_INTERPRET=1 to force interpret mode for "
             "testing)",
-            stacklevel=2,
+            stacklevel=3,
         )
-    return flash_attention if flash_active(use_flash) else full_attention
+    return active
+
+
+def attention_best(use_flash: bool | None = None):
+    """Pick the attention implementation for this run: the Pallas kernel
+    when ``--flash`` is active on a capable backend, else the dense
+    oracle (ops/attention.py).  Returns an ``AttentionFn`` —
+    models/vit.py injects it through the family's shared sublayer."""
+    from .attention import full_attention
+
+    return (
+        flash_attention if flash_active_or_warn(use_flash) else full_attention
+    )

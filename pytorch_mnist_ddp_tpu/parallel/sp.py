@@ -123,6 +123,60 @@ def ring_attention(
     return finalize_block_acc(acc, q.dtype)
 
 
+def ring_attention_flash(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = SEQ_AXIS,
+) -> jax.Array:
+    """:func:`ring_attention` with each hop's fold fused into the Pallas
+    partial-accumulation kernel (ops/pallas_attention.py:
+    flash_block_update) — the two long-context layers composed: the ring
+    moves k/v blocks BETWEEN chips, the kernel fuses scores + rescale +
+    value-matmul WITHIN one, and the online-softmax state never leaves
+    the kernel's lane-broadcast layout between hops (fold/pad once,
+    finalize once).  Maskless (the family has no token padding; the
+    masked path stays on :func:`ring_attention`).  Exactness contract and
+    parity pins: tests/test_flash.py."""
+    from ..ops import pallas_attention as pa
+
+    size = jax.lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+    tp = pa.flash_pad_len(t_local)
+    scale = 1.0 / float(d) ** 0.5
+    q3 = pa.flash_fold_pad(q, tp)
+    k3 = pa.flash_fold_pad(k, tp)
+    v3 = pa.flash_fold_pad(v, tp)
+    m, l, a = pa.flash_ring_state(b * h, tp, q3.shape[-1])
+    m, l, a = pa.flash_block_update(m, l, a, q3, k3, v3, t_local, scale)
+
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    # Same VMA discipline as ring_attention when tracking is on; under a
+    # check_vma=False shard_map (the kernel's normal home — see
+    # make_sp_train_step) every vma is empty and no cast exists to make.
+    input_vma = jax.typeof(q3).vma | jax.typeof(k3).vma | jax.typeof(v3).vma
+    target_vma = ({axis_name} | input_vma) if input_vma else set()
+
+    def ensure_varying(leaf):
+        missing = tuple(sorted(target_vma - set(jax.typeof(leaf).vma)))
+        if not missing:
+            return leaf
+        return jax.lax.pcast(leaf, missing, to="varying")
+
+    def hop(carry, _):
+        m, l, a, k3, v3 = carry
+        k3 = jax.lax.ppermute(k3, axis_name, perm)
+        v3 = jax.lax.ppermute(v3, axis_name, perm)
+        m, l, a = pa.flash_block_update(m, l, a, q3, k3, v3, t_local, scale)
+        return (m, l, a, k3, v3), None
+
+    (m, l, a, _, _), _ = jax.lax.scan(
+        hop, jax.tree.map(ensure_varying, (m, l, a, k3, v3)), None,
+        length=size - 1,
+    )
+    return pa.flash_ring_finalize(m, l, a, b, h, t_local, d, q.dtype)
+
+
 # ---------------------------------------------------------------------------
 # Sequence-parallel ViT training: the 2-D (data, seq) step.
 # ---------------------------------------------------------------------------
@@ -140,7 +194,9 @@ def _check_token_divisibility(cfg, mesh: Mesh) -> None:
         )
 
 
-def _sp_vit_forward(params: dict, x: jax.Array, cfg) -> jax.Array:
+def _sp_vit_forward(
+    params: dict, x: jax.Array, cfg, use_flash: bool = False
+) -> jax.Array:
     """The ViT forward over a TOKEN SHARD, inside shard_map.
 
     ``x`` is the local data-shard of images, replicated over ``seq``; this
@@ -170,10 +226,11 @@ def _sp_vit_forward(params: dict, x: jax.Array, cfg) -> jax.Array:
         params["pos_embed"], start, t_local, axis=0
     ).astype(dt)
     tokens = dense(patches, params["embed"]) + pos
+    ring = ring_attention_flash if use_flash else ring_attention
     for i in range(cfg.depth):
         tokens = apply_block(
             params["blocks"][str(i)], tokens, cfg,
-            lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS),
+            lambda q, k, v: ring(q, k, v, SEQ_AXIS),
         )
     tokens = layer_norm(tokens, params["ln_f"])
     # fp32 pool (the same head/log_softmax numerics contract as the
@@ -185,7 +242,8 @@ def _sp_vit_forward(params: dict, x: jax.Array, cfg) -> jax.Array:
     return tokens_to_logp(params, pooled)
 
 
-def make_sp_train_step(mesh: Mesh, cfg, rho: float = 0.9, eps: float = 1e-6):
+def make_sp_train_step(mesh: Mesh, cfg, rho: float = 0.9, eps: float = 1e-6,
+                       use_flash: bool = False):
     """Build the jitted 2-D (data x seq) ViT train step.
 
     ``step_fn(state, x, y, w, lr) -> (state, losses)`` with ``state`` a
@@ -208,7 +266,7 @@ def make_sp_train_step(mesh: Mesh, cfg, rho: float = 0.9, eps: float = 1e-6):
 
     def local_step(state: TrainState, x, y, w, lr):
         def loss_fn(params):
-            logp = _sp_vit_forward(params, x, cfg)
+            logp = _sp_vit_forward(params, x, cfg, use_flash=use_flash)
             return nll_loss(logp, y, w, reduction="mean")
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
@@ -227,7 +285,7 @@ def make_sp_train_step(mesh: Mesh, cfg, rho: float = 0.9, eps: float = 1e-6):
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_sp_eval_step(mesh: Mesh, cfg):
+def make_sp_eval_step(mesh: Mesh, cfg, use_flash: bool = False):
     """Jitted (data x seq) eval step: ring-attention forward + the psum'd
     (loss_sum, correct) totals of ddp.make_eval_step — identical printed
     numbers, full-mesh participation."""
@@ -238,7 +296,7 @@ def make_sp_eval_step(mesh: Mesh, cfg):
     _check_token_divisibility(cfg, mesh)
 
     def local_eval(params, x, y, w):
-        logp = _sp_vit_forward(params, x, cfg)
+        logp = _sp_vit_forward(params, x, cfg, use_flash=use_flash)
         loss_sum = nll_loss(logp, y, w, reduction="sum")
         correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)

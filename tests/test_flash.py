@@ -107,6 +107,113 @@ def test_vit_forward_with_flash_matches_dense():
     )
 
 
+def test_partial_kernel_matches_pure_reference():
+    """flash_block_update == _partial_ref on identical kernel-layout
+    state (the custom-VJP recompute target must track the kernel)."""
+    from pytorch_mnist_ddp_tpu.ops import pallas_attention as pa
+
+    rng = np.random.RandomState(5)
+    bh, t, d = 4, 24, 16
+    tp, dp = pa.flash_pad_len(t), 128
+    scale = 1.0 / d ** 0.5
+    pad = lambda x: jnp.asarray(
+        np.pad(x, ((0, 0), (0, tp - t), (0, dp - d))).astype(np.float32)
+    )
+    q3 = pad(rng.randn(bh, t, d))
+    k3 = pad(rng.randn(bh, t, d))
+    v3 = pad(rng.randn(bh, t, d))
+    state = pa.flash_ring_state(bh, tp, dp)
+    # interpret=True forces the ACTUAL (interpreted) kernel on CPU — the
+    # default dispatch would route to _partial_ref itself off-TPU.
+    out_k = pa._flash_partial(*state, q3, k3, v3, t, scale, interpret=True)
+    out_r = pa._partial_ref(*state, q3, k3, v3, t, scale)
+    # Fold a SECOND block in (state-carrying path, not the empty state).
+    k3b = pad(rng.randn(bh, t, d))
+    v3b = pad(rng.randn(bh, t, d))
+    out_k2 = pa._flash_partial(*out_k, q3, k3b, v3b, t, scale, interpret=True)
+    out_r2 = pa._partial_ref(*out_r, q3, k3b, v3b, t, scale)
+    for a, b in zip(out_k2, out_r2):
+        # Padded q rows hold arbitrary all-masked-state values; compare
+        # the real rows only.
+        np.testing.assert_allclose(
+            np.asarray(a)[:, :t], np.asarray(b)[:, :t], rtol=1e-5, atol=1e-6
+        )
+
+
+def test_ring_flash_matches_dense(devices):
+    """The composed long-context path: ring attention with every hop's
+    fold fused in the kernel == single-device dense attention over the
+    full sequence, on a (2 data x 4 seq) mesh."""
+    from pytorch_mnist_ddp_tpu.parallel.mesh import DATA_AXIS
+    from pytorch_mnist_ddp_tpu.parallel.sp import (
+        SEQ_AXIS, make_sp_mesh, ring_attention_flash,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_sp_mesh(num_data=2, num_seq=4)
+    b, t, h, d = 2, 32, 2, 16
+    rng = np.random.RandomState(6)
+    q, k, v = (
+        jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+        for _ in range(3)
+    )
+
+    def local(q, k, v):
+        return ring_attention_flash(q, k, v, SEQ_AXIS)
+
+    ring = jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(DATA_AXIS, SEQ_AXIS),) * 3,
+        out_specs=P(DATA_AXIS, SEQ_AXIS),
+    ))
+    np.testing.assert_allclose(
+        np.asarray(ring(q, k, v)),
+        np.asarray(full_attention(q, k, v)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+@pytest.mark.slow  # two sp train-step compiles
+def test_sp_train_step_flash_matches_plain(devices):
+    """3 training steps through the flash-ring forward == 3 through the
+    plain ring (same init/batches): the custom-VJP backward of the
+    partial kernel is exact through the whole (data x seq) step."""
+    from pytorch_mnist_ddp_tpu.models.vit import ViTConfig, init_vit_params
+    from pytorch_mnist_ddp_tpu.parallel.ddp import (
+        make_train_state, replicate_params,
+    )
+    from pytorch_mnist_ddp_tpu.parallel.mesh import data_sharding
+    from pytorch_mnist_ddp_tpu.parallel.sp import (
+        make_sp_mesh, make_sp_train_step,
+    )
+
+    cfg = ViTConfig()
+    mesh = make_sp_mesh(num_data=2, num_seq=4)
+    params = jax.device_get(init_vit_params(jax.random.PRNGKey(0), cfg))
+    copy = lambda t: jax.tree.map(np.array, t)
+    s_plain = replicate_params(make_train_state(copy(params)), mesh)
+    s_flash = replicate_params(make_train_state(copy(params)), mesh)
+    step_plain = make_sp_train_step(mesh, cfg)
+    step_flash = make_sp_train_step(mesh, cfg, use_flash=True)
+    ds = data_sharding(mesh)
+    rng = np.random.RandomState(7)
+    for i in range(3):
+        x = jax.device_put(rng.rand(16, 28, 28, 1).astype(np.float32), ds)
+        y = jax.device_put(rng.randint(0, 10, 16).astype(np.int32), ds)
+        w = jax.device_put(np.ones(16, np.float32), ds)
+        s_plain, l_plain = step_plain(s_plain, x, y, w, jnp.float32(0.5))
+        s_flash, l_flash = step_flash(s_flash, x, y, w, jnp.float32(0.5))
+    np.testing.assert_allclose(
+        np.asarray(l_plain), np.asarray(l_flash), rtol=1e-5, atol=1e-6
+    )
+    for a, b in zip(
+        jax.tree.leaves(s_plain.params), jax.tree.leaves(s_flash.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+
+
 def test_dispatch_gate(monkeypatch):
     """attention_best: kernel only when the backend can lower it for real
     (or the interpret hook is set); otherwise dense with a warning —

@@ -112,11 +112,48 @@ def main() -> None:
                       out_to_q=dq_carry), 2
             )
         rows.append(row)
+
+    # Ring-kernel smoke: flash_block_update under a VMA-tracking
+    # shard_map on the real chip (a 1x1 mesh degenerates the ring to the
+    # resident fold) — the CPU tests route this path to the pure-JAX twin,
+    # so hardware is the only place the kernel-under-VMA trace runs.
+    ring_smoke = None
+    try:
+        from jax.sharding import PartitionSpec as P
+
+        from pytorch_mnist_ddp_tpu.ops.attention import full_attention as fa
+        from pytorch_mnist_ddp_tpu.parallel.mesh import DATA_AXIS
+        from pytorch_mnist_ddp_tpu.parallel.sp import (
+            SEQ_AXIS, make_sp_mesh, ring_attention_flash,
+        )
+
+        mesh = make_sp_mesh(num_data=1, num_seq=1, devices=jax.devices()[:1])
+        b, t, h, d = 2, 256, 2, 64
+        key = jax.random.PRNGKey(1)
+        q, k, v = (
+            jax.random.normal(kk, (b, t, h, d), jnp.float32)
+            for kk in jax.random.split(key, 3)
+        )
+        # Sharded in_specs even on the 1x1 mesh: the inputs must be
+        # device-VARYING so the kernel traces with the non-empty vma a
+        # real --sp N --flash run produces (replicated P() inputs would
+        # smoke a different, trivially-easier trace).
+        ring = jax.jit(jax.shard_map(
+            lambda q, k, v: ring_attention_flash(q, k, v, SEQ_AXIS),
+            mesh=mesh, in_specs=(P(DATA_AXIS, SEQ_AXIS),) * 3,
+            out_specs=P(DATA_AXIS, SEQ_AXIS),
+        ))
+        err = float(jnp.abs(ring(q, k, v) - fa(q, k, v)).max())
+        ring_smoke = {"ok": bool(err < 1e-4), "max_err": err}
+    except Exception as e:  # noqa: BLE001 — recorded, not fatal
+        ring_smoke = {"ok": False, "error": repr(e)[:300]}
+
     print(json.dumps({
         "metric": "attention_call_us",
         "iters": opts.iters,
         "backend": backend,
         "device_kind": jax.devices()[0].device_kind,
+        "ring_vma_smoke": ring_smoke,
         "rows": rows,
     }))
 

@@ -65,9 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "--sp/--tp/--pp/--experts/--fused")
     p.add_argument("--flash", action="store_true", default=False,
                    help="fused Pallas flash-attention kernel for the "
-                        "single-device and --zero paths "
-                        "(ops/pallas_attention.py); falls back to the "
-                        "dense path with a warning off-TPU")
+                        "single-device, --zero, and --sp paths "
+                        "(ops/pallas_attention.py; under --sp each ring "
+                        "hop's fold runs in the partial-accumulation "
+                        "kernel); falls back to the dense path with a "
+                        "warning off-TPU")
     p.add_argument("--depth", type=int, default=2, metavar="N",
                    help="transformer blocks (default: 2)")
     p.add_argument("--dim", type=int, default=64, metavar="D",
@@ -101,11 +103,11 @@ def main() -> None:
             "--zero is plain data parallelism; drop --sp/--tp/--pp/"
             "--experts/--fused"
         )
-    if args.flash and (args.sp > 1 or args.tp > 1 or args.pp
+    if args.flash and (args.tp > 1 or args.pp
                        or args.experts > 0 or args.fused):
         raise SystemExit(
-            "--flash rides the single-device and --zero paths; the "
-            "sharded modes compose their own attention"
+            "--flash rides the single-device, --zero, and --sp paths; "
+            "drop --tp/--pp/--experts/--fused"
         )
 
     import jax
@@ -252,16 +254,20 @@ def main() -> None:
         )
         eval_step = make_vit_eval_step(mesh, cfg)
     elif args.sp > 1:
+        from pytorch_mnist_ddp_tpu.ops.pallas_attention import (
+            flash_active_or_warn,
+        )
         from pytorch_mnist_ddp_tpu.parallel.sp import (
             make_sp_eval_step,
             make_sp_mesh,
             make_sp_train_step,
         )
 
+        use_flash = flash_active_or_warn(args.flash)
         mesh = make_sp_mesh(num_data=None, num_seq=args.sp)
         state = replicate_params(make_train_state(params), mesh)
-        train_step = make_sp_train_step(mesh, cfg)
-        eval_step = make_sp_eval_step(mesh, cfg)
+        train_step = make_sp_train_step(mesh, cfg, use_flash=use_flash)
+        eval_step = make_sp_eval_step(mesh, cfg, use_flash=use_flash)
     elif args.experts > 0:
         from pytorch_mnist_ddp_tpu.parallel.ep import (
             make_ep_eval_step,
