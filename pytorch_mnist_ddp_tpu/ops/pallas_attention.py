@@ -113,16 +113,12 @@ def _out_struct(shape, dtype, *inputs) -> jax.ShapeDtypeStruct:
     return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, t_real: int, block: int, nk: int, scale: float):
-    kb = pl.program_id(2)
-
-    @pl.when(kb == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-
+def _fold_block(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                kb, block: int, t_kv: int, scale: float) -> None:
+    """THE online-softmax fold, shared by both kernels (whole-forward and
+    partial): score matmul, padded-key-column mask, running (m, l, acc)
+    rescale-update — the numerically load-bearing body lives once.
+    Scratch layout: lane-broadcast ``[bq, 128]`` m/l, ``[bq, dp]`` acc."""
     q = q_ref[0]  # [bq, dp]
     k = k_ref[0]  # [bk, dp]
     v = v_ref[0]
@@ -132,7 +128,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     # Mask padded key columns (t padded up to a block multiple): their
     # zero-filled k rows would otherwise contribute exp(0 - m) mass.
     cols = kb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    s = jnp.where(cols < t_real, s, NEG_INF)
+    s = jnp.where(cols < t_kv, s, NEG_INF)
 
     m_prev = m_scr[:]  # [bq, 128] lane-broadcast
     row_max = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
@@ -147,6 +143,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         preferred_element_type=jnp.float32,
     )
     m_scr[:] = m_new
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, t_real: int, block: int, nk: int, scale: float):
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    _fold_block(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                kb, block, t_real, scale)
 
     @pl.when(kb == nk - 1)
     def _finalize():
@@ -213,8 +223,6 @@ def _unfold(x3: jax.Array, b: int, h: int) -> jax.Array:
     return x3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _prep(x: jax.Array, tp: int, dp: int) -> jax.Array:
-    return _pad_to(_pad_to(_fold(x), 1, tp), 2, dp)
 
 
 def _bwd_blockwise(q3, k3, v3, out3, lse, g3, t_real: int, scale: float):
@@ -269,15 +277,33 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     return out
 
 
+def _dense_fwd_res(q, k, v, scale):
+    """Pure-JAX twin of the whole-forward kernel, same (out, lse) contract
+    — the off-TPU route when tracing under VMA tracking (a Ulysses
+    shard_map), where the Pallas interpreter cannot run.  Numerics match
+    ops/attention.py:full_attention's f32 contract."""
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32), k.astype(jnp.float32),
+    ) * scale
+    lse = jax.scipy.special.logsumexp(s, axis=-1)  # [b, h, t]
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    b, t, h, _ = q.shape
+    return out.astype(q.dtype), lse.reshape(b * h, t)
+
+
 def _flash_fwd_res(q, k, v):
     b, t, h, d = q.shape
-    interpret = jax.default_backend() != "tpu"
-    block = _block(t)
-    tp = -(-t // block) * block
-    dp = -(-d // _LANES) * _LANES
     scale = 1.0 / float(d) ** 0.5
+    interpret = jax.default_backend() != "tpu"
+    if interpret and jax.typeof(q).vma:
+        # Under VMA-tracked shard_map the interpreter cannot trace the
+        # kernel (see _flash_partial); same exact-twin dispatch.
+        return _dense_fwd_res(q, k, v, scale)
+    tp = flash_pad_len(t)
     out3, lse = _flash_fwd(
-        _prep(q, tp, dp), _prep(k, tp, dp), _prep(v, tp, dp),
+        flash_fold_pad(q, tp), flash_fold_pad(k, tp), flash_fold_pad(v, tp),
         t_real=t, scale=scale, interpret=interpret,
     )
     out = _unfold(out3[:, :t, :d], b, h)
@@ -321,28 +347,8 @@ def _partial_kernel(q_ref, k_ref, v_ref, m0_ref, l0_ref, a0_ref,
         l_scr[:] = l0_ref[0]
         acc_scr[:] = a0_ref[0]
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-    cols = kb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    s = jnp.where(cols < t_kv, s, NEG_INF)
-
-    m_prev = m_scr[:]
-    row_max = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, jnp.broadcast_to(row_max, m_prev.shape))
-    p = jnp.exp(s - m_new[:, :1])
-    corr = jnp.exp(m_prev - m_new)
-    l_scr[:] = l_scr[:] * corr + jnp.broadcast_to(
-        jnp.sum(p, axis=1, keepdims=True), m_prev.shape
-    )
-    acc_scr[:] = acc_scr[:] * corr[:, :1] + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    m_scr[:] = m_new
+    _fold_block(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                kb, block, t_kv, scale)
 
     @pl.when(kb == nk - 1)
     def _store():
@@ -492,7 +498,7 @@ def flash_active_or_warn(use_flash: bool | None) -> bool:
             "the dense attention path instead (set "
             "TPU_MNIST_PALLAS_INTERPRET=1 to force interpret mode for "
             "testing)",
-            stacklevel=3,
+            stacklevel=2,
         )
     return active
 

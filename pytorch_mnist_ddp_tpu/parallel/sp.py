@@ -151,9 +151,10 @@ def ring_attention_flash(
     m, l, a = pa.flash_block_update(m, l, a, q3, k3, v3, t_local, scale)
 
     perm = [(i, (i + 1) % size) for i in range(size)]
-    # Same VMA discipline as ring_attention when tracking is on; under a
-    # check_vma=False shard_map (the kernel's normal home — see
-    # make_sp_train_step) every vma is empty and no cast exists to make.
+    # Same VMA discipline as ring_attention when tracking is on (the sp
+    # steps keep check_vma=True — their transpose-inserted psums are
+    # load-bearing); under a check_vma=False shard_map every vma is
+    # empty and no cast exists to make.
     input_vma = jax.typeof(q3).vma | jax.typeof(k3).vma | jax.typeof(v3).vma
     target_vma = ({axis_name} | input_vma) if input_vma else set()
 
@@ -177,25 +178,76 @@ def ring_attention_flash(
     return pa.flash_ring_finalize(m, l, a, b, h, t_local, d, q.dtype)
 
 
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    use_flash: bool = False,
+) -> jax.Array:
+    """All-to-all sequence parallelism (the DeepSpeed-Ulysses pattern) —
+    the OTHER canonical long-context strategy next to the ring.
+
+    Where the ring keeps queries pinned and rotates k/v blocks S-1 hops,
+    Ulysses re-shards ONCE per attention: an ``all_to_all`` over the seq
+    axis trades the token sharding for a head sharding, so each device
+    holds the FULL sequence for ``heads/S`` of the heads, runs ordinary
+    dense attention locally (optionally the fused Pallas kernel — the
+    production long-context recipe), and a second ``all_to_all`` restores
+    the token sharding.  Two collectives total vs the ring's S-1 hops;
+    memory per device is O(T·h/S) during attention (vs the ring's
+    O(T/S·h)) — the canonical tradeoff.  Requires ``heads % S == 0``
+    (checked at step construction).
+
+    Call inside ``shard_map`` with ``q/k/v`` the LOCAL token blocks
+    ``[b, T/S, h, d]``; token shards are contiguous in ring order, so the
+    all_to_all's peer-ordered concat reassembles the global token order
+    exactly.  Maskless, like the flash paths (the family has no token
+    padding)."""
+    from ..ops.attention import full_attention
+    from ..ops.pallas_attention import flash_attention
+
+    # [b, T/S, h, d] -> [b, T, h/S, d]: split heads over peers, gather
+    # every peer's token block.
+    to_heads = lambda x: jax.lax.all_to_all(
+        x, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    fn = flash_attention if use_flash else full_attention
+    out = fn(to_heads(q), to_heads(k), to_heads(v))
+    # [b, T, h/S, d] -> [b, T/S, h, d]: the exact inverse.
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
 # ---------------------------------------------------------------------------
 # Sequence-parallel ViT training: the 2-D (data, seq) step.
 # ---------------------------------------------------------------------------
 
 
-def _check_token_divisibility(cfg, mesh: Mesh) -> None:
+def _check_token_divisibility(cfg, mesh: Mesh, impl: str = "ring") -> None:
     """A non-divisible token count would silently drop the trailing
     ``num_tokens % num_seq`` tokens from every shard's slice (and skew the
-    mean-pool denominator) — fail loudly at step-construction time."""
+    mean-pool denominator) — fail loudly at step-construction time.
+    Ulysses additionally needs the heads to split over the seq axis."""
     num_seq = mesh.shape[SEQ_AXIS]
     if cfg.num_tokens % num_seq:
         raise ValueError(
             f"num_tokens={cfg.num_tokens} not divisible by the seq axis "
             f"({num_seq}); pick a patch grid divisible by the mesh"
         )
+    if impl == "ulysses" and cfg.heads % num_seq:
+        raise ValueError(
+            f"--sp-impl ulysses shards heads over the seq axis: "
+            f"heads={cfg.heads} not divisible by {num_seq}"
+        )
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sp impl {impl!r}")
 
 
 def _sp_vit_forward(
-    params: dict, x: jax.Array, cfg, use_flash: bool = False
+    params: dict, x: jax.Array, cfg, use_flash: bool = False,
+    impl: str = "ring",
 ) -> jax.Array:
     """The ViT forward over a TOKEN SHARD, inside shard_map.
 
@@ -226,12 +278,15 @@ def _sp_vit_forward(
         params["pos_embed"], start, t_local, axis=0
     ).astype(dt)
     tokens = dense(patches, params["embed"]) + pos
-    ring = ring_attention_flash if use_flash else ring_attention
-    for i in range(cfg.depth):
-        tokens = apply_block(
-            params["blocks"][str(i)], tokens, cfg,
-            lambda q, k, v: ring(q, k, v, SEQ_AXIS),
+    if impl == "ulysses":
+        attn = lambda q, k, v: ulysses_attention(
+            q, k, v, SEQ_AXIS, use_flash=use_flash
         )
+    else:
+        ring = ring_attention_flash if use_flash else ring_attention
+        attn = lambda q, k, v: ring(q, k, v, SEQ_AXIS)
+    for i in range(cfg.depth):
+        tokens = apply_block(params["blocks"][str(i)], tokens, cfg, attn)
     tokens = layer_norm(tokens, params["ln_f"])
     # fp32 pool (the same head/log_softmax numerics contract as the
     # single-device trunk).
@@ -243,7 +298,7 @@ def _sp_vit_forward(
 
 
 def make_sp_train_step(mesh: Mesh, cfg, rho: float = 0.9, eps: float = 1e-6,
-                       use_flash: bool = False):
+                       use_flash: bool = False, impl: str = "ring"):
     """Build the jitted 2-D (data x seq) ViT train step.
 
     ``step_fn(state, x, y, w, lr) -> (state, losses)`` with ``state`` a
@@ -261,12 +316,14 @@ def make_sp_train_step(mesh: Mesh, cfg, rho: float = 0.9, eps: float = 1e-6,
     from ..ops.loss import nll_loss
     from .ddp import TrainState
 
-    _check_token_divisibility(cfg, mesh)
+    _check_token_divisibility(cfg, mesh, impl)
     num_data = mesh.shape[DATA_AXIS]
 
     def local_step(state: TrainState, x, y, w, lr):
         def loss_fn(params):
-            logp = _sp_vit_forward(params, x, cfg, use_flash=use_flash)
+            logp = _sp_vit_forward(
+                params, x, cfg, use_flash=use_flash, impl=impl
+            )
             return nll_loss(logp, y, w, reduction="mean")
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
@@ -285,18 +342,22 @@ def make_sp_train_step(mesh: Mesh, cfg, rho: float = 0.9, eps: float = 1e-6,
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_sp_eval_step(mesh: Mesh, cfg, use_flash: bool = False):
-    """Jitted (data x seq) eval step: ring-attention forward + the psum'd
-    (loss_sum, correct) totals of ddp.make_eval_step — identical printed
-    numbers, full-mesh participation."""
+def make_sp_eval_step(mesh: Mesh, cfg, use_flash: bool = False,
+                      impl: str = "ring"):
+    """Jitted (data x seq) eval step: sequence-parallel forward (ring or
+    ulysses) + the psum'd (loss_sum, correct) totals of
+    ddp.make_eval_step — identical printed numbers, full-mesh
+    participation."""
     from jax.sharding import PartitionSpec as P
 
     from ..ops.loss import nll_loss
 
-    _check_token_divisibility(cfg, mesh)
+    _check_token_divisibility(cfg, mesh, impl)
 
     def local_eval(params, x, y, w):
-        logp = _sp_vit_forward(params, x, cfg, use_flash=use_flash)
+        logp = _sp_vit_forward(
+            params, x, cfg, use_flash=use_flash, impl=impl
+        )
         loss_sum = nll_loss(logp, y, w, reduction="sum")
         correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)

@@ -144,7 +144,24 @@ def main() -> None:
             out_specs=P(DATA_AXIS, SEQ_AXIS),
         ))
         err = float(jnp.abs(ring(q, k, v) - fa(q, k, v)).max())
-        ring_smoke = {"ok": bool(err < 1e-4), "max_err": err}
+        # Same for the OTHER kernel-under-VMA path: the whole-forward
+        # kernel through ulysses_attention(use_flash=True) — off-TPU it
+        # always routes to the pure twin, so hardware is its only trace.
+        from pytorch_mnist_ddp_tpu.parallel.sp import ulysses_attention
+
+        ul = jax.jit(jax.shard_map(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, SEQ_AXIS, use_flash=True
+            ),
+            mesh=mesh, in_specs=(P(DATA_AXIS, SEQ_AXIS),) * 3,
+            out_specs=P(DATA_AXIS, SEQ_AXIS),
+        ))
+        ul_err = float(jnp.abs(ul(q, k, v) - fa(q, k, v)).max())
+        ring_smoke = {
+            "ok": bool(err < 1e-4 and ul_err < 1e-4),
+            "ring_max_err": err,
+            "ulysses_flash_max_err": ul_err,
+        }
     except Exception as e:  # noqa: BLE001 — recorded, not fatal
         ring_smoke = {"ok": False, "error": repr(e)[:300]}
 

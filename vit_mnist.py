@@ -44,6 +44,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence-parallel degree: ring attention over an "
                         "S-way seq axis (parallel/sp.py); composes with "
                         "--tp into the 3-D (data, seq, model) step")
+    p.add_argument("--sp-impl", type=str, default="ring",
+                   choices=("ring", "ulysses"),
+                   help="sequence-parallel strategy: 'ring' rotates k/v "
+                        "blocks S-1 ppermute hops; 'ulysses' re-shards "
+                        "tokens->heads with one all_to_all pair and runs "
+                        "dense (or --flash) attention locally "
+                        "(needs heads %% S == 0; plain --sp only)")
     p.add_argument("--tp", type=int, default=1, metavar="M",
                    help="tensor-parallel degree: Megatron-style head/MLP "
                         "sharding over an M-way model axis "
@@ -102,6 +109,15 @@ def main() -> None:
         raise SystemExit(
             "--zero is plain data parallelism; drop --sp/--tp/--pp/"
             "--experts/--fused"
+        )
+    if args.sp_impl != "ring" and args.tp > 1:
+        raise SystemExit(
+            "--sp-impl ulysses is the plain --sp path; the 3-D --sp --tp "
+            "composition rides the ring"
+        )
+    if args.sp_impl != "ring" and args.sp <= 1:
+        raise SystemExit(
+            "--sp-impl selects the --sp strategy; add --sp N (> 1)"
         )
     if args.flash and (args.tp > 1 or args.pp
                        or args.experts > 0 or args.fused):
@@ -266,8 +282,12 @@ def main() -> None:
         use_flash = flash_active_or_warn(args.flash)
         mesh = make_sp_mesh(num_data=None, num_seq=args.sp)
         state = replicate_params(make_train_state(params), mesh)
-        train_step = make_sp_train_step(mesh, cfg, use_flash=use_flash)
-        eval_step = make_sp_eval_step(mesh, cfg, use_flash=use_flash)
+        train_step = make_sp_train_step(
+            mesh, cfg, use_flash=use_flash, impl=args.sp_impl
+        )
+        eval_step = make_sp_eval_step(
+            mesh, cfg, use_flash=use_flash, impl=args.sp_impl
+        )
     elif args.experts > 0:
         from pytorch_mnist_ddp_tpu.parallel.ep import (
             make_ep_eval_step,
