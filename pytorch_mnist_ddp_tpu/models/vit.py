@@ -50,6 +50,12 @@ class ViTConfig(NamedTuple):
     # softmax, attention accumulation, and the log_softmax tail stay fp32 —
     # the same plumbing contract as the CNN family's --bf16.
     bf16: bool = False
+    # Rematerialize each transformer block's activations in backward
+    # (jax.checkpoint): per-block activation memory drops from O(depth)
+    # live tensors to O(1) at the cost of one extra forward — the
+    # HBM-for-FLOPs trade long/deep configurations want.  Numerics are
+    # unchanged (the recomputed values are the same values).
+    remat: bool = False
 
     @property
     def grid(self) -> int:
@@ -200,6 +206,8 @@ def _vit_trunk(
     patches = patchify(x, cfg).astype(dt)
     tokens = dense(patches, params["embed"]) + params["pos_embed"].astype(dt)
     aux_total = jnp.float32(0.0)
+    if cfg.remat:
+        block_fn = jax.checkpoint(block_fn)
     for i in range(cfg.depth):
         tokens, aux = block_fn(params["blocks"][str(i)], tokens)
         aux_total = aux_total + aux

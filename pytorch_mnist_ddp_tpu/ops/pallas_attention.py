@@ -484,10 +484,14 @@ def flash_ring_finalize(m, l, a, b: int, h: int, t: int, d: int, dtype):
     return _unfold(out3[:, :t, :d], b, h).astype(dtype)
 
 
-def flash_active_or_warn(use_flash: bool | None) -> bool:
+def flash_active_or_warn(
+    use_flash: bool | None, stacklevel: int = 2
+) -> bool:
     """``flash_active`` plus the one shared off-TPU fallback warning —
     every CLI branch (single-device/--zero via :func:`attention_best`,
-    the --sp ring) reports the inactive-kernel case through here."""
+    the --sp ring) reports the inactive-kernel case through here.
+    ``stacklevel`` counts from THIS function's caller (2); wrappers add
+    their own frame so the warning lands on the user's line."""
     active = flash_active(use_flash)
     if use_flash and not active:
         import warnings
@@ -498,7 +502,7 @@ def flash_active_or_warn(use_flash: bool | None) -> bool:
             "the dense attention path instead (set "
             "TPU_MNIST_PALLAS_INTERPRET=1 to force interpret mode for "
             "testing)",
-            stacklevel=2,
+            stacklevel=stacklevel,
         )
     return active
 
@@ -511,5 +515,7 @@ def attention_best(use_flash: bool | None = None):
     from .attention import full_attention
 
     return (
-        flash_attention if flash_active_or_warn(use_flash) else full_attention
+        flash_attention
+        if flash_active_or_warn(use_flash, stacklevel=3)
+        else full_attention
     )

@@ -377,7 +377,8 @@ def test_ulysses_train_step_matches_ring(devices):
 
 
 def test_ulysses_rejects_indivisible_heads(devices):
-    """heads=4 cannot split over a 3-way seq axis — construction fails."""
+    """heads=6 cannot split over the 4-way seq axis — construction
+    fails (tokens still divide, isolating the heads check)."""
     import pytest as _pytest
 
     from pytorch_mnist_ddp_tpu.models.vit import ViTConfig
@@ -386,6 +387,51 @@ def test_ulysses_rejects_indivisible_heads(devices):
     cfg3 = ViTConfig(heads=6)  # tokens 16 % 4 == 0, heads 6 % 4 != 0
     with _pytest.raises(ValueError, match="heads"):
         make_sp_train_step(mesh, cfg3, impl="ulysses")
+
+
+def test_remat_is_numerically_invisible(devices):
+    """--remat (jax.checkpoint around each block) recomputes the SAME
+    values: loss and grads match the un-remat'd forward exactly, on both
+    the single-device trunk and the sequence-parallel path."""
+    from pytorch_mnist_ddp_tpu.models.vit import ViTConfig
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+    from pytorch_mnist_ddp_tpu.parallel.sp import _sp_vit_forward
+
+    cfg_r = ViTConfig(remat=True)
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    rng = np.random.RandomState(8)
+    x = jnp.asarray(rng.rand(8, 28, 28, 1), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, 8), jnp.int32)
+    w = jnp.ones((8,), jnp.float32)
+
+    def loss(p, cfg):
+        return nll_loss(vit_forward(p, x, cfg), y, w, reduction="mean")
+
+    l0, g0 = jax.value_and_grad(loss)(params, CFG)
+    l1, g1 = jax.value_and_grad(loss)(params, cfg_r)
+    assert float(l0) == float(l1)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    mesh = make_sp_mesh(num_data=2, num_seq=4, devices=devices)
+
+    def sp_loss(cfg):
+        def local(p, x, y, w):
+            logp = _sp_vit_forward(p, x, cfg)
+            return nll_loss(logp, y, w, reduction="mean")
+
+        return jax.jit(jax.shard_map(
+            jax.grad(local), mesh=mesh,
+            in_specs=(P(), P("data"), P("data"), P("data")),
+            out_specs=P(),
+        ))
+
+    gs0 = sp_loss(CFG)(params, x, y, w)
+    gs1 = sp_loss(cfg_r)(params, x, y, w)
+    for a, b in zip(jax.tree.leaves(gs0), jax.tree.leaves(gs1)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
 
 
 def test_vit_trains_on_toy_task():

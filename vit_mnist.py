@@ -84,6 +84,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bf16", action="store_true", default=False,
                    help="bfloat16 activations/matmuls (params, routing, "
                         "attention accumulation, and log_softmax stay fp32)")
+    p.add_argument("--remat", action="store_true", default=False,
+                   help="rematerialize each transformer block in backward "
+                        "(jax.checkpoint): O(1) live block activations "
+                        "instead of O(depth), one extra forward — for "
+                        "deep/long configurations; single-device, --zero, "
+                        "--sp, and --fused paths")
     p.add_argument("--fused", action="store_true", default=False,
                    help="whole-run fusion: HBM-resident dataset, every "
                         "epoch a device-side scan, ONE jitted call for "
@@ -118,6 +124,11 @@ def main() -> None:
     if args.sp_impl != "ring" and args.sp <= 1:
         raise SystemExit(
             "--sp-impl selects the --sp strategy; add --sp N (> 1)"
+        )
+    if args.remat and (args.tp > 1 or args.pp or args.experts > 0):
+        raise SystemExit(
+            "--remat rides the single-device/--zero/--sp/--fused paths; "
+            "drop --tp/--pp/--experts"
         )
     if args.flash and (args.tp > 1 or args.pp
                        or args.experts > 0 or args.fused):
@@ -160,7 +171,8 @@ def main() -> None:
     start = time.time()
 
     cfg = ViTConfig(depth=args.depth, dim=args.dim,
-                    num_experts=args.experts, bf16=args.bf16)
+                    num_experts=args.experts, bf16=args.bf16,
+                    remat=args.remat)
     params = init_vit_params(jax.random.PRNGKey(args.seed), cfg)
     if args.resume:
         from pytorch_mnist_ddp_tpu.utils.checkpoint import load_params_tree
