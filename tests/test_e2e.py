@@ -236,6 +236,48 @@ def test_vit_cli_dry_run_subprocess(tmp_path, extra):
     assert "Total cost time:" in proc.stdout
 
 
+@pytest.mark.slow  # six subprocess training runs
+@pytest.mark.parametrize("mode", [[], ["--zero"]], ids=["plain", "zero"])
+def test_vit_save_resume_state_bit_identical(tmp_path, mode):
+    """--save-state/--resume-state on the ViT family: 2 epochs + a
+    2-epoch continuation end with params BIT-IDENTICAL to an
+    uninterrupted 4-epoch run (schedule, shuffle stream, and optimizer
+    accumulators all travel) — in plain DP and under ZeRO-1 (whose
+    archive round-trips the per-leaf layout)."""
+    import os
+    root = _write_idx(tmp_path)
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MNIST_DATA_DIR"] = root
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    common = [sys.executable, os.path.join(repo, "vit_mnist.py"),
+              "--batch-size", "32", "--test-batch-size", "128",
+              "--data-root", root, "--log-interval", "1000", *mode]
+
+    def run(extra, cwd):
+        cwd.mkdir(exist_ok=True)
+        proc = subprocess.run(
+            common + extra, capture_output=True, text=True, env=env,
+            cwd=str(cwd), timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr
+        return proc
+
+    run(["--epochs", "4", "--save-model"], tmp_path / "full")
+    state = str(tmp_path / "mid.npz")
+    run(["--epochs", "2", "--save-state", state], tmp_path / "split")
+    run(["--epochs", "2", "--resume-state", state, "--save-model"],
+        tmp_path / "split")
+
+    import numpy as _np
+
+    with _np.load(tmp_path / "full" / "vit_mnist.npz") as a, \
+            _np.load(tmp_path / "split" / "vit_mnist.npz") as b:
+        assert set(a.files) == set(b.files)
+        for key in a.files:
+            _np.testing.assert_array_equal(a[key], b[key], err_msg=key)
+
+
 def test_vit_cli_save_and_resume(tmp_path):
     """--save-model writes a load_params_tree archive and --resume
     restores it (shape-checked); a wrong-architecture resume fails fast."""

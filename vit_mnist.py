@@ -101,6 +101,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", type=str, default=None, metavar="PATH",
                    help="initialize params from a vit_mnist.npz archive "
                         "instead of random init (optimizer starts fresh)")
+    p.add_argument("--save-state", type=str, default=None, metavar="PATH",
+                   help="save the FULL training state (params, Adadelta "
+                        "accumulators, step/epoch counters) at the end — "
+                        "a --resume-state continuation is bit-identical "
+                        "to an uninterrupted run")
+    p.add_argument("--resume-state", type=str, default=None, metavar="PATH",
+                   help="continue training from a --save-state archive "
+                        "(schedule, shuffle stream, and epoch numbering "
+                        "pick up where the save left off); layout-"
+                        "portable across --zero/plain runs and with the "
+                        "CNN CLI's archive format")
     return p
 
 
@@ -192,6 +203,79 @@ def main() -> None:
 
         params = jax.tree.map(_check, params, loaded)
 
+    # Full-state continuation (--save-state / --resume-state): the whole
+    # TrainState travels, the trainer.fit contract (utils/checkpoint.
+    # save_train_state) — archives are layout-portable with the CNN CLI.
+    epoch0 = 0
+    loaded_state = None
+    if (args.resume_state or args.save_state) and (
+        args.tp > 1 or args.pp or args.experts > 0
+    ):
+        raise SystemExit(
+            "--save-state/--resume-state ride the replicated-state paths "
+            "(single-device, --zero, --sp, --fused); drop --tp/--pp/"
+            "--experts"
+        )
+    if args.save_state and args.dry_run:
+        raise SystemExit(
+            "--dry-run trains one batch per epoch; a --save-state archive "
+            "from it would misrepresent its epoch count on resume — drop one"
+        )
+    if args.resume_state:
+        if args.resume:
+            raise SystemExit(
+                "--resume (model-only) and --resume-state (full state) "
+                "are mutually exclusive"
+            )
+        from pytorch_mnist_ddp_tpu.utils.checkpoint import load_train_state
+
+        loaded_state, epoch0 = load_train_state(args.resume_state)
+
+        def _check_state(init, got):
+            got = np.asarray(got)
+            if got.shape != init.shape:
+                raise SystemExit(
+                    f"--resume-state param shape {got.shape} does not "
+                    f"match this config's {init.shape}"
+                )
+            return got.astype(init.dtype)
+
+        # Same npz format as the CNN CLI's archives (shared saver/loader)
+        # but the ARCHITECTURE must match this config — a mismatched tree
+        # (e.g. a CNN archive) fails the shape/structure check here.
+        try:
+            checked = jax.tree.map(_check_state, params, loaded_state.params)
+        except ValueError as e:
+            raise SystemExit(
+                f"--resume-state {args.resume_state!r} holds a different "
+                f"model's parameter tree: {e}"
+            ) from None
+        loaded_state = loaded_state._replace(params=checked)
+
+    # One definition of "fresh or resumed" for every replicated-state
+    # branch; the zero branch's sharded placement is the only divergence.
+    def base_state():
+        return (
+            make_train_state(params) if loaded_state is None else loaded_state
+        )
+
+    def save_state_if_asked(state, mesh, zero_mode=False):
+        if not args.save_state:
+            return
+        from pytorch_mnist_ddp_tpu.utils.checkpoint import save_train_state
+
+        st = state
+        if zero_mode:
+            from pytorch_mnist_ddp_tpu.parallel.zero import zero_opt_to_per_leaf
+
+            # Archives are always per-leaf (portable across --zero/plain).
+            st = state._replace(
+                opt=zero_opt_to_per_leaf(state.opt, state.params, mesh)
+            )
+        save_train_state(
+            jax.device_get(st), args.save_state, epoch=epoch0 + args.epochs
+        )
+
     # Whole-run fusion: like the CNN CLI, --dry-run (a per-batch smoke
     # semantics) silently falls back to the per-batch path.
     fused = args.fused and not args.dry_run
@@ -208,7 +292,7 @@ def main() -> None:
 
         mesh = make_mesh(num_model=1)
         n_shards = mesh.shape["data"]
-        state = replicate_params(make_train_state(params), mesh)
+        state = replicate_params(base_state(), mesh)
         tr_x, tr_y = load_mnist_arrays(args.data_root, "train")
         te_x, te_y = load_mnist_arrays(args.data_root, "test", download=False)
         tr_dev = device_put_dataset(tr_x, tr_y, mesh)
@@ -217,11 +301,13 @@ def main() -> None:
         eval_batch = args.test_batch_size * n_shards
         run_fn, num_batches = make_fused_vit_run(
             mesh, cfg, len(tr_x), len(te_x), global_batch, eval_batch,
-            args.epochs,
+            args.epochs, start_epoch=epoch0 + 1,
         )
         lr_for_epoch = step_lr(args.lr, args.gamma)
         lrs = jnp.asarray(
-            [lr_for_epoch(e) for e in range(1, args.epochs + 1)], jnp.float32
+            [lr_for_epoch(e)
+             for e in range(epoch0 + 1, epoch0 + args.epochs + 1)],
+            jnp.float32,
         )
         state, losses, evals = run_fn(
             state, *tr_dev, *te_dev, jax.random.PRNGKey(args.seed), lrs
@@ -230,12 +316,13 @@ def main() -> None:
         for e in range(args.epochs):
             for b in range(0, num_batches, args.log_interval):
                 print(train_log_line(
-                    e + 1, b * global_batch, len(tr_x), b, num_batches,
-                    float(losses[e, b, 0]),
+                    epoch0 + e + 1, b * global_batch, len(tr_x), b,
+                    num_batches, float(losses[e, b, 0]),
                 ))
             print(test_summary_lines(
                 float(evals[e, 0]) / len(te_x), int(evals[e, 1]), len(te_x)
             ))
+        save_state_if_asked(state, mesh)
         if args.save_model:
             from pytorch_mnist_ddp_tpu.utils.checkpoint import save_params_tree
 
@@ -245,6 +332,7 @@ def main() -> None:
         print(total_time_line(time.time() - start))
         return
 
+    zero_ran = False  # which branch built the state (drives save layout)
     if args.sp > 1 and args.tp > 1:
         from pytorch_mnist_ddp_tpu.parallel.sp3 import (
             make_3d_mesh,
@@ -293,7 +381,7 @@ def main() -> None:
 
         use_flash = flash_active_or_warn(args.flash)
         mesh = make_sp_mesh(num_data=None, num_seq=args.sp)
-        state = replicate_params(make_train_state(params), mesh)
+        state = replicate_params(base_state(), mesh)
         train_step = make_sp_train_step(
             mesh, cfg, use_flash=use_flash, impl=args.sp_impl
         )
@@ -321,7 +409,13 @@ def main() -> None:
 
         attention_fn = attention_best(args.flash)
         mesh = make_mesh(num_model=1)
-        state = make_zero_train_state(params, mesh)
+        zero_ran = True
+        if loaded_state is None:
+            state = make_zero_train_state(params, mesh)
+        else:
+            from pytorch_mnist_ddp_tpu.parallel.zero import shard_zero_state
+
+            state = shard_zero_state(loaded_state, mesh)
         train_step = make_zero_vit_train_step(
             mesh, cfg, attention_fn=attention_fn
         )
@@ -331,7 +425,7 @@ def main() -> None:
 
         attention_fn = attention_best(args.flash)
         mesh = make_mesh(num_data=1, devices=jax.devices()[:1])
-        state = replicate_params(make_train_state(params), mesh)
+        state = replicate_params(base_state(), mesh)
 
         @jax.jit
         def train_step(state, x, y, w, lr):
@@ -372,7 +466,7 @@ def main() -> None:
     )
     lr_for_epoch = step_lr(args.lr, args.gamma)
 
-    for epoch in range(1, args.epochs + 1):
+    for epoch in range(epoch0 + 1, epoch0 + args.epochs + 1):
         lr = jnp.float32(lr_for_epoch(epoch))
         num_batches = len(train_loader)
         for batch_idx, (x, y, w) in enumerate(train_loader.epoch(epoch)):
@@ -396,6 +490,9 @@ def main() -> None:
             totals[0] / len(te_x), int(totals[1]), len(te_x)
         ))
 
+    # zero_ran (not args.zero) so the layout conversion tracks the branch
+    # that actually built the state, whatever future flag combos allow.
+    save_state_if_asked(state, mesh, zero_mode=zero_ran)
     if args.save_model:
         from pytorch_mnist_ddp_tpu.parallel.tp import gather_replicated
         from pytorch_mnist_ddp_tpu.utils.checkpoint import save_params_tree
