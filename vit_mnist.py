@@ -101,6 +101,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", type=str, default=None, metavar="PATH",
                    help="initialize params from a vit_mnist.npz archive "
                         "instead of random init (optimizer starts fresh)")
+    p.add_argument("--profile", type=str, default=None, metavar="DIR",
+                   help="capture a jax.profiler (XProf/TensorBoard) trace "
+                        "of the whole run into DIR (utils/profiling.trace; "
+                        "same surface as the CNN CLI)")
+    p.add_argument("--step-stats", action="store_true", default=False,
+                   help="print per-epoch host-side step-latency summaries "
+                        "(per-batch paths; the fused whole-run has no "
+                        "per-step host boundary)")
     p.add_argument("--save-state", type=str, default=None, metavar="PATH",
                    help="save the FULL training state (params, Adadelta "
                         "accumulators, step/epoch counters) at the end — "
@@ -180,6 +188,20 @@ def main() -> None:
 
     enable_persistent_cache()
     start = time.time()
+    import atexit
+    import contextlib
+
+    from pytorch_mnist_ddp_tpu.utils.profiling import StepStats, trace
+
+    profile_region = contextlib.ExitStack()
+    profile_region.enter_context(trace(args.profile))
+    # Exception safety without re-indenting the whole body: a run that
+    # raises (flag-check SystemExit, mid-train error, Ctrl-C) still
+    # finalizes the trace at interpreter exit — the failing run is
+    # exactly the one worth profiling.  The explicit close() calls on
+    # the success paths keep the trace bounded to the run proper
+    # (ExitStack.close is idempotent, so the atexit hook then no-ops).
+    atexit.register(profile_region.close)
 
     cfg = ViTConfig(depth=args.depth, dim=args.dim,
                     num_experts=args.experts, bf16=args.bf16,
@@ -329,6 +351,7 @@ def main() -> None:
             save_params_tree(
                 jax.device_get(state.params), "vit_mnist.npz"
             )
+        profile_region.close()
         print(total_time_line(time.time() - start))
         return
 
@@ -469,8 +492,13 @@ def main() -> None:
     for epoch in range(epoch0 + 1, epoch0 + args.epochs + 1):
         lr = jnp.float32(lr_for_epoch(epoch))
         num_batches = len(train_loader)
+        stats = StepStats() if args.step_stats else None
+        if stats is not None:
+            stats.start()
         for batch_idx, (x, y, w) in enumerate(train_loader.epoch(epoch)):
             state, losses = train_step(state, x, y, w, lr)
+            if stats is not None:
+                stats.mark(losses)
             if batch_idx % args.log_interval == 0:
                 local_loss = float(
                     np.asarray(losses.addressable_shards[0].data)[0]
@@ -481,6 +509,8 @@ def main() -> None:
                 ))
             if args.dry_run:
                 break
+        if stats is not None:
+            print(stats.summary_line(epoch))
         totals = np.zeros(2)
         for x, y, w in test_loader.epoch(0):
             totals += np.asarray(eval_step(eval_params(state), x, y, w))
@@ -504,6 +534,7 @@ def main() -> None:
         )
         save_params_tree(host_params, "vit_mnist.npz")
 
+    profile_region.close()
     print(total_time_line(time.time() - start))
 
 
