@@ -214,6 +214,8 @@ def test_cli_dry_run_subprocess(tmp_path, script, extra):
     ["--zero"],          # ZeRO-1 DP: optimizer state sharded over 8 devices
     ["--sp", "4", "--sp-impl", "ulysses"],  # all-to-all head-sharded SP
     ["--step-stats"],    # per-epoch step-latency summary (observability)
+    ["--zero", "--bf16", "--flash"],  # composition: sharded opt + bf16 +
+                                      # flash (dense fallback off-TPU)
 ])
 def test_vit_cli_dry_run_subprocess(tmp_path, extra):
     """The ViT family CLI end-to-end in each parallel mode: flags parse,
