@@ -34,7 +34,12 @@ from ..ops.adadelta import adadelta_update
 from ..ops.loss import nll_loss
 from .ddp import TrainState
 from .mesh import DATA_AXIS, MODEL_AXIS, make_nd_mesh
-from .sp import SEQ_AXIS, _check_token_divisibility, ring_attention
+from .sp import (
+    SEQ_AXIS,
+    _check_token_divisibility,
+    ring_attention,
+    ring_attention_flash,
+)
 from .tp_vit import (
     _check_head_divisibility,
     _tp_block,
@@ -73,7 +78,9 @@ def shard_sp3_state(state: TrainState, mesh: Mesh, cfg: ViTConfig):
     return shard_vit_tp_state(state, mesh, cfg)
 
 
-def _sp3_vit_forward(params: dict, x: jax.Array, cfg: ViTConfig) -> jax.Array:
+def _sp3_vit_forward(
+    params: dict, x: jax.Array, cfg: ViTConfig, use_flash: bool = False
+) -> jax.Array:
     """The ViT forward over a (token, head) shard, inside shard_map.
 
     ``x`` is the local data-shard of images (replicated over seq/model);
@@ -93,10 +100,11 @@ def _sp3_vit_forward(params: dict, x: jax.Array, cfg: ViTConfig) -> jax.Array:
         params["pos_embed"], start, t_local, axis=0
     ).astype(dt)
     tokens = dense(patches, params["embed"]) + pos
+    _ring = ring_attention_flash if use_flash else ring_attention
     for i in range(cfg.depth):
         tokens = _tp_block(
             params["blocks"][str(i)], tokens, cfg, heads_local,
-            attention_fn=lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS),
+            attention_fn=lambda q, k, v: _ring(q, k, v, SEQ_AXIS),
         )
     tokens = layer_norm(tokens, params["ln_f"])
     pooled = (
@@ -112,7 +120,8 @@ def _check(cfg: ViTConfig, mesh: Mesh) -> None:
 
 
 def make_sp3_train_step(
-    mesh: Mesh, cfg: ViTConfig, rho: float = 0.9, eps: float = 1e-6
+    mesh: Mesh, cfg: ViTConfig, rho: float = 0.9, eps: float = 1e-6,
+    use_flash: bool = False,
 ):
     """Build the jitted 3-D (data x seq x model) ViT train step.
 
@@ -125,7 +134,7 @@ def make_sp3_train_step(
 
     def local_step(state: TrainState, x, y, w, lr):
         def loss_fn(params):
-            logp = _sp3_vit_forward(params, x, cfg)
+            logp = _sp3_vit_forward(params, x, cfg, use_flash=use_flash)
             return nll_loss(logp, y, w, reduction="mean")
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
@@ -144,13 +153,13 @@ def make_sp3_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_sp3_eval_step(mesh: Mesh, cfg: ViTConfig):
+def make_sp3_eval_step(mesh: Mesh, cfg: ViTConfig, use_flash: bool = False):
     """Jitted 3-D eval step: the (token, head)-sharded forward + the
     psum'd (loss_sum, correct) totals every eval path shares."""
     _check(cfg, mesh)
 
     def local_eval(params, x, y, w):
-        logp = _sp3_vit_forward(params, x, cfg)
+        logp = _sp3_vit_forward(params, x, cfg, use_flash=use_flash)
         loss_sum = nll_loss(logp, y, w, reduction="sum")
         correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)

@@ -135,24 +135,37 @@ def _tp_block(
     return x + _row(h, bp["mlp_out"])
 
 
-def _tp_vit_forward(params: dict, x: jax.Array, cfg: ViTConfig) -> jax.Array:
+def _tp_vit_forward(
+    params: dict, x: jax.Array, cfg: ViTConfig, use_flash: bool = False
+) -> jax.Array:
     """The ViT forward over a MODEL shard, inside shard_map: every token is
     local (no seq sharding); weights of the sharded layers are local
     slices.  Composes the same patchify/layer_norm/pool/head contract as
-    models/vit.py's single-device trunk."""
+    models/vit.py's single-device trunk.  ``use_flash`` swaps the local
+    per-head-shard attention for the fused Pallas kernel
+    (ops/pallas_attention.py — head-sharded local attention is exactly
+    the kernel's shape, the ulysses composition again)."""
     heads_local = cfg.heads // jax.lax.axis_size(MODEL_AXIS)
+    if use_flash:
+        from ..ops.pallas_attention import flash_attention as attention_fn
+    else:
+        attention_fn = full_attention
     dt = jnp.bfloat16 if cfg.bf16 else x.dtype
     patches = patchify(x, cfg).astype(dt)
     tokens = dense(patches, params["embed"]) + params["pos_embed"].astype(dt)
     for i in range(cfg.depth):
-        tokens = _tp_block(params["blocks"][str(i)], tokens, cfg, heads_local)
+        tokens = _tp_block(
+            params["blocks"][str(i)], tokens, cfg, heads_local,
+            attention_fn=attention_fn,
+        )
     tokens = layer_norm(tokens, params["ln_f"])
     pooled = tokens.astype(jnp.float32).mean(axis=1)
     return tokens_to_logp(params, pooled)
 
 
 def make_vit_tp_train_step(
-    mesh: Mesh, cfg: ViTConfig, rho: float = 0.9, eps: float = 1e-6
+    mesh: Mesh, cfg: ViTConfig, rho: float = 0.9, eps: float = 1e-6,
+    use_flash: bool = False,
 ):
     """Build the jitted 2-D (data x model) ViT train step.
 
@@ -169,7 +182,7 @@ def make_vit_tp_train_step(
 
     def local_step(state: TrainState, x, y, w, lr):
         def loss_fn(params):
-            logp = _tp_vit_forward(params, x, cfg)
+            logp = _tp_vit_forward(params, x, cfg, use_flash=use_flash)
             return nll_loss(logp, y, w, reduction="mean")
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
@@ -188,14 +201,14 @@ def make_vit_tp_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_vit_tp_eval_step(mesh: Mesh, cfg: ViTConfig):
+def make_vit_tp_eval_step(mesh: Mesh, cfg: ViTConfig, use_flash: bool = False):
     """Jitted (data x model) eval step: TP forward + the psum'd
     (loss_sum, correct) totals every eval path in the framework shares —
     params stay model-sharded through evaluation."""
     _check_head_divisibility(cfg, mesh)
 
     def local_eval(params, x, y, w):
-        logp = _tp_vit_forward(params, x, cfg)
+        logp = _tp_vit_forward(params, x, cfg, use_flash=use_flash)
         loss_sum = nll_loss(logp, y, w, reduction="sum")
         correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)

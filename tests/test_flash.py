@@ -214,6 +214,115 @@ def test_sp_train_step_flash_matches_plain(devices):
         )
 
 
+def test_tp_forward_with_flash_matches_plain(devices):
+    """The kernel under the ViT-TP head shard (local heads, full tokens —
+    the ulysses shape again): forward parity with the dense TP path on
+    the (2 data x 4 model) mesh, off-TPU via the VMA-safe pure twin."""
+    from jax.sharding import PartitionSpec as P
+
+    from pytorch_mnist_ddp_tpu.models.vit import ViTConfig, init_vit_params
+    from pytorch_mnist_ddp_tpu.parallel.mesh import DATA_AXIS, make_mesh
+    from pytorch_mnist_ddp_tpu.parallel.tp_vit import (
+        _tp_vit_forward, vit_tp_param_specs,
+    )
+
+    cfg = ViTConfig()
+    mesh = make_mesh(num_data=2, num_model=4)
+    params = init_vit_params(jax.random.PRNGKey(0), cfg)
+    x = jnp.asarray(
+        np.random.RandomState(11).rand(8, 28, 28, 1).astype(np.float32)
+    )
+
+    def fwd(use_flash):
+        return jax.jit(jax.shard_map(
+            lambda p, x: _tp_vit_forward(p, x, cfg, use_flash=use_flash),
+            mesh=mesh,
+            in_specs=(vit_tp_param_specs(cfg), P(DATA_AXIS)),
+            out_specs=P(DATA_AXIS),
+        ))
+
+    np.testing.assert_allclose(
+        np.asarray(fwd(True)(params, x)),
+        np.asarray(fwd(False)(params, x)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+@pytest.mark.slow  # two TP train-step compiles
+def test_tp_train_step_flash_matches_plain(devices):
+    """2 training steps through the (data x model) TP step with the
+    flash kernel == 2 with dense attention: the whole-forward kernel's
+    VJP composes with the Megatron column/row shardings and their psum
+    transposes."""
+    from pytorch_mnist_ddp_tpu.models.vit import ViTConfig, init_vit_params
+    from pytorch_mnist_ddp_tpu.parallel.ddp import make_train_state
+    from pytorch_mnist_ddp_tpu.parallel.mesh import data_sharding, make_mesh
+    from pytorch_mnist_ddp_tpu.parallel.tp_vit import (
+        make_vit_tp_train_step, shard_vit_tp_state,
+    )
+
+    cfg = ViTConfig()
+    mesh = make_mesh(num_data=2, num_model=4)
+    params = jax.device_get(init_vit_params(jax.random.PRNGKey(0), cfg))
+    copy = lambda t: jax.tree.map(np.array, t)
+    s_p = shard_vit_tp_state(make_train_state(copy(params)), mesh, cfg)
+    s_f = shard_vit_tp_state(make_train_state(copy(params)), mesh, cfg)
+    step_p = make_vit_tp_train_step(mesh, cfg)
+    step_f = make_vit_tp_train_step(mesh, cfg, use_flash=True)
+    ds = data_sharding(mesh)
+    rng = np.random.RandomState(13)
+    for _ in range(2):
+        x = jax.device_put(rng.rand(8, 28, 28, 1).astype(np.float32), ds)
+        y = jax.device_put(rng.randint(0, 10, 8).astype(np.int32), ds)
+        w = jax.device_put(np.ones(8, np.float32), ds)
+        s_p, l_p = step_p(s_p, x, y, w, jnp.float32(0.5))
+        s_f, l_f = step_f(s_f, x, y, w, jnp.float32(0.5))
+    np.testing.assert_allclose(
+        np.asarray(l_p), np.asarray(l_f), rtol=1e-5, atol=1e-6
+    )
+    for a, b in zip(jax.tree.leaves(s_p.params), jax.tree.leaves(s_f.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+
+
+@pytest.mark.slow  # two 3-D train-step compiles
+def test_sp3_train_step_flash_matches_plain(devices):
+    """2 training steps through the 3-D (data x seq x model) step with
+    the flash ring == 2 with the plain ring: the partial kernel's VJP
+    composes with the Megatron shardings too."""
+    from pytorch_mnist_ddp_tpu.models.vit import ViTConfig, init_vit_params
+    from pytorch_mnist_ddp_tpu.parallel.ddp import make_train_state
+    from pytorch_mnist_ddp_tpu.parallel.mesh import data_sharding
+    from pytorch_mnist_ddp_tpu.parallel.sp3 import (
+        make_3d_mesh, make_sp3_train_step, shard_sp3_state,
+    )
+
+    cfg = ViTConfig()
+    mesh = make_3d_mesh(num_data=2, num_seq=2, num_model=2)
+    params = jax.device_get(init_vit_params(jax.random.PRNGKey(0), cfg))
+    copy = lambda t: jax.tree.map(np.array, t)
+    s_p = shard_sp3_state(make_train_state(copy(params)), mesh, cfg)
+    s_f = shard_sp3_state(make_train_state(copy(params)), mesh, cfg)
+    step_p = make_sp3_train_step(mesh, cfg)
+    step_f = make_sp3_train_step(mesh, cfg, use_flash=True)
+    ds = data_sharding(mesh)
+    rng = np.random.RandomState(12)
+    for _ in range(2):
+        x = jax.device_put(rng.rand(8, 28, 28, 1).astype(np.float32), ds)
+        y = jax.device_put(rng.randint(0, 10, 8).astype(np.int32), ds)
+        w = jax.device_put(np.ones(8, np.float32), ds)
+        s_p, l_p = step_p(s_p, x, y, w, jnp.float32(0.5))
+        s_f, l_f = step_f(s_f, x, y, w, jnp.float32(0.5))
+    np.testing.assert_allclose(
+        np.asarray(l_p), np.asarray(l_f), rtol=1e-5, atol=1e-6
+    )
+    for a, b in zip(jax.tree.leaves(s_p.params), jax.tree.leaves(s_f.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+
+
 def test_dispatch_gate(monkeypatch):
     """attention_best: kernel only when the backend can lower it for real
     (or the interpret hook is set); otherwise dense with a warning —

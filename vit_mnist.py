@@ -71,12 +71,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "1/N (parallel/zero.py); mutually exclusive with "
                         "--sp/--tp/--pp/--experts/--fused")
     p.add_argument("--flash", action="store_true", default=False,
-                   help="fused Pallas flash-attention kernel for the "
-                        "single-device, --zero, and --sp paths "
-                        "(ops/pallas_attention.py; under --sp each ring "
-                        "hop's fold runs in the partial-accumulation "
-                        "kernel); falls back to the dense path with a "
-                        "warning off-TPU")
+                   help="fused Pallas flash-attention kernel "
+                        "(ops/pallas_attention.py) — composes with the "
+                        "single-device, --zero, --sp (ring hops fold in "
+                        "the partial-accumulation kernel), --tp (local "
+                        "head-shard attention), and 3-D --sp --tp paths; "
+                        "falls back to the dense path with a warning "
+                        "off-TPU")
     p.add_argument("--depth", type=int, default=2, metavar="N",
                    help="transformer blocks (default: 2)")
     p.add_argument("--dim", type=int, default=64, metavar="D",
@@ -149,11 +150,10 @@ def main() -> None:
             "--remat rides the single-device/--zero/--sp/--fused paths; "
             "drop --tp/--pp/--experts"
         )
-    if args.flash and (args.tp > 1 or args.pp
-                       or args.experts > 0 or args.fused):
+    if args.flash and (args.pp or args.experts > 0 or args.fused):
         raise SystemExit(
-            "--flash rides the single-device, --zero, and --sp paths; "
-            "drop --tp/--pp/--experts/--fused"
+            "--flash rides the single-device, --zero, --sp, --tp, and "
+            "3-D paths; drop --pp/--experts/--fused"
         )
 
     import jax
@@ -356,6 +356,16 @@ def main() -> None:
         return
 
     zero_ran = False  # which branch built the state (drives save layout)
+    # One gate (and at most one off-TPU fallback warning) for every
+    # flash-capable branch below.
+    from pytorch_mnist_ddp_tpu.ops.attention import full_attention
+    from pytorch_mnist_ddp_tpu.ops.pallas_attention import (
+        flash_active_or_warn,
+        flash_attention,
+    )
+
+    use_flash = flash_active_or_warn(args.flash)
+    attention_fn = flash_attention if use_flash else full_attention
     if args.sp > 1 and args.tp > 1:
         from pytorch_mnist_ddp_tpu.parallel.sp3 import (
             make_3d_mesh,
@@ -367,8 +377,8 @@ def main() -> None:
         mesh = make_3d_mesh(num_data=None, num_seq=args.sp,
                             num_model=args.tp)
         state = shard_sp3_state(make_train_state(params), mesh, cfg)
-        train_step = make_sp3_train_step(mesh, cfg)
-        eval_step = make_sp3_eval_step(mesh, cfg)
+        train_step = make_sp3_train_step(mesh, cfg, use_flash=use_flash)
+        eval_step = make_sp3_eval_step(mesh, cfg, use_flash=use_flash)
     elif args.tp > 1:
         from pytorch_mnist_ddp_tpu.parallel.tp_vit import (
             make_vit_tp_eval_step,
@@ -378,8 +388,8 @@ def main() -> None:
 
         mesh = make_mesh(num_data=None, num_model=args.tp)
         state = shard_vit_tp_state(make_train_state(params), mesh, cfg)
-        train_step = make_vit_tp_train_step(mesh, cfg)
-        eval_step = make_vit_tp_eval_step(mesh, cfg)
+        train_step = make_vit_tp_train_step(mesh, cfg, use_flash=use_flash)
+        eval_step = make_vit_tp_eval_step(mesh, cfg, use_flash=use_flash)
     elif args.pp:
         from pytorch_mnist_ddp_tpu.parallel.pp_vit import (
             make_vit_eval_step,
@@ -393,16 +403,12 @@ def main() -> None:
         )
         eval_step = make_vit_eval_step(mesh, cfg)
     elif args.sp > 1:
-        from pytorch_mnist_ddp_tpu.ops.pallas_attention import (
-            flash_active_or_warn,
-        )
         from pytorch_mnist_ddp_tpu.parallel.sp import (
             make_sp_eval_step,
             make_sp_mesh,
             make_sp_train_step,
         )
 
-        use_flash = flash_active_or_warn(args.flash)
         mesh = make_sp_mesh(num_data=None, num_seq=args.sp)
         state = replicate_params(base_state(), mesh)
         train_step = make_sp_train_step(
@@ -423,14 +429,12 @@ def main() -> None:
         train_step = make_ep_train_step(mesh, cfg)
         eval_step = make_ep_eval_step(mesh, cfg)
     elif args.zero:
-        from pytorch_mnist_ddp_tpu.ops.pallas_attention import attention_best
         from pytorch_mnist_ddp_tpu.parallel.pp_vit import make_vit_eval_step
         from pytorch_mnist_ddp_tpu.parallel.zero import (
             make_zero_train_state,
             make_zero_vit_train_step,
         )
 
-        attention_fn = attention_best(args.flash)
         mesh = make_mesh(num_model=1)
         zero_ran = True
         if loaded_state is None:
@@ -444,9 +448,7 @@ def main() -> None:
         )
         eval_step = make_vit_eval_step(mesh, cfg, attention_fn=attention_fn)
     else:
-        from pytorch_mnist_ddp_tpu.ops.pallas_attention import attention_best
 
-        attention_fn = attention_best(args.flash)
         mesh = make_mesh(num_data=1, devices=jax.devices()[:1])
         state = replicate_params(base_state(), mesh)
 
