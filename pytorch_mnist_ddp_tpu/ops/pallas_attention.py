@@ -507,15 +507,19 @@ def flash_active_or_warn(
     return active
 
 
-def attention_best(use_flash: bool | None = None):
-    """Pick the attention implementation for this run: the Pallas kernel
-    when ``--flash`` is active on a capable backend, else the dense
-    oracle (ops/attention.py).  Returns an ``AttentionFn`` —
-    models/vit.py injects it through the family's shared sublayer."""
+def select_attention(use_flash: bool):
+    """``use_flash`` -> ``AttentionFn``, for an ALREADY-GATED flag (the
+    caller ran ``flash_active``/``flash_active_or_warn``).  The one
+    selection every flash-capable mode shares — CLI branches, the TP
+    head-shard forward, the EP blocks."""
     from .attention import full_attention
 
-    return (
-        flash_attention
-        if flash_active_or_warn(use_flash, stacklevel=3)
-        else full_attention
-    )
+    return flash_attention if use_flash else full_attention
+
+
+def attention_best(use_flash: bool | None = None):
+    """Gate + pick in one call: the Pallas kernel when ``--flash`` is
+    active on a capable backend (warning otherwise), else the dense
+    oracle.  Returns an ``AttentionFn`` — models/vit.py injects it
+    through the family's shared sublayer."""
+    return select_attention(flash_active_or_warn(use_flash, stacklevel=3))

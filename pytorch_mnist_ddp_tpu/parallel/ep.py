@@ -152,6 +152,7 @@ def make_ep_train_step(
     rho: float = 0.9,
     eps: float = 1e-6,
     aux_weight: float = AUX_LOSS_WEIGHT,
+    use_flash: bool = False,
 ):
     """Build the jitted expert-parallel MoE-ViT train step.
 
@@ -167,11 +168,15 @@ def make_ep_train_step(
     _check_expert_divisibility(cfg, mesh)
     num_data = mesh.shape[DATA_AXIS]
     state_specs = ep_state_specs(cfg)
+    from ..ops.pallas_attention import select_attention
+
+    attention_fn = select_attention(use_flash)
 
     def local_step(state: TrainState, x, y, w, lr):
         def loss_fn(params):
             logp, aux = vit_moe_forward(
                 params, x, cfg,
+                attention_fn=attention_fn,
                 moe_fn=lambda mp, h: moe_mlp_ep(mp, h, cfg),
             )
             nll = nll_loss(logp, y, w, reduction="mean")
@@ -195,16 +200,20 @@ def make_ep_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_ep_eval_step(mesh: Mesh, cfg: ViTConfig):
+def make_ep_eval_step(mesh: Mesh, cfg: ViTConfig, use_flash: bool = False):
     """Jitted EP eval step: expert-parallel forward + the psum'd
     (loss_sum, correct) totals every eval path in the framework shares."""
     from ..ops.loss import nll_loss
 
     _check_expert_divisibility(cfg, mesh)
+    from ..ops.pallas_attention import select_attention
+
+    attention_fn = select_attention(use_flash)
 
     def local_eval(params, x, y, w):
         logp, _ = vit_moe_forward(
-            params, x, cfg, moe_fn=lambda mp, h: moe_mlp_ep(mp, h, cfg)
+            params, x, cfg, attention_fn=attention_fn,
+            moe_fn=lambda mp, h: moe_mlp_ep(mp, h, cfg),
         )
         loss_sum = nll_loss(logp, y, w, reduction="sum")
         correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()

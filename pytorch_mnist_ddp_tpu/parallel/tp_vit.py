@@ -146,10 +146,9 @@ def _tp_vit_forward(
     (ops/pallas_attention.py — head-sharded local attention is exactly
     the kernel's shape, the ulysses composition again)."""
     heads_local = cfg.heads // jax.lax.axis_size(MODEL_AXIS)
-    if use_flash:
-        from ..ops.pallas_attention import flash_attention as attention_fn
-    else:
-        attention_fn = full_attention
+    from ..ops.pallas_attention import select_attention
+
+    attention_fn = select_attention(use_flash)
     dt = jnp.bfloat16 if cfg.bf16 else x.dtype
     patches = patchify(x, cfg).astype(dt)
     tokens = dense(patches, params["embed"]) + params["pos_embed"].astype(dt)

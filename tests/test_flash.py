@@ -248,6 +248,41 @@ def test_tp_forward_with_flash_matches_plain(devices):
     )
 
 
+def test_ep_train_step_flash_matches_plain(devices):
+    """1 training step through the expert-parallel MoE with the flash
+    kernel == 1 with dense attention (replicated heads, local batch —
+    the kernel rides along with the all_to_all expert routing)."""
+    from pytorch_mnist_ddp_tpu.models.vit import ViTConfig, init_vit_params
+    from pytorch_mnist_ddp_tpu.parallel.ddp import make_train_state
+    from pytorch_mnist_ddp_tpu.parallel.ep import (
+        make_ep_train_step, shard_ep_state,
+    )
+    from pytorch_mnist_ddp_tpu.parallel.mesh import data_sharding, make_mesh
+
+    cfg = ViTConfig(num_experts=8, capacity_factor=2.0)
+    mesh = make_mesh(num_model=1)
+    params = jax.device_get(init_vit_params(jax.random.PRNGKey(0), cfg))
+    copy = lambda t: jax.tree.map(np.array, t)
+    s_p = shard_ep_state(make_train_state(copy(params)), mesh, cfg)
+    s_f = shard_ep_state(make_train_state(copy(params)), mesh, cfg)
+    step_p = make_ep_train_step(mesh, cfg)
+    step_f = make_ep_train_step(mesh, cfg, use_flash=True)
+    ds = data_sharding(mesh)
+    rng = np.random.RandomState(14)
+    x = jax.device_put(rng.rand(16, 28, 28, 1).astype(np.float32), ds)
+    y = jax.device_put(rng.randint(0, 10, 16).astype(np.int32), ds)
+    w = jax.device_put(np.ones(16, np.float32), ds)
+    s_p, l_p = step_p(s_p, x, y, w, jnp.float32(0.5))
+    s_f, l_f = step_f(s_f, x, y, w, jnp.float32(0.5))
+    np.testing.assert_allclose(
+        np.asarray(l_p), np.asarray(l_f), rtol=1e-5, atol=1e-6
+    )
+    for a, b in zip(jax.tree.leaves(s_p.params), jax.tree.leaves(s_f.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+
+
 @pytest.mark.slow  # two TP train-step compiles
 def test_tp_train_step_flash_matches_plain(devices):
     """2 training steps through the (data x model) TP step with the

@@ -72,12 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "--sp/--tp/--pp/--experts/--fused")
     p.add_argument("--flash", action="store_true", default=False,
                    help="fused Pallas flash-attention kernel "
-                        "(ops/pallas_attention.py) — composes with the "
-                        "single-device, --zero, --sp (ring hops fold in "
-                        "the partial-accumulation kernel), --tp (local "
-                        "head-shard attention), and 3-D --sp --tp paths; "
-                        "falls back to the dense path with a warning "
-                        "off-TPU")
+                        "(ops/pallas_attention.py) — composes with every "
+                        "mode except --pp/--fused: single-device, --zero, "
+                        "--sp (ring hops fold in the partial-accumulation "
+                        "kernel), --tp (local head-shard attention), 3-D "
+                        "--sp --tp, and --experts; falls back to the "
+                        "dense path with a warning off-TPU")
     p.add_argument("--depth", type=int, default=2, metavar="N",
                    help="transformer blocks (default: 2)")
     p.add_argument("--dim", type=int, default=64, metavar="D",
@@ -150,10 +150,10 @@ def main() -> None:
             "--remat rides the single-device/--zero/--sp/--fused paths; "
             "drop --tp/--pp/--experts"
         )
-    if args.flash and (args.pp or args.experts > 0 or args.fused):
+    if args.flash and (args.pp or args.fused):
         raise SystemExit(
-            "--flash rides the single-device, --zero, --sp, --tp, and "
-            "3-D paths; drop --pp/--experts/--fused"
+            "--flash composes with every mode except the pipeline engine "
+            "and the fused whole-run; drop --pp/--fused"
         )
 
     import jax
@@ -358,14 +358,13 @@ def main() -> None:
     zero_ran = False  # which branch built the state (drives save layout)
     # One gate (and at most one off-TPU fallback warning) for every
     # flash-capable branch below.
-    from pytorch_mnist_ddp_tpu.ops.attention import full_attention
     from pytorch_mnist_ddp_tpu.ops.pallas_attention import (
         flash_active_or_warn,
-        flash_attention,
+        select_attention,
     )
 
     use_flash = flash_active_or_warn(args.flash)
-    attention_fn = flash_attention if use_flash else full_attention
+    attention_fn = select_attention(use_flash)
     if args.sp > 1 and args.tp > 1:
         from pytorch_mnist_ddp_tpu.parallel.sp3 import (
             make_3d_mesh,
@@ -426,8 +425,8 @@ def main() -> None:
 
         mesh = make_mesh(num_model=1)
         state = shard_ep_state(make_train_state(params), mesh, cfg)
-        train_step = make_ep_train_step(mesh, cfg)
-        eval_step = make_ep_eval_step(mesh, cfg)
+        train_step = make_ep_train_step(mesh, cfg, use_flash=use_flash)
+        eval_step = make_ep_eval_step(mesh, cfg, use_flash=use_flash)
     elif args.zero:
         from pytorch_mnist_ddp_tpu.parallel.pp_vit import make_vit_eval_step
         from pytorch_mnist_ddp_tpu.parallel.zero import (
