@@ -11,6 +11,7 @@ CONTRACTS over the whole input space the components claim to support:
 """
 
 import numpy as np
+import pytest
 from hypothesis import given, settings, strategies as st
 
 import jax
@@ -232,3 +233,34 @@ def test_torch_layout_roundtrip_identity(seed):
     # And the conversion actually transposes (it is not the identity).
     assert torch_side["conv1.weight"].shape == (32, 1, 3, 3)
     assert torch_side["fc1.weight"].shape == (128, 9216)
+
+
+@pytest.mark.slow  # 12 distinct shapes = 12 Pallas-interpret compiles (~20 s)
+@settings(max_examples=12, deadline=None)
+@given(
+    b=st.integers(1, 3),
+    t=st.integers(1, 48),
+    h=st.integers(1, 3),
+    d=st.sampled_from([4, 8, 16, 24]),
+    seed=st.integers(0, 2**31 - 1),
+)
+def test_flash_attention_matches_dense_at_arbitrary_shapes(b, t, h, d, seed):
+    """The Pallas flash kernel (interpret mode) == the dense oracle at
+    ARBITRARY geometry — batch, token count (incl. non-multiples of the
+    block and sublane sizes), heads, head_dim — not just the hand-picked
+    shapes of tests/test_flash.py.  Fuzzes the padding/masking paths:
+    every t not a multiple of 8 exercises the in-kernel iota mask, every
+    d < 128 the lane zero-pad."""
+    from pytorch_mnist_ddp_tpu.ops.attention import full_attention
+    from pytorch_mnist_ddp_tpu.ops.pallas_attention import flash_attention
+
+    rng = np.random.RandomState(seed)
+    q, k, v = (
+        jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+        for _ in range(3)
+    )
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v)),
+        np.asarray(full_attention(q, k, v)),
+        rtol=1e-5, atol=1e-6,
+    )
