@@ -1,37 +1,35 @@
-"""The generic 2-stage GPipe schedule: microbatched ppermute + custom_vjp.
+"""The generic S-stage GPipe schedule: microbatched ppermute + custom_vjp.
 
-parallel/pp.py introduced this schedule for the reference CNN (conv stage
--> dense stage); parallel/pp_vit.py pipelines the ViT's transformer blocks
-with it.  The schedule itself is model-agnostic — what moves between
-devices is "the stage-boundary activation", whatever its shape — so it
-lives here once, parameterized by the two stage bodies:
+parallel/pp.py introduced the 2-stage form for the reference CNN (conv
+stage -> dense stage); parallel/pp_vit.py pipelines the ViT's transformer
+blocks over ANY stage count with the generalized engine.  The schedule is
+model-agnostic — what moves between devices is "the stage-boundary
+activation", whatever its (uniform) shape — so it lives here once,
+parameterized by the list of stage bodies (``make_pipeline_loss_multi``;
+the 2-stage ``make_pipeline_loss`` API is a wrapper over the same code).
 
-- ``stage0_fn(params, x_mb, key, j) -> act``: the first half of the model
-  on microbatch ``j`` (``key`` is the caller's dropout key; stateless
-  models ignore it);
-- ``stage1_fn(params, act, y_mb, w_mb, key, j) -> loss_sum``: the second
-  half through the weighted NLL SUM for microbatch ``j``.
+Schedule (S stages, M microbatches, ``M + S - 1`` ticks each direction,
+driven by ``lax.scan`` with one ``lax.ppermute`` hop per tick):
 
-Schedule (NUM_STAGES = 2, ``num_micro`` microbatches, driven by
-``lax.scan`` with one ``lax.ppermute`` hop per tick):
-
-- **forward** (``num_micro + 1`` ticks): stage 0 runs microbatch ``t``
-  while stage 1 consumes the activation sent at ``t - 1`` and accumulates
-  the loss; arriving activations are stashed for the backward pass.
-- **backward** (``num_micro + 1`` ticks, reverse order): stage 1 re-runs
-  its microbatch body under ``jax.vjp`` (rematerialization — the same
+- **forward**: stage ``s`` processes microbatch ``j`` at tick ``s + j``
+  — stage 0 consumes raw microbatches, middle stages the activation that
+  arrived on the ring one tick earlier, the last stage accumulates the
+  loss; every arriving activation is stashed for the backward pass.
+- **backward** (reverse ring): stage ``s`` rematerializes microbatch
+  ``j`` at tick ``(S-1-s) + (M-1-j)`` under ``jax.vjp`` (the same
   ``j``-folded keys, so dropout masks replay exactly), accumulates its
-  param grads, and ppermutes the activation cotangent back; stage 0
-  consumes it one tick later.
+  param grads, and ppermutes the input-activation cotangent back one
+  hop, where stage ``s-1`` consumes it the next tick.
 
-Each device executes ONLY its own stage's FLOPs: stage selection is a
-runtime ``lax.cond`` on the device's stage-axis index.  Transposing such
-a ``cond`` nested in this scan+ppermute SIGABRTs the XLA:CPU runtime
-(jaxlib in this image), which is why the backward schedule is hand-written
-under ``jax.custom_vjp`` — autodiff never transposes anything, and the
-pipeline's collective pattern stays fully explicit: the per-tick
-activation/cotangent ppermute plus one stage-axis ``psum`` of the
-(disjoint) per-stage grad trees.
+Each device executes ONLY its own stage's FLOPs: body selection is a
+runtime ``lax.switch`` on the device's stage-axis index, with the
+activity test in a ``lax.cond`` PREDICATE (idle ticks take the free
+zeros branch).  Transposing such a cond nested in this scan+ppermute
+SIGABRTs the XLA:CPU runtime (jaxlib in this image), which is why the
+backward schedule is hand-written under ``jax.custom_vjp`` — autodiff
+never transposes anything, and the pipeline's collective pattern stays
+fully explicit: the per-tick activation/cotangent ppermute plus one
+stage-axis ``psum`` of the (disjoint) per-stage grad trees.
 """
 
 from __future__ import annotations
@@ -52,27 +50,49 @@ def _float0_zeros(v: jax.Array):
     return np.zeros(v.shape, jax.dtypes.float0)
 
 
-def make_pipeline_loss(stage0_fn, stage1_fn, num_micro: int):
+def make_pipeline_loss_multi(stage_fns, num_micro: int):
     """Build ``pipeline_loss(params, x_mbs, y_mbs, w_mbs, key) ->
-    loss_sum`` — the scheduled, ``custom_vjp``-differentiable 2-stage
-    pipeline over one data shard, for use inside ``shard_map`` with
-    ``check_vma=False``.
+    loss_sum`` — the scheduled, ``custom_vjp``-differentiable S-stage
+    GPipe pipeline over one data shard, for use inside ``shard_map`` with
+    ``check_vma=False`` over an S-wide stage axis.
+
+    ``stage_fns`` is a list of S >= 2 bodies with the uniform contract:
+
+    - ``stage_fns[0](params, x_mb, key, j) -> act`` — consumes the raw
+      microbatch;
+    - ``stage_fns[s](params, act, key, j) -> act`` for the middle stages
+      (every boundary activation must share ONE shape/dtype — true for
+      transformer-block stacks, where the boundary is always
+      ``[mb, t, dim]``);
+    - ``stage_fns[-1](params, act, y_mb, w_mb, key, j) -> loss_sum``.
+
+    Schedule indexing (the whole generalization): stage ``s`` processes
+    microbatch ``j`` at forward tick ``s + j`` (the activation it emits
+    arrives at ``s+1`` one tick later) and at backward tick
+    ``(S-1-s) + (M-1-j)`` (its input-cotangent emission reaches ``s-1``
+    one tick later) — for S=2 this reduces exactly to the round-2
+    schedule.  Total ticks each direction: ``M + S - 1``.
 
     ``x_mbs/y_mbs/w_mbs`` are ``[num_micro, mb, ...]``; the returned loss
     is the stage-psum'd SUM over the shard (callers divide by their own
-    weight total).  The stage-boundary activation's shape/dtype is
-    discovered from ``stage0_fn`` via ``jax.eval_shape`` — bf16 boundaries
-    travel at their native width.
+    weight total).  The boundary activation's shape/dtype is discovered
+    from ``stage_fns[0]`` via ``jax.eval_shape`` — bf16 boundaries travel
+    at their native width.
     """
     if num_micro < 1:
         raise ValueError(f"num_micro must be >= 1, got {num_micro}")
-    ring = [(i, (i + 1) % NUM_STAGES) for i in range(NUM_STAGES)]
+    num_stages = len(stage_fns)
+    if num_stages < 2:
+        raise ValueError(f"need >= 2 stage bodies, got {num_stages}")
+    first_fn, last_fn = stage_fns[0], stage_fns[-1]
+    mid_fns = list(stage_fns[1:-1])
+    ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
     ring_rev = [(dst, src) for src, dst in ring]
-    ticks = num_micro + NUM_STAGES - 1
+    ticks = num_micro + num_stages - 1
 
     def _act_zeros(params, x_mbs, key):
         a = jax.eval_shape(
-            lambda p, x, k: stage0_fn(p, x, k, 0), params, x_mbs[0], key
+            lambda p, x, k: first_fn(p, x, k, 0), params, x_mbs[0], key
         )
         return jnp.zeros(a.shape, a.dtype)
 
@@ -85,28 +105,33 @@ def make_pipeline_loss(stage0_fn, stage1_fn, num_micro: int):
         def tick(carry, t):
             in_flight = carry  # activation that arrived at this device
 
-            # stage 0 forwards microbatch t; the activity test lives in the
-            # cond PREDICATE, so idle ticks take the zeros branch for free
+            # This device's microbatch at tick t; activity lives in the
+            # cond PREDICATE so idle ticks take the zeros branch for free
             # (the cond is never transposed — custom_vjp below).
-            t0 = jnp.clip(t, 0, num_micro - 1)
-            x_mb = jax.lax.dynamic_index_in_dim(x_mbs, t0, keepdims=False)
-            out = jax.lax.cond(
-                jnp.logical_and(stage == 0, t < num_micro),
-                lambda: stage0_fn(params, x_mb, key, t0),
-                lambda: zero_act,
-            )
+            j = t - stage
+            active = jnp.logical_and(j >= 0, j < num_micro)
+            jc = jnp.clip(j, 0, num_micro - 1)
+            x_mb = jax.lax.dynamic_index_in_dim(x_mbs, jc, keepdims=False)
+            y_mb = jax.lax.dynamic_index_in_dim(y_mbs, jc, keepdims=False)
+            w_mb = jax.lax.dynamic_index_in_dim(w_mbs, jc, keepdims=False)
 
-            # stage 1 consumes the block sent at tick t-1 (idle at t=0
-            # takes the zero branch).
-            t1 = jnp.clip(t - 1, 0, num_micro - 1)
-            y_mb = jax.lax.dynamic_index_in_dim(y_mbs, t1, keepdims=False)
-            w_mb = jax.lax.dynamic_index_in_dim(w_mbs, t1, keepdims=False)
-            part = jax.lax.cond(
-                jnp.logical_and(stage == 1, t >= 1),
-                lambda: stage1_fn(params, in_flight, y_mb, w_mb, key, t1),
-                lambda: jnp.float32(0.0),
-            )
+            def run_first():
+                return first_fn(params, x_mb, key, jc), jnp.float32(0.0)
 
+            def run_mid(fn):
+                return lambda: (fn(params, in_flight, key, jc), jnp.float32(0.0))
+
+            def run_last():
+                return zero_act, last_fn(
+                    params, in_flight, y_mb, w_mb, key, jc
+                )
+
+            branches = [run_first, *[run_mid(fn) for fn in mid_fns], run_last]
+            out, part = jax.lax.cond(
+                active,
+                lambda: jax.lax.switch(stage, branches),
+                lambda: (zero_act, jnp.float32(0.0)),
+            )
             moved = jax.lax.ppermute(out, STAGE_AXIS, ring)
             return moved, (part, in_flight)
 
@@ -125,12 +150,14 @@ def make_pipeline_loss(stage0_fn, stage1_fn, num_micro: int):
     def pipeline_loss_bwd(res, g):
         """The reverse schedule, hand-written (never a cond transpose).
 
-        Tick s: stage 1 rematerializes microbatch ``num_micro - 1 - s``
-        under ``jax.vjp`` (grads for its params + the activation
-        cotangent, scaled by ``g``), ppermutes the cotangent back; stage 0
-        consumes it at tick ``s + 1``.  Param-grad trees are disjoint per
-        stage; one stage-axis psum at the end makes every device hold the
-        full gradient."""
+        Backward tick sigma: stage s rematerializes microbatch
+        ``j = M - 1 - (sigma - (S-1-s))`` under ``jax.vjp`` — the last
+        stage seeds with ``g``, every other stage with the cotangent that
+        just arrived on the reverse ring; its own input cotangent
+        ppermutes back one hop.  The stashed activation each stage needs
+        is the one that ARRIVED at forward tick ``s + j``.  Param-grad
+        trees are disjoint per stage; one stage-axis psum at the end
+        makes every device hold the full gradient."""
         params, x_mbs, y_mbs, w_mbs, key, stash = res
         stage = jax.lax.axis_index(STAGE_AXIS)
         zero_grads = jax.tree.map(jnp.zeros_like, params)
@@ -138,42 +165,45 @@ def make_pipeline_loss(stage0_fn, stage1_fn, num_micro: int):
 
         def tick(carry, s):
             g_act_in, acc = carry
+            j = num_micro - 1 - (s - (num_stages - 1 - stage))
+            active = jnp.logical_and(j >= 0, j < num_micro)
+            jc = jnp.clip(j, 0, num_micro - 1)
+            # The activation that arrived here at forward tick stage + j.
+            act = jax.lax.dynamic_index_in_dim(
+                stash, jnp.clip(stage + jc, 0, ticks - 1), keepdims=False
+            )
+            x_mb = jax.lax.dynamic_index_in_dim(x_mbs, jc, keepdims=False)
+            y_mb = jax.lax.dynamic_index_in_dim(y_mbs, jc, keepdims=False)
+            w_mb = jax.lax.dynamic_index_in_dim(w_mbs, jc, keepdims=False)
 
-            def s1_body():
-                # stage 1: microbatch j arrived at forward tick j+1
-                j = jnp.clip(num_micro - 1 - s, 0, num_micro - 1)
-                act = jax.lax.dynamic_index_in_dim(stash, j + 1, keepdims=False)
-                y_mb = jax.lax.dynamic_index_in_dim(y_mbs, j, keepdims=False)
-                w_mb = jax.lax.dynamic_index_in_dim(w_mbs, j, keepdims=False)
+            def bwd_first():
                 _, vjp = jax.vjp(
-                    lambda p, a: stage1_fn(p, a, y_mb, w_mb, key, j),
-                    params, act,
+                    lambda p: first_fn(p, x_mb, key, jc), params
                 )
-                gp, ga = vjp(g)
-                return gp, ga
-
-            def s0_body():
-                # stage 0: the cotangent arriving at tick s is for the
-                # microbatch stage 1 processed at tick s-1
-                j = jnp.clip(num_micro - s, 0, num_micro - 1)
-                x_mb = jax.lax.dynamic_index_in_dim(x_mbs, j, keepdims=False)
-                _, vjp = jax.vjp(
-                    lambda p: stage0_fn(p, x_mb, key, j), params
-                )
-                gp, = vjp(g_act_in)
+                (gp,) = vjp(g_act_in)
                 return gp, zero_ga
 
-            def idle():
-                return zero_grads, zero_ga
+            def bwd_mid(fn):
+                def run():
+                    _, vjp = jax.vjp(
+                        lambda p, a: fn(p, a, key, jc), params, act
+                    )
+                    return vjp(g_act_in)
 
-            # Activity in the PREDICATES: each device's idle tick takes the
-            # free zeros branch instead of computing-then-masking.
+                return run
+
+            def bwd_last():
+                _, vjp = jax.vjp(
+                    lambda p, a: last_fn(p, a, y_mb, w_mb, key, jc),
+                    params, act,
+                )
+                return vjp(g)
+
+            branches = [bwd_first, *[bwd_mid(fn) for fn in mid_fns], bwd_last]
             gp, ga = jax.lax.cond(
-                jnp.logical_and(stage == 1, s < num_micro),
-                s1_body,
-                lambda: jax.lax.cond(
-                    jnp.logical_and(stage == 0, s >= 1), s0_body, idle
-                ),
+                active,
+                lambda: jax.lax.switch(stage, branches),
+                lambda: (zero_grads, zero_ga),
             )
             acc = jax.tree.map(jnp.add, acc, gp)
             moved = jax.lax.ppermute(ga, STAGE_AXIS, ring_rev)
@@ -194,3 +224,10 @@ def make_pipeline_loss(stage0_fn, stage1_fn, num_micro: int):
 
     pipeline_loss.defvjp(pipeline_loss_fwd, pipeline_loss_bwd)
     return pipeline_loss
+
+
+def make_pipeline_loss(stage0_fn, stage1_fn, num_micro: int):
+    """The 2-stage special case (the round-2 API, unchanged): conv |
+    dense for the CNN (parallel/pp.py), block-halves for the ViT
+    (parallel/pp_vit.py)."""
+    return make_pipeline_loss_multi([stage0_fn, stage1_fn], num_micro)

@@ -1,15 +1,19 @@
 """Pipeline parallelism for the ViT family: transformer blocks as stages.
 
-The textbook transformer pipeline — depth splits across stages, the
-``[mb, tokens, dim]`` token activations travel the stage boundary:
+The textbook transformer pipeline — depth splits across S stages
+(``--pp-stages``, the stage axis's width) into nearly-even chunks, and
+the ``[mb, tokens, dim]`` token activations travel every boundary:
 
-- **stage 0**: patchify -> embed + pos-embed -> blocks[0 : depth/2]
-- **stage 1**: blocks[depth/2 :] -> final LN -> mean-pool -> head ->
+- **stage 0**: patchify -> embed + pos-embed -> first block chunk
+- **stages 1..S-2**: a chunk of blocks each (uniform boundary shape —
+  what makes the transformer the natural multi-stage pipeline)
+- **stage S-1**: last chunk -> final LN -> mean-pool -> head ->
   weighted NLL
 
 The microbatched ppermute schedule and its hand-written ``custom_vjp``
-backward come from parallel/pipeline.py (shared with the CNN pipeline,
-parallel/pp.py); this module supplies the ViT stage bodies, composed from
+backward come from parallel/pipeline.py's S-stage engine (shared with
+the CNN pipeline, parallel/pp.py, which stays at its natural 2 stages:
+conv | dense); this module supplies the ViT stage bodies, composed from
 the same models/vit.py helpers the single-device forward uses, so parity
 (tests/test_pp_vit.py) is exact — the family has no dropout, hence no
 mask-geometry caveat.  Under ``cfg.bf16`` the stage boundary travels at
@@ -38,39 +42,39 @@ from ..ops.attention import full_attention
 from ..ops.loss import nll_loss
 from .ddp import TrainState
 from .mesh import DATA_AXIS
-from .pipeline import NUM_STAGES, STAGE_AXIS, make_pipeline_loss
+from .pipeline import STAGE_AXIS, make_pipeline_loss_multi
 
 
-def _check_depth(cfg: ViTConfig) -> int:
-    if cfg.depth < NUM_STAGES:
-        raise ValueError(
-            f"pipeline needs depth >= {NUM_STAGES} blocks, got {cfg.depth}"
-        )
-    return cfg.depth // NUM_STAGES
+def _stage_bounds(depth: int, num_stages: int) -> list[int]:
+    """Block-index boundaries distributing ``depth`` blocks over stages
+    as evenly as possible.  Floor-based (``i*depth // S``, never
+    ``round`` — banker's rounding would flip the depth=7 S=2 split to
+    4|3), so S=2 reproduces the round-2 ``depth // 2`` split exactly at
+    every depth."""
+    return [i * depth // num_stages for i in range(num_stages + 1)]
 
 
-def _stage0_fwd(params: dict, x: jax.Array, cfg: ViTConfig, split: int):
-    """embed + the first ``split`` blocks: [mb, 28, 28, 1] ->
-    [mb, tokens, dim] (bf16 under cfg.bf16 — the boundary dtype)."""
-    dt = jnp.bfloat16 if cfg.bf16 else x.dtype
-    patches = patchify(x, cfg).astype(dt)
-    tokens = dense(patches, params["embed"]) + params["pos_embed"].astype(dt)
-    for i in range(split):
+def _run_blocks(params: dict, tokens: jax.Array, cfg: ViTConfig,
+                start: int, end: int) -> jax.Array:
+    for i in range(start, end):
         tokens = apply_block(
             params["blocks"][str(i)], tokens, cfg, full_attention
         )
     return tokens
 
 
-def _stage1_loss_sum(
+def _embed(params: dict, x: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """patchify + embed + pos-embed: [mb, 28, 28, 1] -> [mb, tokens, dim]
+    (bf16 under cfg.bf16 — the boundary dtype)."""
+    dt = jnp.bfloat16 if cfg.bf16 else x.dtype
+    patches = patchify(x, cfg).astype(dt)
+    return dense(patches, params["embed"]) + params["pos_embed"].astype(dt)
+
+
+def _head_loss_sum(
     params: dict, tokens: jax.Array, y: jax.Array, w: jax.Array,
-    cfg: ViTConfig, split: int,
 ) -> jax.Array:
-    """Remaining blocks + LN + pool + head + weighted NLL SUM."""
-    for i in range(split, cfg.depth):
-        tokens = apply_block(
-            params["blocks"][str(i)], tokens, cfg, full_attention
-        )
+    """final LN + mean-pool + head + weighted NLL SUM."""
     tokens = layer_norm(tokens, params["ln_f"])
     pooled = tokens.astype(jnp.float32).mean(axis=1)
     logp = tokens_to_logp(params, pooled)
@@ -84,26 +88,47 @@ def make_vit_pp_train_step(
     rho: float = 0.9,
     eps: float = 1e-6,
 ):
-    """Build the jitted (data x stage) pipelined ViT train step.
+    """Build the jitted (data x stage) pipelined ViT train step for ANY
+    stage count: the stage axis's width S splits the ``depth``
+    transformer blocks into S nearly-even chunks (embed rides the first
+    stage, LN/pool/head/loss the last), scheduled by the generic S-stage
+    GPipe engine (parallel/pipeline.py:make_pipeline_loss_multi).
 
     ``step_fn(state, x, y, w, lr) -> (state, losses)`` with ``state``
     fully replicated, ``x/y/w`` sharded over ``data``, ``losses`` one
     local mean loss per data shard (the vit_mnist.py step signature).
     """
-    if mesh.shape[STAGE_AXIS] != NUM_STAGES:
+    num_stages = mesh.shape[STAGE_AXIS]
+    if num_stages < 2:
         raise ValueError(
-            f"pipeline needs a {NUM_STAGES}-wide '{STAGE_AXIS}' axis, got "
-            f"{mesh.shape[STAGE_AXIS]}"
+            f"pipeline needs a >= 2-wide '{STAGE_AXIS}' axis, got "
+            f"{num_stages}"
         )
-    split = _check_depth(cfg)
+    if cfg.depth < num_stages:
+        raise ValueError(
+            f"pipeline needs depth >= {num_stages} blocks, got {cfg.depth}"
+        )
+    bounds = _stage_bounds(cfg.depth, num_stages)
 
-    def stage0(params, x_mb, key, j):
-        return _stage0_fwd(params, x_mb, cfg, split)
+    def first(params, x_mb, key, j):
+        tokens = _embed(params, x_mb, cfg)
+        return _run_blocks(params, tokens, cfg, bounds[0], bounds[1])
 
-    def stage1(params, act, y_mb, w_mb, key, j):
-        return _stage1_loss_sum(params, act, y_mb, w_mb, cfg, split)
+    def make_mid(start, end):
+        def mid(params, act, key, j):
+            return _run_blocks(params, act, cfg, start, end)
 
-    pipeline_loss = make_pipeline_loss(stage0, stage1, num_micro)
+        return mid
+
+    mids = [
+        make_mid(bounds[s], bounds[s + 1]) for s in range(1, num_stages - 1)
+    ]
+
+    def last(params, act, y_mb, w_mb, key, j):
+        tokens = _run_blocks(params, act, cfg, bounds[-2], bounds[-1])
+        return _head_loss_sum(params, tokens, y_mb, w_mb)
+
+    pipeline_loss = make_pipeline_loss_multi([first, *mids, last], num_micro)
 
     def local_step(state: TrainState, x, y, w, lr):
         n = x.shape[0]

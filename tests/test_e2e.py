@@ -216,6 +216,7 @@ def test_cli_dry_run_subprocess(tmp_path, script, extra):
     ["--step-stats"],    # per-epoch step-latency summary (observability)
     ["--zero", "--bf16", "--flash"],  # composition: sharded opt + bf16 +
                                       # flash (dense fallback off-TPU)
+    ["--pp", "--pp-stages", "4", "--depth", "4"],  # 4-stage GPipe
 ])
 def test_vit_cli_dry_run_subprocess(tmp_path, extra):
     """The ViT family CLI end-to-end in each parallel mode: flags parse,

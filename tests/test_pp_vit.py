@@ -79,6 +79,78 @@ def test_pp_train_step_matches_single_device(devices, num_micro):
     )
 
 
+@pytest.mark.parametrize("depth", [4, 5], ids=["even", "uneven"])
+def test_pp_four_stages_match_single_device(devices, depth):
+    """The S-stage generalization: 3 pipelined steps over a
+    (2 data x 4 stage) mesh — middle stages rematerialize their chunk
+    and relay cotangents on the reverse ring — track the single-device
+    recurrence, for an even depth/stages split AND an uneven one
+    (chunks of 1/1/2/1 at depth=5)."""
+    from pytorch_mnist_ddp_tpu.ops.adadelta import (
+        adadelta_init,
+        adadelta_update,
+    )
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+
+    cfg = ViTConfig(depth=depth)
+    mesh = make_mesh(num_data=2, num_model=4, devices=devices)
+    params = init_vit_params(jax.random.PRNGKey(0), cfg)
+    ref_params = jax.tree.map(jnp.array, params)
+    state = replicate_params(make_train_state(params), mesh)
+    step = make_vit_pp_train_step(mesh, cfg, num_micro=2)
+
+    @jax.jit
+    def ref_step(params, opt, x, y, w, lr):
+        def loss_fn(p):
+            return nll_loss(vit_forward(p, x, cfg), y, w, reduction="mean")
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt = adadelta_update(params, grads, opt, lr, 0.9, 1e-6)
+        return params, opt, loss
+
+    ref_opt = adadelta_init(ref_params)
+    rng = np.random.RandomState(5)
+    for _ in range(3):
+        x = jnp.asarray(rng.randn(8, 28, 28, 1), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 10, 8), jnp.int32)
+        w = jnp.ones((8,), jnp.float32)
+        state, losses = step(state, x, y, w, jnp.float32(1.0))
+        ref_params, ref_opt, ref_loss = ref_step(
+            ref_params, ref_opt, x, y, w, jnp.float32(1.0)
+        )
+        np.testing.assert_allclose(
+            np.mean(losses), ref_loss, rtol=2e-5, atol=2e-5
+        )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=3e-4, atol=3e-5),
+        jax.device_get(state.params),
+        jax.device_get(ref_params),
+    )
+
+
+def test_pp_stage_bounds_contract():
+    """Chunks cover every block exactly once, are nearly even, and the
+    S=2 case reproduces the round-2 depth//2 split."""
+    from pytorch_mnist_ddp_tpu.parallel.pp_vit import _stage_bounds
+
+    for depth in range(2, 13):
+        for stages in range(2, min(depth, 6) + 1):
+            b = _stage_bounds(depth, stages)
+            assert b[0] == 0 and b[-1] == depth
+            sizes = [b[i + 1] - b[i] for i in range(stages)]
+            assert all(s >= 1 for s in sizes), (depth, stages, sizes)
+            assert max(sizes) - min(sizes) <= 1, (depth, stages, sizes)
+        # S=2 reproduces the round-2 depth//2 split at EVERY depth (a
+        # round()-based bound flips 3|4 to 4|3 at depth = 3 mod 4).
+        assert _stage_bounds(depth, 2)[1] == depth // 2, depth
+
+
+def test_pp_rejects_depth_below_stages(devices):
+    mesh = make_mesh(num_data=2, num_model=4, devices=devices)
+    with pytest.raises(ValueError, match="depth"):
+        make_vit_pp_train_step(mesh, ViTConfig(depth=3), num_micro=2)
+
+
 def test_pp_forward_loss_matches_full_batch(devices):
     """One pipelined step's reported loss equals the single-device
     full-batch mean loss (fast tier: forward schedule only needs one

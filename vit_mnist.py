@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "schedule); mutually exclusive with --sp/--tp")
     p.add_argument("--pp-microbatches", type=int, default=2, metavar="M",
                    help="microbatches per shard batch in --pp mode")
+    p.add_argument("--pp-stages", type=int, default=2, metavar="S",
+                   help="pipeline stage count in --pp mode: depth blocks "
+                        "split into S nearly-even chunks over an S-wide "
+                        "stage axis (needs --depth >= S)")
     p.add_argument("--experts", type=int, default=0, metavar="E",
                    help="switch-MoE with E experts, expert-parallel over "
                         "the data axis (models/moe.py + parallel/ep.py); "
@@ -144,6 +148,10 @@ def main() -> None:
     if args.sp_impl != "ring" and args.sp <= 1:
         raise SystemExit(
             "--sp-impl selects the --sp strategy; add --sp N (> 1)"
+        )
+    if args.pp and args.pp_stages < 2:
+        raise SystemExit(
+            f"--pp-stages must be >= 2, got {args.pp_stages}"
         )
     if args.remat and (args.tp > 1 or args.pp or args.experts > 0):
         raise SystemExit(
@@ -395,7 +403,7 @@ def main() -> None:
             make_vit_pp_train_step,
         )
 
-        mesh = make_mesh(num_data=None, num_model=2)
+        mesh = make_mesh(num_data=None, num_model=args.pp_stages)
         state = replicate_params(make_train_state(params), mesh)
         train_step = make_vit_pp_train_step(
             mesh, cfg, num_micro=args.pp_microbatches
