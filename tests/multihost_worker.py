@@ -115,6 +115,79 @@ def _vit3d_world(dist, data_root: str, out_path: str) -> None:
     print(f"worker rank {dist.process_rank} done", flush=True)
 
 
+def _vitpp8_world(dist, data_root: str, out_path: str) -> None:
+    """The S-stage pipeline leg: an 8-stage ViT pipeline over a
+    (1 data x 8 stage) mesh spanning both processes — the per-tick
+    activation/cotangent ppermutes between stages 3 and 4 cross the OS
+    process boundary in BOTH directions, and the stage-axis grad psum
+    crosses it too.  Both processes must end with bit-identical
+    replicated params."""
+    import jax.numpy as jnp
+
+    from pytorch_mnist_ddp_tpu.data.loader import DataLoader
+    from pytorch_mnist_ddp_tpu.data.mnist import MNIST
+    from pytorch_mnist_ddp_tpu.models.vit import ViTConfig, init_vit_params
+    from pytorch_mnist_ddp_tpu.parallel.ddp import (
+        make_train_state,
+        replicate_params,
+    )
+    from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+    from pytorch_mnist_ddp_tpu.parallel.pp_vit import (
+        make_vit_eval_step,
+        make_vit_pp_train_step,
+    )
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import _flatten_raw
+
+    cfg = ViTConfig(depth=8)
+    mesh = make_mesh(num_data=1, num_model=8, devices=jax.devices())
+    params = init_vit_params(jax.random.PRNGKey(1), cfg)
+    state = replicate_params(make_train_state(params), mesh)
+    step = make_vit_pp_train_step(mesh, cfg, num_micro=4)
+    eval_step = make_vit_eval_step(mesh, cfg)
+
+    # The (1 data x 8 stage) mesh has a REPLICATED batch (every device
+    # is a data replica): both processes must feed the IDENTICAL global
+    # batch, so the loaders run UNSHARDED (process_count=1) — a
+    # rank-sharded loader here would hand stage 0 rank 0's images and
+    # the last stage rank 1's labels (a process-divergent "replicated"
+    # array), training on incoherent pairs.
+    train_set = MNIST(root=data_root, train=True)
+    loader = DataLoader(
+        train_set.images, train_set.labels, 16, mesh=mesh, shuffle=True,
+        seed=1,
+    )
+    first_loss = last_loss = None
+    for epoch in range(1, 3):
+        for x, y, w in loader.epoch(epoch):
+            state, losses = step(state, x, y, w, jnp.float32(1.0))
+            last_loss = float(
+                np.asarray(losses.addressable_shards[0].data)[0]
+            )
+            if first_loss is None:
+                first_loss = last_loss
+    assert last_loss is not None
+
+    test_set = MNIST(root=data_root, train=False)
+    test_loader = DataLoader(
+        test_set.images, test_set.labels, 16, mesh=mesh, shuffle=False,
+        mask_padding=True,
+    )
+    totals = np.zeros(2)
+    for x, y, w in test_loader.epoch(0):
+        totals += np.asarray(eval_step(state.params, x, y, w))
+
+    host = jax.tree.map(np.asarray, jax.device_get(state.params))
+    np.savez(
+        out_path,
+        avg_loss=np.float64(totals[0] / len(test_set.images)),
+        correct=np.int64(totals[1]),
+        first_loss=np.float64(first_loss),
+        last_loss=np.float64(last_loss),
+        **_flatten_raw(host),
+    )
+    print(f"worker rank {dist.process_rank} done", flush=True)
+
+
 def main() -> None:
     data_root, out_path, mode = sys.argv[1], sys.argv[2], sys.argv[3]
 
@@ -128,6 +201,9 @@ def main() -> None:
 
     if mode == "vit3d":
         _vit3d_world(dist, data_root, out_path)
+        return
+    if mode == "vitpp8":
+        _vitpp8_world(dist, data_root, out_path)
         return
 
     import os

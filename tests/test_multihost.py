@@ -223,6 +223,32 @@ def test_two_process_world_replica_consistency(tmp_path, mode):
     assert losses[-1] < losses[0]
 
 
+def test_two_process_vitpp8_consistency(tmp_path):
+    """An 8-stage ViT pipeline over a (1 data x 8 stage) mesh spanning
+    the process boundary: the per-tick activation and cotangent
+    ppermutes between stages 3 and 4 cross the OS processes in both
+    directions, and the stage-axis grad psum crosses too.  Both
+    processes must end with bit-identical replicated params."""
+    r0, r1, logs = _run_world(tmp_path, "vitpp8")
+    param_keys = [
+        k for k in r0
+        if k not in ("avg_loss", "correct", "first_loss", "last_loss")
+    ]
+    # ViT(depth=8) tree: 7 non-block arrays + 8 blocks x 12 leaves.
+    assert len(param_keys) == 7 + 8 * 12, sorted(param_keys)[:5]
+    for k in param_keys:
+        np.testing.assert_array_equal(r0[k], r1[k], err_msg=k)
+    assert r0["correct"] == r1["correct"]
+    np.testing.assert_allclose(r0["avg_loss"], r1["avg_loss"], rtol=1e-6)
+    assert 0 <= int(r0["correct"]) <= 256
+    # The model LEARNS on coherent (image, label) pairs — the assertion
+    # that catches a divergent-"replicated"-batch regression (a
+    # rank-sharded loader on this mesh feeds mismatched pairs).
+    assert float(r0["last_loss"]) < float(r0["first_loss"]), (
+        r0["first_loss"], r0["last_loss"],
+    )
+
+
 def test_two_process_vit3d_consistency(tmp_path):
     """The ViT 3-D (2 data x 2 seq x 2 model) mesh spanning the process
     boundary: ring-attention ppermutes, row-parallel psums, and the VMA

@@ -176,3 +176,69 @@ def test_pp_dropout_grads_match_manual_reference(devices):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-6, err_msg=str(pa)
         )
+
+
+def test_pipeline_engine_three_stages_toy(devices):
+    """Engine-level coverage of make_pipeline_loss_multi, independent of
+    any model: a 3-stage chain of linear layers over a (1 data x 3
+    stage) mesh (the engine's data-axis composition is pinned by the
+    CNN/ViT step tests) must reproduce the direct computation's loss
+    AND its grads exactly — the middle stage's remat + cotangent relay
+    is the part no 2-stage test exercises."""
+    from jax.sharding import PartitionSpec as P
+
+    from pytorch_mnist_ddp_tpu.parallel.mesh import DATA_AXIS
+    from pytorch_mnist_ddp_tpu.parallel.pipeline import (
+        make_pipeline_loss_multi,
+    )
+
+    rng = np.random.RandomState(0)
+    params = {
+        "w1": jnp.asarray(rng.randn(6, 5).astype(np.float32)),
+        "w2": jnp.asarray(rng.randn(5, 5).astype(np.float32)),
+        "w3": jnp.asarray(rng.randn(5, 1).astype(np.float32)),
+    }
+    x = jnp.asarray(rng.randn(8, 6).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 2, 8).astype(np.int32))
+    w = jnp.ones((8,), jnp.float32)
+
+    def first(p, x_mb, key, j):
+        return jnp.tanh(x_mb @ p["w1"])
+
+    def mid(p, act, key, j):
+        return jnp.tanh(act @ p["w2"])
+
+    def last(p, act, y_mb, w_mb, key, j):
+        pred = (act @ p["w3"])[:, 0]
+        return (w_mb * (pred - y_mb.astype(jnp.float32)) ** 2).sum()
+
+    def direct(p, x, y, w):
+        act = jnp.tanh(jnp.tanh(x @ p["w1"]) @ p["w2"])
+        pred = (act @ p["w3"])[:, 0]
+        return (w * (pred - y.astype(jnp.float32)) ** 2).sum()
+
+    # (1 data x 3 stage): isolates the 3-stage schedule — the engine's
+    # data-axis composition is already pinned by the CNN/ViT step tests.
+    mesh = make_mesh(num_data=1, num_model=3, devices=devices[:3])
+    pipeline_loss = make_pipeline_loss_multi([first, mid, last], num_micro=2)
+
+    def local(p, x, y, w):
+        x_mbs = x.reshape(2, 4, 6)  # 8 rows -> 2 microbatches of 4
+        y_mbs = y.reshape(2, 4)
+        w_mbs = w.reshape(2, 4)
+        return pipeline_loss(p, x_mbs, y_mbs, w_mbs, jax.random.PRNGKey(0))
+
+    grad_fn = jax.jit(jax.shard_map(
+        jax.value_and_grad(local), mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    ))
+    loss_pp, grads_pp = grad_fn(params, x, y, w)
+    loss_ref, grads_ref = jax.value_and_grad(direct)(params, x, y, w)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-6)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(grads_pp[k]), np.asarray(grads_ref[k]),
+            rtol=1e-5, atol=1e-6, err_msg=k,
+        )
