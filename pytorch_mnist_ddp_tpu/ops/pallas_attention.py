@@ -30,10 +30,15 @@ Design (mirrors the framework's other kernel, ops/pallas_adadelta.py):
   pass — which is what makes the custom-VJP backward a simple
   ``lax.scan`` over k blocks in plain JAX (O(t) memory, XLA-fused), the
   standard flash backward split.
-- accumulation is float32 regardless of input dtype (bf16 q/k/v feed the
-  MXU at native width; the softmax stats stay exact) — the same contract
-  as ops/attention.py:block_update, so the dense oracle pins this kernel
-  too (tests/test_flash.py).
+- the softmax stats (m, l, logsumexp) and the output accumulator are
+  float32 regardless of input dtype; the probability block is rounded to
+  v.dtype before the value matmul (standard flash practice — bf16 p·v
+  feeds the MXU at native width).  For bf16 inputs the forward therefore
+  differs from the dense oracle (which never rounds p) by that rounding,
+  and the custom-VJP backward — which reconstructs p in f32 — computes
+  the gradient of the UNROUNDED function; tests/test_flash.py's bf16
+  tolerances (2e-2) absorb both.  f32 inputs match
+  ops/attention.py:block_update exactly.
 
 Non-TPU backends run the kernel in interpret mode for tests
 (``TPU_MNIST_PALLAS_INTERPRET=1``); the CLI gate (``flash_active``)
@@ -269,12 +274,33 @@ def _bwd_blockwise(q3, k3, v3, out3, lse, g3, t_real: int, scale: float):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=())
-def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """Fused flash-attention: drop-in for ``ops.attention.full_attention``
-    (no kv_mask — the ViT family has no token padding; the dense path
-    handles masked cases).  ``q/k/v``: ``[b, t, h, d]``."""
+def _flash_attention_core(
+    q: jax.Array, k: jax.Array, v: jax.Array
+) -> jax.Array:
     out, _ = _flash_fwd_res(q, k, v)
     return out
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Fused flash-attention, signature-compatible with
+    ``ops.attention.full_attention``.  ``q/k/v``: ``[b, t, h, d]``.
+
+    MASKLESS: the kernel has no kv_mask plumbing (every current caller is
+    an unpadded ViT path).  The argument exists so a masked caller
+    arriving through ``select_attention`` fails loudly here instead of
+    silently attending to padding — route masked inputs to the dense
+    path."""
+    if kv_mask is not None:
+        raise ValueError(
+            "flash_attention does not support kv_mask; use "
+            "ops.attention.full_attention for masked inputs"
+        )
+    return _flash_attention_core(q, k, v)
 
 
 def _dense_fwd_res(q, k, v, scale):
@@ -327,7 +353,7 @@ def _vjp_bwd(res, g):
     return cast(dq3, q), cast(dk3, k), cast(dv3, v)
 
 
-flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+_flash_attention_core.defvjp(_vjp_fwd, _vjp_bwd)
 
 
 def _partial_kernel(q_ref, k_ref, v_ref, m0_ref, l0_ref, a0_ref,

@@ -285,14 +285,20 @@ def _sp_vit_forward(
     else:
         ring = ring_attention_flash if use_flash else ring_attention
         attn = lambda q, k, v: ring(q, k, v, SEQ_AXIS)
-    block = apply_block
+    def block(bp, tokens):
+        # cfg and attn are closed over, NOT passed as static args: the
+        # attn lambda above is constructed fresh per step build, and a
+        # static-argnum lambda would key a new trace-cache entry each
+        # time (round-3 advisor finding).
+        return apply_block(bp, tokens, cfg, attn)
+
     if cfg.remat:
         # Same remat contract as the single-device trunk (_vit_trunk):
         # collectives inside the block (the ring/all_to_all) replay in
         # backward too — jax.checkpoint handles them like any other op.
-        block = jax.checkpoint(apply_block, static_argnums=(2, 3))
+        block = jax.checkpoint(block)
     for i in range(cfg.depth):
-        tokens = block(params["blocks"][str(i)], tokens, cfg, attn)
+        tokens = block(params["blocks"][str(i)], tokens)
     tokens = layer_norm(tokens, params["ln_f"])
     # fp32 pool (the same head/log_softmax numerics contract as the
     # single-device trunk).

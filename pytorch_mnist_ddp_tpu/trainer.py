@@ -32,18 +32,13 @@ from .utils.logging import test_summary_lines, train_log_line
 from .utils.rng import root_key, split_streams
 
 
-def _assert_checkpoint_consistent(path: str) -> None:
-    """Multi-controller guard: every process loads its LOCAL copy of a
-    resume file, and replicate_params assumes those copies are identical
-    by construction — so cross-check a digest of the raw file bytes over
-    all processes and refuse divergent copies (the single-process case is
-    a no-op)."""
+def _assert_digest_consistent(digest: bytes, path: str, what: str) -> None:
+    """Multi-controller guard: allgather an 8-byte digest prefix across
+    processes and refuse divergent per-host copies — replicate_params
+    assumes local copies are identical by construction.  No-op in a
+    single-process world."""
     if jax.process_count() <= 1:
         return
-    import hashlib
-
-    with open(path, "rb") as f:
-        digest = hashlib.sha256(f.read()).digest()
     from jax.experimental import multihost_utils
 
     digests = multihost_utils.process_allgather(
@@ -51,10 +46,22 @@ def _assert_checkpoint_consistent(path: str) -> None:
     )
     if not bool(np.all(digests == digests[0])):
         raise ValueError(
-            f"resume file {path!r} differs across processes (per-host "
-            "copies are not identical); distribute one consistent file "
-            "to every host before resuming"
+            f"{what} {path!r} differs across processes (per-host copies "
+            "are not identical); distribute one consistent file to every "
+            "host before resuming"
         )
+
+
+def _assert_checkpoint_consistent(path: str) -> None:
+    """Cross-check a digest of a resume file's raw bytes over all
+    processes (see _assert_digest_consistent)."""
+    if jax.process_count() <= 1:
+        return
+    import hashlib
+
+    with open(path, "rb") as f:
+        digest = hashlib.sha256(f.read()).digest()
+    _assert_digest_consistent(digest, path, "resume file")
 
 
 def _load_resume_variables(path: str, syncbn: bool, init_key) -> tuple:
@@ -86,21 +93,16 @@ def _load_resume_variables(path: str, syncbn: bool, init_key) -> tuple:
 
     flat = load_state_dict(path)
     if jax.process_count() > 1:
+        # Digest the PARSED tensors (not file bytes): .pt archives admit
+        # byte-level differences (pickle protocol, zip metadata) that do
+        # not change the tensors, and those must not fail the guard.
         digest = hashlib.sha256()
         for key in sorted(flat):
             digest.update(key.encode())
             digest.update(np.ascontiguousarray(flat[key]).tobytes())
-        from jax.experimental import multihost_utils
-
-        digests = multihost_utils.process_allgather(
-            np.frombuffer(digest.digest()[:8], dtype=np.uint8)
+        _assert_digest_consistent(
+            digest.digest(), path, "--resume checkpoint"
         )
-        if not bool(np.all(digests == digests[0])):
-            raise ValueError(
-                f"--resume checkpoint {path!r} differs across processes "
-                "(per-host copies are not identical); distribute one "
-                "consistent file to every host before resuming"
-            )
     variables = variables_from_state_dict(flat)
     params = variables["params"]
     has_bn = "bn1" in params

@@ -371,3 +371,15 @@ def test_dispatch_gate(monkeypatch):
         with pytest.warns(UserWarning, match="interpret"):
             fn = attention_best(True)
         assert fn is not flash_attention
+
+
+def test_kv_mask_rejected():
+    """flash_attention is maskless: a kv_mask arriving through the
+    select_attention seam must fail loudly, not silently attend to
+    padding (round-3 advisor finding)."""
+    q, k, v = _qkv(SHAPES[0])
+    mask = jnp.ones(q.shape[:2], bool)
+    with pytest.raises(ValueError, match="kv_mask"):
+        flash_attention(q, k, v, mask)
+    with pytest.raises(ValueError, match="kv_mask"):
+        flash_attention(q, k, v, kv_mask=mask)
