@@ -76,6 +76,50 @@ def run_flops(train_samples: int, test_samples: int, epochs: int) -> int:
     return epochs * per_epoch
 
 
+def vit_forward_flops_per_sample(cfg) -> int:
+    """Matmul FLOPs for one sample's ViT forward pass (models/vit.py).
+
+    ``cfg`` is duck-typed to ViTConfig (tokens/patch_dim/dim/depth/heads/
+    mlp_dim/num_classes) so this module stays import-light.  Counts the
+    MXU work only, same convention as the CNN model above: patch embed,
+    per-block qkv/scores/values/proj + MLP, classifier head.  The MoE
+    variant routes each token through ONE expert, so the dense count is
+    also the switch-MoE count at capacity.
+    """
+    t = cfg.grid * cfg.grid
+    d = cfg.dim
+    per_block = (
+        3 * t * d * d      # qkv projections
+        + t * t * d        # attention scores  q @ k^T
+        + t * t * d        # attention output  p @ v
+        + t * d * d        # output projection
+        + t * d * cfg.mlp_dim + t * cfg.mlp_dim * d  # MLP in/out
+    )
+    total = (
+        t * cfg.patch_dim * d          # patch embedding
+        + cfg.depth * per_block
+        + d * cfg.num_classes          # classifier head (pooled token)
+    )
+    return 2 * total
+
+
+def vit_train_step_flops_per_sample(cfg) -> int:
+    """Forward + backward (3x forward, same convention as the CNN)."""
+    return 3 * vit_forward_flops_per_sample(cfg)
+
+
+def vit_run_flops(cfg, train_samples: int, test_samples: int,
+                  epochs: int) -> int:
+    """Total model FLOPs for a ViT benchmark run (epochs of train over
+    ``train_samples`` + one eval forward pass over ``test_samples`` per
+    epoch — the fused_vit.py run structure)."""
+    per_epoch = (
+        train_samples * vit_train_step_flops_per_sample(cfg)
+        + test_samples * vit_forward_flops_per_sample(cfg)
+    )
+    return epochs * per_epoch
+
+
 def tpu_peak_flops_per_chip(device_kind: str) -> float | None:
     """Peak bf16 FLOP/s for ``device_kind``, or None if unrecognized."""
     kind = device_kind.lower()

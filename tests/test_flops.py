@@ -62,3 +62,38 @@ def test_peak_table_lookup():
     assert tpu_peak_flops_per_chip("TPU v4") == 275.0e12
     assert tpu_peak_flops_per_chip("cpu") is None
     assert tpu_peak_flops_per_chip("Radically New Chip") is None
+
+
+def test_vit_flops_against_xla_costing():
+    """Pin the analytic ViT FLOPs model against XLA's own cost analysis
+    of the real forward (the same oracle the CNN model uses above)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_mnist_ddp_tpu.models.vit import (
+        ViTConfig,
+        init_vit_params,
+        vit_forward,
+    )
+    from pytorch_mnist_ddp_tpu.utils.flops import (
+        vit_forward_flops_per_sample,
+        vit_run_flops,
+        vit_train_step_flops_per_sample,
+    )
+
+    cfg = ViTConfig()
+    params = init_vit_params(jax.random.PRNGKey(0), cfg)
+    x = jnp.zeros((200, 28, 28, 1), jnp.float32)
+    comp = jax.jit(lambda p, x: vit_forward(p, x, cfg)).lower(params, x)
+    xla_flops = comp.compile().cost_analysis()["flops"]
+    analytic = vit_forward_flops_per_sample(cfg) * 200
+    # Looser than the CNN's 2%: the analytic model skips layernorm/gelu/
+    # softmax elementwise work, a bigger share at dim-64 ViT scale.
+    assert abs(xla_flops - analytic) / analytic < 0.25
+    assert vit_train_step_flops_per_sample(cfg) == 3 * vit_forward_flops_per_sample(cfg)
+    one = vit_run_flops(cfg, 60000, 10000, 1)
+    assert one == (
+        60000 * vit_train_step_flops_per_sample(cfg)
+        + 10000 * vit_forward_flops_per_sample(cfg)
+    )
+    assert vit_run_flops(cfg, 60000, 10000, 20) == 20 * one

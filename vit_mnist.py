@@ -40,7 +40,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dry-run", action="store_true", default=False,
                    help="run a single batch per epoch")
     p.add_argument("--data-root", type=str, default="./data")
-    p.add_argument("--sp", type=int, default=1, metavar="S",
+    p.add_argument("--sp", type=int, default=None, metavar="S",
                    help="sequence-parallel degree: ring attention over an "
                         "S-way seq axis (parallel/sp.py); composes with "
                         "--tp into the 3-D (data, seq, model) step")
@@ -51,10 +51,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "tokens->heads with one all_to_all pair and runs "
                         "dense (or --flash) attention locally "
                         "(needs heads %% S == 0; plain --sp only)")
-    p.add_argument("--tp", type=int, default=1, metavar="M",
+    p.add_argument("--tp", type=int, default=None, metavar="M",
                    help="tensor-parallel degree: Megatron-style head/MLP "
                         "sharding over an M-way model axis "
                         "(parallel/tp_vit.py); composes with --sp")
+    p.add_argument("--allow-degree-1", action="store_true", default=False,
+                   help="take the --sp/--tp/--pp parallel code paths even "
+                        "at degree 1: the shard_map programs, collectives, "
+                        "and kernels compile and run on a 1-wide axis — "
+                        "the single-chip hardware smoke for modes whose "
+                        "full degree needs more devices than are visible")
     p.add_argument("--pp", action="store_true", default=False,
                    help="pipeline the transformer blocks across 2 stages "
                         "(parallel/pp_vit.py: microbatched ppermute "
@@ -114,6 +120,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print per-epoch host-side step-latency summaries "
                         "(per-batch paths; the fused whole-run has no "
                         "per-step host boundary)")
+    p.add_argument("--timings-json", type=str, default=None, metavar="PATH",
+                   help="(--fused only) write a wall-clock attribution "
+                        "JSON to PATH: compile_s / data_s / run_s split "
+                        "via an AOT lower+compile, plus accuracies and "
+                        "dataset provenance — the same contract bench.py "
+                        "records for the CNN (tools/vit_bench.py reads it)")
     p.add_argument("--save-state", type=str, default=None, metavar="PATH",
                    help="save the FULL training state (params, Adadelta "
                         "accumulators, step/epoch counters) at the end — "
@@ -130,30 +142,44 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main() -> None:
     args = build_parser().parse_args()
-    if args.experts > 0 and (args.sp > 1 or args.tp > 1 or args.pp):
+    # --sp/--tp default to None (off).  A parallel path is taken at
+    # degree > 1, or at an explicit degree 1 under --allow-degree-1 (the
+    # single-chip hardware smoke); after this block args.sp/args.tp are
+    # plain ints and sp_on/tp_on are the branch selectors.
+    for name in ("sp", "tp"):
+        v = getattr(args, name)
+        if v is not None and v < 1:
+            raise SystemExit(f"--{name} must be >= 1, got {v}")
+    sp_on = args.sp is not None and (args.sp > 1 or args.allow_degree_1)
+    tp_on = args.tp is not None and (args.tp > 1 or args.allow_degree_1)
+    args.sp = args.sp or 1
+    args.tp = args.tp or 1
+    if args.experts > 0 and (sp_on or tp_on or args.pp):
         raise SystemExit("--experts is mutually exclusive with --sp/--tp/--pp")
-    if args.pp and (args.sp > 1 or args.tp > 1):
+    if args.pp and (sp_on or tp_on):
         raise SystemExit("--pp is mutually exclusive with --sp/--tp")
-    if args.zero and (args.sp > 1 or args.tp > 1 or args.pp
+    if args.zero and (sp_on or tp_on or args.pp
                       or args.experts > 0 or args.fused):
         raise SystemExit(
             "--zero is plain data parallelism; drop --sp/--tp/--pp/"
             "--experts/--fused"
         )
-    if args.sp_impl != "ring" and args.tp > 1:
+    if args.sp_impl != "ring" and tp_on:
         raise SystemExit(
             "--sp-impl ulysses is the plain --sp path; the 3-D --sp --tp "
             "composition rides the ring"
         )
-    if args.sp_impl != "ring" and args.sp <= 1:
+    if args.sp_impl != "ring" and not sp_on:
         raise SystemExit(
             "--sp-impl selects the --sp strategy; add --sp N (> 1)"
         )
     if args.pp and args.pp_stages < 2:
+        # (--allow-degree-1 does not extend here: the GPipe engine's
+        # first/last stage split is structurally >= 2 stages.)
         raise SystemExit(
             f"--pp-stages must be >= 2, got {args.pp_stages}"
         )
-    if args.remat and (args.tp > 1 or args.pp or args.experts > 0):
+    if args.remat and (tp_on or args.pp or args.experts > 0):
         raise SystemExit(
             "--remat rides the single-device/--zero/--sp/--fused paths; "
             "drop --tp/--pp/--experts"
@@ -239,7 +265,7 @@ def main() -> None:
     epoch0 = 0
     loaded_state = None
     if (args.resume_state or args.save_state) and (
-        args.tp > 1 or args.pp or args.experts > 0
+        tp_on or args.pp or args.experts > 0
     ):
         raise SystemExit(
             "--save-state/--resume-state ride the replicated-state paths "
@@ -309,7 +335,7 @@ def main() -> None:
     # Whole-run fusion: like the CNN CLI, --dry-run (a per-batch smoke
     # semantics) silently falls back to the per-batch path.
     fused = args.fused and not args.dry_run
-    if args.fused and (args.sp > 1 or args.tp > 1 or args.pp or args.experts > 0):
+    if args.fused and (sp_on or tp_on or args.pp or args.experts > 0):
         raise SystemExit(
             "--fused is the data-parallel whole-run; drop --sp/--tp/--pp/"
             "--experts"
@@ -323,10 +349,14 @@ def main() -> None:
         mesh = make_mesh(num_model=1)
         n_shards = mesh.shape["data"]
         state = replicate_params(base_state(), mesh)
-        tr_x, tr_y = load_mnist_arrays(args.data_root, "train")
+        tr_x, tr_y, tr_src = load_mnist_arrays(
+            args.data_root, "train", return_source=True
+        )
         te_x, te_y = load_mnist_arrays(args.data_root, "test", download=False)
+        _t0 = time.perf_counter()
         tr_dev = device_put_dataset(tr_x, tr_y, mesh)
         te_dev = device_put_dataset(te_x, te_y, mesh)
+        _data_dispatch = time.perf_counter() - _t0
         global_batch = args.batch_size * n_shards
         eval_batch = args.test_batch_size * n_shards
         run_fn, num_batches = make_fused_vit_run(
@@ -339,10 +369,40 @@ def main() -> None:
              for e in range(epoch0 + 1, epoch0 + args.epochs + 1)],
             jnp.float32,
         )
-        state, losses, evals = run_fn(
+        run_inputs = (
             state, *tr_dev, *te_dev, jax.random.PRNGKey(args.seed), lrs
         )
-        losses, evals = np.asarray(losses), np.asarray(evals)
+        if args.timings_json:
+            # The bench attribution contract (trainer.py fused path /
+            # bench.py): AOT lower+compile so a cold ~20 s compile can't
+            # masquerade as device time, D2H reads INSIDE the run_s window
+            # so tunnel-async dispatch can't park device time in a later
+            # print (trainer.py:437-458 documents both hazards).
+            import json as _json
+
+            timings = {"dataset": tr_src}
+            _t1 = time.perf_counter()
+            compiled = run_fn.lower(*run_inputs).compile()
+            timings["compile_s"] = time.perf_counter() - _t1
+            _t1 = time.perf_counter()
+            jax.block_until_ready((tr_dev, te_dev))
+            timings["data_s"] = _data_dispatch + time.perf_counter() - _t1
+            _t1 = time.perf_counter()
+            state, losses, evals = compiled(*run_inputs)
+            losses, evals = np.asarray(losses), np.asarray(evals)
+            timings["run_s"] = time.perf_counter() - _t1
+            timings.update(
+                train_size=len(tr_x), test_size=len(te_x),
+                epochs=args.epochs, n_shards=n_shards,
+                depth=cfg.depth, dim=cfg.dim,
+                epoch1_test_accuracy=float(evals[0, 1]) / len(te_x),
+                final_test_accuracy=float(evals[-1, 1]) / len(te_x),
+            )
+            with open(args.timings_json, "w") as f:
+                _json.dump(timings, f)
+        else:
+            state, losses, evals = run_fn(*run_inputs)
+            losses, evals = np.asarray(losses), np.asarray(evals)
         for e in range(args.epochs):
             for b in range(0, num_batches, args.log_interval):
                 print(train_log_line(
@@ -373,7 +433,7 @@ def main() -> None:
 
     use_flash = flash_active_or_warn(args.flash)
     attention_fn = select_attention(use_flash)
-    if args.sp > 1 and args.tp > 1:
+    if sp_on and tp_on:
         from pytorch_mnist_ddp_tpu.parallel.sp3 import (
             make_3d_mesh,
             make_sp3_eval_step,
@@ -386,7 +446,7 @@ def main() -> None:
         state = shard_sp3_state(make_train_state(params), mesh, cfg)
         train_step = make_sp3_train_step(mesh, cfg, use_flash=use_flash)
         eval_step = make_sp3_eval_step(mesh, cfg, use_flash=use_flash)
-    elif args.tp > 1:
+    elif tp_on:
         from pytorch_mnist_ddp_tpu.parallel.tp_vit import (
             make_vit_tp_eval_step,
             make_vit_tp_train_step,
@@ -409,7 +469,7 @@ def main() -> None:
             mesh, cfg, num_micro=args.pp_microbatches
         )
         eval_step = make_vit_eval_step(mesh, cfg)
-    elif args.sp > 1:
+    elif sp_on:
         from pytorch_mnist_ddp_tpu.parallel.sp import (
             make_sp_eval_step,
             make_sp_mesh,
