@@ -1,0 +1,99 @@
+"""tools/trace_attr.py: the profiler-trace distiller the watcher commits
+after each tunnel-window capture (round-3 verdict item 1 — the 47 MB raw
+trace died with a machine reset; the distilled JSON survives as a commit).
+
+Synthetic Chrome-trace fixtures pin the two load-bearing behaviors:
+self-time attribution under nested events (an enclosing `while` must not
+absorb its body's time) and the op-line selection (host threads without
+HLO-op events are ignored)."""
+
+import gzip
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "trace_attr.py")
+
+
+def _write_trace(tmp_path, events):
+    d = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    d.mkdir(parents=True)
+    payload = {"displayTimeUnit": "ns", "traceEvents": events}
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump(payload, f)
+    return tmp_path
+
+
+def _meta(pid, pname, tid, tname):
+    return [
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": pname}},
+        {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+         "args": {"name": tname}},
+    ]
+
+
+def _op(pid, tid, name, ts, dur, module="jit_run"):
+    return {"ph": "X", "pid": pid, "tid": tid, "ts": ts, "dur": dur,
+            "name": name, "args": {"hlo_op": name, "hlo_module": module}}
+
+
+def _run(trace_dir):
+    proc = subprocess.run(
+        [sys.executable, TOOL, str(trace_dir)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(proc.stdout)
+
+
+def test_nested_events_get_self_time(tmp_path):
+    """An enclosing `while` (the epoch scan) is charged only the time its
+    children don't cover; leaf ops keep their full durations."""
+    events = _meta(1, "/device:TPU:0", 10, "XLA Ops") + [
+        _op(1, 10, "while.1", 0.0, 100.0),
+        _op(1, 10, "convolution.1", 10.0, 30.0),
+        _op(1, 10, "loop_add_fusion.2", 50.0, 20.0),
+    ]
+    r = _run(_write_trace(tmp_path, events))
+    ops = {o["op"]: o["time_s"] for o in r["top_ops"]}
+    assert ops["convolution.1"] == pytest.approx(30e-6)
+    assert ops["loop_add_fusion.2"] == pytest.approx(20e-6)
+    assert ops["while.1"] == pytest.approx(50e-6)  # 100 - 30 - 20
+    assert r["busy_s"] == pytest.approx(100e-6)
+    assert r["gap_share"] == pytest.approx(0.0)
+    assert r["by_category"]["convolution"]["time_s"] == pytest.approx(30e-6)
+
+
+def test_host_threads_ignored_and_gaps_counted(tmp_path):
+    """Only HLO-op lines count; a python host thread with huge spans must
+    not be selected, and idle time between ops lands in gap_share."""
+    events = (
+        _meta(1, "/device:TPU:0", 10, "XLA Ops")
+        + _meta(2, "/host:CPU", 20, "python")
+        + [
+            _op(1, 10, "dot.1", 0.0, 25.0),
+            _op(1, 10, "dot.2", 75.0, 25.0),
+            # No hlo args and not an op-line thread name: ignored.
+            {"ph": "X", "pid": 2, "tid": 20, "ts": 0.0, "dur": 1e6,
+             "name": "PyRun"},
+        ]
+    )
+    r = _run(_write_trace(tmp_path, events))
+    assert r["process"] == "/device:TPU:0"
+    assert r["busy_s"] == pytest.approx(50e-6)
+    assert r["gap_share"] == pytest.approx(0.5)
+    assert r["by_category"]["matmul"]["count"] == 2
+
+
+def test_empty_trace_fails_structured(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, TOOL, str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert json.loads(proc.stdout)["error"]

@@ -34,6 +34,11 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--grad", action="store_true",
                     help="also time forward+backward")
+    ap.add_argument("--parity", action="store_true",
+                    help="also record COMPILED-MODE parity vs the dense "
+                         "oracle at each shape (fwd + grad max |err|, f32 "
+                         "and bf16) — the on-hardware counterpart of the "
+                         "interpret-mode tests/test_flash.py suite")
     ap.add_argument("--allow-cpu", action="store_true")
     opts = ap.parse_args()
 
@@ -111,6 +116,40 @@ def main() -> None:
                 timed(jax.grad(flash_loss, argnums=(0, 1, 2)), q, k, v,
                       out_to_q=dq_carry), 2
             )
+        if opts.parity:
+            # Non-interpret parity vs the dense oracle, the check the
+            # interpret-mode test suite cannot provide (round-3 verdict
+            # item 2).  Tolerances mirror tests/test_flash.py.
+            def max_err(a, b):
+                return float(jnp.abs(
+                    a.astype(jnp.float32) - b.astype(jnp.float32)
+                ).max())
+
+            def dense_l(q, k, v):
+                return (full_attention(q, k, v).astype(jnp.float32) ** 2).sum()
+
+            def flash_l(q, k, v):
+                return (flash_attention(q, k, v).astype(jnp.float32) ** 2).sum()
+
+            parity = {}
+            for label, dt, tol_f, tol_g in (
+                ("f32", jnp.float32, 1e-4, 1e-3),
+                ("bf16", jnp.bfloat16, 2e-2, 1e-1),
+            ):
+                qd, kd, vd = (a.astype(dt) for a in (q, k, v))
+                fwd_err = max_err(
+                    jax.jit(flash_attention)(qd, kd, vd),
+                    jax.jit(full_attention)(qd, kd, vd),
+                )
+                gf = jax.jit(jax.grad(flash_l, argnums=(0, 1, 2)))(qd, kd, vd)
+                gd = jax.jit(jax.grad(dense_l, argnums=(0, 1, 2)))(qd, kd, vd)
+                grad_err = max(max_err(a, b) for a, b in zip(gf, gd))
+                parity[label] = {
+                    "fwd_max_err": fwd_err,
+                    "grad_max_err": grad_err,
+                    "ok": bool(fwd_err < tol_f and grad_err < tol_g),
+                }
+            row["parity"] = parity
         rows.append(row)
 
     # Ring-kernel smoke: flash_block_update under a VMA-tracking

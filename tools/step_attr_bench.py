@@ -1,0 +1,230 @@
+"""Decompose the fused training step's ~0.8 ms/step on real hardware.
+
+Round-3 verdict item 1: the headline warm steady state (`run_s` ~5.2 s
+for 6000 steps + eval) sits ~10x above compute-bound and nothing in the
+repo says where the time goes.  tools/trace_attr.py answers that from a
+profiler trace; this tool answers it by CONSTRUCTION — it times a ladder
+of step variants, each a warm jitted ``lax.scan`` over one epoch's worth
+of steps (300 at the protocol batch 200), so consecutive rungs isolate
+one ingredient:
+
+    empty_scan     scan + int carry only            -> loop overhead
+    gather_norm    + batch gather & normalize        -> input cost
+    gather_epoch   one pre-permuted epoch gather +   -> the candidate
+                   contiguous slices                    input optimization
+    fwd            + forward & loss (fixed batch)    -> forward compute
+    fwd_bwd        + value_and_grad                  -> backward compute
+    full_nodrop    + pmean + Adadelta, dropout off   -> optimizer cost
+    full           the real step (dropout on)        -> dropout/RNG cost
+    full_nogather  full minus gather (fixed batch)   -> cross-check
+    full_pregather full with the epoch-pregather     -> end-to-end win
+                   input path                           estimate
+
+Differences between adjacent rungs attribute the per-step budget; the
+`full` rung should reproduce bench.py's measured per-step time (run_s /
+steps) — if it doesn't, the gap is OUTSIDE the step program (per-epoch
+eval, epoch-boundary overhead, D2H of the loss traces).
+
+Prints ONE JSON line; run by tools/tunnel_watch.sh in tunnel windows.
+Usage: python tools/step_attr_bench.py [--steps N] [--batch N] [--reps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch", type=int, default=200)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--allow-cpu", action="store_true")
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_default_prng_impl", "rbg")  # the bench's RNG
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    backend = jax.default_backend()
+    if backend == "cpu" and not args.allow_cpu:
+        print(json.dumps({
+            "metric": "step_attr_us", "error": "cpu backend (no TPU)",
+        }))
+        return 1
+
+    from pytorch_mnist_ddp_tpu.models.net import Net, init_params
+    from pytorch_mnist_ddp_tpu.ops.adadelta import adadelta_init, adadelta_update
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+    from pytorch_mnist_ddp_tpu.parallel.fused import _normalize_dev
+    from pytorch_mnist_ddp_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    model = Net()
+    params = init_params(jax.random.PRNGKey(0))
+    opt = adadelta_init(params)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randint(0, 256, (60000, 28, 28), dtype=np.uint8))
+    labels = jnp.asarray(rng.randint(0, 10, 60000).astype(np.int32))
+    perm = jnp.asarray(rng.permutation(60000)[: args.steps * args.batch]
+                       .reshape(args.steps, args.batch))
+    fixed_x = _normalize_dev(images[: args.batch], jnp.float32)
+    fixed_y = labels[: args.batch]
+    w = jnp.ones((args.batch,), jnp.float32)
+    key = jax.random.PRNGKey(1)
+    lr = jnp.float32(1.0)
+
+    # Each variant: scan body over `steps` iterations.  The carry always
+    # includes a live f32 accumulator folded from the body's result so no
+    # rung is dead-code-eliminated.
+
+    def loss_of(params, x, y, dropout_key=None):
+        if dropout_key is None:
+            logp = model.apply({"params": params}, x, train=False)
+        else:
+            logp = model.apply({"params": params}, x, train=True,
+                               rngs={"dropout": dropout_key})
+        return nll_loss(logp, y, w, reduction="mean")
+
+    def make_empty():
+        def body(carry, i):
+            return carry + 1, ()
+        return lambda: jax.lax.scan(body, jnp.int32(0),
+                                    jnp.arange(args.steps))[0]
+
+    def make_gather_norm():
+        def body(carry, idx):
+            x = _normalize_dev(jnp.take(images, idx, axis=0), jnp.float32)
+            y = jnp.take(labels, idx, axis=0)
+            return carry + x.sum() + y.sum(), ()
+        return lambda: jax.lax.scan(body, jnp.float32(0.0), perm)[0]
+
+    def make_gather_epoch():
+        # The candidate optimization: ONE permuted gather of the whole
+        # epoch up front, then contiguous dynamic slices per step —
+        # trades 300 random-row gathers for 1 big gather + cheap slices.
+        # Identical samples in identical order (bit-identical batches).
+        flat_perm = perm.reshape(-1)
+
+        def run():
+            ep_x = jnp.take(images, flat_perm, axis=0)
+            ep_y = jnp.take(labels, flat_perm, axis=0)
+
+            def body(carry, i):
+                x = _normalize_dev(jax.lax.dynamic_slice_in_dim(
+                    ep_x, i * args.batch, args.batch), jnp.float32)
+                y = jax.lax.dynamic_slice_in_dim(ep_y, i * args.batch,
+                                                 args.batch)
+                return carry + x.sum() + y.sum(), ()
+
+            return jax.lax.scan(body, jnp.float32(0.0),
+                                jnp.arange(args.steps))[0]
+        return run
+
+    def make_fwd():
+        def body(carry, i):
+            # carry-dependent input: a loop-INVARIANT body would be
+            # hoisted out of the scan and time ~0 (observed on CPU).
+            x = fixed_x + carry * jnp.float32(1e-30)
+            return carry + loss_of(params, x, fixed_y), ()
+        return lambda: jax.lax.scan(body, jnp.float32(0.0),
+                                    jnp.arange(args.steps))[0]
+
+    def make_fwd_bwd():
+        def body(carry, i):
+            x = fixed_x + carry * jnp.float32(1e-30)  # see make_fwd
+            loss, grads = jax.value_and_grad(loss_of)(params, x, fixed_y)
+            acc = carry + loss + jax.tree.leaves(grads)[0].sum()
+            return acc, ()
+        return lambda: jax.lax.scan(body, jnp.float32(0.0),
+                                    jnp.arange(args.steps))[0]
+
+    def make_full(dropout: bool, gather: str):
+        """gather: 'step' (the shipped per-step take), 'none' (fixed
+        batch), or 'epoch' (the pre-gathered-epoch candidate)."""
+        def body_of(ep_x, ep_y):
+            def body(carry, inp):
+                p, o, acc, step = carry
+                if gather == "step":
+                    x = _normalize_dev(jnp.take(images, inp, axis=0),
+                                       jnp.float32)
+                    y = jnp.take(labels, inp, axis=0)
+                elif gather == "epoch":
+                    x = _normalize_dev(jax.lax.dynamic_slice_in_dim(
+                        ep_x, inp * args.batch, args.batch), jnp.float32)
+                    y = jax.lax.dynamic_slice_in_dim(ep_y, inp * args.batch,
+                                                     args.batch)
+                else:
+                    x, y = fixed_x, fixed_y
+                dk = jax.random.fold_in(key, step) if dropout else None
+                loss, grads = jax.value_and_grad(loss_of)(p, x, y, dk)
+                # Single-device mesh: the data-axis pmean of the real step
+                # is the identity here; it stays out so this tool needs no
+                # mesh.
+                p2, o2 = adadelta_update(p, grads, o, lr, 0.9, 1e-6)
+                return (p2, o2, acc + loss, step + 1), ()
+            return body
+
+        xs = perm if gather == "step" else jnp.arange(args.steps)
+
+        def run():
+            if gather == "epoch":
+                flat = perm.reshape(-1)
+                ep_x = jnp.take(images, flat, axis=0)
+                ep_y = jnp.take(labels, flat, axis=0)
+            else:
+                ep_x = ep_y = None
+            (p2, o2, acc, _), _ = jax.lax.scan(
+                body_of(ep_x, ep_y),
+                (params, opt, jnp.float32(0.0), jnp.int32(0)), xs
+            )
+            return acc
+        return run
+
+    variants = {
+        "empty_scan": make_empty(),
+        "gather_norm": make_gather_norm(),
+        "gather_epoch": make_gather_epoch(),
+        "fwd": make_fwd(),
+        "fwd_bwd": make_fwd_bwd(),
+        "full_nodrop": make_full(dropout=False, gather="step"),
+        "full": make_full(dropout=True, gather="step"),
+        "full_nogather": make_full(dropout=True, gather="none"),
+        "full_pregather": make_full(dropout=True, gather="epoch"),
+    }
+
+    result = {
+        "metric": "step_attr_us",
+        "backend": backend,
+        "device_kind": jax.devices()[0].device_kind,
+        "steps": args.steps,
+        "batch": args.batch,
+    }
+    for name, fn in variants.items():
+        jitted = jax.jit(fn)
+        try:
+            jax.block_until_ready(jitted())  # compile (or cache load)
+            best = float("inf")
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(jitted())
+                best = min(best, time.perf_counter() - t0)
+            result[name] = round(best / args.steps * 1e6, 2)  # us/step
+        except Exception as e:  # tunnel drop mid-ladder: keep partials
+            result[name] = None
+            result.setdefault("errors", {})[name] = repr(e)[:200]
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,211 @@
+"""Distill an XProf/JAX profiler trace into a per-op attribution JSON.
+
+Round-3 verdict item 1: the headline benchmark's warm steady state sits
+~10x above compute-bound (MFU ~9%) and the captured trace was lost to a
+machine reset before anyone read it.  This tool turns a
+``jax.profiler.trace`` output directory into a SMALL committed artifact:
+total device-busy time, the idle-gap share, and a per-category / per-op
+breakdown — enough to decide where the ~0.8 ms/step goes without keeping
+the multi-MB trace alive.
+
+Works on the Chrome-trace JSON (``*.trace.json.gz``) that every backend
+emits (the .xplane.pb needs tensorboard's profile plugin, not installed
+here).  Device selection is heuristic but resilient:
+
+* prefer events whose args carry ``hlo_op``/``hlo_module`` (the XLA
+  executor lines; on CPU that is the PjRt client thread, on TPU the
+  TensorCore "XLA Ops" lines),
+* attribute time per THREAD and report the busiest op timeline, so
+  overlapping host threads can't double-count device time,
+* categorize ops by HLO-name heuristics (convolution / dot / rng / copy /
+  collective / gather-scatter / reduce / other-fusion / infeed).
+
+Usage:
+    python tools/trace_attr.py TRACE_DIR [--out attr.json] [--top N]
+
+Prints the JSON to stdout (and writes --out if given).  Exit 1 with an
+error JSON if no trace file or no op events are found.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+from collections import defaultdict
+
+# Category heuristics over HLO op / fusion names, first match wins.  A
+# fusion is named after its root, so "loop_convolution_fusion" lands in
+# convolution — the MXU/VPU split stays honest.
+_CATEGORIES = (
+    ("convolution", re.compile(r"conv")),
+    ("matmul", re.compile(r"\bdot|dot_general|matmul|gemm|einsum")),
+    ("rng", re.compile(r"rng|threefry|philox|erf_inv|random")),
+    ("collective", re.compile(
+        r"all-reduce|all_reduce|all-gather|all_gather|reduce-scatter"
+        r"|reduce_scatter|collective|permute|all-to-all|all_to_all")),
+    ("gather_scatter", re.compile(r"gather|scatter|dynamic-slice|dynamic_slice"
+                                  r"|dynamic-update|dynamic_update")),
+    ("copy_layout", re.compile(r"copy|transpose|bitcast|reshape|broadcast"
+                               r"|convert|slice|concatenate|pad")),
+    ("reduce", re.compile(r"reduce|argmax|argmin|sort|top-k|topk")),
+    ("infeed_outfeed", re.compile(r"infeed|outfeed|send|recv|transfer")),
+    ("elementwise_fusion", re.compile(r"fusion|add|multiply|subtract|divide"
+                                      r"|maximum|minimum|exp|log|tanh|select"
+                                      r"|compare|map")),
+)
+
+
+def _categorize(name: str) -> str:
+    low = name.lower()
+    for cat, pat in _CATEGORIES:
+        if pat.search(low):
+            return cat
+    return "other"
+
+
+def _load_trace(trace_dir: str) -> dict:
+    if os.path.isfile(trace_dir):
+        candidates = [trace_dir]
+    else:
+        candidates = sorted(
+            glob.glob(os.path.join(
+                trace_dir, "plugins", "profile", "*", "*.trace.json.gz"))
+            + glob.glob(os.path.join(trace_dir, "*.trace.json.gz"))
+        )
+    if not candidates:
+        raise FileNotFoundError(f"no *.trace.json.gz under {trace_dir}")
+    path = candidates[-1]  # latest capture wins
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        return json.load(f)
+
+
+def attribute(trace_dir: str, top: int = 25) -> dict:
+    data = _load_trace(trace_dir)
+    events = data.get("traceEvents", [])
+    proc_names: dict[int, str] = {}
+    thread_names: dict[tuple[int, int], str] = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            proc_names[e["pid"]] = e["args"]["name"]
+        elif e.get("name") == "thread_name":
+            thread_names[(e["pid"], e.get("tid"))] = e["args"]["name"]
+
+    # Pass 1: collect op events — complete events whose args identify an
+    # HLO op, or that live on an "XLA Ops"-style line (TPU traces name the
+    # TensorCore op lines, not the args).
+    raw: dict[tuple[int, int], list] = defaultdict(list)
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        key = (e.get("pid"), e.get("tid"))
+        tname = thread_names.get(key, "")
+        is_op = "hlo_op" in args or "hlo_module" in args or \
+            re.search(r"XLA Ops|TensorCore|Steps", tname)
+        if not is_op:
+            continue
+        op = args.get("hlo_op", e.get("name", "?"))
+        raw[key].append(
+            (float(e.get("ts", 0.0)), float(e.get("dur", 0.0)), op))
+    if not raw:
+        raise ValueError("no HLO op events found in trace")
+
+    # Pass 2: per-thread SELF-time attribution.  Chrome X events on one
+    # thread can nest (a `while` wrapping its body ops); naive summing
+    # double-counts the wrapper.  A stack walk charges each op only the
+    # time not covered by its children — on a flat device line this
+    # degrades to self == dur.
+    per_thread: dict[tuple[int, int], dict] = {}
+    for key, evs in raw.items():
+        evs.sort(key=lambda t: (t[0], -t[1]))
+        rec = {"busy": 0.0, "n": len(evs), "t0": evs[0][0], "t1": 0.0,
+               "ops": defaultdict(lambda: [0.0, 0])}
+        stack: list[list] = []  # [end_ts, op, child_time_us, start_ts]
+        def _pop(entry):
+            end, op, child, start = entry
+            self_us = max(end - start - child, 0.0)
+            rec["busy"] += self_us
+            slot = rec["ops"][op]
+            slot[0] += self_us
+            slot[1] += 1
+            if stack:
+                stack[-1][2] += end - start
+        for ts, dur, op in evs:
+            while stack and stack[-1][0] <= ts:
+                _pop(stack.pop())
+            stack.append([ts + dur, op, 0.0, ts])
+            rec["t1"] = max(rec["t1"], ts + dur)
+        while stack:
+            _pop(stack.pop())
+        per_thread[key] = rec
+
+    # The busiest op line IS the device timeline (XLA executes one op at a
+    # time per core); other qualifying lines are reported but not summed.
+    main_key = max(per_thread, key=lambda k: per_thread[k]["busy"])
+    main = per_thread[main_key]
+    span_us = main["t1"] - main["t0"]
+    busy_us = main["busy"]
+
+    by_cat: dict[str, list[float]] = defaultdict(lambda: [0.0, 0])
+    for op, (dur, n) in main["ops"].items():
+        c = by_cat[_categorize(op)]
+        c[0] += dur
+        c[1] += n
+    top_ops = sorted(main["ops"].items(), key=lambda kv: -kv[1][0])[:top]
+
+    return {
+        "metric": "trace_attribution",
+        "process": proc_names.get(main_key[0], "?"),
+        "thread": thread_names.get(main_key, "?"),
+        "op_events": main["n"],
+        "span_s": round(span_us / 1e6, 6),
+        "busy_s": round(busy_us / 1e6, 6),
+        "gap_share": round(1.0 - busy_us / span_us, 3) if span_us else None,
+        "by_category": {
+            cat: {"time_s": round(d / 1e6, 9), "count": n,
+                  "share_of_busy": round(d / busy_us, 3) if busy_us else None}
+            for cat, (d, n) in sorted(by_cat.items(), key=lambda kv: -kv[1][0])
+        },
+        "top_ops": [
+            {"op": op, "time_s": round(d / 1e6, 9), "count": n,
+             "share_of_busy": round(d / busy_us, 3) if busy_us else None}
+            for op, (d, n) in top_ops
+        ],
+        "other_op_lines": {
+            f"{proc_names.get(k[0], '?')}:{thread_names.get(k, '?')}":
+                round(v["busy"] / 1e6, 6)
+            for k, v in per_thread.items() if k != main_key
+        },
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("trace_dir")
+    p.add_argument("--out", default=None)
+    p.add_argument("--top", type=int, default=25)
+    args = p.parse_args()
+    try:
+        result = attribute(args.trace_dir, args.top)
+    except (OSError, ValueError, KeyError) as e:
+        result = {"metric": "trace_attribution", "error": repr(e)}
+        print(json.dumps(result))
+        return 1
+    out = json.dumps(result, indent=1)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
