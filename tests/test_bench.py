@@ -90,8 +90,12 @@ def test_tunnel_watch_script_stays_valid():
     known = declared_flags(bench_mod.__file__)
     known |= declared_flags(os.path.join(repo, "mnist.py"))
     known |= declared_flags(os.path.join(repo, "mnist_ddp.py"))
-    for tool in ("flash_bench.py", "pallas_opt_bench.py", "vit_bench.py"):
+    for tool in ("flash_bench.py", "pallas_opt_bench.py", "vit_bench.py",
+                 "trace_attr.py", "step_attr_bench.py", "fetch_mnist.py"):
         known |= declared_flags(os.path.join(repo, "tools", tool))
+    # The artifact-durability commits (r4 watcher) use git's own flags;
+    # they are not CLI-surface flags of this repo.
+    known |= {"--cached", "--quiet"}
     missing = flags - known
     assert not missing, f"watcher passes unknown CLI flags: {missing}"
 
@@ -195,3 +199,32 @@ def test_vit_bench_tool_cpu_smoke():
     assert row["dataset"] == "synthetic"
     assert row["n_chips"] == 1
     assert row["global_batch"] == 500
+
+
+@pytest.mark.slow  # 8-virtual-device fused subprocess run (~2-4 min)
+def test_bench_multichip_path_cpu_smoke():
+    """bench.py's multi-chip branch (len(devices) > 1 -> a world-sized
+    DistState, per-chip throughput divided by n_chips) has only ever run
+    implicitly (round-3 verdict item 7): pin it on the 8-virtual-device
+    CPU mesh so a future real multi-chip window needs zero new code."""
+    import subprocess
+
+    from conftest import cpu_subprocess_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = cpu_subprocess_env(force_single_device=False)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--quick",
+         "--allow-cpu", "--train-limit", "192", "--probe-attempts", "1",
+         "--run-timeout", "420"],
+        capture_output=True, text=True, cwd=repo, timeout=540, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip())
+    assert out["n_chips"] == 8
+    assert out["value"] > 0 and out["train_limit"] == 192
+    # Throughput fields are per chip: consistent with the 8-way division.
+    if "images_per_sec_per_chip_run" in out:
+        total = out["images_per_sec_per_chip_run"] * 8
+        assert total > 0

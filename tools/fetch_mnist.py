@@ -1,0 +1,76 @@
+"""Attempt to fetch the real MNIST IDX files; log the outcome durably.
+
+The reference trains on the actual IDX files (reference mnist_ddp.py:153-160)
+and its README speed table is real-MNIST wall clock.  This host is normally
+air-gapped, so `data/` stays empty and every recorded run says
+``dataset: "synthetic"`` — but network conditions MAY differ while the
+accelerator tunnel is up (round-3 verdict, next-round item 3).  The watcher
+therefore runs this tool at the top of every tunnel window; each attempt's
+outcome is appended to ``data/idx_attempts.log`` (committed), so either the
+files eventually land (and bench.py records an ``dataset: "idx"`` row) or
+the log proves the attempts were made.
+
+Usage: python tools/fetch_mnist.py [--root DIR]
+Exit 0 if all four files are present afterwards, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pytorch_mnist_ddp_tpu.data.mnist import (  # noqa: E402
+    _FILES,
+    _MIRRORS,
+    _read_maybe_gz,
+    _try_download,
+)
+
+LOG_PATH = os.path.join(REPO, "data", "idx_attempts.log")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--root", default=os.environ.get(
+        "MNIST_DATA_DIR", os.path.join(REPO, "data")))
+    args = p.parse_args()
+
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    # Log the attempt BEFORE downloading: hanging connections can run to
+    # ~160 s total and an outer timeout may SIGTERM this process — the
+    # begin line proves the attempt even then (round-4 review finding).
+    os.makedirs(os.path.dirname(LOG_PATH), exist_ok=True)
+    with open(LOG_PATH, "a") as f:
+        f.write(f"{stamp} root={args.root} begin\n")
+    present, fetched, failed = [], [], []
+    for key, filename in _FILES.items():
+        path = os.path.join(args.root, filename)
+        if _read_maybe_gz(path) is not None:
+            present.append(filename)
+            continue
+        if _try_download(args.root, filename) is not None:
+            fetched.append(filename)
+        else:
+            failed.append(filename)
+
+    ok = not failed
+    line = (
+        f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} "
+        f"root={args.root} present={len(present)} "
+        f"fetched={len(fetched)} failed={len(failed)} "
+        f"mirrors={','.join(_MIRRORS)} "
+        f"outcome={'complete' if ok else 'failed:' + ','.join(failed)}"
+    )
+    with open(LOG_PATH, "a") as f:
+        f.write(line + "\n")
+    print(line)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
