@@ -197,6 +197,13 @@ def main() -> None:
                    help="benchmark the fused Pallas optimizer kernel path "
                         "(recorded in the JSON; not the headline until it "
                         "measures faster)")
+    p.add_argument("--pregather", action="store_true",
+                   help="benchmark the pre-permuted-epoch input path "
+                        "(parallel/fused.py pregather: one big gather per "
+                        "epoch + contiguous per-step slices instead of "
+                        "per-step row gathers; bit-identical batches — "
+                        "recorded in the JSON, not the headline until it "
+                        "measures faster)")
     p.add_argument("--zero", action="store_true",
                    help="benchmark the ZeRO-1 sharded-optimizer DP path "
                         "(parallel/zero.py; per-batch loop — the sharded "
@@ -272,6 +279,7 @@ def main() -> None:
         bf16=args.bf16,
         syncbn=args.syncbn,
         pallas_opt=args.pallas_opt,
+        pregather=args.pregather,
         zero=args.zero,
         train_limit=args.train_limit,
         data_root="./data",
@@ -330,6 +338,7 @@ def main() -> None:
         "cache": cache_state,
         "syncbn": bool(args.syncbn),
         "pallas_opt": bool(args.pallas_opt),
+        "pregather": bool(args.pregather),
         "zero": bool(args.zero),
         "train_limit": args.train_limit or None,
         # "idx" (real MNIST files) or "synthetic" (air-gapped fallback):
@@ -389,6 +398,7 @@ def main() -> None:
         and not args.bf16
         and not args.syncbn
         and not args.pallas_opt
+        and not args.pregather
         and not args.zero
         and not args.train_limit
         and args.epochs == PROTOCOL["epochs"]

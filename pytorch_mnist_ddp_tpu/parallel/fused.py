@@ -67,6 +67,7 @@ def _epoch_scan_builder(
     n_shards: int,
     compute_dtype,
     step_fn,
+    pregather: bool = False,
 ):
     """The family-agnostic fused-epoch skeleton: epoch-seeded permutation
     with wrap-fill masking, per-shard batch slicing + on-device normalize,
@@ -74,7 +75,16 @@ def _epoch_scan_builder(
     dropout_key, lr) -> (state, loss)`` is the family-specific body
     (forward + grads + update); fused_vit.py injects the ViT's.  Shared so
     the sampling/masking semantics cannot diverge between families.
-    Returns ``(local_epoch, num_batches)``."""
+    Returns ``(local_epoch, num_batches)``.
+
+    ``pregather``: materialize the whole permuted epoch ONCE up front
+    (one big gather, +47 MB transient uint8 HBM at MNIST scale) and slice
+    each step's batch contiguously, instead of gathering 200 random rows
+    per step.  Identical rows in identical order — bit-identical batches
+    and losses (tests/test_fused.py pins it) — only the input-path HLO
+    differs.  Off by default until the hardware step-attribution ladder
+    (tools/step_attr_bench.py) shows which input path wins; measured by
+    ``bench.py --pregather``."""
     if global_batch % n_shards:
         raise ValueError(f"global batch {global_batch} not divisible by mesh")
     shard_batch = global_batch // n_shards
@@ -93,26 +103,47 @@ def _epoch_scan_builder(
         valid = (positions < dataset_size).astype(jnp.float32)
         shard = jax.lax.axis_index(DATA_AXIS)
 
-        def one_step(state, batch):
-            step_perm, step_valid = batch  # [global_batch] each
-            idx = jax.lax.dynamic_slice_in_dim(
-                step_perm, shard * shard_batch, shard_batch
-            )
-            w = jax.lax.dynamic_slice_in_dim(
-                step_valid, shard * shard_batch, shard_batch
-            )
-            x = _normalize_dev(jnp.take(images, idx, axis=0), compute_dtype)
-            y = jnp.take(labels, idx, axis=0)
-            return step_fn(state, x, y, w, shard, dropout_key, lr)
+        if pregather:
+            ep_x = jnp.take(images, perm, axis=0)
+            ep_y = jnp.take(labels, perm, axis=0)
 
-        state, losses = jax.lax.scan(
-            one_step,
-            state,
-            (
+            def one_step(state, batch):
+                step, step_valid = batch
+                start = step * global_batch + shard * shard_batch
+                w = jax.lax.dynamic_slice_in_dim(
+                    step_valid, shard * shard_batch, shard_batch
+                )
+                x = _normalize_dev(
+                    jax.lax.dynamic_slice_in_dim(ep_x, start, shard_batch),
+                    compute_dtype,
+                )
+                y = jax.lax.dynamic_slice_in_dim(ep_y, start, shard_batch)
+                return step_fn(state, x, y, w, shard, dropout_key, lr)
+
+            xs = (
+                jnp.arange(num_batches),
+                valid.reshape(num_batches, global_batch),
+            )
+        else:
+
+            def one_step(state, batch):
+                step_perm, step_valid = batch  # [global_batch] each
+                idx = jax.lax.dynamic_slice_in_dim(
+                    step_perm, shard * shard_batch, shard_batch
+                )
+                w = jax.lax.dynamic_slice_in_dim(
+                    step_valid, shard * shard_batch, shard_batch
+                )
+                x = _normalize_dev(jnp.take(images, idx, axis=0), compute_dtype)
+                y = jnp.take(labels, idx, axis=0)
+                return step_fn(state, x, y, w, shard, dropout_key, lr)
+
+            xs = (
                 perm.reshape(num_batches, global_batch),
                 valid.reshape(num_batches, global_batch),
-            ),
-        )
+            )
+
+        state, losses = jax.lax.scan(one_step, state, xs)
         return state, losses
 
     return local_epoch, num_batches
@@ -129,6 +160,7 @@ def _local_epoch_builder(
     dropout: bool,
     use_pallas: bool | None,
     use_bn: bool = False,
+    pregather: bool = False,
 ):
     """The CNN family's fused-epoch body on the shared skeleton: returns
     ``local_epoch(state, images, labels, epoch, shuffle_key, dropout_key,
@@ -170,7 +202,8 @@ def _local_epoch_builder(
         return TrainState(params, opt, state.step + 1, new_stats), loss
 
     return _epoch_scan_builder(
-        dataset_size, global_batch, n_shards, compute_dtype, step_fn
+        dataset_size, global_batch, n_shards, compute_dtype, step_fn,
+        pregather=pregather,
     )
 
 
@@ -322,6 +355,7 @@ def make_fused_run(
     from_key: bool = False,
     use_bn: bool = False,
     start_epoch: int = 1,
+    pregather: bool = False,
 ):
     """Whole-run fusion: EVERY epoch's training scan plus its full-test-set
     eval as ONE jitted device call.
@@ -365,6 +399,7 @@ def make_fused_run(
     local_epoch, num_batches = _local_epoch_builder(
         model, train_size, global_batch, n_shards,
         compute_dtype, rho, eps, dropout, use_pallas, use_bn=use_bn,
+        pregather=pregather,
     )
     local_eval = _local_eval_builder(
         model, test_size, eval_batch, n_shards, compute_dtype, use_bn=use_bn

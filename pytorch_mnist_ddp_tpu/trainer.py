@@ -411,6 +411,7 @@ def _fit_body(
             args.epochs, compute_dtype=compute_dtype, use_pallas=use_pallas,
             from_key=resume_path is None and loaded_state is None,
             use_bn=syncbn, start_epoch=epoch0 + 1,
+            pregather=getattr(args, "pregather", False),
         )
         if loaded_state is not None:
             lead = replicate_params(loaded_state, mesh)

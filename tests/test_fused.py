@@ -285,3 +285,39 @@ def test_fused_run_with_rbg_keys_matches_per_epoch(devices):
         np.testing.assert_allclose(
             np.asarray(evals1[epoch - 1]), np.asarray(totals), rtol=1e-5
         )
+
+
+@pytest.mark.slow  # compile-heavy; full tier only (pytest.ini)
+def test_fused_run_pregather_is_bit_identical(devices):
+    """The pre-permuted-epoch input path (pregather=True: one big gather
+    per epoch + contiguous slices) must be BIT-identical to the shipped
+    per-step-gather path — same rows in the same order, so every loss,
+    eval total, and final parameter matches exactly.  Non-divisible
+    dataset so the wrap-filler masking rides the new path too."""
+    mesh = make_mesh()
+    tr_images, tr_labels = _dataset(90, seed=31)  # 90 % 32 != 0: wrap path
+    te_images, te_labels = _dataset(40, seed=32)
+    tx, ty = device_put_dataset(tr_images, tr_labels, mesh)
+    ex, ey = device_put_dataset(te_images, te_labels, mesh)
+    epochs, gb, eb = 2, 32, 16
+    init_key = jax.random.PRNGKey(0)
+    shuffle_key, dropout_key = jax.random.PRNGKey(5), jax.random.PRNGKey(6)
+    lrs = jnp.asarray([1.0, 0.7], jnp.float32)
+
+    run_a, nb_a = make_fused_run(mesh, 90, 40, gb, eb, epochs, from_key=True)
+    sa, losses_a, evals_a = run_a(
+        init_key, tx, ty, ex, ey, shuffle_key, dropout_key, lrs
+    )
+
+    run_b, nb_b = make_fused_run(
+        mesh, 90, 40, gb, eb, epochs, from_key=True, pregather=True
+    )
+    sb, losses_b, evals_b = run_b(
+        init_key, tx, ty, ex, ey, shuffle_key, dropout_key, lrs
+    )
+
+    assert nb_a == nb_b
+    np.testing.assert_array_equal(np.asarray(losses_a), np.asarray(losses_b))
+    np.testing.assert_array_equal(np.asarray(evals_a), np.asarray(evals_b))
+    for a, b in zip(jax.tree.leaves(sa.params), jax.tree.leaves(sb.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -155,6 +155,9 @@ while true; do
         # --- 5: variant rows (each min-by-value) ------------------------
         run_bench bf16_run --bf16 && echo "[$(stamp)] bf16: $(promote bf16_run bf16)"
         run_bench pallas_run --pallas-opt && echo "[$(stamp)] pallas: $(promote pallas_run pallas)"
+        # The pre-permuted-epoch input path (bit-identical batches, HLO
+        # differs): decision row for flipping the headline's input path.
+        run_bench pregather_run --pregather && echo "[$(stamp)] pregather: $(promote pregather_run pregather)"
         run_bench syncbn_run --syncbn && echo "[$(stamp)] syncbn: $(promote syncbn_run syncbn)"
         # ZeRO-1 per-batch dispatch through the tunnel is ~120 ms/step:
         # only the 2-epoch --quick protocol fits a short window.
