@@ -201,30 +201,34 @@ def test_vit_bench_tool_cpu_smoke():
     assert row["global_batch"] == 500
 
 
-@pytest.mark.slow  # 8-virtual-device fused subprocess run (~2-4 min)
+@pytest.mark.slow  # multi-virtual-device fused subprocess run (~2-8 min)
 def test_bench_multichip_path_cpu_smoke():
     """bench.py's multi-chip branch (len(devices) > 1 -> a world-sized
     DistState, per-chip throughput divided by n_chips) has only ever run
-    implicitly (round-3 verdict item 7): pin it on the 8-virtual-device
-    CPU mesh so a future real multi-chip window needs zero new code."""
+    implicitly (round-3 verdict item 7): pin it on a 2-virtual-device
+    CPU mesh so a future real multi-chip window needs zero new code.
+
+    2 devices, not 8: the branch under test is identical for any N > 1,
+    and XLA:CPU executes the sharded conv-in-scan program so slowly that
+    8 interleaved shards exceed any sane test budget (measured: 2
+    devices ~2.3 min idle, 8 devices > 15 min)."""
     import subprocess
 
     from conftest import cpu_subprocess_env
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = cpu_subprocess_env(force_single_device=False)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "bench.py"), "--quick",
          "--allow-cpu", "--train-limit", "192", "--probe-attempts", "1",
-         "--run-timeout", "420"],
-        capture_output=True, text=True, cwd=repo, timeout=540, env=env,
+         "--run-timeout", "780"],
+        capture_output=True, text=True, cwd=repo, timeout=900, env=env,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     out = json.loads(proc.stdout.strip())
-    assert out["n_chips"] == 8
+    assert out["n_chips"] == 2
     assert out["value"] > 0 and out["train_limit"] == 192
-    # Throughput fields are per chip: consistent with the 8-way division.
+    # Throughput fields are per chip: consistent with the N-way division.
     if "images_per_sec_per_chip_run" in out:
-        total = out["images_per_sec_per_chip_run"] * 8
-        assert total > 0
+        assert out["images_per_sec_per_chip_run"] > 0
