@@ -145,3 +145,38 @@ def test_loader_abandoned_epoch_reaps_prefetch_thread():
     while threading.active_count() > before and time.time() < deadline:
         time.sleep(0.01)
     assert threading.active_count() <= before
+
+
+def test_fetch_mnist_logs_attempt_durably(tmp_path, monkeypatch):
+    """tools/fetch_mnist.py (the watcher's per-window IDX attempt): the
+    begin line lands BEFORE any network I/O so a SIGTERM mid-download
+    cannot erase the attempt evidence, and the outcome line names the
+    failed files on this air-gapped box."""
+    import importlib.util
+    import os as _os
+    import sys as _sys
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "fetch_mnist", _os.path.join(repo, "tools", "fetch_mnist.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    log_path = tmp_path / "idx_attempts.log"
+    monkeypatch.setattr(mod, "LOG_PATH", str(log_path))
+    # No network on this box, but pin it anyway: downloads must fail
+    # fast and deterministically.
+    monkeypatch.setattr(
+        mod, "_try_download", lambda root, filename: None
+    )
+    monkeypatch.setattr(
+        _sys, "argv", ["fetch_mnist.py", "--root", str(tmp_path / "data")]
+    )
+    rc = mod.main()
+    assert rc == 1  # nothing fetched
+    lines = log_path.read_text().splitlines()
+    assert len(lines) == 2
+    assert lines[0].endswith("begin")
+    assert "failed=4" in lines[1] and "outcome=failed:" in lines[1]
+    assert "train-images-idx3-ubyte" in lines[1]
