@@ -55,6 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run the whole multi-epoch training as one device "
                         "call over an HBM-resident dataset (fastest; same "
                         "printed output, emitted after the run completes)")
+    p.add_argument("--pregather", action="store_true", default=False,
+                   help="(--fused only) pre-permuted-epoch input path: one "
+                        "big gather per epoch + contiguous per-step slices "
+                        "(parallel/fused.py pregather; bit-identical "
+                        "batches, different input HLO)")
     p.add_argument("--pallas-opt", action="store_true", default=False,
                    help="use the fused Pallas Adadelta kernel for the "
                         "optimizer update (ops/pallas_adadelta.py)")

@@ -44,6 +44,7 @@ def make_fused_vit_run(
     rho: float = 0.9,
     eps: float = 1e-6,
     start_epoch: int = 1,
+    pregather: bool = False,
 ):
     """Build the whole-run fusion for the ViT.
 
@@ -68,7 +69,8 @@ def make_fused_vit_run(
         return TrainState(params, opt, state.step + 1), loss
 
     local_epoch, num_batches = _epoch_scan_builder(
-        train_size, global_batch, n_shards, jnp.float32, step_fn
+        train_size, global_batch, n_shards, jnp.float32, step_fn,
+        pregather=pregather,
     )
     local_eval = _eval_scan_builder(
         test_size, eval_batch, n_shards, jnp.float32,

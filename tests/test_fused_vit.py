@@ -144,3 +144,35 @@ def test_fused_vit_masks_partial_batches(devices):
     y = jnp.asarray(te_labels.astype(np.int32))
     assert int(evals[0, 1]) == int((jnp.argmax(logp, axis=1) == y).sum())
     assert 0 <= int(evals[0, 1]) <= 21
+
+
+def test_fused_vit_pregather_is_bit_identical(devices):
+    """The shared skeleton's pregather input path under the ViT body:
+    bit-identical losses/evals/params vs the per-step-gather run (the
+    CNN twin lives in tests/test_fused.py; this pins the pass-through
+    in make_fused_vit_run)."""
+    mesh = make_mesh()
+    images, labels = _dataset(56, seed=7)   # 56 % 32 != 0: wrap path
+    te_images, te_labels = _dataset(24, seed=8)
+    tr = device_put_dataset(images, labels, mesh)
+    te = device_put_dataset(te_images, te_labels, mesh)
+    key = jax.random.PRNGKey(3)
+    lrs = jnp.asarray([1.0, 0.7], jnp.float32)
+
+    outs = []
+    for pre in (False, True):
+        run_fn, _ = make_fused_vit_run(
+            mesh, CFG, 56, 24, global_batch=32, eval_batch=16, epochs=2,
+            pregather=pre,
+        )
+        state = replicate_params(
+            make_train_state(init_vit_params(jax.random.PRNGKey(0), CFG)),
+            mesh,
+        )
+        outs.append(run_fn(state, *tr, *te, key, lrs))
+
+    (sa, la, ea), (sb, lb, eb) = outs
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    np.testing.assert_array_equal(np.asarray(ea), np.asarray(eb))
+    for a, b in zip(jax.tree.leaves(sa.params), jax.tree.leaves(sb.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -25,8 +25,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # (batch, tokens, heads, head_dim): the ViT's own tiny geometry, then
-# long-context shapes where flash is the point.
-SHAPES = [(8, 16, 4, 16), (4, 512, 4, 64), (2, 2048, 4, 64)]
+# long-context shapes where flash is the point (at t=8192 the dense
+# path materializes a 512 MB f32 score tensor; flash keeps O(t)).
+SHAPES = [(8, 16, 4, 16), (4, 512, 4, 64), (2, 2048, 4, 64),
+          (1, 8192, 2, 64)]
 
 
 def main() -> None:

@@ -106,6 +106,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "epoch a device-side scan, ONE jitted call for "
                         "the entire run (parallel/fused_vit.py); "
                         "data-parallel only")
+    p.add_argument("--pregather", action="store_true", default=False,
+                   help="(--fused only) pre-permuted-epoch input path: "
+                        "one big gather per epoch + contiguous per-step "
+                        "slices (parallel/fused.py pregather; "
+                        "bit-identical batches)")
     p.add_argument("--save-model", action="store_true", default=False,
                    help="save the final params to vit_mnist.npz "
                         "(utils.checkpoint.save_params_tree)")
@@ -189,6 +194,8 @@ def main() -> None:
             "--flash composes with every mode except the pipeline engine "
             "and the fused whole-run; drop --pp/--fused"
         )
+    if args.pregather and not args.fused:
+        raise SystemExit("--pregather is the fused input path; add --fused")
 
     import jax
 
@@ -361,7 +368,7 @@ def main() -> None:
         eval_batch = args.test_batch_size * n_shards
         run_fn, num_batches = make_fused_vit_run(
             mesh, cfg, len(tr_x), len(te_x), global_batch, eval_batch,
-            args.epochs, start_epoch=epoch0 + 1,
+            args.epochs, start_epoch=epoch0 + 1, pregather=args.pregather,
         )
         lr_for_epoch = step_lr(args.lr, args.gamma)
         lrs = jnp.asarray(
