@@ -215,6 +215,10 @@ def main() -> None:
                         f"{1 + len(PROBE_BACKOFFS_S)}-attempt schedule, "
                         "~5 min of patience)")
     args = p.parse_args()
+    if args.zero and args.pregather:
+        # --zero runs the per-batch loop (fused=False below): --pregather
+        # would be a silent no-op recorded as true in the JSON row.
+        p.error("--pregather rides the fused run; --zero disables it")
     if args.quick:
         args.epochs = 2
     metric = f"mnist_{args.epochs}epoch_wall_clock"

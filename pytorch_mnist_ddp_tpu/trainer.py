@@ -280,6 +280,14 @@ def _fit_body(
     if zero and bool(getattr(args, "pallas_opt", False)):
         raise ValueError("--zero and --pallas-opt both re-lay-out the "
                          "Adadelta state; pick one")
+    # --pregather (the pre-permuted-epoch input path, parallel/fused.py)
+    # exists only inside the fused whole-run; validated here so every
+    # caller (both CLIs, bench.py) fails loudly instead of silently
+    # running the per-step-gather path while claiming otherwise.
+    if bool(getattr(args, "pregather", False)) and not bool(
+        getattr(args, "fused", False)
+    ):
+        raise ValueError("--pregather is the fused input path; add --fused")
     # Full-state continuation (--save-state / --resume-state): the whole
     # TrainState travels, so the continued run is bit-identical to an
     # uninterrupted one (utils/checkpoint.save_train_state).

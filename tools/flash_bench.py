@@ -42,7 +42,13 @@ def main() -> None:
                          "and bf16) — the on-hardware counterpart of the "
                          "interpret-mode tests/test_flash.py suite")
     ap.add_argument("--allow-cpu", action="store_true")
+    ap.add_argument("--budget-s", type=float, default=480.0,
+                    help="soft time budget: once exceeded, remaining "
+                         "SHAPES are skipped (recorded as skipped rows) "
+                         "so an outer timeout can never discard the "
+                         "already-measured rows with the whole process")
     opts = ap.parse_args()
+    t_start = time.perf_counter()
 
     import jax
     import jax.numpy as jnp
@@ -83,6 +89,10 @@ def main() -> None:
 
     rows = []
     for b, t, h, d in SHAPES:
+        if time.perf_counter() - t_start > opts.budget_s:
+            rows.append({"shape": [b, t, h, d],
+                         "skipped": f"over --budget-s {opts.budget_s}"})
+            continue
         key = jax.random.PRNGKey(0)
         kq, kk, kv = jax.random.split(key, 3)
         shape = (b, t, h, d)

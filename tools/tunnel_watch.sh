@@ -118,7 +118,10 @@ while true; do
         commit_artifacts "headline"
         # --- 2: flash kernel on hardware (verdict item 2) ---------------
         echo "[$(stamp)] flash-attention bench + compiled parity"
-        timeout 540 python "$REPO/tools/flash_bench.py" --grad --parity \
+        # Outer bound > the tool's own --budget-s soft limit (it skips
+        # remaining shapes once over budget and still prints its JSON):
+        # a SIGTERM here would discard ALL rows, the worse failure.
+        timeout 900 python "$REPO/tools/flash_bench.py" --grad --parity --budget-s 700 \
             >"$OUT/bench_r4_flash.json" 2>"$OUT/bench_r4_flash.err" \
             && echo "[$(stamp)] flash: $(head -c 400 "$OUT/bench_r4_flash.json")" \
             || echo "[$(stamp)] flash bench failed rc=$?"
