@@ -44,7 +44,11 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=300)
     p.add_argument("--batch", type=int, default=200)
+    p.add_argument("--eval-batch", type=int, default=1000)
+    p.add_argument("--eval-steps", type=int, default=10)
     p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--bf16", action="store_true",
+                   help="run the ladder at the --bf16 compute dtype")
     p.add_argument("--allow-cpu", action="store_true")
     args = p.parse_args()
 
@@ -69,7 +73,8 @@ def main() -> int:
     from pytorch_mnist_ddp_tpu.utils.compile_cache import enable_persistent_cache
 
     enable_persistent_cache()
-    model = Net()
+    compute_dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    model = Net(compute_dtype=compute_dtype)
     params = init_params(jax.random.PRNGKey(0))
     opt = adadelta_init(params)
     rng = np.random.RandomState(0)
@@ -77,7 +82,7 @@ def main() -> int:
     labels = jnp.asarray(rng.randint(0, 10, 60000).astype(np.int32))
     perm = jnp.asarray(rng.permutation(60000)[: args.steps * args.batch]
                        .reshape(args.steps, args.batch))
-    fixed_x = _normalize_dev(images[: args.batch], jnp.float32)
+    fixed_x = _normalize_dev(images[: args.batch], compute_dtype)
     fixed_y = labels[: args.batch]
     w = jnp.ones((args.batch,), jnp.float32)
     key = jax.random.PRNGKey(1)
@@ -103,7 +108,7 @@ def main() -> int:
 
     def make_gather_norm():
         def body(carry, idx):
-            x = _normalize_dev(jnp.take(images, idx, axis=0), jnp.float32)
+            x = _normalize_dev(jnp.take(images, idx, axis=0), compute_dtype)
             y = jnp.take(labels, idx, axis=0)
             return carry + x.sum() + y.sum(), ()
         return lambda: jax.lax.scan(body, jnp.float32(0.0), perm)[0]
@@ -121,7 +126,7 @@ def main() -> int:
 
             def body(carry, i):
                 x = _normalize_dev(jax.lax.dynamic_slice_in_dim(
-                    ep_x, i * args.batch, args.batch), jnp.float32)
+                    ep_x, i * args.batch, args.batch), compute_dtype)
                 y = jax.lax.dynamic_slice_in_dim(ep_y, i * args.batch,
                                                  args.batch)
                 return carry + x.sum() + y.sum(), ()
@@ -148,6 +153,31 @@ def main() -> int:
         return lambda: jax.lax.scan(body, jnp.float32(0.0),
                                     jnp.arange(args.steps))[0]
 
+    def make_eval():
+        # One epoch's eval: eval-steps batches of eval-batch contiguous
+        # rows, masked-sum loss + correct count — mirrors the fused
+        # local_eval body so run_s can be reconstructed as
+        # steps*full + evals*eval (per epoch).
+        def body(carry, i):
+            loss_sum, correct = carry
+            start = i * args.eval_batch
+            x = _normalize_dev(jax.lax.dynamic_slice_in_dim(
+                images, start, args.eval_batch), compute_dtype)
+            y = jax.lax.dynamic_slice_in_dim(labels, start, args.eval_batch)
+            logp = model.apply({"params": params}, x, train=False)
+            wv = jnp.ones((args.eval_batch,), jnp.float32)
+            loss_sum += nll_loss(logp, y, wv, reduction="sum")
+            correct += ((jnp.argmax(logp, axis=1) == y) * wv).sum()
+            return (loss_sum, correct), ()
+
+        def run():
+            (ls, c), _ = jax.lax.scan(
+                body, (jnp.float32(0.0), jnp.float32(0.0)),
+                jnp.arange(args.eval_steps),
+            )
+            return ls + c
+        return run
+
     def make_full(dropout: bool, gather: str):
         """gather: 'step' (the shipped per-step take), 'none' (fixed
         batch), or 'epoch' (the pre-gathered-epoch candidate)."""
@@ -156,11 +186,11 @@ def main() -> int:
                 p, o, acc, step = carry
                 if gather == "step":
                     x = _normalize_dev(jnp.take(images, inp, axis=0),
-                                       jnp.float32)
+                                       compute_dtype)
                     y = jnp.take(labels, inp, axis=0)
                 elif gather == "epoch":
                     x = _normalize_dev(jax.lax.dynamic_slice_in_dim(
-                        ep_x, inp * args.batch, args.batch), jnp.float32)
+                        ep_x, inp * args.batch, args.batch), compute_dtype)
                     y = jax.lax.dynamic_slice_in_dim(ep_y, inp * args.batch,
                                                      args.batch)
                 else:
@@ -200,6 +230,7 @@ def main() -> int:
         "full": make_full(dropout=True, gather="step"),
         "full_nogather": make_full(dropout=True, gather="none"),
         "full_pregather": make_full(dropout=True, gather="epoch"),
+        "eval": make_eval(),
     }
 
     result = {
@@ -210,6 +241,9 @@ def main() -> int:
         "batch": args.batch,
     }
     for name, fn in variants.items():
+        # us per ITERATION of that variant's scan ("eval" iterates
+        # eval-steps batches; everything else `steps` train steps).
+        iters = args.eval_steps if name == "eval" else args.steps
         jitted = jax.jit(fn)
         try:
             jax.block_until_ready(jitted())  # compile (or cache load)
@@ -218,7 +252,7 @@ def main() -> int:
                 t0 = time.perf_counter()
                 jax.block_until_ready(jitted())
                 best = min(best, time.perf_counter() - t0)
-            result[name] = round(best / args.steps * 1e6, 2)  # us/step
+            result[name] = round(best / iters * 1e6, 2)
         except Exception as e:  # tunnel drop mid-ladder: keep partials
             result[name] = None
             result.setdefault("errors", {})[name] = repr(e)[:200]
