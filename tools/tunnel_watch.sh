@@ -137,7 +137,9 @@ while true; do
         # full±dropout±gather — attributes the ~0.8 ms floor by
         # construction, independent of the trace path below.
         echo "[$(stamp)] step-attribution ladder"
-        timeout 420 python "$REPO/tools/step_attr_bench.py" \
+        # 10 rungs x ~20 s cold compile each through the tunnel on the
+        # first window; the persistent cache makes later windows warm.
+        timeout 600 python "$REPO/tools/step_attr_bench.py" \
             >"$OUT/bench_r4_stepattr.json" 2>"$OUT/bench_r4_stepattr.err" \
             && echo "[$(stamp)] stepattr: $(head -c 400 "$OUT/bench_r4_stepattr.json")" \
             || echo "[$(stamp)] stepattr failed rc=$?"
@@ -174,6 +176,12 @@ while true; do
                 && echo "[$(stamp)] vit-$mode: $(promote "vit_${mode}_run" "vit_$mode")" \
                 || echo "[$(stamp)] vit-$mode failed rc=$?"
         done
+        # The bf16 ladder (explains why --bf16 moved run_s only 4%).
+        echo "[$(stamp)] step-attribution ladder (bf16)"
+        timeout 600 python "$REPO/tools/step_attr_bench.py" --bf16 \
+            >"$OUT/bench_r4_stepattr_bf16.json" 2>"$OUT/bench_r4_stepattr_bf16.err" \
+            && echo "[$(stamp)] stepattr-bf16: $(head -c 400 "$OUT/bench_r4_stepattr_bf16.json")" \
+            || echo "[$(stamp)] stepattr-bf16 failed rc=$?"
         # Pallas optimizer micro-benchmark (decision data for the kernel).
         python "$REPO/tools/pallas_opt_bench.py" \
             >"$OUT/bench_r4_pallas_micro.json" 2>"$OUT/bench_r4_pallas_micro.err" \
