@@ -398,3 +398,65 @@ def test_vit_cli_fused_subprocess(tmp_path):
     assert proc.stdout.count("Test set: Average loss:") == 2
     assert "Total cost time:" in proc.stdout
     assert (tmp_path / "vit_mnist.npz").exists()
+
+
+def test_vit_mode_flag_resolution():
+    """The ViT CLI's mode truth table (vit_mnist.resolve_mode_flags) —
+    unit-level, no subprocess: degree semantics incl. the round-4
+    --allow-degree-1 single-chip smoke surface, plus every SystemExit
+    combination the CLI promises."""
+    import importlib.util
+    import os
+
+    import pytest as _pytest
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "vit_mnist_cli", os.path.join(repo, "vit_mnist.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    def resolve(argv):
+        args = mod.build_parser().parse_args(argv)
+        return mod.resolve_mode_flags(args), args
+
+    # Defaults: no parallel path.
+    (sp_on, tp_on), args = resolve([])
+    assert (sp_on, tp_on) == (False, False)
+    assert (args.sp, args.tp) == (1, 1)  # normalized for mesh math
+    # Degree > 1 switches the paths on without the allow flag.
+    (sp_on, tp_on), _ = resolve(["--sp", "4"])
+    assert (sp_on, tp_on) == (True, False)
+    (sp_on, tp_on), _ = resolve(["--sp", "2", "--tp", "2"])
+    assert (sp_on, tp_on) == (True, True)
+    # Explicit degree 1 is OFF without --allow-degree-1 (back-compat)...
+    (sp_on, tp_on), _ = resolve(["--sp", "1"])
+    assert (sp_on, tp_on) == (False, False)
+    # ...and ON with it (the single-chip hardware smoke).
+    (sp_on, tp_on), _ = resolve(["--sp", "1", "--allow-degree-1"])
+    assert (sp_on, tp_on) == (True, False)
+    (sp_on, tp_on), _ = resolve(["--tp", "1", "--allow-degree-1"])
+    assert (sp_on, tp_on) == (False, True)
+    # ulysses needs an active sp path, at any degree.
+    (sp_on, _), _ = resolve(
+        ["--sp", "1", "--sp-impl", "ulysses", "--allow-degree-1"]
+    )
+    assert sp_on
+    for bad in (
+        ["--sp", "0"],
+        ["--sp-impl", "ulysses"],                      # no --sp
+        ["--sp", "1", "--sp-impl", "ulysses"],         # degree-1 w/o allow
+        ["--sp", "2", "--tp", "2", "--sp-impl", "ulysses"],
+        ["--pp", "--sp", "2"],
+        ["--pp", "--pp-stages", "1", "--allow-degree-1"],  # engine >= 2
+        ["--experts", "4", "--tp", "2"],
+        ["--zero", "--sp", "2"],
+        ["--zero", "--tp", "1", "--allow-degree-1"],
+        ["--remat", "--tp", "2"],
+        ["--flash", "--fused"],
+        ["--pregather"],                               # needs --fused
+        ["--fused", "--sp", "1", "--allow-degree-1"],  # fused is DP-only
+    ):
+        with _pytest.raises(SystemExit):
+            resolve(bad)

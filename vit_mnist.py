@@ -145,12 +145,17 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def main() -> None:
-    args = build_parser().parse_args()
-    # --sp/--tp default to None (off).  A parallel path is taken at
-    # degree > 1, or at an explicit degree 1 under --allow-degree-1 (the
-    # single-chip hardware smoke); after this block args.sp/args.tp are
-    # plain ints and sp_on/tp_on are the branch selectors.
+def resolve_mode_flags(args) -> tuple[bool, bool]:
+    """Validate the mode-flag surface and return ``(sp_on, tp_on)``.
+
+    --sp/--tp default to None (off).  A parallel path is taken at
+    degree > 1, or at an explicit degree 1 under --allow-degree-1 (the
+    single-chip hardware smoke); after this call args.sp/args.tp are
+    plain ints and sp_on/tp_on are the branch selectors.  Every invalid
+    flag combination raises SystemExit with the message the CLI prints —
+    separated from main() so tests can pin the whole truth table
+    without subprocesses (tests/test_e2e.py covers the degree>1 modes
+    end-to-end)."""
     for name in ("sp", "tp"):
         v = getattr(args, name)
         if v is not None and v < 1:
@@ -196,6 +201,17 @@ def main() -> None:
         )
     if args.pregather and not args.fused:
         raise SystemExit("--pregather is the fused input path; add --fused")
+    if args.fused and (sp_on or tp_on or args.pp or args.experts > 0):
+        raise SystemExit(
+            "--fused is the data-parallel whole-run; drop --sp/--tp/--pp/"
+            "--experts"
+        )
+    return sp_on, tp_on
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    sp_on, tp_on = resolve_mode_flags(args)
 
     import jax
 
@@ -341,12 +357,8 @@ def main() -> None:
 
     # Whole-run fusion: like the CNN CLI, --dry-run (a per-batch smoke
     # semantics) silently falls back to the per-batch path.
+    # (fused-vs-mode exclusivity already validated in resolve_mode_flags.)
     fused = args.fused and not args.dry_run
-    if args.fused and (sp_on or tp_on or args.pp or args.experts > 0):
-        raise SystemExit(
-            "--fused is the data-parallel whole-run; drop --sp/--tp/--pp/"
-            "--experts"
-        )
     if fused:
         from pytorch_mnist_ddp_tpu.parallel.fused_vit import (
             device_put_dataset,
