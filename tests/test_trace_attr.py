@@ -97,3 +97,26 @@ def test_empty_trace_fails_structured(tmp_path):
     )
     assert proc.returncode == 1
     assert json.loads(proc.stdout)["error"]
+
+
+def test_steps_line_never_outranks_op_line(tmp_path):
+    """TPU traces carry a 'Steps' line whose events span whole steps —
+    busiest by construction.  It must not be selected as the op timeline
+    while a real 'XLA Ops' line qualifies."""
+    events = (
+        _meta(1, "/device:TPU:0", 10, "XLA Ops")
+        + _meta(1, "/device:TPU:0", 11, "Steps")
+        + [
+            _op(1, 10, "convolution.1", 0.0, 30.0),
+            # Step events cover everything and carry no hlo args.
+            {"ph": "X", "pid": 1, "tid": 11, "ts": 0.0, "dur": 100.0,
+             "name": "1"},
+            {"ph": "X", "pid": 1, "tid": 11, "ts": 100.0, "dur": 100.0,
+             "name": "2"},
+        ]
+    )
+    r = _run(_write_trace(tmp_path, events))
+    assert r["thread"] == "XLA Ops"
+    assert r["top_ops"][0]["op"] == "convolution.1"
+    # The Steps line is still visible as a secondary op line.
+    assert any("Steps" in k for k in r["other_op_lines"])

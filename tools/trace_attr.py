@@ -149,7 +149,16 @@ def attribute(trace_dir: str, top: int = 25) -> dict:
 
     # The busiest op line IS the device timeline (XLA executes one op at a
     # time per core); other qualifying lines are reported but not summed.
-    main_key = max(per_thread, key=lambda k: per_thread[k]["busy"])
+    # TPU traces also carry a "Steps" line whose events span whole steps —
+    # it would trivially win on busy-time and reduce the table to step
+    # numbers, so it is only eligible when nothing better qualified.
+    def _rank(k):
+        tname = thread_names.get(k, "")
+        is_steps = bool(re.search(r"\bSteps\b", tname)) and not re.search(
+            r"XLA Ops|TensorCore", tname)
+        return (0 if is_steps else 1, per_thread[k]["busy"])
+
+    main_key = max(per_thread, key=_rank)
     main = per_thread[main_key]
     span_us = main["t1"] - main["t0"]
     busy_us = main["busy"]
