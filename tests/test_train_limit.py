@@ -41,3 +41,28 @@ def test_train_limit_zero_is_no_op(tmp_path, capsys, devices):
     fit(args, dist, timings=timings)
     capsys.readouterr()
     assert timings["train_size"] == 512 and timings["test_size"] == 256
+
+
+def test_fit_pregather_matches_default_through_trainer(tmp_path, capsys, devices):
+    """fit(pregather=True) end-to-end through the trainer seam (the
+    bit-identity tests call make_fused_run directly): identical printed
+    output and timings accuracies vs the default input path on the same
+    tiny truncated run."""
+    root = _write_idx(tmp_path)
+    outs, accs = [], []
+    for pre in (False, True):
+        args = _args(root, batch_size=8, fused=True,
+                     log_interval=10_000_000)
+        args.train_limit = 64
+        args.pregather = pre
+        dist = DistState(
+            distributed=True, process_rank=0, process_count=1,
+            world_size=8, devices=list(devices),
+        )
+        timings = {}
+        fit(args, dist, timings=timings)
+        outs.append(capsys.readouterr().out)
+        accs.append((timings["epoch1_test_accuracy"],
+                     timings["final_test_accuracy"]))
+    assert outs[0] == outs[1]
+    assert accs[0] == accs[1]
