@@ -1,0 +1,176 @@
+"""Turn the round-4 attribution artifacts into the docs/PERF.md verdict.
+
+The watcher runs this after its ladder/trace legs each tunnel window:
+it reads whichever of ``bench_r4_stepattr.json`` /
+``bench_r4_stepattr_bf16.json`` / ``bench_r4_attr.json`` /
+``bench_r4_warm.json`` exist, computes the rung deltas and the run_s
+reconciliation from docs/PERF.md's decision rules, APPENDS a dated
+analysis block to docs/PERF.md, and prints the same block to stdout —
+so the analysis lands as a commit even when the window opens after the
+interactive session died (the round-3 failure mode for evidence).
+
+Usage: python tools/perf_report.py [--no-write]
+Exit 0 with a block if at least the ladder artifact exists; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PERF_MD = os.path.join(REPO, "docs", "PERF.md")
+
+# Headline protocol facts (bench.py PROTOCOL): 20 epochs x 300 steps,
+# 10 eval batches per epoch.
+TRAIN_STEPS = 6000
+EVAL_BATCHES = 200
+EPOCHS = 20
+
+
+def _load(name):
+    try:
+        with open(os.path.join(REPO, name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _fmt_us(v):
+    return "—" if v is None else f"{v:,.0f} µs"
+
+
+def build_report() -> str | None:
+    ladder = _load("bench_r4_stepattr.json")
+    if not ladder or ladder.get("full") is None:
+        return None
+    bf16 = _load("bench_r4_stepattr_bf16.json")
+    attr = _load("bench_r4_attr.json")
+    warm = _load("bench_r4_warm.json")
+
+    g = ladder.get  # µs per iteration, or None
+    lines = []
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    lines.append(f"### Window analysis — {stamp} "
+                 f"({ladder.get('device_kind', '?')})")
+    lines.append("")
+    lines.append("| Rung | µs/iter |")
+    lines.append("|---|---|")
+    for k in ("empty_scan", "gather_norm", "gather_epoch", "fwd",
+              "fwd_bwd", "full_nodrop", "full", "full_nogather",
+              "full_pregather", "eval"):
+        if g(k) is not None:
+            lines.append(f"| {k} | {g(k):,.1f} |")
+    lines.append("")
+
+    def delta(a, b):
+        return None if g(a) is None or g(b) is None else g(a) - g(b)
+
+    attrib = [
+        ("scan-loop overhead", g("empty_scan")),
+        ("input (per-step gather+normalize)", delta("gather_norm",
+                                                    "empty_scan")),
+        ("input (pregather alternative)", delta("gather_epoch",
+                                                "empty_scan")),
+        ("forward compute", delta("fwd", "empty_scan")),
+        ("backward extra", delta("fwd_bwd", "fwd")),
+        ("optimizer + input (full_nodrop − fwd_bwd)",
+         delta("full_nodrop", "fwd_bwd")),
+        ("dropout/RNG (full − full_nodrop)", delta("full", "full_nodrop")),
+        ("gather cross-check (full − full_nogather)",
+         delta("full", "full_nogather")),
+        ("pregather end-to-end win (full − full_pregather)",
+         delta("full", "full_pregather")),
+    ]
+    lines.append("| Attribution | µs/step |")
+    lines.append("|---|---|")
+    for name, v in attrib:
+        lines.append(f"| {name} | {_fmt_us(v)} |")
+    lines.append("")
+
+    # run_s reconciliation against the warm headline row, if present.
+    if g("full") is not None and g("eval") is not None:
+        pred = (TRAIN_STEPS * g("full") + EVAL_BATCHES * g("eval")) / 1e6
+        lines.append(f"Reconstructed run_s from the ladder: "
+                     f"{TRAIN_STEPS}×full + {EVAL_BATCHES}×eval = "
+                     f"**{pred:.2f} s**.")
+        if warm and warm.get("run_s"):
+            got = warm["run_s"]
+            lines.append(f"Measured warm `run_s` ({warm.get('cache')} row): "
+                         f"**{got:.2f} s** — "
+                         f"{'reconciles' if abs(pred - got) / got < 0.25 else 'DOES NOT reconcile'} "
+                         f"({pred / got:,.2f}×); residual outside the step "
+                         f"program: {got - pred:+.2f} s.")
+        lines.append("")
+
+    # Decision rules (docs/PERF.md).
+    verdicts = []
+    win = delta("full", "full_pregather")
+    if win is not None and g("full"):
+        share = win / g("full")
+        if share > 0.05:
+            verdicts.append(
+                f"**Flip to pregather**: the pregather step is "
+                f"{share:.0%} faster ({win:,.1f} µs/step); confirm with "
+                f"`bench.py --pregather` then make it the default and "
+                f"re-warm in-window."
+            )
+        else:
+            verdicts.append(
+                f"Input path verdict: pregather wins only {share:.0%} "
+                f"per step — keep the shipped per-step gather."
+            )
+    fb, fu = g("fwd_bwd"), g("full")
+    if fb is not None and fu:
+        if fb / fu > 0.8:
+            verdicts.append(
+                f"The step is {fb / fu:.0%} fwd+bwd compute: the floor is "
+                f"compute/layout-bound at these conv shapes, not overhead "
+                f"— see the per-op table ({'bench_r4_attr.json' if attr else 'trace pending'}) "
+                f"for the conv1/conv2 split."
+            )
+        else:
+            verdicts.append(
+                f"fwd+bwd is only {fb / fu:.0%} of the full step — "
+                f"{fu - fb:,.1f} µs/step rides input/optimizer/dropout; "
+                f"see the attribution rows above."
+            )
+    if bf16 and bf16.get("full") and fu:
+        verdicts.append(
+            f"bf16 ladder: full {bf16['full']:,.1f} µs vs f32 {fu:,.1f} µs "
+            f"({1 - bf16['full'] / fu:+.0%})."
+        )
+    if attr and attr.get("gap_share") is not None:
+        verdicts.append(
+            f"Trace: device busy {attr.get('busy_s')}s over "
+            f"{attr.get('span_s')}s span — gap share "
+            f"{attr['gap_share']:.0%}; top category: "
+            f"{next(iter(attr.get('by_category', {'?': None})))}."
+        )
+    for v in verdicts:
+        lines.append(f"- {v}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--no-write", action="store_true")
+    args = p.parse_args()
+    report = build_report()
+    if report is None:
+        print("perf_report: no ladder artifact (bench_r4_stepattr.json) "
+              "yet", file=sys.stderr)
+        return 1
+    print(report)
+    if not args.no_write:
+        with open(PERF_MD, "a") as f:
+            f.write("\n" + report + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

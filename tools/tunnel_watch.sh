@@ -187,6 +187,14 @@ while true; do
             >"$OUT/bench_r4_pallas_micro.json" 2>"$OUT/bench_r4_pallas_micro.err" \
             && echo "[$(stamp)] micro: $(cat "$OUT/bench_r4_pallas_micro.json")" \
             || echo "[$(stamp)] micro-bench failed rc=$?"
+        # Distill everything this window produced into docs/PERF.md's
+        # results section and commit it: the analysis lands even if no
+        # interactive session is alive when this window opens.
+        timeout 60 python "$REPO/tools/perf_report.py" \
+            >>"$OUT/bench_r4_perf_report.log" 2>&1 \
+            && ( cd "$REPO" && git add docs/PERF.md 2>/dev/null ) \
+            && echo "[$(stamp)] perf report appended" \
+            || echo "[$(stamp)] perf report skipped rc=$?"
         commit_artifacts "variants"
         echo "[$(stamp)] window complete; continuing to poll (re-warm duty)"
         sleep "$POST_WINDOW_SLEEP_S"
