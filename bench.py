@@ -204,6 +204,11 @@ def main() -> None:
                         "per-step row gathers; bit-identical batches — "
                         "recorded in the JSON, not the headline until it "
                         "measures faster)")
+    p.add_argument("--conv-impl", type=str, default="conv",
+                   choices=["conv", "im2col_c1", "im2col"],
+                   help="benchmark a GEMM-lowered conv variant "
+                        "(models/net.py CONV_IMPLS; recorded in the JSON, "
+                        "not the headline until it measures faster)")
     p.add_argument("--zero", action="store_true",
                    help="benchmark the ZeRO-1 sharded-optimizer DP path "
                         "(parallel/zero.py; per-batch loop — the sharded "
@@ -284,6 +289,7 @@ def main() -> None:
         syncbn=args.syncbn,
         pallas_opt=args.pallas_opt,
         pregather=args.pregather,
+        conv_impl=args.conv_impl,
         zero=args.zero,
         train_limit=args.train_limit,
         data_root="./data",
@@ -343,6 +349,7 @@ def main() -> None:
         "syncbn": bool(args.syncbn),
         "pallas_opt": bool(args.pallas_opt),
         "pregather": bool(args.pregather),
+        "conv_impl": args.conv_impl,
         "zero": bool(args.zero),
         "train_limit": args.train_limit or None,
         # "idx" (real MNIST files) or "synthetic" (air-gapped fallback):
@@ -403,6 +410,7 @@ def main() -> None:
         and not args.syncbn
         and not args.pallas_opt
         and not args.pregather
+        and args.conv_impl == "conv"
         and not args.zero
         and not args.train_limit
         and args.epochs == PROTOCOL["epochs"]

@@ -60,6 +60,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "big gather per epoch + contiguous per-step slices "
                         "(parallel/fused.py pregather; bit-identical "
                         "batches, different input HLO)")
+    p.add_argument("--conv-impl", type=str, default="conv",
+                   choices=["conv", "im2col_c1", "im2col"],
+                   help="convolution lowering (models/net.py): XLA's native "
+                        "conv (default), or GEMM-lowered via im2col for "
+                        "conv1 only / both convs — conv1's C_in=1 windows "
+                        "cannot tile the MXU (docs/PERF.md); same params, "
+                        "same math, different reduction tree")
     p.add_argument("--pallas-opt", action="store_true", default=False,
                    help="use the fused Pallas Adadelta kernel for the "
                         "optimizer update (ops/pallas_adadelta.py)")

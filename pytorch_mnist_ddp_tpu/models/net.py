@@ -67,6 +67,67 @@ DROPOUT1_RATE = 0.25
 DROPOUT2_RATE = 0.5
 
 
+# Net.conv_impl values: which convolution lowering the forward uses.
+# "conv" is the shipped default (XLA's native conv); the im2col variants
+# exist because conv1 has C_in=1 — 9-element contraction dims that cannot
+# tile the 128x128 MXU (docs/PERF.md names it the prime suspect for the
+# unattributed ~0.5 ms/step floor).  Selectable per run (--conv-impl) and
+# per ladder rung (tools/step_attr_bench.py) so the hardware decides.
+CONV_IMPLS = ("conv", "im2col_c1", "im2col")
+
+
+def _im2col_patches(x: jax.Array, kh: int, kw: int) -> jax.Array:
+    """VALID-window patch extraction as static slices + one concat —
+    ``[N, H, W, C] -> [N, H-kh+1, W-kw+1, kh*kw*C]`` with features ordered
+    (kh, kw, C)-major, which is exactly the order of a flattened HWIO
+    kernel, so ``patches @ kernel.reshape(kh*kw*C, out)`` equals the conv.
+
+    Pure layout ops (no identity-kernel conv like
+    ``lax.conv_general_dilated_patches`` lowers to): XLA fuses the slices
+    into the consuming matmul's operand reads."""
+    h = x.shape[1] - kh + 1
+    w = x.shape[2] - kw + 1
+    cols = [
+        x[:, i : i + h, j : j + w, :] for i in range(kh) for j in range(kw)
+    ]
+    return jnp.concatenate(cols, axis=-1)
+
+
+class Im2colConv(nn.Module):
+    """A drop-in ``nn.Conv`` twin (same param names/shapes/init, so
+    checkpoints and param trees are interchangeable) that lowers the
+    convolution as im2col + GEMM instead of ``lax.conv_general_dilated``.
+
+    Why: conv1's C_in=1 3x3 windows give the native conv a contraction
+    dim of 9 — unable to tile the MXU's 128-wide systolic dimension
+    (docs/PERF.md).  As a GEMM the contraction is still kh*kw*C, but the
+    operand layout is a plain [M, K] x [K, N] matmul XLA maps with its
+    mature GEMM path rather than the small-channel conv path, and the
+    patch slices fuse into the operand read.  Numerics: same products,
+    different reduction tree — parity is pinned to tight f32 tolerance in
+    tests/test_model.py, and the variant is opt-in (``Net.conv_impl``)
+    until the step-attribution ladder measures it faster on hardware."""
+
+    features: int
+    kernel_size: tuple[int, int] = (3, 3)
+    dtype: jnp.dtype = jnp.float32
+    kernel_init: nn.initializers.Initializer = nn.initializers.lecun_normal()
+    bias_init: nn.initializers.Initializer = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kh, kw = self.kernel_size
+        c_in = x.shape[-1]
+        kernel = self.param(
+            "kernel", self.kernel_init, (kh, kw, c_in, self.features)
+        )
+        bias = self.param("bias", self.bias_init, (self.features,))
+        patches = _im2col_patches(x.astype(self.dtype), kh, kw)
+        km = kernel.astype(self.dtype).reshape(kh * kw * c_in, self.features)
+        y = jax.lax.dot_general(patches, km, (((3,), (0,)), ((), ())))
+        return y + bias.astype(self.dtype)
+
+
 # torch.nn.BatchNorm2d defaults (SyncBatchNorm inherits them): eps=1e-5,
 # momentum=0.1 (torch's momentum weights the NEW batch statistic).
 BN_EPS = 1e-5
@@ -190,6 +251,21 @@ class Net(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
     use_bn: bool = False
     bn_axis: str | None = None
+    # Convolution lowering (see CONV_IMPLS): "conv" = XLA native (default,
+    # the shipped program); "im2col_c1" = GEMM-lowered conv1 only (the
+    # MXU-untileable C_in=1 layer); "im2col" = both convs as GEMMs.
+    conv_impl: str = "conv"
+
+    def _conv(self, features: int, fan_in: int, name: str, im2col: bool):
+        """conv1/conv2 constructor: the native ``nn.Conv`` or its
+        :class:`Im2colConv` twin — identical param trees either way."""
+        kwargs = dict(
+            name=name, dtype=self.compute_dtype,
+            kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(fan_in),
+        )
+        if im2col:
+            return Im2colConv(features, (3, 3), **kwargs)
+        return nn.Conv(features, (3, 3), padding="VALID", **kwargs)
 
     def _maybe_bn(
         self, x: jax.Array, name: str, train: bool, mask: jax.Array | None
@@ -215,17 +291,17 @@ class Net(nn.Module):
         # BN with dropout off.  ``mask`` (the loader's 0/1 padding weights,
         # shape [N]) keeps zero-padded samples out of the BN statistics.
         use_dropout = train if dropout is None else dropout
+        if self.conv_impl not in CONV_IMPLS:
+            raise ValueError(
+                f"conv_impl {self.conv_impl!r} not in {CONV_IMPLS}"
+            )
         x = x.astype(self.compute_dtype)
-        x = nn.Conv(
-            32, (3, 3), padding="VALID", name="conv1", dtype=self.compute_dtype,
-            kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(1 * 9),
+        x = self._conv(
+            32, 1 * 9, "conv1", self.conv_impl in ("im2col_c1", "im2col")
         )(x)
         x = self._maybe_bn(x, "bn1", train, mask)
         x = nn.relu(x)
-        x = nn.Conv(
-            64, (3, 3), padding="VALID", name="conv2", dtype=self.compute_dtype,
-            kernel_init=torch_reset_uniform(), bias_init=_bias_init_like(32 * 9),
-        )(x)
+        x = self._conv(64, 32 * 9, "conv2", self.conv_impl == "im2col")(x)
         x = self._maybe_bn(x, "bn2", train, mask)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))

@@ -154,6 +154,7 @@ def make_train_step(
     dropout: bool = True,
     use_pallas: bool | None = None,
     use_bn: bool = False,
+    conv_impl: str = "conv",
 ):
     """Build the jitted DP train step.
 
@@ -172,7 +173,7 @@ def make_train_step(
     """
     model = Net(
         compute_dtype=compute_dtype, use_bn=use_bn,
-        bn_axis=DATA_AXIS if use_bn else None,
+        bn_axis=DATA_AXIS if use_bn else None, conv_impl=conv_impl,
     )
 
     def local_step(state: TrainState, x, y, w, dropout_key, lr):
@@ -208,7 +209,8 @@ def make_train_step(
 
 
 def make_eval_step(
-    mesh: Mesh, compute_dtype: jnp.dtype = jnp.float32, use_bn: bool = False
+    mesh: Mesh, compute_dtype: jnp.dtype = jnp.float32, use_bn: bool = False,
+    conv_impl: str = "conv",
 ):
     """Build the jitted distributed eval step.
 
@@ -221,7 +223,9 @@ def make_eval_step(
     ``{"params": ..., "batch_stats": ...}`` and eval normalizes by the
     running averages (torch ``model.eval()`` semantics).
     """
-    model = Net(compute_dtype=compute_dtype, use_bn=use_bn)
+    model = Net(
+        compute_dtype=compute_dtype, use_bn=use_bn, conv_impl=conv_impl
+    )
 
     def local_eval(params, x, y, w):
         variables = params if use_bn else {"params": params}

@@ -356,6 +356,7 @@ def make_fused_run(
     use_bn: bool = False,
     start_epoch: int = 1,
     pregather: bool = False,
+    conv_impl: str = "conv",
 ):
     """Whole-run fusion: EVERY epoch's training scan plus its full-test-set
     eval as ONE jitted device call.
@@ -393,7 +394,7 @@ def make_fused_run(
 
     model = Net(
         compute_dtype=compute_dtype, use_bn=use_bn,
-        bn_axis=DATA_AXIS if use_bn else None,
+        bn_axis=DATA_AXIS if use_bn else None, conv_impl=conv_impl,
     )
     n_shards = mesh.shape[DATA_AXIS]
     local_epoch, num_batches = _local_epoch_builder(

@@ -225,6 +225,7 @@ def make_zero_train_step(
     eps: float = 1e-6,
     dropout: bool = True,
     use_bn: bool = False,
+    conv_impl: str = "conv",
 ):
     """Build the jitted ZeRO-1 DP train step.
 
@@ -237,7 +238,7 @@ def make_zero_train_step(
     n_shards = mesh.shape[DATA_AXIS]
     model = Net(
         compute_dtype=compute_dtype, use_bn=use_bn,
-        bn_axis=DATA_AXIS if use_bn else None,
+        bn_axis=DATA_AXIS if use_bn else None, conv_impl=conv_impl,
     )
 
     def local_step(state: TrainState, x, y, w, dropout_key, lr):

@@ -280,6 +280,12 @@ def _fit_body(
     if zero and bool(getattr(args, "pallas_opt", False)):
         raise ValueError("--zero and --pallas-opt both re-lay-out the "
                          "Adadelta state; pick one")
+    # --conv-impl (models/net.py CONV_IMPLS): the GEMM-lowered conv
+    # variants ride every DP path (per-batch and fused); the tp/pp raw-lax
+    # forwards pin the native conv, so reject the combination loudly.
+    conv_impl = str(getattr(args, "conv_impl", None) or "conv")
+    if conv_impl != "conv" and num_model > 1:
+        raise ValueError("--conv-impl rides the DP paths; drop --tp/--pp")
     # --pregather (the pre-permuted-epoch input path, parallel/fused.py)
     # exists only inside the fused whole-run; validated here so every
     # caller (both CLIs, bench.py) fails loudly instead of silently
@@ -420,6 +426,7 @@ def _fit_body(
             from_key=resume_path is None and loaded_state is None,
             use_bn=syncbn, start_epoch=epoch0 + 1,
             pregather=getattr(args, "pregather", False),
+            conv_impl=conv_impl,
         )
         if loaded_state is not None:
             lead = replicate_params(loaded_state, mesh)
@@ -579,18 +586,20 @@ def _fit_body(
             # params are replicated either way; only the train step and
             # the optimizer-state layout differ.
             step_fn = make_zero_train_step(
-                mesh, compute_dtype=compute_dtype, use_bn=syncbn
+                mesh, compute_dtype=compute_dtype, use_bn=syncbn,
+                conv_impl=conv_impl,
             )
             eval_fn = None
         else:
             step_fn = make_train_step(
                 mesh, compute_dtype=compute_dtype, use_pallas=use_pallas,
-                use_bn=syncbn,
+                use_bn=syncbn, conv_impl=conv_impl,
             )
             eval_fn = None
         if eval_fn is None:
             eval_fn = make_eval_step(
-                mesh, compute_dtype=compute_dtype, use_bn=syncbn
+                mesh, compute_dtype=compute_dtype, use_bn=syncbn,
+                conv_impl=conv_impl,
             )
         want_stats = bool(getattr(args, "step_stats", False))
         for epoch in range(epoch0 + 1, epoch0 + args.epochs + 1):

@@ -49,6 +49,11 @@ def main() -> int:
     p.add_argument("--reps", type=int, default=3)
     p.add_argument("--bf16", action="store_true",
                    help="run the ladder at the --bf16 compute dtype")
+    p.add_argument("--conv-impl", type=str, default="conv",
+                   choices=["conv", "im2col_c1", "im2col"],
+                   help="run the ladder with a GEMM-lowered conv variant "
+                        "(models/net.py CONV_IMPLS) — isolates conv1's "
+                        "MXU-untileable C_in=1 contraction (docs/PERF.md)")
     p.add_argument("--allow-cpu", action="store_true")
     args = p.parse_args()
 
@@ -74,7 +79,7 @@ def main() -> int:
 
     enable_persistent_cache()
     compute_dtype = jnp.bfloat16 if args.bf16 else jnp.float32
-    model = Net(compute_dtype=compute_dtype)
+    model = Net(compute_dtype=compute_dtype, conv_impl=args.conv_impl)
     params = init_params(jax.random.PRNGKey(0))
     opt = adadelta_init(params)
     rng = np.random.RandomState(0)
@@ -239,6 +244,8 @@ def main() -> int:
         "device_kind": jax.devices()[0].device_kind,
         "steps": args.steps,
         "batch": args.batch,
+        "compute_dtype": "bfloat16" if args.bf16 else "float32",
+        "conv_impl": args.conv_impl,
     }
     for name, fn in variants.items():
         # us per ITERATION of that variant's scan ("eval" iterates
