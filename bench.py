@@ -352,8 +352,10 @@ def main() -> None:
         "conv_impl": args.conv_impl,
         "zero": bool(args.zero),
         "train_limit": args.train_limit or None,
-        # "idx" (real MNIST files) or "synthetic" (air-gapped fallback):
-        # says which task produced the accuracy fields below.
+        # "idx" (real MNIST files, SHA-256-verified), "idx-unverified"
+        # (real-format files whose bytes miss the golden digests), or
+        # "synthetic" (air-gapped fallback): says which task produced the
+        # accuracy fields below.
         "dataset": timings.get("dataset", "unknown"),
     }
     if "run_s" in timings:
@@ -401,7 +403,9 @@ def main() -> None:
     # Snapshot for the last-known-good fallback (full headline config only:
     # a --quick/--allow-cpu/--bf16 run must not overwrite the real number).
     # The snapshot is self-describing (carries its "dataset" field), but a
-    # synthetic-task run never replaces a real-MNIST record.
+    # lower-provenance run never replaces a higher one: verified real MNIST
+    # ("idx") > real-format unverified bytes ("idx-unverified") > synthetic.
+    _PROVENANCE_RANK = {"idx": 2, "idx-unverified": 1}
     prev = _read_last_good()
     if (
         not args.quick
@@ -417,8 +421,8 @@ def main() -> None:
         and args.batch_size == PROTOCOL["batch_size"]
         and not (
             prev is not None
-            and prev.get("dataset") == "idx"
-            and result.get("dataset") != "idx"
+            and _PROVENANCE_RANK.get(prev.get("dataset"), 0)
+            > _PROVENANCE_RANK.get(result.get("dataset"), 0)
         )
     ):
         try:

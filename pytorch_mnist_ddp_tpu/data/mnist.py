@@ -17,8 +17,10 @@ hosts have no torchvision, so this module is self-contained:
 from __future__ import annotations
 
 import gzip
+import hashlib
 import os
 import struct
+import sys
 import urllib.request
 
 import numpy as np
@@ -34,6 +36,39 @@ _FILES = {
     ("test", "images"): "t10k-images-idx3-ubyte",
     ("test", "labels"): "t10k-labels-idx1-ubyte",
 }
+
+# Golden SHA-256 digests of the four RAW (uncompressed) IDX files — the
+# canonical MNIST distribution (reference mnist_ddp.py:157 downloads the
+# same files via torchvision).  Verified on load (round-4 verdict item 3):
+# matching files record provenance "idx"; a mismatch is NEVER fatal — the
+# data still loads, the computed digest is printed, and provenance becomes
+# "idx-unverified" so bench.py's evidence chain stays honest either way.
+_SHA256 = {
+    "train-images-idx3-ubyte":
+        "ba891046e6505d7aadcbbe25680a0738ad16aec93bde7f9b65e87a2fc25776db",
+    "train-labels-idx1-ubyte":
+        "65a50cbbf4e906d70832878ad85ccda5333a97f0f4c3dd2ef09a8a9eef7101c5",
+    "t10k-images-idx3-ubyte":
+        "1bf45877962fd391f7abb20534a30fd2203d0865309fec5f87d576dbdbefdcb1",
+    "t10k-labels-idx1-ubyte":
+        "b7e25cb63ef54da8d0fd3b0d8a38b9aaad06962e663b5d202cb1b7098e54aaf9",
+}
+
+
+def verify_idx_digest(filename: str, raw: bytes) -> bool:
+    """True iff ``raw`` matches the golden SHA-256 for ``filename``.
+    On mismatch, print both digests (stderr) so a wrong golden or a
+    corrupt download is diagnosable from the run log alone."""
+    golden = _SHA256.get(filename)
+    digest = hashlib.sha256(raw).hexdigest()
+    if digest == golden:
+        return True
+    print(
+        f"warning: {filename} SHA-256 {digest} does not match golden "
+        f"{golden}; loading anyway with provenance 'idx-unverified'",
+        file=sys.stderr,
+    )
+    return False
 
 _IMAGE_MAGIC = 2051
 _LABEL_MAGIC = 2049
@@ -240,11 +275,15 @@ def load_mnist_arrays(
     return_source: bool = False,
 ):
     """Return ``(images uint8 [N,28,28], labels uint8 [N])`` for a split
-    (plus the provenance string ``"idx"`` | ``"synthetic"`` when
-    ``return_source``).
+    (plus the provenance string ``"idx"`` | ``"idx-unverified"`` |
+    ``"synthetic"`` when ``return_source``).
 
     Resolution order: ``$MNIST_DATA_DIR`` / ``root`` IDX files -> download
-    (when allowed) -> deterministic synthetic fallback.
+    (when allowed) -> deterministic synthetic fallback.  Real files are
+    SHA-256-checked against the canonical digests: drop the four IDX
+    files into ``root`` and the whole evidence chain (bench JSON
+    ``dataset`` field included) flips to verified real MNIST with zero
+    code changes.
     """
     root = os.environ.get("MNIST_DATA_DIR", root)
     arrays = {}
@@ -254,6 +293,8 @@ def load_mnist_arrays(
         raw = _read_maybe_gz(os.path.join(root, filename))
         if raw is None and download:
             raw = _try_download(root, filename)
+        if raw is not None and not verify_idx_digest(filename, raw):
+            source = "idx-unverified"
         if raw is None:
             if not allow_synthetic:
                 raise FileNotFoundError(
@@ -278,9 +319,11 @@ def load_mnist_arrays(
 class MNIST:
     """Dataset object: raw uint8 arrays + length; transforms happen at batch
     time in the loader (vectorized, not per-sample like torchvision).
-    ``source`` records provenance: ``"idx"`` (real files) or
-    ``"synthetic"`` (air-gapped fallback) — surfaced in bench.py's JSON so
-    recorded accuracy numbers say which task produced them."""
+    ``source`` records provenance: ``"idx"`` (real files, SHA-256-verified
+    against the canonical digests), ``"idx-unverified"`` (IDX files whose
+    bytes miss the goldens — loaded, loudly), or ``"synthetic"``
+    (air-gapped fallback) — surfaced in bench.py's JSON so recorded
+    accuracy numbers say which task produced them."""
 
     def __init__(
         self,

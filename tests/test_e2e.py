@@ -103,7 +103,9 @@ def test_fit_fused_populates_timings(tmp_path, capsys, devices):
         "train_size", "test_size",
         "epoch1_test_accuracy", "final_test_accuracy",
     }
-    assert timings.pop("dataset") == "idx"  # _write_idx provides real files
+    # _write_idx provides real-format files; they are not the canonical
+    # bytes, so the golden-SHA-256 guard labels them idx-unverified.
+    assert timings.pop("dataset") == "idx-unverified"
     # Actual sizes (bench.py's throughput/MFU denominators) follow the
     # dataset, not the 60k protocol constant.
     assert timings.pop("train_size") == 512 and timings.pop("test_size") == 256

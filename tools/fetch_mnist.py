@@ -29,6 +29,7 @@ from pytorch_mnist_ddp_tpu.data.mnist import (  # noqa: E402
     _MIRRORS,
     _read_maybe_gz,
     _try_download,
+    verify_idx_digest,
 )
 
 LOG_PATH = os.path.join(REPO, "data", "idx_attempts.log")
@@ -47,22 +48,41 @@ def main() -> int:
     os.makedirs(os.path.dirname(LOG_PATH), exist_ok=True)
     with open(LOG_PATH, "a") as f:
         f.write(f"{stamp} root={args.root} begin\n")
-    present, fetched, failed = [], [], []
+    present, fetched, failed, verified = [], [], [], []
     for key, filename in _FILES.items():
         path = os.path.join(args.root, filename)
-        if _read_maybe_gz(path) is not None:
+        raw = _read_maybe_gz(path)
+        # Golden-digest check (data/mnist.py): the log then proves not just
+        # that bytes landed but that they are the canonical files.  A
+        # present-but-non-canonical file (corrupt/truncated earlier fetch)
+        # is retried: the mirror may hold the real bytes one download away
+        # (_try_download only overwrites on a successful decompress).
+        ok_digest = raw is not None and verify_idx_digest(filename, raw)
+        if raw is not None and not ok_digest:
+            fresh = _try_download(args.root, filename)
+            if fresh is not None:
+                fetched.append(filename)
+                ok_digest = verify_idx_digest(filename, fresh)
+            else:
+                present.append(filename)
+        elif raw is not None:
             present.append(filename)
-            continue
-        if _try_download(args.root, filename) is not None:
-            fetched.append(filename)
         else:
-            failed.append(filename)
+            raw = _try_download(args.root, filename)
+            if raw is not None:
+                fetched.append(filename)
+                ok_digest = verify_idx_digest(filename, raw)
+            else:
+                failed.append(filename)
+        if ok_digest:
+            verified.append(filename)
 
     ok = not failed
     line = (
         f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} "
         f"root={args.root} present={len(present)} "
         f"fetched={len(fetched)} failed={len(failed)} "
+        f"verified={len(verified)}/4 "
         f"mirrors={','.join(_MIRRORS)} "
         f"outcome={'complete' if ok else 'failed:' + ','.join(failed)}"
     )
