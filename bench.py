@@ -211,19 +211,14 @@ def main() -> None:
                         "not the headline until it measures faster)")
     p.add_argument("--zero", action="store_true",
                    help="benchmark the ZeRO-1 sharded-optimizer DP path "
-                        "(parallel/zero.py; per-batch loop — the sharded "
-                        "state has no fused whole-run program, so pair "
-                        "with --quick in short tunnel windows; recorded "
-                        "in the JSON, never the headline)")
+                        "(parallel/zero.py), composed into the fused "
+                        "whole-run program (recorded in the JSON, never "
+                        "the headline)")
     p.add_argument("--probe-attempts", type=int, default=None,
                    help="cap backend-probe attempts (default: full "
                         f"{1 + len(PROBE_BACKOFFS_S)}-attempt schedule, "
                         "~5 min of patience)")
     args = p.parse_args()
-    if args.zero and args.pregather:
-        # --zero runs the per-batch loop (fused=False below): --pregather
-        # would be a silent no-op recorded as true in the JSON row.
-        p.error("--pregather rides the fused run; --zero disables it")
     if args.quick:
         args.epochs = 2
     metric = f"mnist_{args.epochs}epoch_wall_clock"
@@ -284,7 +279,7 @@ def main() -> None:
         log_interval=10_000_000,  # silence train lines; epoch evals remain
         dry_run=False,
         save_model=False,
-        fused=not args.zero,
+        fused=True,
         bf16=args.bf16,
         syncbn=args.syncbn,
         pallas_opt=args.pallas_opt,

@@ -161,6 +161,7 @@ def _local_epoch_builder(
     use_pallas: bool | None,
     use_bn: bool = False,
     pregather: bool = False,
+    zero: bool = False,
 ):
     """The CNN family's fused-epoch body on the shared skeleton: returns
     ``local_epoch(state, images, labels, epoch, shuffle_key, dropout_key,
@@ -170,7 +171,17 @@ def _local_epoch_builder(
     ``use_bn``: the scan carry's ``state.batch_stats`` threads the BN
     running averages through every step; batch statistics psum over the
     data axis inside the forward and the wrap-filler rows (weight 0) are
-    mask-excluded, exactly like the per-batch step (parallel/ddp.py)."""
+    mask-excluded, exactly like the per-batch step (parallel/ddp.py).
+
+    ``zero``: ZeRO-1 optimizer sharding (parallel/zero.py) inside the
+    fused scan — the carry's ``state.opt`` is each shard's LOCAL 1/N flat
+    accumulator slice, and the update runs zero_update's
+    psum_scatter -> shard-local Adadelta -> all_gather instead of
+    pmean + replicated update.  Same dropout-stream folding as the
+    per-batch steps (step, then shard), so fused-ZeRO trajectories are
+    bit-comparable to per-batch ZeRO's."""
+    if zero:
+        from .zero import zero_update
 
     def step_fn(state: TrainState, x, y, w, shard, dropout_key, lr):
         key = jax.random.fold_in(dropout_key, state.step)
@@ -195,10 +206,18 @@ def _local_epoch_builder(
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
-        grads = jax.lax.pmean(grads, DATA_AXIS)
-        params, opt = adadelta_update_best(
-            state.params, grads, state.opt, lr, rho, eps, use_pallas=use_pallas
-        )
+        if zero:
+            # zero_update's psum_scatter consumes the RAW local grads (the
+            # /N that makes DDP's mean happens on the scattered shard).
+            params, opt = zero_update(
+                state.params, grads, state.opt, lr, n_shards, rho, eps
+            )
+        else:
+            grads = jax.lax.pmean(grads, DATA_AXIS)
+            params, opt = adadelta_update_best(
+                state.params, grads, state.opt, lr, rho, eps,
+                use_pallas=use_pallas,
+            )
         return TrainState(params, opt, state.step + 1, new_stats), loss
 
     return _epoch_scan_builder(
@@ -357,9 +376,19 @@ def make_fused_run(
     start_epoch: int = 1,
     pregather: bool = False,
     conv_impl: str = "conv",
+    zero: bool = False,
 ):
     """Whole-run fusion: EVERY epoch's training scan plus its full-test-set
     eval as ONE jitted device call.
+
+    ``zero`` composes ZeRO-1 optimizer sharding (parallel/zero.py) into
+    the fused program (round-4 verdict item 5): ``state.opt`` is the flat
+    sharded :class:`~..parallel.zero.ZeroAdadeltaState` (in/out specs
+    ``P('data')``), the per-step update is zero_update's
+    reduce-scatter/local-update/all-gather, and a ``from_key`` run creates
+    the local accumulator slices inside the compiled program.  Excludes
+    ``use_pallas`` (both re-lay-out the same state; one flat-layout owner
+    per run, same rule as the per-batch paths).
 
     ``start_epoch`` (default 1 — same lowered program as always) offsets
     the scanned epoch numbers so a ``--resume-state`` continuation keeps
@@ -383,9 +412,16 @@ def make_fused_run(
     so a cold process reaches the hot loop with one device dispatch total —
     no separate init program to compile/load, no parameter upload.
     """
+    import math
+
     from ..ops.adadelta import adadelta_init as _tree_init
     from ..ops.pallas_adadelta import adadelta_init_flat, pallas_opt_active
 
+    if zero and pallas_opt_active(use_pallas):
+        raise ValueError(
+            "zero and use_pallas both re-lay-out the Adadelta state; "
+            "pick one"
+        )
     # Same layout decision the step's update dispatch makes: the kernel's
     # persistent padded-flat accumulators iff the kernel will actually run.
     adadelta_init = (
@@ -397,10 +433,26 @@ def make_fused_run(
         bn_axis=DATA_AXIS if use_bn else None, conv_impl=conv_impl,
     )
     n_shards = mesh.shape[DATA_AXIS]
+    if zero:
+        from .zero import ZeroAdadeltaState, zero_chunk, zero_state_spec
+    if zero and from_key:
+        # Static per-shard accumulator length for the in-program init,
+        # from the param shapes alone (eval_shape touches no device).
+        shapes = jax.eval_shape(
+            lambda k: model.init(
+                {"params": k}, jnp.zeros((1, 28, 28, 1), jnp.float32),
+                train=False,
+            ),
+            jax.random.PRNGKey(0),
+        )
+        n_params = sum(
+            math.prod(s.shape) for s in jax.tree.leaves(shapes["params"])
+        )
+        zero_chunk_len = zero_chunk(n_params, n_shards)
     local_epoch, num_batches = _local_epoch_builder(
         model, train_size, global_batch, n_shards,
         compute_dtype, rho, eps, dropout, use_pallas, use_bn=use_bn,
-        pregather=pregather,
+        pregather=pregather, zero=zero,
     )
     local_eval = _local_eval_builder(
         model, test_size, eval_batch, n_shards, compute_dtype, use_bn=use_bn
@@ -414,8 +466,17 @@ def make_fused_run(
                 {"params": state}, jnp.zeros((1, 28, 28, 1), jnp.float32),
                 train=False,
             )
+            if zero:
+                # This shard's LOCAL 1/N accumulator slice (the shard_map
+                # out-spec P('data') reassembles the global flat vector).
+                opt0 = ZeroAdadeltaState(
+                    square_avg=jnp.zeros((zero_chunk_len,), jnp.float32),
+                    acc_delta=jnp.zeros((zero_chunk_len,), jnp.float32),
+                )
+            else:
+                opt0 = adadelta_init(variables["params"])
             state = TrainState(
-                variables["params"], adadelta_init(variables["params"]),
+                variables["params"], opt0,
                 jnp.int32(0), variables["batch_stats"] if use_bn else (),
             )
 
@@ -441,11 +502,15 @@ def make_fused_run(
         gathered = jax.lax.all_gather(losses, DATA_AXIS)  # [shards, E, B]
         return state, jnp.moveaxis(gathered, 0, -1), evals
 
+    # ZeRO-1 state travels sharded: opt specs are P('data') in AND out
+    # (a from_key run has no state input — the key is replicated).
+    state_out_spec = zero_state_spec() if zero else P()
+    state_in_spec = P() if from_key else state_out_spec
     sharded = jax.shard_map(
         local_run,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
-        out_specs=(P(), P(), P()),
+        in_specs=(state_in_spec, P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(state_out_spec, P(), P()),
         check_vma=False,
     )
     donate = () if from_key else (0,)

@@ -266,17 +266,15 @@ def _fit_body(
     if syncbn and num_model > 1:
         raise ValueError("--syncbn rides the DP paths; drop --tp/--pp")
     # --zero (ZeRO-1: Adadelta state sharded over the data axis,
-    # parallel/zero.py) rides the per-batch DP loop; composes with
-    # --syncbn and --bf16, excludes the model-axis modes, the fused
-    # whole-run (replicated-optimizer program), and --pallas-opt (the
+    # parallel/zero.py) rides the DP paths — per-batch AND the fused
+    # whole-run (the epoch scan carries each shard's local accumulator
+    # slice; parallel/fused.py).  Composes with --syncbn, --bf16, and
+    # --pregather; excludes the model-axis modes and --pallas-opt (the
     # kernel's persistent layout is a different sharding of the same
     # state — one flat-layout owner per run).
     zero = bool(getattr(args, "zero", False))
     if zero and num_model > 1:
         raise ValueError("--zero rides the DP paths; drop --tp/--pp")
-    if zero and bool(getattr(args, "fused", False)):
-        raise ValueError("--fused runs the replicated-optimizer program; "
-                         "drop it for --zero")
     if zero and bool(getattr(args, "pallas_opt", False)):
         raise ValueError("--zero and --pallas-opt both re-lay-out the "
                          "Adadelta state; pick one")
@@ -426,12 +424,28 @@ def _fit_body(
             from_key=resume_path is None and loaded_state is None,
             use_bn=syncbn, start_epoch=epoch0 + 1,
             pregather=getattr(args, "pregather", False),
-            conv_impl=conv_impl,
+            conv_impl=conv_impl, zero=zero,
         )
         if loaded_state is not None:
-            lead = replicate_params(loaded_state, mesh)
+            if zero:
+                # Archives are per-leaf (portable); convert to the flat
+                # sharded accumulator layout on placement.
+                from .parallel.zero import shard_zero_state
+
+                lead = shard_zero_state(loaded_state, mesh)
+            else:
+                lead = replicate_params(loaded_state, mesh)
         elif resume_path is None:
             lead = keys["init"]
+        elif zero:
+            from .parallel.zero import make_zero_train_state
+
+            r_params, r_stats, r_step = _load_resume_variables(
+                resume_path, syncbn, keys["init"]
+            )
+            lead = make_zero_train_state(
+                r_params, mesh, r_stats, step0=r_step
+            )
         else:
             r_params, r_stats, r_step = _load_resume_variables(
                 resume_path, syncbn, keys["init"]

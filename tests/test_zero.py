@@ -257,7 +257,8 @@ def test_zero_vit_matches_single_device(devices):
 
 
 def test_fit_rejects_zero_flag_conflicts(devices):
-    """--zero excludes --fused / --pallas-opt / the model-axis modes."""
+    """--zero excludes --pallas-opt / the model-axis modes (--fused now
+    composes: parallel/fused.py zero=True)."""
     from types import SimpleNamespace
 
     from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
@@ -276,8 +277,6 @@ def test_fit_rejects_zero_flag_conflicts(devices):
         distributed=True, process_rank=0, process_count=1,
         world_size=8, devices=list(devices),
     )
-    with pytest.raises(ValueError, match="drop it for --zero"):
-        fit(args(fused=True), dist)
     with pytest.raises(ValueError, match="pick one"):
         fit(args(pallas_opt=True), dist)
     with pytest.raises(ValueError, match="drop --tp/--pp"):
