@@ -459,6 +459,13 @@ def test_vit_mode_flag_resolution():
         ["--flash", "--fused"],
         ["--pregather"],                               # needs --fused
         ["--fused", "--sp", "1", "--allow-degree-1"],  # fused is DP-only
+        ["--timings-json", "x.json"],                  # needs --fused
+        # --dry-run demotes --fused, so the attribution JSON would never
+        # be written — reject instead of exiting 0 without the file.
+        ["--timings-json", "x.json", "--fused", "--dry-run"],
     ):
         with _pytest.raises(SystemExit):
             resolve(bad)
+    # The valid combination still resolves.
+    _, args = resolve(["--timings-json", "x.json", "--fused"])
+    assert args.timings_json == "x.json"

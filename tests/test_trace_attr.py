@@ -69,6 +69,26 @@ def test_nested_events_get_self_time(tmp_path):
     assert r["by_category"]["convolution"]["time_s"] == pytest.approx(30e-6)
 
 
+def test_overlapping_non_nested_events_redistribute(tmp_path):
+    """A child whose end outruns its parent's (non-nested overlap, seen in
+    malformed/merged trace lines) must split its charge across ancestors:
+    busy_s stays exactly the covered span — neither the old undercount
+    (parent self zeroed) nor an overcount (overflow double-charged)."""
+    events = _meta(1, "/device:TPU:0", 10, "XLA Ops") + [
+        _op(1, 10, "while.1", 0.0, 100.0),     # grandparent [0, 100)
+        _op(1, 10, "fusion.1", 10.0, 20.0),    # parent      [10, 30)
+        _op(1, 10, "dot.1", 15.0, 30.0),       # child       [15, 45) — overlaps
+    ]
+    r = _run(_write_trace(tmp_path, events))
+    ops = {o["op"]: o["time_s"] for o in r["top_ops"]}
+    assert r["overlap_events"] == 1
+    assert ops["dot.1"] == pytest.approx(30e-6)      # full own span
+    assert ops["fusion.1"] == pytest.approx(5e-6)    # 20 - 15 in-span child
+    assert ops["while.1"] == pytest.approx(65e-6)    # 100 - 20 - 15 overflow
+    assert r["busy_s"] == pytest.approx(100e-6)      # == span, not 110
+    assert r["gap_share"] == pytest.approx(0.0)
+
+
 def test_host_threads_ignored_and_gaps_counted(tmp_path):
     """Only HLO-op lines count; a python host thread with huge spans must
     not be selected, and idle time between ops lands in gap_share."""

@@ -31,7 +31,7 @@ SHAPES = [(8, 16, 4, 16), (4, 512, 4, 64), (2, 2048, 4, 64),
           (1, 8192, 2, 64)]
 
 
-def main() -> None:
+def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--grad", action="store_true",
@@ -57,12 +57,9 @@ def main() -> None:
     if backend != "tpu" and not opts.allow_cpu:
         print(json.dumps({"error": f"backend {backend!r}; pass --allow-cpu "
                           "to run interpret-mode sanity timings"}))
-        sys.exit(1)
+        return 1
     if backend != "tpu":
         os.environ["TPU_MNIST_PALLAS_INTERPRET"] = "1"
-
-    from pytorch_mnist_ddp_tpu.ops.attention import full_attention
-    from pytorch_mnist_ddp_tpu.ops.pallas_attention import flash_attention
 
     def timed(fn, q, k, v, out_to_q=lambda r: r) -> float:
         """Per-call microseconds over a jitted scan whose carry feeds each
@@ -93,81 +90,112 @@ def main() -> None:
             rows.append({"shape": [b, t, h, d],
                          "skipped": f"over --budget-s {opts.budget_s}"})
             continue
-        key = jax.random.PRNGKey(0)
-        kq, kk, kv = jax.random.split(key, 3)
-        shape = (b, t, h, d)
-        q = jax.random.normal(kq, shape, jnp.float32)
-        k = jax.random.normal(kk, shape, jnp.float32)
-        v = jax.random.normal(kv, shape, jnp.float32)
-        row = {
-            "shape": list(shape),
-            "dense_scores_mb": round(b * h * t * t * 4 / 2**20, 1),
-            "dense_us": round(timed(full_attention, q, k, v), 2),
-            "flash_us": round(timed(flash_attention, q, k, v), 2),
-        }
-        if opts.grad:
-            def dense_loss(q, k, v):
-                return (full_attention(q, k, v) ** 2).sum()
-
-            def flash_loss(q, k, v):
-                return (flash_attention(q, k, v) ** 2).sum()
-
-            # Feed dq back as the next q, RMS-normalized so 50 chained
-            # grad calls can't decay/overflow the operands (the normalize
-            # is negligible next to the attention FLOPs).
-            def dq_carry(r):
-                dq = r[0]
-                rms = jnp.sqrt(jnp.mean(dq.astype(jnp.float32) ** 2) + 1e-12)
-                return (dq / rms).astype(dq.dtype)
-
-            row["dense_grad_us"] = round(
-                timed(jax.grad(dense_loss, argnums=(0, 1, 2)), q, k, v,
-                      out_to_q=dq_carry), 2
-            )
-            row["flash_grad_us"] = round(
-                timed(jax.grad(flash_loss, argnums=(0, 1, 2)), q, k, v,
-                      out_to_q=dq_carry), 2
-            )
-        if opts.parity:
-            # Non-interpret parity vs the dense oracle, the check the
-            # interpret-mode test suite cannot provide (round-3 verdict
-            # item 2).  Tolerances mirror tests/test_flash.py.
-            def max_err(a, b):
-                return float(jnp.abs(
-                    a.astype(jnp.float32) - b.astype(jnp.float32)
-                ).max())
-
-            def dense_l(q, k, v):
-                return (full_attention(q, k, v).astype(jnp.float32) ** 2).sum()
-
-            def flash_l(q, k, v):
-                return (flash_attention(q, k, v).astype(jnp.float32) ** 2).sum()
-
-            parity = {}
-            for label, dt, tol_f, tol_g in (
-                ("f32", jnp.float32, 1e-4, 1e-3),
-                ("bf16", jnp.bfloat16, 2e-2, 1e-1),
-            ):
-                qd, kd, vd = (a.astype(dt) for a in (q, k, v))
-                fwd_err = max_err(
-                    jax.jit(flash_attention)(qd, kd, vd),
-                    jax.jit(full_attention)(qd, kd, vd),
-                )
-                gf = jax.jit(jax.grad(flash_l, argnums=(0, 1, 2)))(qd, kd, vd)
-                gd = jax.jit(jax.grad(dense_l, argnums=(0, 1, 2)))(qd, kd, vd)
-                grad_err = max(max_err(a, b) for a, b in zip(gf, gd))
-                parity[label] = {
-                    "fwd_max_err": fwd_err,
-                    "grad_max_err": grad_err,
-                    "ok": bool(fwd_err < tol_f and grad_err < tol_g),
-                }
-            row["parity"] = parity
+        # Per-shape failure isolation (round-4 advisor): an OOM or compile
+        # failure at one shape (the big ones materialize ~0.5 GB dense
+        # scores; grad triples that) must not discard the rows already
+        # measured in this window — record an error row and move on.  Each
+        # finished row is also echoed to stderr immediately, so even a
+        # SIGKILL mid-ladder leaves the measurements in the .err sidecar.
+        try:
+            row = _bench_shape(opts, timed, (b, t, h, d))
+        except Exception as e:
+            row = {"shape": [b, t, h, d], "error": repr(e)[:300]}
+        print(f"row: {json.dumps(row)}", file=sys.stderr, flush=True)
         rows.append(row)
 
+    ring_smoke = _ring_smoke()
+    _emit(opts, rows, ring_smoke, backend)
+    return 0
+
+
+def _bench_shape(opts, timed, shape_tuple):
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_mnist_ddp_tpu.ops.attention import full_attention
+    from pytorch_mnist_ddp_tpu.ops.pallas_attention import flash_attention
+
+    b, t, h, d = shape_tuple
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, t, h, d)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    row = {
+        "shape": list(shape),
+        "dense_scores_mb": round(b * h * t * t * 4 / 2**20, 1),
+        "dense_us": round(timed(full_attention, q, k, v), 2),
+        "flash_us": round(timed(flash_attention, q, k, v), 2),
+    }
+    if opts.grad:
+        def dense_loss(q, k, v):
+            return (full_attention(q, k, v) ** 2).sum()
+
+        def flash_loss(q, k, v):
+            return (flash_attention(q, k, v) ** 2).sum()
+
+        # Feed dq back as the next q, RMS-normalized so 50 chained
+        # grad calls can't decay/overflow the operands (the normalize
+        # is negligible next to the attention FLOPs).
+        def dq_carry(r):
+            dq = r[0]
+            rms = jnp.sqrt(jnp.mean(dq.astype(jnp.float32) ** 2) + 1e-12)
+            return (dq / rms).astype(dq.dtype)
+
+        row["dense_grad_us"] = round(
+            timed(jax.grad(dense_loss, argnums=(0, 1, 2)), q, k, v,
+                  out_to_q=dq_carry), 2
+        )
+        row["flash_grad_us"] = round(
+            timed(jax.grad(flash_loss, argnums=(0, 1, 2)), q, k, v,
+                  out_to_q=dq_carry), 2
+        )
+    if opts.parity:
+        # Non-interpret parity vs the dense oracle, the check the
+        # interpret-mode test suite cannot provide (round-3 verdict
+        # item 2).  Tolerances mirror tests/test_flash.py.
+        def max_err(a, b):
+            return float(jnp.abs(
+                a.astype(jnp.float32) - b.astype(jnp.float32)
+            ).max())
+
+        def dense_l(q, k, v):
+            return (full_attention(q, k, v).astype(jnp.float32) ** 2).sum()
+
+        def flash_l(q, k, v):
+            return (flash_attention(q, k, v).astype(jnp.float32) ** 2).sum()
+
+        parity = {}
+        for label, dt, tol_f, tol_g in (
+            ("f32", jnp.float32, 1e-4, 1e-3),
+            ("bf16", jnp.bfloat16, 2e-2, 1e-1),
+        ):
+            qd, kd, vd = (a.astype(dt) for a in (q, k, v))
+            fwd_err = max_err(
+                jax.jit(flash_attention)(qd, kd, vd),
+                jax.jit(full_attention)(qd, kd, vd),
+            )
+            gf = jax.jit(jax.grad(flash_l, argnums=(0, 1, 2)))(qd, kd, vd)
+            gd = jax.jit(jax.grad(dense_l, argnums=(0, 1, 2)))(qd, kd, vd)
+            grad_err = max(max_err(a, b) for a, b in zip(gf, gd))
+            parity[label] = {
+                "fwd_max_err": fwd_err,
+                "grad_max_err": grad_err,
+                "ok": bool(fwd_err < tol_f and grad_err < tol_g),
+            }
+        row["parity"] = parity
+    return row
+
+
+def _ring_smoke():
     # Ring-kernel smoke: flash_block_update under a VMA-tracking
     # shard_map on the real chip (a 1x1 mesh degenerates the ring to the
     # resident fold) — the CPU tests route this path to the pure-JAX twin,
     # so hardware is the only place the kernel-under-VMA trace runs.
+    import jax
+    import jax.numpy as jnp
+
     ring_smoke = None
     try:
         from jax.sharding import PartitionSpec as P
@@ -215,6 +243,11 @@ def main() -> None:
         }
     except Exception as e:  # noqa: BLE001 — recorded, not fatal
         ring_smoke = {"ok": False, "error": repr(e)[:300]}
+    return ring_smoke
+
+
+def _emit(opts, rows, ring_smoke, backend):
+    import jax
 
     print(json.dumps({
         "metric": "attention_call_us",
@@ -227,4 +260,4 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -85,8 +85,13 @@ def main() -> int:
     rng = np.random.RandomState(0)
     images = jnp.asarray(rng.randint(0, 256, (60000, 28, 28), dtype=np.uint8))
     labels = jnp.asarray(rng.randint(0, 10, 60000).astype(np.int32))
-    perm = jnp.asarray(rng.permutation(60000)[: args.steps * args.batch]
-                       .reshape(args.steps, args.batch))
+    # Tiled modulo the dataset so steps*batch > 60000 wraps (the fused
+    # path's semantics) instead of dying on a reshape error (round-4
+    # advisor); for steps*batch <= 60000 this is exactly the old slice.
+    idx = np.arange(args.steps * args.batch) % 60000
+    perm = jnp.asarray(
+        rng.permutation(60000)[idx].reshape(args.steps, args.batch)
+    )
     fixed_x = _normalize_dev(images[: args.batch], compute_dtype)
     fixed_y = labels[: args.batch]
     w = jnp.ones((args.batch,), jnp.float32)

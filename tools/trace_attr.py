@@ -127,7 +127,7 @@ def attribute(trace_dir: str, top: int = 25) -> dict:
     for key, evs in raw.items():
         evs.sort(key=lambda t: (t[0], -t[1]))
         rec = {"busy": 0.0, "n": len(evs), "t0": evs[0][0], "t1": 0.0,
-               "ops": defaultdict(lambda: [0.0, 0])}
+               "overlap": 0, "ops": defaultdict(lambda: [0.0, 0])}
         stack: list[list] = []  # [end_ts, op, child_time_us, start_ts]
         def _pop(entry):
             end, op, child, start = entry
@@ -137,7 +137,24 @@ def attribute(trace_dir: str, top: int = 25) -> dict:
             slot[0] += self_us
             slot[1] += 1
             if stack:
-                stack[-1][2] += end - start
+                # Charge this event's span to its ancestors' child-time.
+                # Nested events charge the immediate parent in full; an
+                # overlapping NON-nested event (end outruns the parent's)
+                # is split — the in-parent slice to the parent, the
+                # overflow to whichever ancestor spans it — so neither the
+                # parent's self-time is zeroed (old undercount) nor the
+                # overflow double-counted at the grandparent (overcount).
+                seg_start, overflowed = start, False
+                for frame in reversed(stack):
+                    contrib = min(end, frame[0]) - seg_start
+                    if contrib > 0:
+                        frame[2] += contrib
+                    if end <= frame[0]:
+                        break
+                    overflowed = True
+                    seg_start = max(seg_start, frame[0])
+                if overflowed:
+                    rec["overlap"] += 1
         for ts, dur, op in evs:
             while stack and stack[-1][0] <= ts:
                 _pop(stack.pop())
@@ -175,6 +192,11 @@ def attribute(trace_dir: str, top: int = 25) -> dict:
         "process": proc_names.get(main_key[0], "?"),
         "thread": thread_names.get(main_key, "?"),
         "op_events": main["n"],
+        # Non-nested overlapping events seen on the main line; their spans
+        # were redistributed across ancestors during the self-time walk,
+        # so busy_s stays exact — nonzero just flags that the trace was
+        # not purely nested (per-op attribution is then approximate).
+        "overlap_events": int(main["overlap"]),
         "span_s": round(span_us / 1e6, 6),
         "busy_s": round(busy_us / 1e6, 6),
         "gap_share": round(1.0 - busy_us / span_us, 3) if span_us else None,

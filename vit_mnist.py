@@ -201,6 +201,15 @@ def resolve_mode_flags(args) -> tuple[bool, bool]:
         )
     if args.pregather and not args.fused:
         raise SystemExit("--pregather is the fused input path; add --fused")
+    if args.timings_json and not (args.fused and not args.dry_run):
+        # The attribution JSON is produced only by the fused AOT split;
+        # --dry-run demotes --fused to the per-batch smoke, so exiting 0
+        # without writing PATH would read as a missing-timings run to a
+        # consumer like tools/vit_bench.py (round-4 advisor).
+        raise SystemExit(
+            "--timings-json needs the fused whole-run; "
+            + ("drop --dry-run" if args.fused else "add --fused")
+        )
     if args.fused and (sp_on or tp_on or args.pp or args.experts > 0):
         raise SystemExit(
             "--fused is the data-parallel whole-run; drop --sp/--tp/--pp/"
