@@ -1,9 +1,10 @@
-"""Turn the round-4 attribution artifacts into the docs/PERF.md verdict.
+"""Turn the tunnel-window attribution artifacts into the docs/PERF.md verdict.
 
 The watcher runs this after its ladder/trace legs each tunnel window:
-it reads whichever of ``bench_r4_stepattr.json`` /
-``bench_r4_stepattr_bf16.json`` / ``bench_r4_attr.json`` /
-``bench_r4_warm.json`` exist, computes the rung deltas and the run_s
+it reads whichever of ``bench_r*_stepattr.json`` (plus the bf16 and
+conv-impl ladder variants) / ``bench_r*_attr.json`` /
+``bench_r*_warm.json`` exist (newest round first, so a round-5 artifact
+shadows its round-4 namesake), computes the rung deltas and the run_s
 reconciliation from docs/PERF.md's decision rules, APPENDS a dated
 analysis block to docs/PERF.md, and prints the same block to stdout —
 so the analysis lands as a commit even when the window opens after the
@@ -31,9 +32,26 @@ EVAL_BATCHES = 200
 EPOCHS = 20
 
 
-def _load(name):
+def _detect_prefix():
+    """The newest round whose BASELINE ladder exists (bench_rN_stepattr
+    .json, glob-resolved so a future round needs no edit here).  Every
+    companion artifact is then loaded under the SAME prefix — mixing
+    rounds would compute flip/keep verdicts from numbers measured under
+    different cache/throughput regimes (tunnel throughput is bimodal)."""
+    import glob
+    import re
+
+    rounds = []
+    for path in glob.glob(os.path.join(REPO, "bench_r*_stepattr.json")):
+        m = re.match(r"bench_r(\d+)_stepattr\.json$", os.path.basename(path))
+        if m:
+            rounds.append(int(m.group(1)))
+    return f"bench_r{max(rounds)}_" if rounds else None
+
+
+def _load(suffix, prefix):
     try:
-        with open(os.path.join(REPO, name)) as f:
+        with open(os.path.join(REPO, prefix + suffix)) as f:
             return json.load(f)
     except (OSError, ValueError):
         return None
@@ -44,18 +62,24 @@ def _fmt_us(v):
 
 
 def build_report() -> str | None:
-    ladder = _load("bench_r4_stepattr.json")
+    prefix = _detect_prefix()
+    if prefix is None:
+        return None
+    ladder = _load("stepattr.json", prefix)
     if not ladder or ladder.get("full") is None:
         return None
-    bf16 = _load("bench_r4_stepattr_bf16.json")
-    attr = _load("bench_r4_attr.json")
-    warm = _load("bench_r4_warm.json")
+    bf16 = _load("stepattr_bf16.json", prefix)
+    attr = _load("attr.json", prefix)
+    warm = _load("warm.json", prefix)
+    # Conv-lowering ladder variants (round-5: the conv1 MXU question).
+    conv_c1 = _load("stepattr_im2col_c1.json", prefix)
+    conv_all = _load("stepattr_im2col.json", prefix)
 
     g = ladder.get  # µs per iteration, or None
     lines = []
     stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     lines.append(f"### Window analysis — {stamp} "
-                 f"({ladder.get('device_kind', '?')})")
+                 f"({ladder.get('device_kind', '?')}; artifacts {prefix}*)")
     lines.append("")
     lines.append("| Rung | µs/iter |")
     lines.append("|---|---|")
@@ -129,7 +153,7 @@ def build_report() -> str | None:
             verdicts.append(
                 f"The step is {fb / fu:.0%} fwd+bwd compute: the floor is "
                 f"compute/layout-bound at these conv shapes, not overhead "
-                f"— see the per-op table ({'bench_r4_attr.json' if attr else 'trace pending'}) "
+                f"— see the per-op table ({'bench_r*_attr.json' if attr else 'trace pending'}) "
                 f"for the conv1/conv2 split."
             )
         else:
@@ -143,12 +167,24 @@ def build_report() -> str | None:
             f"bf16 ladder: full {bf16['full']:,.1f} µs vs f32 {fu:,.1f} µs "
             f"({1 - bf16['full'] / fu:+.0%})."
         )
+    for label, lad in (("im2col_c1", conv_c1), ("im2col", conv_all)):
+        if lad and lad.get("full") and fu:
+            win = 1 - lad["full"] / fu
+            verdicts.append(
+                f"conv ladder ({label}): full {lad['full']:,.1f} µs vs "
+                f"native-conv {fu:,.1f} µs ({win:+.0%})"
+                + (f"; fwd {lad['fwd']:,.1f} vs {g('fwd'):,.1f} µs"
+                   if lad.get("fwd") and g("fwd") else "")
+                + (" — flip `--conv-impl` after an end-to-end "
+                   "`bench.py --conv-impl` row confirms" if win > 0.05
+                   else " — keep the native conv.")
+            )
     if attr and attr.get("gap_share") is not None:
         verdicts.append(
             f"Trace: device busy {attr.get('busy_s')}s over "
             f"{attr.get('span_s')}s span — gap share "
             f"{attr['gap_share']:.0%}; top category: "
-            f"{next(iter(attr.get('by_category', {'?': None})))}."
+            f"{next(iter(attr.get('by_category') or {}), '?')}."
         )
     for v in verdicts:
         lines.append(f"- {v}")
@@ -162,7 +198,7 @@ def main() -> int:
     args = p.parse_args()
     report = build_report()
     if report is None:
-        print("perf_report: no ladder artifact (bench_r4_stepattr.json) "
+        print("perf_report: no ladder artifact (bench_r*_stepattr.json) "
               "yet", file=sys.stderr)
         return 1
     print(report)

@@ -1,23 +1,26 @@
 #!/bin/bash
-# Round-long accelerator-tunnel watcher (round-3 verdict, next-round items
-# 1-4 and 6).
+# Round-long accelerator-tunnel watcher (round-5: VERDICT items 1, 2, 6).
 #
 # The TPU tunnel on this host is up only in short windows (round 2: one
-# 8-minute window in ~20 hours; round 3: ~80 s windows).  This script polls
-# cheaply and, the moment the chip answers, runs the window playbook in
-# value order (headline first, evidence-gap fillers next, variants last)
-# so a drop mid-window still lands the most important artifacts:
-#   0. real-MNIST IDX fetch attempt (verdict item 3; logged durably)
+# 8-minute window in ~20 hours; round 3: ~80 s windows; round 4: none).
+# This script polls cheaply and, the moment the chip answers, runs the
+# window playbook in value order (headline first, the round-5 attribution
+# ladders next, variants last) so a drop mid-window still lands the most
+# important artifacts:
+#   0. real-MNIST IDX fetch attempt (digest-verified; logged durably)
 #   1. headline bench — re-warm + warm record (min-by-value promotion)
-#   2. flash-attention micro-bench + compiled-mode parity (verdict item 2)
-#   3. ViT fused bench with run/compile/data attribution (verdict item 4)
-#   4. fused-step profiler trace -> committed per-op attribution (item 1)
-#   5. variant rows: bf16, pallas-opt, syncbn, zero-quick, ViT sp/tp/pp
+#   2. step-attribution ladders: f32, conv-impl variants (THE round-5
+#      decision data: does GEMM-lowering conv1 move the 0.83 ms floor?)
+#   3. fused-step profiler trace -> committed per-op attribution
+#   4. flash-attention micro-bench + compiled-mode parity
+#   5. ViT fused bench with run/compile/data attribution
+#   6. variant rows: bf16, pallas-opt, pregather, conv-impl end-to-end,
+#      syncbn, fused-zero, ViT sp/tp/pp modes, bf16 ladder, micro
 # After each major group the artifacts are git-committed: machine resets
 # wipe uncommitted files (round 3 lost the 47 MB trace this way), so
 # durability means a commit, not a file.
 #
-# Usage: nohup bash tools/tunnel_watch.sh >>/tmp/tunnel_watch_r4.log 2>&1 &
+# Usage: nohup bash tools/tunnel_watch.sh >>/tmp/tunnel_watch_r5.log 2>&1 &
 # NEVER edit this file while an instance runs (bash re-reads mid-execution):
 # kill, edit, relaunch.
 set -u
@@ -42,21 +45,21 @@ run_bench() { # $1 = tag, rest = extra bench.py args
     # the structured failure JSON is always written before SIGTERM.
     timeout $((BENCH_TIMEOUT_S + 180)) \
         python "$REPO/bench.py" --probe-attempts 1 --run-timeout "$BENCH_TIMEOUT_S" "$@" \
-        >"$OUT/bench_r4_${tag}.json" 2>"$OUT/bench_r4_${tag}.err"
+        >"$OUT/bench_r5_${tag}.json" 2>"$OUT/bench_r5_${tag}.err"
     local rc=$?
-    echo "[$(stamp)] bench $tag rc=$rc: $(cat "$OUT/bench_r4_${tag}.json" 2>/dev/null | head -c 400)"
+    echo "[$(stamp)] bench $tag rc=$rc: $(cat "$OUT/bench_r5_${tag}.json" 2>/dev/null | head -c 400)"
     return $rc
 }
 
 is_warm() { # $1 = tag; true if that run's JSON recorded a warm cache
-    grep -q '"cache": "warm"' "$OUT/bench_r4_$1.json" 2>/dev/null
+    grep -q '"cache": "warm"' "$OUT/bench_r5_$1.json" 2>/dev/null
 }
 
 promote() { # $1 = src tag, $2 = dst tag; copy ONLY if src beats dst.
     # Tunnel throughput is bimodal (9.3 s vs 61.8 s for the same warm
     # program minutes apart): every recorded row is min-by-value, never
     # latest-wins.  The .err sidecar travels with its json.
-    python - "$OUT/bench_r4_$1" "$OUT/bench_r4_$2" <<'EOF'
+    python - "$OUT/bench_r5_$1" "$OUT/bench_r5_$2" <<'EOF'
 import json, os, shutil, sys
 src, dst = sys.argv[1], sys.argv[2]
 new = json.load(open(src + ".json"))["value"]
@@ -74,26 +77,43 @@ else:
 EOF
 }
 
+ladder() { # $1 = tag suffix, rest = extra step_attr_bench.py args
+    local tag="$1"; shift
+    echo "[$(stamp)] step-attribution ladder ($tag)"
+    # ~11 rungs x ~20 s cold compile each through the tunnel on the first
+    # window; the persistent cache makes later windows warm.
+    timeout 600 python "$REPO/tools/step_attr_bench.py" "$@" \
+        >"$OUT/bench_r5_stepattr_${tag}.json" 2>"$OUT/bench_r5_stepattr_${tag}.err"
+    local rc=$?
+    echo "[$(stamp)] stepattr-$tag rc=$rc: $(head -c 400 "$OUT/bench_r5_stepattr_${tag}.json" 2>/dev/null)"
+    return $rc
+}
+
 commit_artifacts() { # $1 = note.  Durability = a commit, not a file.
     ( cd "$REPO" || exit 1
       # Each path group added separately and force-added (-f): a missing
       # file or a stray ignore rule must not abort staging of the rest
       # (a single `git add a b c` exits 128 on the first unmatched
       # pathspec and stages NOTHING — round-4 review finding).
-      for p in bench_r4_*.json bench_r4_*.err bench_last_good.json \
+      for p in bench_r5_*.json bench_r5_*.err bench_last_good.json \
                data/idx_attempts.log; do
           git add -f -- "$p" 2>/dev/null || true
       done
       # Commit only if the index actually changed; retry once on a lock
-      # race with an interactive session.
+      # race with an interactive session.  The success line is gated on
+      # the commit's exit status (round-4 advisor: an unconditional echo
+      # claimed durability while the artifacts stayed reset-volatile).
       if ! git diff --cached --quiet 2>/dev/null; then
-          git commit -q -m "watcher: tunnel-window artifacts ($1)" \
-              || { sleep 20; git commit -q -m "watcher: tunnel-window artifacts ($1)"; }
-          echo "[$(stamp)] committed artifacts ($1)"
-      fi ) || echo "[$(stamp)] artifact commit failed ($1)"
+          if git commit -q -m "watcher: tunnel-window artifacts ($1)" \
+              || { sleep 20; git commit -q -m "watcher: tunnel-window artifacts ($1)"; }; then
+              echo "[$(stamp)] committed artifacts ($1)"
+          else
+              echo "[$(stamp)] artifact commit FAILED ($1) — retry next group"
+          fi
+      fi ) || echo "[$(stamp)] artifact commit FAILED ($1)"
 }
 
-echo "[$(stamp)] r4 watcher up, polling every ${POLL_S}s"
+echo "[$(stamp)] r5 watcher up, polling every ${POLL_S}s"
 while true; do
     if probe; then
         echo "[$(stamp)] TUNNEL UP — window playbook"
@@ -116,82 +136,85 @@ while true; do
             fi
         fi
         commit_artifacts "headline"
-        # --- 2: flash kernel on hardware (verdict item 2) ---------------
-        echo "[$(stamp)] flash-attention bench + compiled parity"
-        # Outer bound > the tool's own --budget-s soft limit (it skips
-        # remaining shapes once over budget and still prints its JSON):
-        # a SIGTERM here would discard ALL rows, the worse failure.
-        timeout 900 python "$REPO/tools/flash_bench.py" --grad --parity --budget-s 700 \
-            >"$OUT/bench_r4_flash.json" 2>"$OUT/bench_r4_flash.err" \
-            && echo "[$(stamp)] flash: $(head -c 400 "$OUT/bench_r4_flash.json")" \
-            || echo "[$(stamp)] flash bench failed rc=$?"
-        # --- 3: ViT fused bench with attribution (verdict item 4) -------
-        echo "[$(stamp)] vit bench"
-        timeout 480 python "$REPO/tools/vit_bench.py" \
-            >"$OUT/bench_r4_vit_run.json" 2>"$OUT/bench_r4_vit_run.err" \
-            && echo "[$(stamp)] vit: $(promote vit_run vit)" \
-            || echo "[$(stamp)] vit bench failed rc=$?"
-        commit_artifacts "flash+vit"
-        # --- 4a: step-variant decomposition ladder (verdict item 1):
-        # warm per-step us for empty scan / gather / fwd / fwd+bwd /
-        # full±dropout±gather — attributes the ~0.8 ms floor by
-        # construction, independent of the trace path below.
-        echo "[$(stamp)] step-attribution ladder"
-        # 10 rungs x ~20 s cold compile each through the tunnel on the
-        # first window; the persistent cache makes later windows warm.
-        timeout 600 python "$REPO/tools/step_attr_bench.py" \
-            >"$OUT/bench_r4_stepattr.json" 2>"$OUT/bench_r4_stepattr.err" \
-            && echo "[$(stamp)] stepattr: $(head -c 400 "$OUT/bench_r4_stepattr.json")" \
-            || echo "[$(stamp)] stepattr failed rc=$?"
-        # --- 4: fused-step trace -> per-op attribution (verdict item 1) -
+        # --- 2: the round-5 decision ladders ---------------------------
+        # f32 baseline rungs, then the conv-lowering variants: adjacent
+        # deltas attribute the ~0.83 ms/step floor and decide --conv-impl.
+        # Committed after EACH ladder (a reset mid-group must not wipe a
+        # completed one), and the unsuffixed copy perf_report reads is
+        # refreshed only on a successful f32 run — a truncated later
+        # artifact must never clobber a good committed baseline.
+        if ladder f32; then
+            cp "$OUT/bench_r5_stepattr_f32.json" "$OUT/bench_r5_stepattr.json"
+        fi
+        commit_artifacts "ladder-f32"
+        ladder im2col_c1 --conv-impl im2col_c1
+        commit_artifacts "ladder-im2col-c1"
+        ladder im2col --conv-impl im2col
+        commit_artifacts "ladder-im2col"
+        # --- 3: fused-step trace -> per-op attribution ------------------
         # The trace itself is huge and reset-volatile: keep it in /tmp and
         # commit only the distilled attribution JSON.
         echo "[$(stamp)] fused trace capture + attribution"
         timeout 300 python "$REPO/mnist_ddp.py" --fused --epochs 2 \
-            --batch-size 200 --profile /tmp/trace_r4 \
-            >/tmp/trace_r4_run.log 2>&1 \
-            && timeout 120 python "$REPO/tools/trace_attr.py" /tmp/trace_r4 \
-                --out "$OUT/bench_r4_attr.json" \
-                >>"$OUT/bench_r4_attr.json.err" 2>&1 \
-            && echo "[$(stamp)] attr: $(head -c 400 "$OUT/bench_r4_attr.json")" \
-            || echo "[$(stamp)] trace/attr failed rc=$? (see /tmp/trace_r4_run.log)"
-        ( cd "$REPO" && git add bench_r4_attr.json 2>/dev/null ) || true
+            --batch-size 200 --profile /tmp/trace_r5 \
+            >/tmp/trace_r5_run.log 2>&1 \
+            && timeout 120 python "$REPO/tools/trace_attr.py" /tmp/trace_r5 \
+                --out "$OUT/bench_r5_attr.json" \
+                >>"$OUT/bench_r5_attr.json.err" 2>&1 \
+            && echo "[$(stamp)] attr: $(head -c 400 "$OUT/bench_r5_attr.json")" \
+            || echo "[$(stamp)] trace/attr failed rc=$? (see /tmp/trace_r5_run.log)"
         commit_artifacts "trace-attr"
-        # --- 5: variant rows (each min-by-value) ------------------------
+        # --- 4: flash kernel on hardware --------------------------------
+        echo "[$(stamp)] flash-attention bench + compiled parity"
+        # Outer bound > the tool's own --budget-s soft limit (it skips
+        # remaining shapes once over budget and still prints its JSON);
+        # per-shape try/except keeps earlier rows on an OOM at one shape.
+        timeout 900 python "$REPO/tools/flash_bench.py" --grad --parity --budget-s 700 \
+            >"$OUT/bench_r5_flash.json" 2>"$OUT/bench_r5_flash.err" \
+            && echo "[$(stamp)] flash: $(head -c 400 "$OUT/bench_r5_flash.json")" \
+            || echo "[$(stamp)] flash bench failed rc=$?"
+        # --- 5: ViT fused bench with attribution ------------------------
+        echo "[$(stamp)] vit bench"
+        timeout 480 python "$REPO/tools/vit_bench.py" \
+            >"$OUT/bench_r5_vit_run.json" 2>"$OUT/bench_r5_vit_run.err" \
+            && echo "[$(stamp)] vit: $(promote vit_run vit)" \
+            || echo "[$(stamp)] vit bench failed rc=$?"
+        commit_artifacts "flash+vit"
+        # --- 6: variant rows (each min-by-value) ------------------------
         run_bench bf16_run --bf16 && echo "[$(stamp)] bf16: $(promote bf16_run bf16)"
         run_bench pallas_run --pallas-opt && echo "[$(stamp)] pallas: $(promote pallas_run pallas)"
         # The pre-permuted-epoch input path (bit-identical batches, HLO
         # differs): decision row for flipping the headline's input path.
         run_bench pregather_run --pregather && echo "[$(stamp)] pregather: $(promote pregather_run pregather)"
+        # End-to-end conv-lowering rows (pair with the ladder rungs above
+        # before any default flip).
+        run_bench conv_c1_run --conv-impl im2col_c1 && echo "[$(stamp)] conv_c1: $(promote conv_c1_run conv_c1)"
+        run_bench conv_all_run --conv-impl im2col && echo "[$(stamp)] conv_all: $(promote conv_all_run conv_all)"
         run_bench syncbn_run --syncbn && echo "[$(stamp)] syncbn: $(promote syncbn_run syncbn)"
-        # ZeRO-1 per-batch dispatch through the tunnel is ~120 ms/step:
-        # only the 2-epoch --quick protocol fits a short window.
-        run_bench zero_run --zero --quick && echo "[$(stamp)] zero: $(promote zero_run zero)"
-        # ViT mode smoke rows (verdict item 6): every shipped mode gets at
-        # least one hardware number.  2-epoch quick protocol per mode.
+        # ZeRO-1 now rides the fused whole-run (round-5): a full-protocol
+        # row is one compile + one dispatch, same as the headline.
+        run_bench zero_run --zero && echo "[$(stamp)] zero: $(promote zero_run zero)"
+        # ViT mode smoke rows: every shipped mode gets at least one
+        # hardware number.  2-epoch quick protocol per mode.
         for mode in sp sp-ulysses tp flash zero; do
             echo "[$(stamp)] vit mode smoke: $mode"
             timeout 480 python "$REPO/tools/vit_bench.py" --mode "$mode" --epochs 2 \
-                >"$OUT/bench_r4_vit_${mode}_run.json" 2>"$OUT/bench_r4_vit_${mode}_run.err" \
+                >"$OUT/bench_r5_vit_${mode}_run.json" 2>"$OUT/bench_r5_vit_${mode}_run.err" \
                 && echo "[$(stamp)] vit-$mode: $(promote "vit_${mode}_run" "vit_$mode")" \
                 || echo "[$(stamp)] vit-$mode failed rc=$?"
         done
         # The bf16 ladder (explains why --bf16 moved run_s only 4%).
-        echo "[$(stamp)] step-attribution ladder (bf16)"
-        timeout 600 python "$REPO/tools/step_attr_bench.py" --bf16 \
-            >"$OUT/bench_r4_stepattr_bf16.json" 2>"$OUT/bench_r4_stepattr_bf16.err" \
-            && echo "[$(stamp)] stepattr-bf16: $(head -c 400 "$OUT/bench_r4_stepattr_bf16.json")" \
-            || echo "[$(stamp)] stepattr-bf16 failed rc=$?"
+        ladder bf16 --bf16
         # Pallas optimizer micro-benchmark (decision data for the kernel).
         python "$REPO/tools/pallas_opt_bench.py" \
-            >"$OUT/bench_r4_pallas_micro.json" 2>"$OUT/bench_r4_pallas_micro.err" \
-            && echo "[$(stamp)] micro: $(cat "$OUT/bench_r4_pallas_micro.json")" \
+            >"$OUT/bench_r5_pallas_micro.json" 2>"$OUT/bench_r5_pallas_micro.err" \
+            && echo "[$(stamp)] micro: $(cat "$OUT/bench_r5_pallas_micro.json")" \
             || echo "[$(stamp)] micro-bench failed rc=$?"
         # Distill everything this window produced into docs/PERF.md's
         # results section and commit it: the analysis lands even if no
         # interactive session is alive when this window opens.
         timeout 60 python "$REPO/tools/perf_report.py" \
-            >>"$OUT/bench_r4_perf_report.log" 2>&1 \
+            >>"$OUT/bench_r5_perf_report.log" 2>&1 \
             && ( cd "$REPO" && git add docs/PERF.md 2>/dev/null ) \
             && echo "[$(stamp)] perf report appended" \
             || echo "[$(stamp)] perf report skipped rc=$?"
