@@ -34,23 +34,32 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_world(tmp_path, mode: str, expect_error: str | None = None) -> list:
+def _run_world(
+    tmp_path,
+    mode: str,
+    expect_error: str | None = None,
+    n_procs: int = 2,
+    n_local: int = 4,
+) -> list:
+    """Form an ``n_procs x n_local``-device world (8 devices total in
+    every configuration used here) and run one worker per process.
+    Returns ``[rank0_arrays, ..., rankN_arrays, logs]``."""
     root = _write_idx(tmp_path)
     port = _free_port()
     procs, outs = [], []
-    for rank in range(2):
+    for rank in range(n_procs):
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env.update(
             PYTHONPATH=os.path.dirname(os.path.dirname(_WORKER)),
             RANK=str(rank),
-            WORLD_SIZE="2",
+            WORLD_SIZE=str(n_procs),
             LOCAL_RANK="0",
             MASTER_ADDR="127.0.0.1",
             MASTER_PORT=str(port),
-            NPROC_PER_NODE="4",
+            NPROC_PER_NODE=str(n_local),
             JAX_PLATFORMS="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={n_local}",
         )
         out = str(tmp_path / f"rank{rank}.npz")
         outs.append(out)
@@ -65,9 +74,12 @@ def _run_world(tmp_path, mode: str, expect_error: str | None = None) -> list:
             )
         )
     logs = []
+    # More controllers rendezvous and compile more slowly under CPU
+    # contention: scale the bound with the world's process count.
+    deadline = 420 + 120 * (n_procs - 2)
     for p in procs:
         try:
-            stdout, _ = p.communicate(timeout=420)
+            stdout, _ = p.communicate(timeout=deadline)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -183,40 +195,52 @@ def test_two_process_resume_divergent_files_refused(tmp_path):
 
 
 @pytest.mark.parametrize(
-    "mode", ["batch", "fused", "tp", "pp", "syncbn", "zero"]
+    "n_procs,n_local,mode",
+    # The full mode matrix at 2 processes x 4 local devices, plus the
+    # 4-process x 2-device formation of the SAME 8-device world (round-4
+    # verdict item 4: multi-host coverage beyond 2 processes) for the
+    # pure-DP and ZeRO legs — pmean crosses three process boundaries and
+    # the flat optimizer shards split 2/2/2/2 across the controllers.
+    [(2, 4, m) for m in ("batch", "fused", "tp", "pp", "syncbn", "zero")]
+    + [(4, 2, "batch"), (4, 2, "zero")],
+    ids=lambda v: str(v),
 )
-def test_two_process_world_replica_consistency(tmp_path, mode):
+def test_process_world_replica_consistency(tmp_path, n_procs, n_local, mode):
     """batch/fused: pure DP replica consistency.  tp: the (data=4, model=2)
     mesh spans the process boundary — multi-controller shard placement,
     cross-process logits psum, and the gathered params must still be
-    identical on both processes.  pp: the same mesh pipelined — per-tick
+    identical on every process.  pp: the same mesh pipelined — per-tick
     activation/cotangent ppermute and the stage-axis grad psum cross the
     process boundary.  syncbn: the per-step BN statistics psum crosses the
     boundary, so the dumped running averages (bn*.running_*) must be
     bit-identical too.  zero: ZeRO-1 — the optimizer-state shards split
-    4/4 across the processes, and the per-step gradient psum_scatter /
-    delta all_gather cross the boundary; replicated params must still
+    evenly across the processes, and the per-step gradient psum_scatter /
+    delta all_gather cross every boundary; replicated params must still
     end bit-identical."""
-    r0, r1, logs = _run_world(tmp_path, mode)
-    # Replica/shard consistency: both processes hold bit-identical params
+    *ranks, logs = _run_world(tmp_path, mode, n_procs=n_procs, n_local=n_local)
+    assert len(ranks) == n_procs
+    r0 = ranks[0]
+    # Replica/shard consistency: every process holds bit-identical params
     # (for syncbn this includes the BN scale/bias and running statistics).
     param_keys = [k for k in r0 if k not in ("avg_loss", "correct")]
     assert len(param_keys) == (16 if mode == "syncbn" else 8)
     if mode == "syncbn":
         assert "bn1.running_mean" in param_keys
-    for k in param_keys:
-        np.testing.assert_array_equal(r0[k], r1[k], err_msg=k)
+    for i, r in enumerate(ranks[1:], start=1):
+        for k in param_keys:
+            np.testing.assert_array_equal(
+                r0[k], r[k], err_msg=f"rank {i}: {k}"
+            )
+        # psum correctness: identical global eval totals on every process
+        # (tp/pp evaluate over their 2-D training mesh after the gather).
+        assert r["correct"] == r0["correct"]
+        np.testing.assert_allclose(r["avg_loss"], r0["avg_loss"], rtol=1e-6)
     assert r0["fc1.weight"].shape == (9216, 128)  # full gathered tensor
-    # psum correctness: identical global eval totals on every process
-    # (tp/pp evaluate over their 2-D training mesh after the gather).
-    assert r0["correct"] == r1["correct"]
-    np.testing.assert_allclose(r0["avg_loss"], r1["avg_loss"], rtol=1e-6)
     assert 0 <= int(r0["correct"]) <= 256
     # Learning: chief's logged train losses fall across the run.
-    chief_log = logs[0]
     losses = [
         float(line.rsplit("Loss:", 1)[1])
-        for line in chief_log.splitlines()
+        for line in logs[0].splitlines()
         if line.startswith("Train Epoch")
     ]
     assert len(losses) >= 4
