@@ -45,6 +45,7 @@ def make_fused_vit_run(
     eps: float = 1e-6,
     start_epoch: int = 1,
     pregather: bool = False,
+    zero: bool = False,
 ):
     """Build the whole-run fusion for the ViT.
 
@@ -52,9 +53,14 @@ def make_fused_vit_run(
     te_x, te_y, shuffle_key, lrs) -> (state, losses[epochs, num_batches,
     n_shards], evals[epochs, 2])`` — the fused.make_fused_run contract
     minus the dropout key (the family has none).  ``state`` is a
-    replicated ddp.TrainState over ViT params.
+    replicated ddp.TrainState over ViT params — or, with ``zero``, a
+    ZeRO-1 state (parallel/zero.py: ``make_zero_train_state``) whose
+    flat accumulator shards ride the epoch-scan carry exactly like the
+    CNN family's fused ZeRO composition (fused.py ``zero=True``).
     """
     n_shards = mesh.shape[DATA_AXIS]
+    if zero:
+        from .zero import zero_state_spec, zero_update
 
     def step_fn(state: TrainState, x, y, w, shard, dropout_key, lr):
         def loss_fn(params):
@@ -62,10 +68,15 @@ def make_fused_vit_run(
             return nll_loss(logp, y, w, reduction="mean")
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        grads = jax.lax.pmean(grads, DATA_AXIS)
-        params, opt = adadelta_update(
-            state.params, grads, state.opt, lr, rho, eps
-        )
+        if zero:
+            params, opt = zero_update(
+                state.params, grads, state.opt, lr, n_shards, rho, eps
+            )
+        else:
+            grads = jax.lax.pmean(grads, DATA_AXIS)
+            params, opt = adadelta_update(
+                state.params, grads, state.opt, lr, rho, eps
+            )
         return TrainState(params, opt, state.step + 1), loss
 
     local_epoch, num_batches = _epoch_scan_builder(
@@ -96,11 +107,12 @@ def make_fused_vit_run(
         gathered = jax.lax.all_gather(losses, DATA_AXIS)  # [shards, E, B]
         return state, jnp.moveaxis(gathered, 0, -1), evals
 
+    state_spec = zero_state_spec() if zero else P()
     sharded = jax.shard_map(
         local_run,
         mesh=mesh,
-        in_specs=(P(),) * 7,
-        out_specs=(P(), P(), P()),
+        in_specs=(state_spec,) + (P(),) * 6,
+        out_specs=(state_spec, P(), P()),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,)), num_batches

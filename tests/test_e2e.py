@@ -242,14 +242,20 @@ def test_vit_cli_dry_run_subprocess(tmp_path, extra):
     assert "Total cost time:" in proc.stdout
 
 
-@pytest.mark.slow  # six subprocess training runs
-@pytest.mark.parametrize("mode", [[], ["--zero"]], ids=["plain", "zero"])
+@pytest.mark.slow  # nine subprocess training runs
+@pytest.mark.parametrize(
+    "mode",
+    [[], ["--zero"], ["--zero", "--fused"]],
+    ids=["plain", "zero", "zero-fused"],
+)
 def test_vit_save_resume_state_bit_identical(tmp_path, mode):
     """--save-state/--resume-state on the ViT family: 2 epochs + a
     2-epoch continuation end with params BIT-IDENTICAL to an
     uninterrupted 4-epoch run (schedule, shuffle stream, and optimizer
-    accumulators all travel) — in plain DP and under ZeRO-1 (whose
-    archive round-trips the per-leaf layout)."""
+    accumulators all travel) — in plain DP, under ZeRO-1 (whose archive
+    round-trips the per-leaf layout), and under ZeRO-1 composed into the
+    fused whole-run (the resume converts the per-leaf archive back to
+    the sharded scan-carry layout)."""
     import os
     root = _write_idx(tmp_path)
     env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
@@ -466,6 +472,9 @@ def test_vit_mode_flag_resolution():
     ):
         with _pytest.raises(SystemExit):
             resolve(bad)
-    # The valid combination still resolves.
+    # The valid combinations still resolve.
     _, args = resolve(["--timings-json", "x.json", "--fused"])
     assert args.timings_json == "x.json"
+    # --zero --fused composes (round-5: fused_vit.py zero=True).
+    (sp_on, tp_on), args = resolve(["--zero", "--fused"])
+    assert (sp_on, tp_on) == (False, False) and args.zero and args.fused

@@ -117,6 +117,52 @@ def test_fused_vit_run_matches_per_batch(devices):
     assert int(evals[-1, 1]) == int((jnp.argmax(logp, axis=1) == y).sum())
 
 
+def test_fused_vit_zero_matches_plain_fused(devices):
+    """ZeRO-1 composed into the fused ViT run (vit_mnist --zero --fused):
+    sharded flat accumulators in the scan carry must reproduce the
+    replicated-optimizer fused run — same update math, different
+    reduction routing — to float tolerance."""
+    from pytorch_mnist_ddp_tpu.parallel.zero import (
+        ZeroAdadeltaState,
+        make_zero_train_state,
+    )
+
+    mesh = make_mesh()
+    images, labels = _dataset(64, seed=3)
+    te_images, te_labels = _dataset(32, seed=4)
+    tr = device_put_dataset(images, labels, mesh)
+    te = device_put_dataset(te_images, te_labels, mesh)
+    shuffle_key = jax.random.PRNGKey(5)
+    lrs = jnp.asarray([1.0, 0.7], jnp.float32)
+
+    zero_fn, num_batches = make_fused_vit_run(
+        mesh, CFG, 64, 32, global_batch=32, eval_batch=16, epochs=2,
+        zero=True,
+    )
+    sz = make_zero_train_state(init_vit_params(jax.random.PRNGKey(0), CFG), mesh)
+    sz, z_losses, z_evals = zero_fn(sz, *tr, *te, shuffle_key, lrs)
+    assert isinstance(sz.opt, ZeroAdadeltaState)
+
+    plain_fn, _ = make_fused_vit_run(
+        mesh, CFG, 64, 32, global_batch=32, eval_batch=16, epochs=2,
+    )
+    sp = replicate_params(
+        make_train_state(init_vit_params(jax.random.PRNGKey(0), CFG)), mesh
+    )
+    sp, p_losses, p_evals = plain_fn(sp, *tr, *te, shuffle_key, lrs)
+
+    np.testing.assert_allclose(
+        np.asarray(z_losses), np.asarray(p_losses), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(z_evals), np.asarray(p_evals), rtol=1e-4
+    )
+    for a, b in zip(jax.tree.leaves(sz.params), jax.tree.leaves(sp.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-5
+        )
+
+
 def test_fused_vit_masks_partial_batches(devices):
     """Non-divisible train and test sizes: wrapped filler rows carry
     weight 0 and the eval totals count every real sample exactly once."""

@@ -78,8 +78,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--zero", action="store_true", default=False,
                    help="ZeRO-1 data parallelism over every device: batch "
                         "sharded on the data axis, Adadelta state sharded "
-                        "1/N (parallel/zero.py); mutually exclusive with "
-                        "--sp/--tp/--pp/--experts/--fused")
+                        "1/N (parallel/zero.py); composes with --fused "
+                        "(sharded accumulators in the whole-run scan); "
+                        "mutually exclusive with --sp/--tp/--pp/--experts")
     p.add_argument("--flash", action="store_true", default=False,
                    help="fused Pallas flash-attention kernel "
                         "(ops/pallas_attention.py) — composes with every "
@@ -168,11 +169,12 @@ def resolve_mode_flags(args) -> tuple[bool, bool]:
         raise SystemExit("--experts is mutually exclusive with --sp/--tp/--pp")
     if args.pp and (sp_on or tp_on):
         raise SystemExit("--pp is mutually exclusive with --sp/--tp")
-    if args.zero and (sp_on or tp_on or args.pp
-                      or args.experts > 0 or args.fused):
+    if args.zero and (sp_on or tp_on or args.pp or args.experts > 0):
+        # (--zero --fused composes: the fused whole-run carries the
+        # sharded accumulator slices, parallel/fused_vit.py zero=True.)
         raise SystemExit(
             "--zero is plain data parallelism; drop --sp/--tp/--pp/"
-            "--experts/--fused"
+            "--experts"
         )
     if args.sp_impl != "ring" and tp_on:
         raise SystemExit(
@@ -341,10 +343,25 @@ def main() -> None:
         loaded_state = loaded_state._replace(params=checked)
 
     # One definition of "fresh or resumed" for every replicated-state
-    # branch; the zero branch's sharded placement is the only divergence.
+    # branch; the zero branches' sharded placement is the only divergence —
+    # defined ONCE here so the per-batch and fused --zero paths cannot
+    # drift (fresh: accumulators built sharded-in-place; resumed: the
+    # archive's per-leaf accumulators convert on placement).
     def base_state():
         return (
             make_train_state(params) if loaded_state is None else loaded_state
+        )
+
+    def zero_state(mesh):
+        from pytorch_mnist_ddp_tpu.parallel.zero import (
+            make_zero_train_state,
+            shard_zero_state,
+        )
+
+        return (
+            make_zero_train_state(params, mesh)
+            if loaded_state is None
+            else shard_zero_state(loaded_state, mesh)
         )
 
     def save_state_if_asked(state, mesh, zero_mode=False):
@@ -376,7 +393,12 @@ def main() -> None:
 
         mesh = make_mesh(num_model=1)
         n_shards = mesh.shape["data"]
-        state = replicate_params(base_state(), mesh)
+        if args.zero:
+            # ZeRO-1 composed into the whole-run program: flat accumulator
+            # shards in the scan carry (fused_vit.py zero=True).
+            state = zero_state(mesh)
+        else:
+            state = replicate_params(base_state(), mesh)
         tr_x, tr_y, tr_src = load_mnist_arrays(
             args.data_root, "train", return_source=True
         )
@@ -390,6 +412,7 @@ def main() -> None:
         run_fn, num_batches = make_fused_vit_run(
             mesh, cfg, len(tr_x), len(te_x), global_batch, eval_batch,
             args.epochs, start_epoch=epoch0 + 1, pregather=args.pregather,
+            zero=args.zero,
         )
         lr_for_epoch = step_lr(args.lr, args.gamma)
         lrs = jnp.asarray(
@@ -440,7 +463,7 @@ def main() -> None:
             print(test_summary_lines(
                 float(evals[e, 0]) / len(te_x), int(evals[e, 1]), len(te_x)
             ))
-        save_state_if_asked(state, mesh)
+        save_state_if_asked(state, mesh, zero_mode=args.zero)
         if args.save_model:
             from pytorch_mnist_ddp_tpu.utils.checkpoint import save_params_tree
 
@@ -525,19 +548,11 @@ def main() -> None:
         eval_step = make_ep_eval_step(mesh, cfg, use_flash=use_flash)
     elif args.zero:
         from pytorch_mnist_ddp_tpu.parallel.pp_vit import make_vit_eval_step
-        from pytorch_mnist_ddp_tpu.parallel.zero import (
-            make_zero_train_state,
-            make_zero_vit_train_step,
-        )
+        from pytorch_mnist_ddp_tpu.parallel.zero import make_zero_vit_train_step
 
         mesh = make_mesh(num_model=1)
         zero_ran = True
-        if loaded_state is None:
-            state = make_zero_train_state(params, mesh)
-        else:
-            from pytorch_mnist_ddp_tpu.parallel.zero import shard_zero_state
-
-            state = shard_zero_state(loaded_state, mesh)
+        state = zero_state(mesh)
         train_step = make_zero_vit_train_step(
             mesh, cfg, attention_fn=attention_fn
         )
