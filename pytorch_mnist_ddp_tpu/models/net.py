@@ -325,7 +325,9 @@ class Net(nn.Module):
         )
 
 
-def raw_conv_stack(params: dict, x: jax.Array) -> jax.Array:
+def raw_conv_stack(
+    params: dict, x: jax.Array, compute_dtype: jnp.dtype = jnp.float32
+) -> jax.Array:
     """The conv block of ``Net`` written over raw params: conv1 -> relu ->
     conv2 -> relu -> maxpool.  ``[n, 28, 28, 1] -> [n, 12, 12, 64]``.
 
@@ -333,20 +335,25 @@ def raw_conv_stack(params: dict, x: jax.Array) -> jax.Array:
     (parallel/tp.py, parallel/pp.py), whose param shards can't go through
     ``nn.Module.apply`` — one definition so the raw and Flax forwards
     cannot drift apart (their equality is pinned by the parity tests).
-    """
+    ``compute_dtype`` mirrors ``Net.compute_dtype`` (params stay f32;
+    same-dtype casts are trace-level no-ops, so the default program is
+    byte-identical to before the parameter existed)."""
+    x = x.astype(compute_dtype)
+    k1 = params["conv1"]["kernel"].astype(compute_dtype)
     dn = jax.lax.conv_dimension_numbers(
-        x.shape, params["conv1"]["kernel"].shape, ("NHWC", "HWIO", "NHWC")
+        x.shape, k1.shape, ("NHWC", "HWIO", "NHWC")
     )
     x = jax.lax.conv_general_dilated(
-        x, params["conv1"]["kernel"], (1, 1), "VALID", dimension_numbers=dn
-    ) + params["conv1"]["bias"]
+        x, k1, (1, 1), "VALID", dimension_numbers=dn
+    ) + params["conv1"]["bias"].astype(compute_dtype)
     x = jax.nn.relu(x)
+    k2 = params["conv2"]["kernel"].astype(compute_dtype)
     dn = jax.lax.conv_dimension_numbers(
-        x.shape, params["conv2"]["kernel"].shape, ("NHWC", "HWIO", "NHWC")
+        x.shape, k2.shape, ("NHWC", "HWIO", "NHWC")
     )
     x = jax.lax.conv_general_dilated(
-        x, params["conv2"]["kernel"], (1, 1), "VALID", dimension_numbers=dn
-    ) + params["conv2"]["bias"]
+        x, k2, (1, 1), "VALID", dimension_numbers=dn
+    ) + params["conv2"]["bias"].astype(compute_dtype)
     x = jax.nn.relu(x)
     return jax.lax.reduce_window(
         x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"

@@ -39,10 +39,15 @@ from .pipeline import NUM_STAGES, STAGE_AXIS, make_pipeline_loss
 _FLAT = 9216  # stage-boundary activation width (64 * 12 * 12)
 
 
-def _stage0_fwd(params: dict, x: jax.Array, key: jax.Array, train: bool) -> jax.Array:
+def _stage0_fwd(
+    params: dict, x: jax.Array, key: jax.Array, train: bool,
+    compute_dtype: jnp.dtype = jnp.float32,
+) -> jax.Array:
     """convs + pool (+ dropout1 when training) + flatten:
-    [n, 28, 28, 1] -> [n, 9216]."""
-    x = raw_conv_stack(params, x)
+    [n, 28, 28, 1] -> [n, 9216].  With bf16 the stage-boundary activation
+    (the per-tick ppermute payload) travels at half width — the pipeline
+    engine discovers its dtype via eval_shape (parallel/pipeline.py)."""
+    x = raw_conv_stack(params, x, compute_dtype)
     if train:
         keep = 1.0 - DROPOUT1_RATE
         x = x * jax.random.bernoulli(key, keep, x.shape) / keep
@@ -52,13 +57,18 @@ def _stage0_fwd(params: dict, x: jax.Array, key: jax.Array, train: bool) -> jax.
 def _stage1_loss_sum(
     params: dict, act: jax.Array, y: jax.Array, w: jax.Array,
     key: jax.Array, train: bool,
+    compute_dtype: jnp.dtype = jnp.float32,
 ) -> jax.Array:
     """dense head (+ dropout2 when training) + weighted NLL SUM."""
-    h = jax.nn.relu(act @ params["fc1"]["kernel"] + params["fc1"]["bias"])
+    h = jax.nn.relu(
+        act @ params["fc1"]["kernel"].astype(compute_dtype)
+        + params["fc1"]["bias"].astype(compute_dtype)
+    )
     if train:
         keep = 1.0 - DROPOUT2_RATE
         h = h * jax.random.bernoulli(key, keep, h.shape) / keep
-    logits = h @ params["fc2"]["kernel"] + params["fc2"]["bias"]
+    logits = h @ params["fc2"]["kernel"].astype(compute_dtype) \
+        + params["fc2"]["bias"].astype(compute_dtype)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     return nll_loss(logp, y, w, reduction="sum")
 
@@ -76,6 +86,7 @@ def make_pp_train_step(
     rho: float = 0.9,
     eps: float = 1e-6,
     dropout: bool = True,
+    compute_dtype: jnp.dtype = jnp.float32,
 ):
     """Build the jitted (data x stage) pipelined train step.
 
@@ -94,11 +105,13 @@ def make_pp_train_step(
 
     def stage0(params, x_mb, key, j):
         k0, _ = _mb_keys(key, j)
-        return _stage0_fwd(params, x_mb, k0, dropout)
+        return _stage0_fwd(params, x_mb, k0, dropout, compute_dtype)
 
     def stage1(params, act, y_mb, w_mb, key, j):
         _, k1 = _mb_keys(key, j)
-        return _stage1_loss_sum(params, act, y_mb, w_mb, k1, dropout)
+        return _stage1_loss_sum(
+            params, act, y_mb, w_mb, k1, dropout, compute_dtype
+        )
 
     pipeline_loss = make_pipeline_loss(stage0, stage1, num_micro)
 

@@ -69,11 +69,17 @@ def shard_state(state: TrainState, mesh: Mesh) -> TrainState:
     return place_tree(state, state_specs(), mesh)
 
 
-def _tp_forward(params: dict, x: jax.Array, train: bool, key: jax.Array) -> jax.Array:
+def _tp_forward(
+    params: dict, x: jax.Array, train: bool, key: jax.Array,
+    compute_dtype: jnp.dtype = jnp.float32,
+) -> jax.Array:
     """The reference CNN forward (models/net.py architecture) written over
     raw params so the dense layers can be local shards.  ``x`` is the
-    data-shard batch [n, 28, 28, 1]; fc1/fc2 params are model shards."""
-    x = raw_conv_stack(params, x)
+    data-shard batch [n, 28, 28, 1]; fc1/fc2 params are model shards.
+    ``compute_dtype`` mirrors ``Net.compute_dtype`` — with bf16 the
+    model-axis logits psum moves half the bytes, and the log_softmax tail
+    stays f32 exactly like the DP model's."""
+    x = raw_conv_stack(params, x, compute_dtype)
     if train:
         keep1 = 1.0 - DROPOUT1_RATE
         k1 = jax.random.fold_in(key, 1)
@@ -81,7 +87,8 @@ def _tp_forward(params: dict, x: jax.Array, train: bool, key: jax.Array) -> jax.
     x = x.reshape(x.shape[0], -1)  # [n, 9216] NHWC flatten order
 
     # Column-parallel fc1: local [9216, 128/M] shard -> local features.
-    h = x @ params["fc1"]["kernel"] + params["fc1"]["bias"]
+    h = x @ params["fc1"]["kernel"].astype(compute_dtype) \
+        + params["fc1"]["bias"].astype(compute_dtype)
     h = jax.nn.relu(h)
     if train:
         # Distinct dropout mask per model shard (its features are distinct).
@@ -91,8 +98,9 @@ def _tp_forward(params: dict, x: jax.Array, train: bool, key: jax.Array) -> jax.
         )
         h = h * jax.random.bernoulli(k2, keep2, h.shape) / keep2
     # Row-parallel fc2: partial logits, completed by one psum over model.
-    logits = h @ params["fc2"]["kernel"]
-    logits = jax.lax.psum(logits, MODEL_AXIS) + params["fc2"]["bias"]
+    logits = h @ params["fc2"]["kernel"].astype(compute_dtype)
+    logits = jax.lax.psum(logits, MODEL_AXIS) \
+        + params["fc2"]["bias"].astype(compute_dtype)
     return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
 
 
@@ -105,7 +113,7 @@ def gather_replicated(tree: Any, mesh: Mesh) -> Any:
     return jax.jit(lambda t: t, out_shardings=NamedSharding(mesh, P()))(tree)
 
 
-def make_tp_eval_step(mesh: Mesh):
+def make_tp_eval_step(mesh: Mesh, compute_dtype: jnp.dtype = jnp.float32):
     """Build the jitted TP eval step: the TP forward (logits completed by
     the model-axis psum) feeding the same psum'd (loss_sum, correct)
     totals as ddp.make_eval_step — so ``--tp`` runs evaluate with
@@ -116,7 +124,10 @@ def make_tp_eval_step(mesh: Mesh):
 
     def local_eval(params, x, y, w):
         # train=False: the key argument is never consumed.
-        logp = _tp_forward(params, x, train=False, key=jax.random.PRNGKey(0))
+        logp = _tp_forward(
+            params, x, train=False, key=jax.random.PRNGKey(0),
+            compute_dtype=compute_dtype,
+        )
         loss_sum = nll_loss(logp, y, w, reduction="sum")
         correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
@@ -135,6 +146,7 @@ def make_tp_train_step(
     rho: float = 0.9,
     eps: float = 1e-6,
     dropout: bool = True,
+    compute_dtype: jnp.dtype = jnp.float32,
 ):
     """Build the jitted 2-D (data x model) train step.
 
@@ -149,7 +161,10 @@ def make_tp_train_step(
         key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
 
         def loss_fn(params):
-            logp = _tp_forward(params, x, train=dropout, key=key)
+            logp = _tp_forward(
+                params, x, train=dropout, key=key,
+                compute_dtype=compute_dtype,
+            )
             return nll_loss(logp, y, w, reduction="mean")
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)

@@ -256,8 +256,6 @@ def _fit_body(
         raise ValueError(
             "--pallas-opt is implemented for the DP paths; drop --tp/--pp"
         )
-    if num_model > 1 and bool(getattr(args, "bf16", False)):
-        raise ValueError("--bf16 is implemented for the DP paths; drop --tp/--pp")
     if num_model > 1 and not dist.distributed:
         raise ValueError("--tp/--pp need a multi-device mesh (use the launcher)")
     # --syncbn (cross-replica BatchNorm, the torch.nn.SyncBatchNorm
@@ -377,8 +375,8 @@ def _fit_body(
     use_pallas = bool(getattr(args, "pallas_opt", False))
     # --bf16: activations/matmuls at the MXU's native width; params, the
     # Adadelta state, and the log_softmax/NLL tail stay fp32 (models/net.py).
-    # (Incompatibility with --tp/--pp is rejected up top with the other
-    # flag checks, before any dataset work.)
+    # Rides every path — DP (per-batch and fused), ZeRO, TP (half-width
+    # logits psum), and PP (half-width stage-boundary ppermute payloads).
     compute_dtype = jnp.bfloat16 if getattr(args, "bf16", False) else jnp.float32
 
     if fused:
@@ -584,15 +582,16 @@ def _fit_body(
         from .utils.profiling import StepStats
 
         if tp_degree > 1:
-            step_fn = make_tp_train_step(mesh)
-            eval_fn = make_tp_eval_step(mesh)
+            step_fn = make_tp_train_step(mesh, compute_dtype=compute_dtype)
+            eval_fn = make_tp_eval_step(mesh, compute_dtype=compute_dtype)
         elif pp_on:
             from .parallel.pp import make_pp_train_step
 
             step_fn = make_pp_train_step(
-                mesh, num_micro=int(getattr(args, "pp_microbatches", 2))
+                mesh, num_micro=int(getattr(args, "pp_microbatches", 2)),
+                compute_dtype=compute_dtype,
             )
-            eval_fn = make_eval_step(mesh)
+            eval_fn = make_eval_step(mesh, compute_dtype=compute_dtype)
         elif zero:
             from .parallel.zero import make_zero_train_step
 

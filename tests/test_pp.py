@@ -93,6 +93,33 @@ def test_pp_requires_two_stages(devices):
         make_pp_train_step(make_mesh(), num_micro=2)  # 8x1 mesh: no stages
 
 
+def test_pp_bf16_close_to_f32(devices):
+    """--bf16 --pp (round-5): bf16 stage bodies mean the per-tick
+    ppermute payload travels at half width (the engine discovers the
+    boundary dtype via eval_shape); one step's loss and updated params
+    stay within bf16 tolerance of f32, params themselves staying f32."""
+    pp_mesh = make_mesh(num_data=4, num_model=2)
+    key = jax.random.PRNGKey(3)
+
+    def one_step(dtype):
+        step = make_pp_train_step(
+            pp_mesh, num_micro=2, dropout=False, compute_dtype=dtype
+        )
+        state = replicate_params(
+            make_train_state(init_params(jax.random.PRNGKey(0))), pp_mesh
+        )
+        x, y, w = _batch(n=64, seed=1)
+        state, losses = step(state, x, y, w, key, jnp.float32(1.0))
+        assert jax.tree.leaves(state.params)[0].dtype == jnp.float32
+        return float(jnp.mean(losses)), state
+
+    loss32, s32 = one_step(jnp.float32)
+    loss16, s16 = one_step(jnp.bfloat16)
+    np.testing.assert_allclose(loss16, loss32, atol=0.05)
+    for a, b in zip(jax.tree.leaves(s16.params), jax.tree.leaves(s32.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0.1)
+
+
 @pytest.mark.slow  # compile-heavy; full tier only (pytest.ini)
 def test_pp_trains_with_dropout(devices):
     """Dropout pipelines too (rematerialized masks replay in the manual

@@ -70,6 +70,33 @@ def test_tp_matches_dp_exactly(devices):
     assert int(tp_state.step) == 3
 
 
+def test_tp_bf16_close_to_f32(devices):
+    """--bf16 --tp (round-5: compute_dtype through the TP forward): one
+    step's loss and updated params stay within bf16 tolerance of the f32
+    step; params/accumulators remain f32 either way."""
+    key = jax.random.PRNGKey(7)
+    lr = jnp.float32(1.0)
+    tp_mesh = make_mesh(num_data=4, num_model=2)
+
+    def one_step(dtype):
+        state = shard_state(
+            make_train_state(init_params(jax.random.PRNGKey(0))), tp_mesh
+        )
+        step = make_tp_train_step(tp_mesh, dropout=False, compute_dtype=dtype)
+        x, y, w = _batch()
+        state, losses = step(state, x, y, w, key, lr)
+        assert jax.tree.leaves(state.params)[0].dtype == jnp.float32
+        return float(jnp.mean(losses)), state
+
+    loss32, s32 = one_step(jnp.float32)
+    loss16, s16 = one_step(jnp.bfloat16)
+    np.testing.assert_allclose(loss16, loss32, atol=0.05)
+    for a, b in zip(jax.tree.leaves(s16.params), jax.tree.leaves(s32.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=0.1
+        )
+
+
 def test_tp_params_are_actually_sharded(devices):
     """fc1/fc2 really live as shards on the model axis (not replicated)."""
     tp_mesh = make_mesh(num_data=4, num_model=2)
