@@ -148,9 +148,25 @@ def test_bench_end_to_end_cpu_smoke():
         assert not snap.get("train_limit")
 
 
+# The headline benchmark program's StableHLO SHA-256, unchanged since the
+# round-3 cache-warming commit.  The persistent XLA cache on the TPU host
+# keys on this program: any commit that shifts it silently invalidates
+# the warm cache and the driver's round-end bench measures a ~19 s cold
+# compile inside the recorded wall clock.  If a change here is
+# INTENTIONAL (e.g. flipping --pregather or --conv-impl defaults after
+# hardware evidence), update this constant in the same commit and re-warm
+# the cache in the next tunnel window.
+HEADLINE_PROGRAM_SHA256 = (
+    "0167c6b4afc2f24d3611198f11a2bda53b72ee7fff212e49261d411fe88fa01b"
+)
+
+
 def test_bench_program_hash_tool():
     """tools/bench_program_hash.py must keep running (it is the round-end
-    warm-cache check): emits exactly one 64-hex line, deterministically."""
+    warm-cache check): emits exactly one 64-hex line, deterministically —
+    and the value must match the recorded warm-cache hash, so accidental
+    headline-program drift fails HERE instead of as a silently-cold
+    round-end benchmark."""
     import subprocess
 
     from conftest import cpu_subprocess_env
@@ -170,6 +186,11 @@ def test_bench_program_hash_tool():
         outs.append(proc.stdout.strip())
     assert len(outs[0]) == 64 and set(outs[0]) <= set("0123456789abcdef")
     assert outs[0] == outs[1], "hash not deterministic"
+    assert outs[0] == HEADLINE_PROGRAM_SHA256, (
+        "the headline benchmark program's StableHLO changed — the warm "
+        "TPU cache is invalidated; revert, or update "
+        "HEADLINE_PROGRAM_SHA256 deliberately and re-warm in-window"
+    )
 
 
 @pytest.mark.slow  # subprocess fused run on CPU (~1 min)

@@ -156,6 +156,61 @@ class TestIm2colConv:
         with pytest.raises(ValueError, match="conv_impl"):
             Net(conv_impl="winograd").apply({"params": params}, x, train=False)
 
+    def test_syncbn_composition(self, devices):
+        """--conv-impl composes with --syncbn: one REAL cross-replica
+        train step (8-way shard_map, psum'd batch statistics) per conv
+        lowering, from identical init — losses, updated params, and the
+        synced BN running averages must agree to f32 tolerance."""
+        import jax.numpy as jnp
+
+        from pytorch_mnist_ddp_tpu.models.net import init_variables
+        from pytorch_mnist_ddp_tpu.parallel.ddp import (
+            make_train_state,
+            make_train_step,
+            replicate_params,
+        )
+        from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.standard_normal((32, 28, 28, 1)), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 10, 32), jnp.int32)
+        w = jnp.ones((32,), jnp.float32)
+
+        def one_step(impl):
+            variables = init_variables(jax.random.PRNGKey(7), use_bn=True)
+            state = replicate_params(
+                make_train_state(
+                    variables["params"], variables["batch_stats"]
+                ),
+                mesh,
+            )
+            step = make_train_step(
+                mesh, use_bn=True, dropout=False, conv_impl=impl
+            )
+            return step(
+                state, x, y, w, jax.random.PRNGKey(9), jnp.float32(1.0)
+            )
+
+        s_ref, l_ref = one_step("conv")
+        s_alt, l_alt = one_step("im2col")
+        np.testing.assert_allclose(
+            np.asarray(l_alt), np.asarray(l_ref), rtol=1e-4
+        )
+        for a, b in zip(
+            jax.tree.leaves(s_alt.params), jax.tree.leaves(s_ref.params)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-4
+            )
+        for a, b in zip(
+            jax.tree.leaves(s_alt.batch_stats),
+            jax.tree.leaves(s_ref.batch_stats),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+            )
+
 
 @pytest.fixture(scope="module")
 def torch_net():
