@@ -281,3 +281,31 @@ def test_fit_rejects_zero_flag_conflicts(devices):
         fit(args(pallas_opt=True), dist)
     with pytest.raises(ValueError, match="drop --tp/--pp"):
         fit(args(tp=2), dist)
+
+
+def test_fit_rejects_conv_impl_with_model_axis_modes(devices):
+    """--conv-impl rides the DP paths only (the tp/pp raw-lax forwards
+    pin the native conv); rejected loudly whichever model-axis mode
+    claims it."""
+    from types import SimpleNamespace
+
+    from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
+    from pytorch_mnist_ddp_tpu.trainer import fit
+
+    def args(**over):
+        base = dict(
+            batch_size=8, test_batch_size=16, epochs=1, lr=1.0, gamma=0.7,
+            seed=1, log_interval=10, dry_run=True, save_model=False,
+            data_root="/nonexistent",
+        )
+        base.update(over)
+        return SimpleNamespace(**base)
+
+    dist = DistState(
+        distributed=True, process_rank=0, process_count=1,
+        world_size=8, devices=list(devices),
+    )
+    with pytest.raises(ValueError, match="conv-impl rides the DP paths"):
+        fit(args(tp=2, conv_impl="im2col"), dist)
+    with pytest.raises(ValueError, match="conv-impl rides the DP paths"):
+        fit(args(pp=True, conv_impl="im2col_c1"), dist)

@@ -8,13 +8,14 @@ SAME attribution contract as bench.py (round-3 verdict item 4):
 AOT split, steady-state images/sec over ``run_s``, and MFU from the
 analytic ViT FLOPs model (utils/flops.py:vit_run_flops).
 
-``--mode sp|tp|pp|flash|zero`` instead records a parallel-mode smoke row
-(verdict item 6: every shipped mode gets at least one hardware number) —
-per-batch paths with no single compiled program, so those rows carry
-wall clock + accuracy only.
+``--mode sp|tp|flash`` instead records a parallel-mode smoke row (every
+shipped mode gets at least one hardware number) — per-batch paths with
+no single compiled program, so those rows carry wall clock + accuracy
+only.  ``--mode zero`` rides the fused whole-run (the round-5 ZeRO
+composition), so its row carries the full attribution too.
 
 Run by tools/tunnel_watch.sh in accelerator windows; results land in
-``bench_r4_vit*.json`` via the watcher's min-by-value promotion.
+``bench_r5_vit*.json`` via the watcher's min-by-value promotion.
 
 Usage: python tools/vit_bench.py [--mode M] [--epochs N] [--batch-size N]
 Prints ONE JSON line on stdout; exit 1 with an error JSON on failure.
@@ -46,8 +47,14 @@ _MODES = {
     # no "pp": the GPipe engine is structurally >= 2 stages and one chip
     # is visible — its hardware row needs a multi-chip window.
     "flash": ["--flash"],
-    "zero": ["--zero"],
+    # ZeRO-1 rides the fused whole-run (round-5 composition), so its row
+    # carries the full run_s/compile_s/data_s attribution like "fused".
+    "zero": ["--zero", "--fused"],
 }
+
+# Modes that run the fused whole-run and therefore support the
+# --timings-json AOT attribution contract.
+_FUSED_MODES = ("fused", "zero")
 
 
 def main() -> int:
@@ -85,7 +92,7 @@ def main() -> int:
         "--test-batch-size", str(args.test_batch_size),
     ] + _MODES[args.mode]
     timings_path = None
-    if args.mode == "fused":
+    if args.mode in _FUSED_MODES:
         fd, timings_path = tempfile.mkstemp(suffix=".json")
         os.close(fd)
         cmd += ["--timings-json", timings_path]
@@ -120,6 +127,7 @@ def main() -> int:
     if not m or not accs:
         cleanup_tmp()
         return fail("output missing timer or accuracy lines")
+    out = proc.stdout + proc.stderr
     final = 100.0 * int(accs[-1][0]) / int(accs[-1][1])
     first = 100.0 * int(accs[0][0]) / int(accs[0][1])
     result = {
@@ -133,9 +141,15 @@ def main() -> int:
         "n_chips": n_chips,
         "batch_size_per_shard": args.batch_size,
         "global_batch": args.batch_size * n_chips,
-        "dataset": "synthetic"
-        if "synthetic MNIST-like data" in (proc.stdout + proc.stderr)
-        else "idx",
+        # Provenance: the fused/zero modes overwrite this below from the
+        # timings JSON's authoritative "dataset" field; the per-batch
+        # smoke modes infer from the run's own notices (mirroring
+        # data/mnist.py's three-way labeling).
+        "dataset": (
+            "synthetic"
+            if "synthetic MNIST-like data" in out
+            else "idx-unverified" if "idx-unverified" in out else "idx"
+        ),
         "subprocess_wall_s": round(wall, 2),
         "epoch1_test_accuracy": round(first, 2),
         "final_test_accuracy": round(final, 2),
@@ -148,6 +162,10 @@ def main() -> int:
             t = {}
         finally:
             cleanup_tmp()
+        if t.get("dataset"):
+            # The CLI recorded the loader's own provenance label — more
+            # reliable than the notice scrape above.
+            result["dataset"] = t["dataset"]
         if "run_s" in t:
             result["run_s"] = round(t["run_s"], 2)
             result["compile_s"] = round(t.get("compile_s", 0.0), 2)
