@@ -29,6 +29,12 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--epochs", type=int, default=20)
     p.add_argument("--batch-size", type=int, default=200)
+    p.add_argument("--conv-impl", type=str, default="conv",
+                   choices=["conv", "im2col_c1", "im2col"],
+                   help="cost-analyze a GEMM-lowered conv variant "
+                        "(models/net.py CONV_IMPLS): offline evidence that "
+                        "the alternative lowering does not change the FLOP "
+                        "count, only the op mix/layout")
     args = p.parse_args()
 
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -62,7 +68,7 @@ def main() -> int:
     )
     run_fn, num_batches = make_fused_run(
         mesh, train_size, test_size, args.batch_size, 1000, args.epochs,
-        from_key=True,
+        from_key=True, conv_impl=args.conv_impl,
     )
     lrs = jnp.asarray([1.0 * 0.7 ** e for e in range(args.epochs)],
                       jnp.float32)
@@ -90,6 +96,7 @@ def main() -> int:
     out = {
         "metric": "fused_program_cost",
         "backend_compiled_for": jax.default_backend(),
+        "conv_impl": args.conv_impl,
         "epochs": args.epochs,
         "train_steps": args.epochs * num_batches,
         "xla_bodies_once_gflops": round(flops / 1e9, 2),
