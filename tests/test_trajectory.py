@@ -133,10 +133,13 @@ def _run_torch_recurrence(model, init_state: dict, xs, ys, lr: float):
     return np.asarray(losses), final
 
 
-def _ours_trajectory(params, xs, ys, lr: float, num_devices: int):
+def _ours_trajectory(params, xs, ys, lr: float, num_devices: int,
+                     conv_impl: str = "conv"):
     dtype = jnp.float64 if xs.dtype == np.float64 else jnp.float32
     mesh = make_mesh(num_data=num_devices, devices=jax.devices()[:num_devices])
-    step_fn = make_train_step(mesh, compute_dtype=dtype, dropout=False)
+    step_fn = make_train_step(
+        mesh, compute_dtype=dtype, dropout=False, conv_impl=conv_impl
+    )
     params = jax.tree.map(lambda v: jnp.asarray(np.asarray(v), dtype), params)
     state = replicate_params(make_train_state(params), mesh)
     w = jnp.ones((BATCH,), dtype)
@@ -173,15 +176,21 @@ def _assert_trajectory_close(our, torch_losses, torch_final, rtol, atol):
 
 
 @pytest.mark.slow  # compile-heavy; full tier only (pytest.ini)
-def test_trajectory_matches_torch_f64(x64_mode):
+@pytest.mark.parametrize("conv_impl", ["conv", "im2col"])
+def test_trajectory_matches_torch_f64(x64_mode, conv_impl):
     """float64 leg: the 20-step trajectory matches the torch recurrence to
     1e-8 — three orders tighter than the 1e-5 target, leaving rounding no
-    room to hide an algorithmic difference."""
+    room to hide an algorithmic difference.  The im2col leg pins the
+    GEMM-lowered conv variant's WHOLE training recurrence against torch
+    too: at f64, reduction-order differences between the native conv and
+    the patches-matmul lowering are ~1e-12, far inside the contract."""
     params = init_params(jax.random.PRNGKey(7))
     torch_init = state_dict_to_torch_layout(model_state_dict(params))
     xs, ys = _make_batches(np.float64)
     torch_out = _torch_reference_trajectory(torch_init, xs, ys, lr=1.0)
-    ours = _ours_trajectory(params, xs, ys, 1.0, num_devices=1)
+    ours = _ours_trajectory(
+        params, xs, ys, 1.0, num_devices=1, conv_impl=conv_impl
+    )
     _assert_trajectory_close(ours, *torch_out, rtol=1e-8, atol=1e-10)
 
 
