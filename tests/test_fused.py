@@ -159,6 +159,37 @@ def test_fused_zero_matches_per_batch_zero(devices):
         )
 
 
+def test_fused_zero_syncbn_composes(devices):
+    """--zero --fused --syncbn: the sharded accumulators AND the BN
+    running averages both travel in the scan carry (accumulators sharded
+    P('data'), stats replicated) — one epoch runs finite and steps."""
+    from pytorch_mnist_ddp_tpu.parallel.zero import ZeroAdadeltaState
+
+    mesh = make_mesh()
+    tr_images, tr_labels = _dataset(64, seed=31)
+    te_images, te_labels = _dataset(32, seed=32)
+    tx, ty = device_put_dataset(tr_images, tr_labels, mesh)
+    ex, ey = device_put_dataset(te_images, te_labels, mesh)
+
+    run_fn, num_batches = make_fused_run(
+        mesh, 64, 32, 32, 16, 1, dropout=False, zero=True, use_bn=True,
+        from_key=True,
+    )
+    state, losses, evals = run_fn(
+        jax.random.PRNGKey(0), tx, ty, ex, ey,
+        jax.random.PRNGKey(5), jax.random.PRNGKey(6),
+        jnp.asarray([1.0], jnp.float32),
+    )
+    assert isinstance(state.opt, ZeroAdadeltaState)
+    assert state.batch_stats  # BN running averages travelled in the carry
+    assert np.isfinite(np.asarray(losses)).all()
+    assert np.isfinite(np.asarray(evals)).all()
+    assert int(state.step) == num_batches
+    # The running averages actually moved off their init values.
+    ra_mean = np.asarray(state.batch_stats["bn1"]["mean"])
+    assert not np.allclose(ra_mean, 0.0)
+
+
 def test_fused_zero_from_key_initializes_in_program(devices):
     """from_key + zero: params AND the local accumulator slices are created
     inside the compiled program; the result matches the host-built state."""
