@@ -190,6 +190,12 @@ while true; do
         # before any default flip).
         run_bench conv_c1_run --conv-impl im2col_c1 && echo "[$(stamp)] conv_c1: $(promote conv_c1_run conv_c1)"
         run_bench conv_all_run --conv-impl im2col && echo "[$(stamp)] conv_all: $(promote conv_all_run conv_all)"
+        # The combined candidate: if both independent flips win, the new
+        # headline would run them together — measure the composition
+        # directly (its ladder analogue is the im2col_c1 ladder's
+        # full_pregather rung).
+        run_bench conv_c1_pregather_run --conv-impl im2col_c1 --pregather \
+            && echo "[$(stamp)] conv_c1+pregather: $(promote conv_c1_pregather_run conv_c1_pregather)"
         run_bench syncbn_run --syncbn && echo "[$(stamp)] syncbn: $(promote syncbn_run syncbn)"
         # ZeRO-1 now rides the fused whole-run (round-5): a full-protocol
         # row is one compile + one dispatch, same as the headline.
