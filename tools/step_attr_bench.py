@@ -55,6 +55,10 @@ def main() -> int:
                         "(models/net.py CONV_IMPLS) — isolates conv1's "
                         "MXU-untileable C_in=1 contraction (docs/PERF.md)")
     p.add_argument("--allow-cpu", action="store_true")
+    p.add_argument("--budget-s", type=float, default=540.0,
+                   help="soft time budget: once exceeded, remaining rungs "
+                        "are skipped and the partial JSON still prints "
+                        "(must sit below the watcher's 600 s SIGTERM)")
     args = p.parse_args()
 
     import jax
@@ -230,16 +234,20 @@ def main() -> int:
             return acc
         return run
 
+    # Decision-value order, not ladder order: through a slow tunnel the
+    # per-rung compiles can eat the whole window budget, so the rungs the
+    # PERF.md decision rules need most run first and every completed rung
+    # is flushed to stderr immediately (a timeout keeps the partials).
     variants = {
-        "empty_scan": make_empty(),
-        "gather_norm": make_gather_norm(),
-        "gather_epoch": make_gather_epoch(),
-        "fwd": make_fwd(),
-        "fwd_bwd": make_fwd_bwd(),
-        "full_nodrop": make_full(dropout=False, gather="step"),
         "full": make_full(dropout=True, gather="step"),
+        "fwd_bwd": make_fwd_bwd(),
         "full_nogather": make_full(dropout=True, gather="none"),
         "full_pregather": make_full(dropout=True, gather="epoch"),
+        "gather_norm": make_gather_norm(),
+        "empty_scan": make_empty(),
+        "gather_epoch": make_gather_epoch(),
+        "full_nodrop": make_full(dropout=False, gather="step"),
+        "fwd": make_fwd(),
         "eval": make_eval(),
     }
 
@@ -252,23 +260,53 @@ def main() -> int:
         "compute_dtype": "bfloat16" if args.bf16 else "float32",
         "conv_impl": args.conv_impl,
     }
+
+    # The watcher SIGTERMs at its outer timeout; flush whatever completed
+    # so the window still yields decision data (the round-4 f32 ladder
+    # timed out at 600 s and produced an empty file).
+    import signal
+
+    def _flush_partial(signum, frame):
+        result.setdefault("partial", True)
+        print(json.dumps(result), flush=True)
+        sys.exit(124)
+
+    signal.signal(signal.SIGTERM, _flush_partial)
+    budget_s = args.budget_s
+    t_start = time.perf_counter()
+
     for name, fn in variants.items():
+        if time.perf_counter() - t_start > budget_s:
+            result.setdefault("skipped", []).append(name)
+            continue
         # us per ITERATION of that variant's scan ("eval" iterates
         # eval-steps batches; everything else `steps` train steps).
         iters = args.eval_steps if name == "eval" else args.steps
         jitted = jax.jit(fn)
         try:
+            t_c0 = time.perf_counter()
             jax.block_until_ready(jitted())  # compile (or cache load)
+            compile_s = time.perf_counter() - t_c0
             best = float("inf")
             for _ in range(args.reps):
                 t0 = time.perf_counter()
                 jax.block_until_ready(jitted())
                 best = min(best, time.perf_counter() - t0)
             result[name] = round(best / iters * 1e6, 2)
+            result.setdefault("compile_s", {})[name] = round(compile_s, 1)
         except Exception as e:  # tunnel drop mid-ladder: keep partials
             result[name] = None
             result.setdefault("errors", {})[name] = repr(e)[:200]
-    print(json.dumps(result))
+        print(f"[rung] {name}: {result.get(name)} us/iter "
+              f"(compile {result.get('compile_s', {}).get(name)}s, "
+              f"elapsed {time.perf_counter() - t_start:.0f}s)",
+              file=sys.stderr, flush=True)
+    if "skipped" in result:
+        result["partial"] = True
+    # Close the handler race before the final print: a SIGTERM landing
+    # mid-print must not let the handler append a second JSON object.
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    print(json.dumps(result), flush=True)
     return 0
 
 

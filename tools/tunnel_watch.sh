@@ -81,8 +81,10 @@ ladder() { # $1 = tag suffix, rest = extra step_attr_bench.py args
     local tag="$1"; shift
     echo "[$(stamp)] step-attribution ladder ($tag)"
     # ~11 rungs x ~20 s cold compile each through the tunnel on the first
-    # window; the persistent cache makes later windows warm.
-    timeout 600 python "$REPO/tools/step_attr_bench.py" "$@" \
+    # window; the persistent cache makes later windows warm.  -k 30: the
+    # tool traps SIGTERM (partial flush), so a process wedged inside a
+    # native XLA call would otherwise never die — escalate to SIGKILL.
+    timeout -k 30 600 python "$REPO/tools/step_attr_bench.py" "$@" \
         >"$OUT/bench_r5_stepattr_${tag}.json" 2>"$OUT/bench_r5_stepattr_${tag}.err"
     local rc=$?
     echo "[$(stamp)] stepattr-$tag rc=$rc: $(head -c 400 "$OUT/bench_r5_stepattr_${tag}.json" 2>/dev/null)"
@@ -136,6 +138,15 @@ while true; do
             fi
         fi
         commit_artifacts "headline"
+        # Windows can be ~2 min (round-5 first window: headline landed,
+        # then the tunnel died and the f32 ladder hung 600 s producing
+        # nothing).  Re-probe between groups: a dead tunnel means abort
+        # back to polling so the NEXT window starts at the top of the
+        # value order instead of whatever leg the dead playbook reached.
+        # Cost on a LIVE tunnel is ~3 s per probe (measured 08:30 this
+        # round); only the dead case pays the 95 s timeout, and then the
+        # abort saves the rest of a ~90 min dead playbook.
+        probe || { echo "[$(stamp)] TUNNEL LOST after headline — back to polling"; sleep "$POLL_S"; continue; }
         # --- 2: the round-5 decision ladders ---------------------------
         # f32 baseline rungs, then the conv-lowering variants: adjacent
         # deltas attribute the ~0.83 ms/step floor and decide --conv-impl.
@@ -143,14 +154,41 @@ while true; do
         # completed one), and the unsuffixed copy perf_report reads is
         # refreshed only on a successful f32 run — a truncated later
         # artifact must never clobber a good committed baseline.
-        if ladder f32; then
-            cp "$OUT/bench_r5_stepattr_f32.json" "$OUT/bench_r5_stepattr.json"
-        fi
+        ladder f32
+        # Promote to the unsuffixed copy perf_report reads ONLY if the
+        # new artifact carries at least as many measured rungs as the
+        # incumbent: a budget-truncated partial must never clobber a
+        # complete committed baseline, but the FIRST partial is still
+        # better than nothing.  Unconditional of the ladder's exit code —
+        # a SIGTERM-flushed partial exits 124 yet may hold real rungs;
+        # the rung-count gate alone decides.  Rungs are counted
+        # structurally (float-valued keys; the tool rounds every measured
+        # rung to a float, metadata keys are str/int/dict) so this stays
+        # correct when a rung is added to the tool.
+        python - "$OUT/bench_r5_stepattr_f32.json" "$OUT/bench_r5_stepattr.json" <<'EOF'
+import json, shutil, sys
+src, dst = sys.argv[1], sys.argv[2]
+def count(path):
+    try:
+        d = json.load(open(path))
+    except Exception:
+        return -1
+    return sum(1 for v in d.values() if isinstance(v, float))
+n_src, n_dst = count(src), count(dst)
+if n_src >= n_dst and n_src > 0:
+    shutil.copy(src, dst)
+    print(f"stepattr promoted ({n_src} rungs over {n_dst})")
+else:
+    print(f"stepattr kept incumbent ({n_dst} rungs vs new {n_src})")
+EOF
         commit_artifacts "ladder-f32"
+        probe || { echo "[$(stamp)] TUNNEL LOST after f32 ladder — back to polling"; sleep "$POLL_S"; continue; }
         ladder im2col_c1 --conv-impl im2col_c1
         commit_artifacts "ladder-im2col-c1"
+        probe || { echo "[$(stamp)] TUNNEL LOST after im2col_c1 ladder — back to polling"; sleep "$POLL_S"; continue; }
         ladder im2col --conv-impl im2col
         commit_artifacts "ladder-im2col"
+        probe || { echo "[$(stamp)] TUNNEL LOST after ladders — back to polling"; sleep "$POLL_S"; continue; }
         # --- 3: fused-step trace -> per-op attribution ------------------
         # The trace itself is huge and reset-volatile: keep it in /tmp and
         # commit only the distilled attribution JSON.
@@ -164,6 +202,7 @@ while true; do
             && echo "[$(stamp)] attr: $(head -c 400 "$OUT/bench_r5_attr.json")" \
             || echo "[$(stamp)] trace/attr failed rc=$? (see /tmp/trace_r5_run.log)"
         commit_artifacts "trace-attr"
+        probe || { echo "[$(stamp)] TUNNEL LOST after trace — back to polling"; sleep "$POLL_S"; continue; }
         # --- 4: flash kernel on hardware --------------------------------
         echo "[$(stamp)] flash-attention bench + compiled parity"
         # Outer bound > the tool's own --budget-s soft limit (it skips
@@ -180,6 +219,7 @@ while true; do
             && echo "[$(stamp)] vit: $(promote vit_run vit)" \
             || echo "[$(stamp)] vit bench failed rc=$?"
         commit_artifacts "flash+vit"
+        probe || { echo "[$(stamp)] TUNNEL LOST after flash+vit — back to polling"; sleep "$POLL_S"; continue; }
         # --- 6: variant rows (each min-by-value) ------------------------
         run_bench bf16_run --bf16 && echo "[$(stamp)] bf16: $(promote bf16_run bf16)"
         run_bench pallas_run --pallas-opt && echo "[$(stamp)] pallas: $(promote pallas_run pallas)"
@@ -200,6 +240,10 @@ while true; do
         # ZeRO-1 now rides the fused whole-run (round-5): a full-protocol
         # row is one compile + one dispatch, same as the headline.
         run_bench zero_run --zero && echo "[$(stamp)] zero: $(promote zero_run zero)"
+        # Commit the nine variant rows BEFORE the ~40-min vit/bf16 tail:
+        # a reset mid-tail must not wipe them (durability = a commit).
+        commit_artifacts "variant rows"
+        probe || { echo "[$(stamp)] TUNNEL LOST after variant rows — back to polling"; sleep "$POLL_S"; continue; }
         # ViT mode smoke rows: every shipped mode gets at least one
         # hardware number.  2-epoch quick protocol per mode.
         for mode in sp sp-ulysses tp flash zero; do
@@ -209,6 +253,8 @@ while true; do
                 && echo "[$(stamp)] vit-$mode: $(promote "vit_${mode}_run" "vit_$mode")" \
                 || echo "[$(stamp)] vit-$mode failed rc=$?"
         done
+        commit_artifacts "vit mode rows"
+        probe || { echo "[$(stamp)] TUNNEL LOST after vit modes — back to polling"; sleep "$POLL_S"; continue; }
         # The bf16 ladder (explains why --bf16 moved run_s only 4%).
         ladder bf16 --bf16
         # Pallas optimizer micro-benchmark (decision data for the kernel).
