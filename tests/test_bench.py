@@ -52,6 +52,51 @@ def test_corrupt_snapshot_is_ignored(tmp_path, capsys, monkeypatch):
     assert "last_known_good" not in out
 
 
+def test_snapshot_verdict_policy():
+    """The last-known-good record is min-by-value within the same program
+    and data provenance (tunnel throughput is bimodal — a slow window
+    must not clobber the chip's demonstrated capability, the round-5
+    first-window regression), but a provenance upgrade or a deliberate
+    program change (default flip) always takes the latest run."""
+    prev = {"value": 11.07, "dataset": "synthetic", "prng_impl": "rbg",
+            "compute_dtype": "float32", "syncbn": False,
+            "pallas_opt": False, "pregather": False,
+            "conv_impl": "conv", "zero": False}
+    same = dict(prev)
+
+    # Same program + provenance: strictly faster replaces, slower keeps.
+    assert bench._snapshot_verdict(prev, dict(same, value=26.03)) is None
+    assert bench._snapshot_verdict(prev, dict(same, value=9.5)) == "faster"
+    assert bench._snapshot_verdict(prev, dict(same, value=11.07)) is None
+
+    # A flipped default is a different compiled program: latest wins even
+    # when slower (the flip itself is only made on hardware evidence).
+    assert bench._snapshot_verdict(
+        prev, dict(same, value=26.0, pregather=True)) == "program changed"
+    assert bench._snapshot_verdict(
+        prev, dict(same, value=26.0, conv_impl="im2col_c1")) == "program changed"
+    # Source-level drift without a flag change moves the StableHLO pin
+    # (enforced by test_bench_program_hash_tool), and the bumped pin must
+    # read as a program change too.
+    assert bench._snapshot_verdict(
+        dict(same, program_sha256="a" * 64),
+        dict(same, value=26.0, program_sha256="b" * 64)) == "program changed"
+
+    # Provenance outranks speed in both directions.
+    assert bench._snapshot_verdict(
+        prev, dict(same, value=30.0, dataset="idx")) == "higher data provenance"
+    assert bench._snapshot_verdict(
+        dict(prev, dataset="idx"), dict(same, value=5.0)) is None
+    assert bench._snapshot_verdict(
+        dict(prev, dataset="idx-unverified"),
+        dict(same, value=30.0, dataset="idx")) == "higher data provenance"
+
+    # Degenerate incumbents never block recording.
+    assert bench._snapshot_verdict(None, same) == "first record"
+    assert bench._snapshot_verdict(
+        dict(same, value=None), dict(same, value=20.0)) == "incumbent unreadable"
+
+
 def test_probe_schedule_capping():
     """--probe-attempts slices the schedule; 0 still probes once (a caller
     asking for 'no patience' gets one quick probe, not the full ~5 min)."""
@@ -148,17 +193,16 @@ def test_bench_end_to_end_cpu_smoke():
         assert not snap.get("train_limit")
 
 
-# The headline benchmark program's StableHLO SHA-256, unchanged since the
-# round-3 cache-warming commit.  The persistent XLA cache on the TPU host
-# keys on this program: any commit that shifts it silently invalidates
-# the warm cache and the driver's round-end bench measures a ~19 s cold
-# compile inside the recorded wall clock.  If a change here is
-# INTENTIONAL (e.g. flipping --pregather or --conv-impl defaults after
-# hardware evidence), update this constant in the same commit and re-warm
-# the cache in the next tunnel window.
-HEADLINE_PROGRAM_SHA256 = (
-    "0167c6b4afc2f24d3611198f11a2bda53b72ee7fff212e49261d411fe88fa01b"
-)
+# The headline program's StableHLO SHA-256 pin lives in bench.py (it is
+# also the last-known-good record's program identity); the test asserts
+# the actual lowered program still matches it.  The persistent XLA cache
+# on the TPU host keys on this program: any commit that shifts it
+# silently invalidates the warm cache and the driver's round-end bench
+# measures a ~19 s cold compile inside the recorded wall clock.  If a
+# change is INTENTIONAL (e.g. flipping --pregather or --conv-impl
+# defaults after hardware evidence), update bench.HEADLINE_PROGRAM_SHA256
+# in the same commit and re-warm the cache in the next tunnel window.
+HEADLINE_PROGRAM_SHA256 = bench.HEADLINE_PROGRAM_SHA256
 
 
 def test_bench_program_hash_tool():
