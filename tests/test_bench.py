@@ -278,6 +278,88 @@ def test_bench_program_hash_tool():
     )
 
 
+def test_step_attr_budget_zero_emits_parseable_partial():
+    """The watcher's window budget machinery: a fully budget-starved
+    ladder must still exit 0 with ONE parseable JSON line marking every
+    rung skipped — the promotion gate and perf_report read this file."""
+    import subprocess
+
+    from conftest import cpu_subprocess_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "step_attr_bench.py"),
+         "--allow-cpu", "--steps", "2", "--batch", "4", "--eval-steps", "1",
+         "--eval-batch", "4", "--reps", "1", "--budget-s", "0"],
+        capture_output=True, text=True, env=cpu_subprocess_env(), timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip())
+    assert out["partial"] is True
+    # Every rung skipped, none measured: no float-valued rung keys, so the
+    # watcher's structural rung count is 0 and promotion can't clobber.
+    assert len(out["skipped"]) == 10
+    assert not any(isinstance(v, float) for v in out.values())
+
+
+@pytest.mark.slow  # subprocess ladder + mid-run SIGTERM (~1-2 min on CPU)
+def test_step_attr_sigterm_flushes_partial():
+    """SIGTERM mid-ladder (the watcher's 600 s timeout) must flush the
+    rungs measured so far as one parseable JSON line and exit 124 — the
+    round-4 f32 ladder died at its timeout with an empty artifact."""
+    import subprocess
+    import time as _time
+
+    from conftest import cpu_subprocess_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "tools", "step_attr_bench.py"),
+         "--allow-cpu", "--steps", "4", "--batch", "8", "--eval-steps", "2",
+         "--eval-batch", "8", "--reps", "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=cpu_subprocess_env(),
+    )
+    # Wait for the first completed rung ("full" runs first — decision-value
+    # order), then SIGTERM.  The handler may be deferred while a later
+    # rung's compile holds the interpreter in native code; allow for it.
+    # A reader thread keeps the blocking readline() off the test's own
+    # deadline path (under CPU contention readline can block arbitrarily
+    # long), and EOF/child-death breaks out instead of busy-spinning.
+    import threading
+
+    first_rung_seen = threading.Event()
+    stderr_lines = []
+
+    def _watch_stderr():
+        for line in proc.stderr:  # EOF (child death) ends the loop
+            stderr_lines.append(line)
+            if line.startswith("[rung] full:"):
+                first_rung_seen.set()
+
+    reader = threading.Thread(target=_watch_stderr, daemon=True)
+    reader.start()
+    try:
+        ok = first_rung_seen.wait(timeout=120)
+        assert ok and proc.poll() is None, (
+            "first rung never completed; child stderr:\n"
+            + "".join(stderr_lines)[-2000:]
+        )
+        proc.send_signal(15)
+        try:
+            stdout, _ = proc.communicate(timeout=90)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 124
+    out = json.loads(stdout.strip())
+    assert out["partial"] is True
+    assert isinstance(out["full"], float)  # the measured rung survived
+
+
 @pytest.mark.slow  # subprocess fused run on CPU (~1 min)
 def test_vit_bench_tool_cpu_smoke():
     """tools/vit_bench.py end-to-end on CPU with tiny settings: emits one
