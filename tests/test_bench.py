@@ -7,7 +7,9 @@ import sys
 
 import pytest
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(1, os.path.join(_REPO, "tools"))  # for perf_report
 
 import bench
 
@@ -276,6 +278,38 @@ def test_bench_program_hash_tool():
         "TPU cache is invalidated; revert, or update "
         "HEADLINE_PROGRAM_SHA256 deliberately and re-warm in-window"
     )
+
+
+def test_perf_report_batch_scaling_verdict(tmp_path, monkeypatch):
+    """The b1000 ladder leg's automatic interpretation: near-flat full
+    µs/step across a 5x batch means per-op/latency overhead dominates;
+    near-proportional scaling means the step is compute/bandwidth-bound.
+    (Partial artifacts without the leg simply omit the verdict.)"""
+    import perf_report
+
+    monkeypatch.setattr(perf_report, "REPO", str(tmp_path))
+    base = {"metric": "step_attr_us", "device_kind": "test", "steps": 300,
+            "batch": 200, "full": 830.0, "fwd_bwd": 700.0, "eval": 900.0,
+            "empty_scan": 5.0, "gather_norm": 30.0}
+    (tmp_path / "bench_r5_stepattr.json").write_text(json.dumps(base))
+
+    def b1000_row(full):
+        (tmp_path / "bench_r5_stepattr_b1000.json").write_text(json.dumps(
+            {"metric": "step_attr_us", "batch": 1000, "steps": 60,
+             "full": full}))
+
+    b1000_row(1100.0)  # 1.3x time for 5x work -> latency-bound
+    rep = perf_report.build_report()
+    assert "per-op/latency overhead" in rep, rep
+
+    b1000_row(3800.0)  # 4.6x time for 5x work -> compute-bound
+    rep = perf_report.build_report()
+    assert "bandwidth/compute-bound" in rep, rep
+
+    # Without the leg the report still builds, minus the verdict.
+    (tmp_path / "bench_r5_stepattr_b1000.json").unlink()
+    rep = perf_report.build_report()
+    assert rep is not None and "Batch-scaling" not in rep
 
 
 def test_step_attr_budget_zero_emits_parseable_partial():

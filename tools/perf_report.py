@@ -74,6 +74,8 @@ def build_report() -> str | None:
     # Conv-lowering ladder variants (round-5: the conv1 MXU question).
     conv_c1 = _load("stepattr_im2col_c1.json", prefix)
     conv_all = _load("stepattr_im2col.json", prefix)
+    # Batch-scaling diagnostic ladder (batch 1000 vs the baseline 200).
+    b1000 = _load("stepattr_b1000.json", prefix)
 
     g = ladder.get  # µs per iteration, or None
     lines = []
@@ -178,6 +180,24 @@ def build_report() -> str | None:
                 + (" — flip `--conv-impl` after an end-to-end "
                    "`bench.py --conv-impl` row confirms" if win > 0.05
                    else " — keep the native conv.")
+            )
+    if b1000 and b1000.get("full") and fu and b1000.get("batch"):
+        base_batch = ladder.get("batch") or 200
+        ratio = b1000["full"] / fu
+        scale = b1000["batch"] / base_batch
+        if ratio < 0.4 * scale:
+            verdicts.append(
+                f"Batch-scaling: full at batch {b1000['batch']} is only "
+                f"{ratio:.1f}x the batch-{base_batch} step "
+                f"({scale:.0f}x the work) — the step is dominated by "
+                f"per-op/latency overhead inside the scan body; fewer, "
+                f"larger ops (or bigger per-step batches) are the lever."
+            )
+        else:
+            verdicts.append(
+                f"Batch-scaling: full scales {ratio:.1f}x for {scale:.0f}x "
+                f"batch — the step is bandwidth/compute-bound at these "
+                f"shapes, not overhead-bound."
             )
     if attr and attr.get("gap_share") is not None:
         verdicts.append(

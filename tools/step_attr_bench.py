@@ -55,6 +55,11 @@ def main() -> int:
                         "(models/net.py CONV_IMPLS) — isolates conv1's "
                         "MXU-untileable C_in=1 contraction (docs/PERF.md)")
     p.add_argument("--allow-cpu", action="store_true")
+    p.add_argument("--only", type=str, default=None,
+                   help="comma-separated rung names to run (e.g. "
+                        "'full,fwd_bwd'); unknown names are an error. "
+                        "Used by the watcher's batch-scaling leg, which "
+                        "needs one rung, not ten cold compiles")
     p.add_argument("--budget-s", type=float, default=540.0,
                    help="soft time budget: once exceeded, remaining rungs "
                         "are skipped and the partial JSON still prints "
@@ -250,6 +255,15 @@ def main() -> int:
         "fwd": make_fwd(),
         "eval": make_eval(),
     }
+
+    if args.only:
+        wanted = [w.strip() for w in args.only.split(",") if w.strip()]
+        unknown = [w for w in wanted if w not in variants]
+        if unknown:
+            print(json.dumps({"metric": "step_attr_us",
+                              "error": f"unknown rungs: {unknown}"}))
+            return 2
+        variants = {k: variants[k] for k in wanted}
 
     result = {
         "metric": "step_attr_us",

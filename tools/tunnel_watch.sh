@@ -189,6 +189,16 @@ EOF
         ladder im2col --conv-impl im2col
         commit_artifacts "ladder-im2col"
         probe || { echo "[$(stamp)] TUNNEL LOST after ladders — back to polling"; sleep "$POLL_S"; continue; }
+        # Batch-scaling diagnostic: if full(batch=1000) us/step is ~flat
+        # vs the f32 ladder's full(batch=200), the ~0.5 ms/step residue
+        # is per-op/latency overhead inside the scan body (fix: fewer,
+        # larger ops); if it scales ~5x, the step is bandwidth/compute
+        # bound and the floor is the model's shape.  60 steps keeps the
+        # epoch-equivalent work bounded; --only spends two compiles (the
+        # consumed rung + the overhead/compute split), not ten.
+        ladder b1000 --batch 1000 --steps 60 --only full,fwd_bwd
+        commit_artifacts "ladder-b1000"
+        probe || { echo "[$(stamp)] TUNNEL LOST after b1000 ladder — back to polling"; sleep "$POLL_S"; continue; }
         # --- 3: fused-step trace -> per-op attribution ------------------
         # The trace itself is huge and reset-volatile: keep it in /tmp and
         # commit only the distilled attribution JSON.
