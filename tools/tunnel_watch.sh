@@ -27,14 +27,22 @@ set -u
 cd "$(dirname "$0")/.."
 REPO="$PWD"
 OUT="$REPO"
-POLL_S=${POLL_S:-60}
+# Poll cadence vs window length: dead probes consume their FULL timeout
+# (measured 95 s at the old bound), so the detection cycle was
+# probe+sleep ~155 s while round-5 windows run ~2 min — entire windows
+# could open and close between polls.  Live probes answer in ~3 s
+# (measured twice this round), so 45 s classification + 20 s sleep gives
+# a ~65 s worst-case detection cycle with >10x margin on the live case;
+# a marginal tunnel misread as dead is re-probed 20 s later.
+POLL_S=${POLL_S:-20}
+PROBE_TIMEOUT_S=${PROBE_TIMEOUT_S:-45}
 POST_WINDOW_SLEEP_S=${POST_WINDOW_SLEEP_S:-900}
 BENCH_TIMEOUT_S=${BENCH_TIMEOUT_S:-240}
 
 stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 
 probe() {
-    timeout 95 python -c "import jax; d=jax.devices(); import sys; sys.exit(0 if d[0].platform != 'cpu' else 1)" \
+    timeout "$PROBE_TIMEOUT_S" python -c "import jax; d=jax.devices(); import sys; sys.exit(0 if d[0].platform != 'cpu' else 1)" \
         >/dev/null 2>&1
 }
 
