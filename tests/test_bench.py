@@ -312,6 +312,59 @@ def test_perf_report_batch_scaling_verdict(tmp_path, monkeypatch):
     assert rep is not None and "Batch-scaling" not in rep
 
 
+def test_window_promote_rules(tmp_path):
+    """The watcher's two promotion rules (extracted to
+    tools/window_promote.py): bench rows are min-by-value with the .err
+    sidecar traveling along; ladder baselines are most-measured-rungs so
+    truncated partials can't clobber a complete artifact."""
+    import window_promote as wp
+
+    src = tmp_path / "run.json"
+    dst = tmp_path / "best.json"
+
+    # value: first record promotes, slower keeps, faster promotes.
+    src.write_text(json.dumps({"value": 26.0}))
+    (tmp_path / "run.err").write_text("warm log")
+    assert "promoted 26.0" in wp.promote_value(str(src), str(dst))
+    assert (tmp_path / "best.err").read_text() == "warm log"
+    src.write_text(json.dumps({"value": 30.0}))
+    assert "kept 26.0" in wp.promote_value(str(src), str(dst))
+    assert json.loads(dst.read_text())["value"] == 26.0
+    src.write_text(json.dumps({"value": 9.3}))
+    assert "promoted 9.3" in wp.promote_value(str(src), str(dst))
+
+    # A structured-failure row (value null) or unparseable src never
+    # replaces a real measurement — and never errors.
+    src.write_text(json.dumps({"value": None, "error": "tunnel died"}))
+    assert "kept incumbent" in wp.promote_value(str(src), str(dst))
+    src.write_text("{not json")
+    assert "kept incumbent" in wp.promote_value(str(src), str(dst))
+    assert json.loads(dst.read_text())["value"] == 9.3
+
+    # ...and a failure row does not land on an ABSENT dst either:
+    # promoted artifacts hold measurements only (deliberate change from
+    # the pre-extraction heredoc).
+    absent = tmp_path / "never_measured.json"
+    src.write_text(json.dumps({"value": None, "error": "tunnel died"}))
+    assert "kept incumbent" in wp.promote_value(str(src), str(absent))
+    assert not absent.exists()
+
+    # rungs: more measured float rungs wins; ties promote (fresher data
+    # at equal coverage); fewer keeps; zero-rung partials never land on
+    # top of real data, but the FIRST partial lands on nothing.
+    lsrc = tmp_path / "ladder_new.json"
+    ldst = tmp_path / "ladder_best.json"
+    lsrc.write_text(json.dumps({"batch": 200, "full": 830.0, "fwd_bwd": 700.0}))
+    assert "promoted (2 rungs over -1" in wp.promote_rungs(str(lsrc), str(ldst))
+    lsrc.write_text(json.dumps({"batch": 200, "full": 820.0,
+                                "partial": True}))
+    assert "kept incumbent (2 rungs vs new 1" in wp.promote_rungs(str(lsrc), str(ldst))
+    lsrc.write_text(json.dumps({"batch": 200, "full": 810.0,
+                                "fwd_bwd": 690.0, "eval": 900.0}))
+    assert "promoted (3 rungs over 2" in wp.promote_rungs(str(lsrc), str(ldst))
+    assert json.loads(ldst.read_text())["full"] == 810.0
+
+
 def test_step_attr_budget_zero_emits_parseable_partial():
     """The watcher's window budget machinery: a fully budget-starved
     ladder must still exit 0 with ONE parseable JSON line marking every

@@ -69,25 +69,9 @@ is_warm() { # $1 = tag; true if that run's JSON recorded a warm cache
 }
 
 promote() { # $1 = src tag, $2 = dst tag; copy ONLY if src beats dst.
-    # Tunnel throughput is bimodal (9.3 s vs 61.8 s for the same warm
-    # program minutes apart): every recorded row is min-by-value, never
-    # latest-wins.  The .err sidecar travels with its json.
-    python - "$OUT/bench_r5_$1" "$OUT/bench_r5_$2" <<'EOF'
-import json, os, shutil, sys
-src, dst = sys.argv[1], sys.argv[2]
-new = json.load(open(src + ".json"))["value"]
-try:
-    old = json.load(open(dst + ".json"))["value"]
-except Exception:
-    old = None
-if old is None or (new is not None and new < old):
-    shutil.copy(src + ".json", dst + ".json")
-    if os.path.exists(src + ".err"):
-        shutil.copy(src + ".err", dst + ".err")
-    print(f"promoted {new} (previous {old})")
-else:
-    print(f"kept {old} (new run {new} is slower)")
-EOF
+    # Min-by-value rule + rationale live (tested) in tools/window_promote.py.
+    python "$REPO/tools/window_promote.py" value \
+        "$OUT/bench_r5_$1.json" "$OUT/bench_r5_$2.json"
 }
 
 ladder() { # $1 = tag suffix, rest = extra step_attr_bench.py args
@@ -168,32 +152,13 @@ while true; do
         # refreshed only on a successful f32 run — a truncated later
         # artifact must never clobber a good committed baseline.
         ladder f32
-        # Promote to the unsuffixed copy perf_report reads ONLY if the
-        # new artifact carries at least as many measured rungs as the
-        # incumbent: a budget-truncated partial must never clobber a
-        # complete committed baseline, but the FIRST partial is still
-        # better than nothing.  Unconditional of the ladder's exit code —
-        # a SIGTERM-flushed partial exits 124 yet may hold real rungs;
-        # the rung-count gate alone decides.  Rungs are counted
-        # structurally (float-valued keys; the tool rounds every measured
-        # rung to a float, metadata keys are str/int/dict) so this stays
-        # correct when a rung is added to the tool.
-        python - "$OUT/bench_r5_stepattr_f32.json" "$OUT/bench_r5_stepattr.json" <<'EOF'
-import json, shutil, sys
-src, dst = sys.argv[1], sys.argv[2]
-def count(path):
-    try:
-        d = json.load(open(path))
-    except Exception:
-        return -1
-    return sum(1 for v in d.values() if isinstance(v, float))
-n_src, n_dst = count(src), count(dst)
-if n_src >= n_dst and n_src > 0:
-    shutil.copy(src, dst)
-    print(f"stepattr promoted ({n_src} rungs over {n_dst})")
-else:
-    print(f"stepattr kept incumbent ({n_dst} rungs vs new {n_src})")
-EOF
+        # Refresh the unsuffixed copy perf_report reads via the rung-count
+        # rule (tools/window_promote.py): runs regardless of the ladder's
+        # exit code — a SIGTERM-flushed partial exits 124 yet may hold
+        # real rungs; a truncated partial never clobbers a more complete
+        # committed baseline, but the FIRST partial still lands.
+        python "$REPO/tools/window_promote.py" rungs \
+            "$OUT/bench_r5_stepattr_f32.json" "$OUT/bench_r5_stepattr.json"
         commit_artifacts "ladder-f32"
         probe || { echo "[$(stamp)] TUNNEL LOST after f32 ladder — back to polling"; sleep "$POLL_S"; continue; }
         ladder im2col_c1 --conv-impl im2col_c1

@@ -1,0 +1,89 @@
+"""Artifact promotion rules for the tunnel-window watcher.
+
+Tunnel throughput is bimodal (round 3: 9.3 s vs 61.8 s for the same
+warm program minutes apart; round 5: 3.8x run_s swing on the warm
+headline), so recorded rows are never latest-wins:
+
+- ``value``: copy src over dst only if src's ``"value"`` beats (is
+  lower than) dst's — the rule for every bench row the watcher records
+  (`bench_r5_warm.json`, variant rows, ViT rows).  The ``.err`` sidecar
+  travels with its json.  A src without a numeric value (a structured-
+  failure row, or unparseable bytes) is NEVER promoted, even onto an
+  absent dst — promoted artifacts hold measurements only; failure
+  breadcrumbs live in the per-run `*_run.json`/`.err` files and the
+  watcher log.  (Deliberate change from the pre-extraction heredoc,
+  which copied a failure row onto an absent dst.)
+- ``rungs``: copy src over dst only if src carries at least as many
+  measured ladder rungs — the rule for the unsuffixed step-attribution
+  baseline `tools/perf_report.py` reads.  Rungs are counted
+  structurally (float-valued keys: the ladder tool rounds every
+  measured rung to a float; metadata keys are str/int/dict/bool), so a
+  budget- or SIGTERM-truncated partial can never clobber a more
+  complete committed artifact, while the FIRST partial still lands.
+
+Usage: python tools/window_promote.py {value|rungs} SRC.json DST.json
+Exit 0 either way (promotion declined is not an error); 2 on bad usage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+
+
+def _load(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def promote_value(src: str, dst: str) -> str:
+    """Min-by-``value`` promotion; returns a human-readable outcome."""
+    src_row = _load(src)
+    new = src_row.get("value") if isinstance(src_row, dict) else None
+    if not isinstance(new, (int, float)):
+        return f"kept incumbent (new run has no value: {src})"
+    dst_row = _load(dst)
+    old = dst_row.get("value") if isinstance(dst_row, dict) else None
+    if isinstance(old, (int, float)) and old <= new:
+        return f"kept {old} (new run {new} is slower)"
+    shutil.copy(src, dst)
+    err = src[: -len(".json")] + ".err" if src.endswith(".json") else None
+    if err and os.path.exists(err) and dst.endswith(".json"):
+        shutil.copy(err, dst[: -len(".json")] + ".err")
+    return f"promoted {new} (previous {old})"
+
+
+def count_rungs(row: dict | None) -> int:
+    """Measured-rung count of a ladder artifact (float-valued keys)."""
+    if not isinstance(row, dict):
+        return -1
+    return sum(1 for v in row.values() if isinstance(v, float))
+
+
+def promote_rungs(src: str, dst: str) -> str:
+    """Most-measured-rungs promotion; returns a human-readable outcome."""
+    n_src = count_rungs(_load(src))
+    n_dst = count_rungs(_load(dst))
+    if n_src >= n_dst and n_src > 0:
+        shutil.copy(src, dst)
+        return f"stepattr promoted ({n_src} rungs over {n_dst})"
+    return f"stepattr kept incumbent ({n_dst} rungs vs new {n_src})"
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 4 or argv[1] not in ("value", "rungs"):
+        print("usage: python tools/window_promote.py {value|rungs} "
+              "SRC.json DST.json", file=sys.stderr)
+        return 2
+    fn = promote_value if argv[1] == "value" else promote_rungs
+    print(fn(argv[2], argv[3]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
