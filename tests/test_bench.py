@@ -349,9 +349,9 @@ def test_window_promote_rules(tmp_path):
     assert "kept incumbent" in wp.promote_value(str(src), str(absent))
     assert not absent.exists()
 
-    # rungs: more measured float rungs wins; ties promote (fresher data
-    # at equal coverage); fewer keeps; zero-rung partials never land on
-    # top of real data, but the FIRST partial lands on nothing.
+    # rungs: more measured float rungs wins; fewer keeps; zero-rung
+    # partials never land on top of real data, but the FIRST partial
+    # lands on nothing.
     lsrc = tmp_path / "ladder_new.json"
     ldst = tmp_path / "ladder_best.json"
     lsrc.write_text(json.dumps({"batch": 200, "full": 830.0, "fwd_bwd": 700.0}))
@@ -363,6 +363,17 @@ def test_window_promote_rules(tmp_path):
                                 "fwd_bwd": 690.0, "eval": 900.0}))
     assert "promoted (3 rungs over 2" in wp.promote_rungs(str(lsrc), str(ldst))
     assert json.loads(ldst.read_text())["full"] == 810.0
+
+    # Ties on rung count break toward the lower full rung: a complete
+    # slow-mode re-run must not clobber a complete fast-mode ladder.
+    lsrc.write_text(json.dumps({"batch": 200, "full": 3100.0,
+                                "fwd_bwd": 2900.0, "eval": 3500.0}))
+    assert "kept incumbent (tie at 3 rungs" in wp.promote_rungs(str(lsrc), str(ldst))
+    assert json.loads(ldst.read_text())["full"] == 810.0
+    lsrc.write_text(json.dumps({"batch": 200, "full": 640.0,
+                                "fwd_bwd": 610.0, "eval": 700.0}))
+    assert "promoted (3 rungs over 3" in wp.promote_rungs(str(lsrc), str(ldst))
+    assert json.loads(ldst.read_text())["full"] == 640.0
 
 
 def test_step_attr_budget_zero_emits_parseable_partial():

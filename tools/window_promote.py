@@ -66,13 +66,26 @@ def count_rungs(row: dict | None) -> int:
 
 
 def promote_rungs(src: str, dst: str) -> str:
-    """Most-measured-rungs promotion; returns a human-readable outcome."""
-    n_src = count_rungs(_load(src))
-    n_dst = count_rungs(_load(dst))
-    if n_src >= n_dst and n_src > 0:
-        shutil.copy(src, dst)
-        return f"stepattr promoted ({n_src} rungs over {n_dst})"
-    return f"stepattr kept incumbent ({n_dst} rungs vs new {n_src})"
+    """Most-measured-rungs promotion; returns a human-readable outcome.
+
+    Ties on rung count break toward the lower ``full`` rung: with the
+    short post-window pause the playbook re-runs in later (possibly
+    slow-mode) passes, and a complete slow-mode ladder must not clobber
+    a complete fast-mode one — the minimum over windows is the one
+    robust cross-window statistic (docs/PERF.md)."""
+    src_row, dst_row = _load(src), _load(dst)
+    n_src, n_dst = count_rungs(src_row), count_rungs(dst_row)
+    if n_src <= 0 or n_src < n_dst:
+        return f"stepattr kept incumbent ({n_dst} rungs vs new {n_src})"
+    if n_src == n_dst:
+        old = dst_row.get("full") if isinstance(dst_row, dict) else None
+        new = src_row.get("full") if isinstance(src_row, dict) else None
+        if (isinstance(old, (int, float)) and
+                not (isinstance(new, (int, float)) and new < old)):
+            return (f"stepattr kept incumbent (tie at {n_dst} rungs, "
+                    f"full {old} <= {new})")
+    shutil.copy(src, dst)
+    return f"stepattr promoted ({n_src} rungs over {n_dst})"
 
 
 def main(argv: list[str]) -> int:
