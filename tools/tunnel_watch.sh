@@ -47,7 +47,10 @@ BENCH_TIMEOUT_S=${BENCH_TIMEOUT_S:-240}
 stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 
 probe() {
-    timeout "$PROBE_TIMEOUT_S" python -c "import jax; d=jax.devices(); import sys; sys.exit(0 if d[0].platform != 'cpu' else 1)" \
+    # -k 10: a dead-tunnel jax init can ignore SIGTERM (observed: a 50 s
+    # probe still alive at 9m40) and timeout(1) without -k waits forever,
+    # wedging the whole watcher loop on one probe.
+    timeout -k 10 "$PROBE_TIMEOUT_S" python -c "import jax; d=jax.devices(); import sys; sys.exit(0 if d[0].platform != 'cpu' else 1)" \
         >/dev/null 2>&1
 }
 
@@ -56,7 +59,7 @@ run_bench() { # $1 = tag, rest = extra bench.py args
     echo "[$(stamp)] bench $tag start"
     # Outer bound covers bench.py's probe (~90 s) + watchdog + margin so
     # the structured failure JSON is always written before SIGTERM.
-    timeout $((BENCH_TIMEOUT_S + 180)) \
+    timeout -k 10 $((BENCH_TIMEOUT_S + 180)) \
         python "$REPO/bench.py" --probe-attempts 1 --run-timeout "$BENCH_TIMEOUT_S" "$@" \
         >"$OUT/bench_r5_${tag}.json" 2>"$OUT/bench_r5_${tag}.err"
     local rc=$?
@@ -119,7 +122,7 @@ while true; do
         # --- 0: real-MNIST attempt.  Worst case is 4 files x 2 mirrors x
         # 20 s hanging urlopens = ~160 s; the bound must cover it so the
         # attempt log line is written before any SIGTERM (review finding).
-        timeout 200 python "$REPO/tools/fetch_mnist.py" \
+        timeout -k 10 200 python "$REPO/tools/fetch_mnist.py" \
             && echo "[$(stamp)] IDX FILES LANDED" \
             || echo "[$(stamp)] idx fetch failed (logged)"
         # --- 1: headline ------------------------------------------------
@@ -181,10 +184,10 @@ while true; do
         # The trace itself is huge and reset-volatile: keep it in /tmp and
         # commit only the distilled attribution JSON.
         echo "[$(stamp)] fused trace capture + attribution"
-        timeout 300 python "$REPO/mnist_ddp.py" --fused --epochs 2 \
+        timeout -k 10 300 python "$REPO/mnist_ddp.py" --fused --epochs 2 \
             --batch-size 200 --profile /tmp/trace_r5 \
             >/tmp/trace_r5_run.log 2>&1 \
-            && timeout 120 python "$REPO/tools/trace_attr.py" /tmp/trace_r5 \
+            && timeout -k 10 120 python "$REPO/tools/trace_attr.py" /tmp/trace_r5 \
                 --out "$OUT/bench_r5_attr.json" \
                 >>"$OUT/bench_r5_attr.json.err" 2>&1 \
             && echo "[$(stamp)] attr: $(head -c 400 "$OUT/bench_r5_attr.json")" \
@@ -196,13 +199,13 @@ while true; do
         # Outer bound > the tool's own --budget-s soft limit (it skips
         # remaining shapes once over budget and still prints its JSON);
         # per-shape try/except keeps earlier rows on an OOM at one shape.
-        timeout 900 python "$REPO/tools/flash_bench.py" --grad --parity --budget-s 700 \
+        timeout -k 10 900 python "$REPO/tools/flash_bench.py" --grad --parity --budget-s 700 \
             >"$OUT/bench_r5_flash.json" 2>"$OUT/bench_r5_flash.err" \
             && echo "[$(stamp)] flash: $(head -c 400 "$OUT/bench_r5_flash.json")" \
             || echo "[$(stamp)] flash bench failed rc=$?"
         # --- 5: ViT fused bench with attribution ------------------------
         echo "[$(stamp)] vit bench"
-        timeout 480 python "$REPO/tools/vit_bench.py" \
+        timeout -k 10 480 python "$REPO/tools/vit_bench.py" \
             >"$OUT/bench_r5_vit_run.json" 2>"$OUT/bench_r5_vit_run.err" \
             && echo "[$(stamp)] vit: $(promote vit_run vit)" \
             || echo "[$(stamp)] vit bench failed rc=$?"
@@ -236,7 +239,7 @@ while true; do
         # hardware number.  2-epoch quick protocol per mode.
         for mode in sp sp-ulysses tp flash zero; do
             echo "[$(stamp)] vit mode smoke: $mode"
-            timeout 480 python "$REPO/tools/vit_bench.py" --mode "$mode" --epochs 2 \
+            timeout -k 10 480 python "$REPO/tools/vit_bench.py" --mode "$mode" --epochs 2 \
                 >"$OUT/bench_r5_vit_${mode}_run.json" 2>"$OUT/bench_r5_vit_${mode}_run.err" \
                 && echo "[$(stamp)] vit-$mode: $(promote "vit_${mode}_run" "vit_$mode")" \
                 || echo "[$(stamp)] vit-$mode failed rc=$?"
@@ -253,7 +256,7 @@ while true; do
         # Distill everything this window produced into docs/PERF.md's
         # results section and commit it: the analysis lands even if no
         # interactive session is alive when this window opens.
-        timeout 60 python "$REPO/tools/perf_report.py" \
+        timeout -k 10 60 python "$REPO/tools/perf_report.py" \
             >>"$OUT/bench_r5_perf_report.log" 2>&1 \
             && ( cd "$REPO" && git add docs/PERF.md 2>/dev/null ) \
             && echo "[$(stamp)] perf report appended" \
