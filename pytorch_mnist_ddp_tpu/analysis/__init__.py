@@ -1,0 +1,33 @@
+"""jaxlint: static analysis for JAX-specific hazards, plus a runtime
+recompile sentinel.
+
+The PyTorch reference leans on its runtime to catch misuse (DDP reducer
+asserts, autograd errors); the JAX port has no such guardrail — PRNG key
+reuse, hidden host syncs, and avoidable retraces are all *silent* here,
+costing correctness or step time only at scale.  This package is the
+equivalent guardrail, run as part of the test suite and CI:
+
+- :mod:`.engine` — AST rule engine: file walker, per-rule visitors,
+  structured findings, inline ``# jaxlint: disable=RULE`` suppressions.
+- :mod:`.rules` — the JL001–JL006 rule set (see docs/ANALYSIS.md).
+- :mod:`.sentinel` — :class:`RecompileSentinel`, a runtime wrapper that
+  fails tests when a jitted function retraces more than expected.
+
+CLI: ``python -m pytorch_mnist_ddp_tpu.analysis [paths] [--json]
+[--fail-on-warning]`` (or ``tools/jaxlint.py``).
+"""
+
+from .engine import Finding, LintEngine, Severity, iter_python_files
+from .rules import ALL_RULES, rule_by_id
+from .sentinel import RecompileError, RecompileSentinel
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "LintEngine",
+    "RecompileError",
+    "RecompileSentinel",
+    "Severity",
+    "iter_python_files",
+    "rule_by_id",
+]

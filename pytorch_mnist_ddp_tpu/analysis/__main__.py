@@ -1,0 +1,89 @@
+"""jaxlint CLI: ``python -m pytorch_mnist_ddp_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (or warnings without ``--fail-on-warning``), 1 when
+findings fail the run, 2 on usage errors.  ``--json`` emits a machine-
+readable report for CI annotation tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .engine import LintEngine, Severity
+from .rules import ALL_RULES
+
+
+def _default_target() -> str:
+    """The package itself — so the bare module invocation lints the repo."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="AST-based JAX correctness analyzer (rules JL001-JL006; "
+        "see docs/ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the "
+        "pytorch_mnist_ddp_tpu package)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as a JSON report on stdout",
+    )
+    parser.add_argument(
+        "--fail-on-warning", action="store_true",
+        help="exit nonzero on warnings, not just errors (the CI setting)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.rule_id} [{rule.severity}] {rule.summary}")
+        return 0
+
+    paths = args.paths or [_default_target()]
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"jaxlint: no such path: {path}", file=sys.stderr)
+            return 2
+
+    engine = LintEngine(ALL_RULES)
+    findings, suppressed = engine.run(paths)
+    errors = sum(1 for f in findings if f.severity is Severity.ERROR)
+    warnings = len(findings) - errors
+
+    if args.as_json:
+        print(json.dumps(
+            {
+                "findings": [f.to_dict() for f in findings],
+                "errors": errors,
+                "warnings": warnings,
+                "suppressed": suppressed,
+            },
+            indent=2,
+        ))
+    else:
+        for f in findings:
+            print(f.format())
+        print(
+            f"jaxlint: {errors} error(s), {warnings} warning(s), "
+            f"{suppressed} suppressed"
+        )
+
+    if errors or (warnings and args.fail_on_warning):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

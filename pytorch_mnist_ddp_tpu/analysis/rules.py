@@ -1,0 +1,803 @@
+"""The jaxlint rule set: JL001–JL006, the JAX hazards this repo has
+actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work).
+
+Every rule is a heuristic over one module's AST — no type inference, no
+cross-file call graph.  "Traced context" below means: a function that is
+(a) decorated with a jax transform, (b) passed by name into a transform
+call (``jax.jit(f)``, ``shard_map(f, ...)``, ``lax.scan(f, ...)`` …), or
+(c) called (by name, same module) from another traced function, to a
+fixpoint.  That per-module closure is what makes "``.item()`` somewhere
+under ``fit``" findable without executing anything.
+
+False positives are expected at the margin; the contract is that they are
+cheap to waive (``# jaxlint: disable=RULE -- reason``) and the waiver is
+visible in review.  See docs/ANALYSIS.md for the per-rule rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .engine import Finding, ModuleContext, Rule, Severity
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``jax.lax.scan`` for an Attribute chain rooted at a Name, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# Transform entry points whose function-valued arguments get traced.  Both
+# fully-dotted and from-import spellings; the last segment alone is NOT
+# matched (a user function named ``scan`` must not poison the analysis).
+_TRANSFORM_CALLS = {
+    "jax.jit", "jit", "pjit", "jax.pjit",
+    "jax.pmap", "pmap",
+    "jax.shard_map", "shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.grad", "grad", "jax.value_and_grad", "value_and_grad",
+    "jax.vmap", "vmap",
+    "jax.checkpoint", "jax.remat", "remat",
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.cond", "lax.cond",
+    "jax.lax.switch", "lax.switch",
+    "jax.lax.map", "lax.map",
+    "jax.custom_jvp", "jax.custom_vjp",
+    "jax.linearize", "jax.vjp", "jax.jvp",
+}
+
+# The subset that builds a *compiled callable with its own trace cache* —
+# constructing one of these inside a loop is a retrace generator (JL004).
+_JIT_CONSTRUCTORS = {
+    "jax.jit", "jit", "pjit", "jax.pjit", "jax.pmap", "pmap",
+}
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def iter_own_body(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a def/lambda body WITHOUT descending into nested scopes.
+
+    Nested defs get their own traced-or-not classification (via the call
+    graph), so descending here would double-report their findings under
+    the wrong function.
+    """
+    if isinstance(fn, ast.Lambda):
+        stack: list[ast.AST] = [fn.body]
+    else:
+        stack = list(getattr(fn, "body", []))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _SCOPE_NODES):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def iter_loop_body_nodes(loop: ast.AST) -> Iterator[ast.AST]:
+    """Nodes executed by a loop's body, not descending into nested scopes.
+
+    A function merely *defined* inside the loop runs elsewhere — flagging
+    its body as per-iteration work would be a false positive (its own
+    call sites get their own classification).
+    """
+    stack: list[ast.AST] = list(loop.body) + list(loop.orelse)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _SCOPE_NODES):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _decorator_is_transform(dec: ast.AST) -> bool:
+    name = dotted_name(dec)
+    if name in _TRANSFORM_CALLS:
+        return True
+    if isinstance(dec, ast.Call):
+        # @partial(jax.jit, ...) / @functools.partial(jit, ...)
+        if dotted_name(dec.func) in {"partial", "functools.partial"}:
+            return any(dotted_name(a) in _TRANSFORM_CALLS for a in dec.args)
+        return dotted_name(dec.func) in _TRANSFORM_CALLS
+    return False
+
+
+class TraceAnalysis:
+    """Which defs/lambdas in a module execute under a jax trace."""
+
+    def __init__(self, tree: ast.Module):
+        self.defs: list[ast.AST] = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+        ]
+        by_name: dict[str, list[ast.AST]] = {}
+        for d in self.defs:
+            if not isinstance(d, ast.Lambda):
+                by_name.setdefault(d.name, []).append(d)
+
+        self.traced: set[ast.AST] = set()
+        for d in self.defs:
+            if any(_decorator_is_transform(dec)
+                   for dec in getattr(d, "decorator_list", [])):
+                self.traced.add(d)
+
+        # Functions handed to a transform by name (or as a lambda literal).
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) not in _TRANSFORM_CALLS:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    self.traced.update(by_name.get(arg.id, []))
+                elif isinstance(arg, ast.Lambda):
+                    self.traced.add(arg)
+
+        # Same-module transitive closure: a call by bare name from a traced
+        # body marks the callee traced ("fit-reachable" within the module).
+        callees: dict[ast.AST, set[str]] = {}
+        for d in self.defs:
+            names = set()
+            for node in iter_own_body(d):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    names.add(node.func.id)
+            callees[d] = names
+        changed = True
+        while changed:
+            changed = False
+            for d in list(self.traced):
+                for name in callees.get(d, ()):
+                    for cand in by_name.get(name, []):
+                        if cand not in self.traced:
+                            self.traced.add(cand)
+                            changed = True
+
+    def traced_defs(self) -> list[ast.AST]:
+        return [d for d in self.defs if d in self.traced]
+
+
+def get_trace_analysis(ctx: ModuleContext) -> TraceAnalysis:
+    cached = getattr(ctx, "_trace_analysis", None)
+    if cached is None:
+        cached = TraceAnalysis(ctx.tree)
+        ctx._trace_analysis = cached  # type: ignore[attr-defined]
+    return cached
+
+
+def _fn_label(fn: ast.AST) -> str:
+    return "<lambda>" if isinstance(fn, ast.Lambda) else fn.name
+
+
+# ---------------------------------------------------------------------------
+# JL001 — PRNG key reuse
+
+
+_KEY_CONSUMERS = {
+    "split", "normal", "uniform", "bernoulli", "randint", "permutation",
+    "shuffle", "choice", "categorical", "gumbel", "truncated_normal",
+    "dirichlet", "beta", "gamma", "poisson", "exponential", "laplace",
+    "cauchy", "rademacher", "bits", "orthogonal", "t", "multivariate_normal",
+    "loggamma", "ball", "maxwell", "binomial",
+}
+# fold_in / PRNGKey derive without consuming; they are deliberately absent.
+_KEY_PREFIXES = ("jax.random.", "random.", "jr.", "jrandom.")
+
+# Bare (from-import) spellings are only matched for names unambiguous
+# enough that a collision with an ordinary local helper is implausible.
+# Generic English words (`t`, `choice`, `shuffle`, `beta`, `normal`, ...)
+# need the module prefix — JL001 is an ERROR, so precision wins.
+_BARE_CONSUMERS = {
+    "split", "bernoulli", "categorical", "gumbel", "dirichlet",
+    "rademacher", "truncated_normal", "multivariate_normal", "loggamma",
+}
+
+
+def _consumer_call(node: ast.Call) -> str | None:
+    """The sampler name if this call consumes a PRNG key, else None."""
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    if name in _BARE_CONSUMERS:  # from jax.random import split, bernoulli
+        return name
+    for prefix in _KEY_PREFIXES:
+        if name.startswith(prefix) and name[len(prefix):] in _KEY_CONSUMERS:
+            return name
+    return None
+
+
+class KeyReuseRule(Rule):
+    """JL001: a PRNG key passed to a second sampler without a re-split.
+
+    Reusing a key makes two "independent" draws identical — silently
+    correlated dropout masks / init values, the kind of bug no test that
+    only checks shapes ever catches.
+    """
+
+    rule_id = "JL001"
+    severity = Severity.ERROR
+    summary = "PRNG key reused after being consumed; split it instead"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        analysis = get_trace_analysis(ctx)
+        reported: set[tuple[str, int]] = set()
+        scopes: list[tuple[ast.AST, list[ast.stmt]]] = [(ctx.tree, ctx.tree.body)]
+        for d in analysis.defs:
+            if not isinstance(d, ast.Lambda):
+                scopes.append((d, d.body))
+        for _scope, body in scopes:
+            state: dict[str, tuple[int, str]] = {}
+            yield from self._scan_stmts(ctx, body, state, reported)
+
+    # -- ordered scan ------------------------------------------------------
+
+    def _scan_stmts(self, ctx, stmts, state, reported) -> Iterator[Finding]:
+        for stmt in stmts:
+            yield from self._scan_stmt(ctx, stmt, state, reported)
+
+    def _scan_stmt(self, ctx, stmt, state, reported) -> Iterator[Finding]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            state.pop(stmt.name, None)
+            return
+        if isinstance(stmt, ast.Assign):
+            yield from self._scan_expr(ctx, stmt.value, state, reported)
+            for target in stmt.targets:
+                self._reset_target(target, state)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                yield from self._scan_expr(ctx, stmt.value, state, reported)
+            self._reset_target(stmt.target, state)
+        elif isinstance(stmt, ast.If):
+            yield from self._scan_expr(ctx, stmt.test, state, reported)
+            snapshot = dict(state)
+            yield from self._scan_stmts(ctx, stmt.body, state, reported)
+            after_body = dict(state)
+            state.clear()
+            state.update(snapshot)
+            yield from self._scan_stmts(ctx, stmt.orelse, state, reported)
+            # Join: consumed on either branch counts as consumed after.
+            state.update(after_body)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            yield from self._scan_expr(ctx, stmt.iter, state, reported)
+            self._reset_target(stmt.target, state)
+            # Two passes over the body: the second catches a key consumed in
+            # iteration k and reused (not re-split) in iteration k+1.
+            yield from self._scan_stmts(ctx, stmt.body, state, reported)
+            self._reset_target(stmt.target, state)
+            yield from self._scan_stmts(ctx, stmt.body, state, reported)
+            yield from self._scan_stmts(ctx, stmt.orelse, state, reported)
+        elif isinstance(stmt, ast.While):
+            yield from self._scan_expr(ctx, stmt.test, state, reported)
+            yield from self._scan_stmts(ctx, stmt.body, state, reported)
+            yield from self._scan_stmts(ctx, stmt.body, state, reported)
+            yield from self._scan_stmts(ctx, stmt.orelse, state, reported)
+        elif isinstance(stmt, ast.With) or isinstance(stmt, ast.AsyncWith):
+            for item in stmt.items:
+                yield from self._scan_expr(ctx, item.context_expr, state, reported)
+                if item.optional_vars is not None:
+                    self._reset_target(item.optional_vars, state)
+            yield from self._scan_stmts(ctx, stmt.body, state, reported)
+        elif isinstance(stmt, ast.Try):
+            yield from self._scan_stmts(ctx, stmt.body, state, reported)
+            for handler in stmt.handlers:
+                yield from self._scan_stmts(ctx, handler.body, state, reported)
+            yield from self._scan_stmts(ctx, stmt.orelse, state, reported)
+            yield from self._scan_stmts(ctx, stmt.finalbody, state, reported)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    yield from self._scan_expr(ctx, child, state, reported)
+
+    def _scan_expr(self, ctx, expr, state, reported) -> Iterator[Finding]:
+        if isinstance(expr, ast.Lambda):
+            return
+        if isinstance(expr, ast.NamedExpr):
+            yield from self._scan_expr(ctx, expr.value, state, reported)
+            self._reset_target(expr.target, state)
+            return
+        if isinstance(expr, ast.Call):
+            for child in ast.iter_child_nodes(expr):
+                if isinstance(child, ast.expr) and child is not expr.func:
+                    yield from self._scan_expr(ctx, child, state, reported)
+            sampler = _consumer_call(expr)
+            if sampler and expr.args and isinstance(expr.args[0], ast.Name):
+                key_name = expr.args[0].id
+                if key_name in state:
+                    first_line, first_sampler = state[key_name]
+                    mark = (key_name, expr.lineno)
+                    if mark not in reported:
+                        reported.add(mark)
+                        yield self.finding(
+                            ctx, expr,
+                            f"PRNG key '{key_name}' reused by {sampler} but "
+                            f"already consumed by {first_sampler} (line "
+                            f"{first_line}); derive fresh keys with "
+                            "jax.random.split/fold_in instead",
+                        )
+                else:
+                    state[key_name] = (expr.lineno, sampler)
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                yield from self._scan_expr(ctx, child, state, reported)
+
+    @staticmethod
+    def _reset_target(target: ast.AST, state: dict) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                state.pop(node.id, None)
+
+
+# ---------------------------------------------------------------------------
+# JL002 — host-device sync inside traced code
+
+
+_NP_HOST_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_SYNC_METHODS = {"item", "tolist", "to_py"}
+
+
+class HostSyncRule(Rule):
+    """JL002: ``.item()`` / ``float(tracer)`` / ``np.asarray`` under trace.
+
+    Under ``jit`` these either fail at trace time (ConcretizationTypeError)
+    or — worse, when the function sometimes runs untraced — silently force
+    a device→host round trip that stalls the TPU pipeline every step.
+    """
+
+    rule_id = "JL002"
+    severity = Severity.ERROR
+    summary = "host-device synchronization inside a traced function"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        analysis = get_trace_analysis(ctx)
+        for fn in analysis.traced_defs():
+            label = _fn_label(fn)
+            static_names = self._static_int_names(fn)
+            for node in iter_own_body(fn):
+                if isinstance(node, ast.Call):
+                    yield from self._check_call(ctx, node, label, static_names)
+                elif isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                    name = dotted_name(test.func) if isinstance(test, ast.Call) else None
+                    if name and (name.startswith("jnp.") or name.startswith("jax.numpy.")):
+                        yield self.finding(
+                            ctx, test,
+                            f"implicit bool() on a traced value in '{label}' "
+                            f"({name}(...) used as a branch condition); use "
+                            "jax.lax.cond/jnp.where for traced control flow",
+                        )
+
+    @staticmethod
+    def _static_int_names(fn: ast.AST) -> set[str]:
+        """Names bound from ``x.shape`` (un)packing in this body.
+
+        Shape elements are static Python ints during tracing, so
+        ``float(d)`` after ``b, t, h, d = q.shape`` is idiomatic JAX, not
+        a host sync — exempt those names from the concretization check.
+        """
+        names: set[str] = set()
+        for node in iter_own_body(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            is_shape = (
+                (isinstance(value, ast.Attribute) and value.attr == "shape")
+                or (isinstance(value, ast.Subscript)
+                    and isinstance(value.value, ast.Attribute)
+                    and value.value.attr == "shape")
+            )
+            if not is_shape:
+                continue
+            for target in node.targets:
+                for sub in ast.walk(target):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+        return names
+
+    def _check_call(
+        self, ctx, node: ast.Call, label: str, static_names: set[str]
+    ) -> Iterator[Finding]:
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS:
+            if not node.args and not node.keywords:
+                yield self.finding(
+                    ctx, node,
+                    f".{func.attr}() inside traced function '{label}' forces "
+                    "a device sync (or fails under jit); return the array "
+                    "and read it on the host side",
+                )
+            return
+        name = dotted_name(func)
+        if name in _NP_HOST_CALLS:
+            yield self.finding(
+                ctx, node,
+                f"{name}(...) inside traced function '{label}' pulls the "
+                "value to host numpy; use jnp.* under trace and convert "
+                "outside the jitted boundary",
+            )
+        elif name in {"jax.device_get", "device_get"}:
+            yield self.finding(
+                ctx, node,
+                f"jax.device_get inside traced function '{label}'; device "
+                "transfers belong outside the jitted boundary",
+            )
+        elif name in {"float", "int", "bool"} and len(node.args) == 1:
+            arg = node.args[0]
+            # Static under trace: literals, shape-derived ints, len() (a
+            # traced len() already fails loudly at trace time), and
+            # x.shape[i] / x.ndim attribute reads.
+            if isinstance(arg, ast.Constant):
+                return
+            if isinstance(arg, ast.Name) and arg.id in static_names:
+                return
+            if isinstance(arg, ast.Call) and dotted_name(arg.func) == "len":
+                return
+            if isinstance(arg, ast.Attribute) and arg.attr in {"shape", "ndim"}:
+                return
+            if (isinstance(arg, ast.Subscript)
+                    and isinstance(arg.value, ast.Attribute)
+                    and arg.value.attr == "shape"):
+                return
+            yield self.finding(
+                ctx, node,
+                f"{name}(...) on a non-literal inside traced function "
+                f"'{label}' concretizes a tracer (host sync or trace "
+                "error); keep values as jnp arrays under trace",
+            )
+
+
+# ---------------------------------------------------------------------------
+# JL003 — Python side effects under trace
+
+
+_IMPURE_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.process_time",
+    "datetime.now", "datetime.datetime.now", "datetime.utcnow",
+    "random.random", "random.randint", "random.shuffle", "random.choice",
+    "np.random.rand", "np.random.randn", "np.random.randint",
+    "np.random.normal", "np.random.uniform", "np.random.seed",
+    "open", "input",
+}
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "pop", "popitem", "remove", "clear", "discard",
+}
+
+
+class SideEffectRule(Rule):
+    """JL003: effects that run at TRACE time, not at step time.
+
+    A ``print``/``time.time()``/list-append under ``jit`` executes once
+    per trace (usually once, period) — code that looks like per-step
+    logging or accumulation silently does nothing after compilation.
+    """
+
+    rule_id = "JL003"
+    severity = Severity.ERROR
+    summary = "Python side effect inside a traced function"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        analysis = get_trace_analysis(ctx)
+        for fn in analysis.traced_defs():
+            label = _fn_label(fn)
+            local_names = self._local_bindings(fn)
+            for node in iter_own_body(fn):
+                if not isinstance(node, (ast.Call, ast.Assign)):
+                    continue
+                if isinstance(node, ast.Assign):
+                    for target in node.targets:
+                        if (isinstance(target, ast.Subscript)
+                                and isinstance(target.value, ast.Name)
+                                and target.value.id not in local_names):
+                            yield self.finding(
+                                ctx, target,
+                                f"assignment into closed-over '{target.value.id}' "
+                                f"inside traced function '{label}' happens at "
+                                "trace time only; thread values through the "
+                                "function's returns instead",
+                            )
+                    continue
+                name = dotted_name(node.func)
+                if name == "print":
+                    yield self.finding(
+                        ctx, node,
+                        f"print() inside traced function '{label}' runs at "
+                        "trace time only (once, with tracers); use "
+                        "jax.debug.print for runtime values",
+                    )
+                elif name in _IMPURE_CALLS:
+                    yield self.finding(
+                        ctx, node,
+                        f"{name}() inside traced function '{label}' is "
+                        "evaluated once at trace time and baked into the "
+                        "program as a constant; compute it outside the "
+                        "jitted boundary",
+                    )
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _MUTATING_METHODS
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id not in local_names):
+                    yield self.finding(
+                        ctx, node,
+                        f".{node.func.attr}() on closed-over "
+                        f"'{node.func.value.id}' inside traced function "
+                        f"'{label}' mutates at trace time only; carry state "
+                        "through the traced function's inputs/outputs",
+                    )
+
+    @staticmethod
+    def _binding_names(target: ast.AST):
+        """Names BOUND by an assignment target.  A Subscript/Attribute
+        target (``cache[k] = v``) binds nothing — collecting its base
+        name would mark the closed-over container "local" and silence
+        the very mutation this rule exists to catch."""
+        if isinstance(target, ast.Name):
+            yield target.id
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                yield from SideEffectRule._binding_names(elt)
+        elif isinstance(target, ast.Starred):
+            yield from SideEffectRule._binding_names(target.value)
+
+    @staticmethod
+    def _local_bindings(fn: ast.AST) -> set[str]:
+        names: set[str] = set()
+        args = getattr(fn, "args", None)
+        if args is not None:
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                names.add(a.arg)
+            for a in (args.vararg, args.kwarg):
+                if a is not None:
+                    names.add(a.arg)
+        for node in iter_own_body(fn):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    names.update(SideEffectRule._binding_names(target))
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                if isinstance(node.target, ast.Name):
+                    names.add(node.target.id)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                names.update(SideEffectRule._binding_names(node.target))
+            elif isinstance(node, ast.NamedExpr):
+                if isinstance(node.target, ast.Name):
+                    names.add(node.target.id)
+        return names
+
+
+# ---------------------------------------------------------------------------
+# JL004 — retrace triggers
+
+
+class RetraceRule(Rule):
+    """JL004: program structure that forces avoidable recompiles.
+
+    (a) building a jitted callable inside a loop — every iteration gets an
+    empty trace cache, so every iteration pays a full trace+compile;
+    (b) ``jnp.array([...])`` literals inside traced functions — a fresh
+    constant materialized on every trace, the round-3 "mystery" constant
+    uploads.
+    """
+
+    rule_id = "JL004"
+    severity = Severity.WARNING
+    summary = "avoidable retrace trigger"
+
+    _JNP_CTORS = {"jnp.array", "jnp.asarray", "jax.numpy.array", "jax.numpy.asarray"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        analysis = get_trace_analysis(ctx)
+        # (a) jit/pmap construction inside any loop body.
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for sub in iter_loop_body_nodes(loop):
+                if (isinstance(sub, ast.Call)
+                        and dotted_name(sub.func) in _JIT_CONSTRUCTORS):
+                    yield self.finding(
+                        ctx, sub,
+                        f"{dotted_name(sub.func)}(...) constructed inside "
+                        "a loop: each iteration builds a fresh callable "
+                        "with an empty trace cache (compile every "
+                        "iteration); hoist the jitted function out of "
+                        "the loop",
+                    )
+        # (b) jnp.array literal construction under trace.  Only flagged
+        # when every element is a compile-time constant: stacking traced
+        # values (`jnp.array([x.sum(), y.sum()])`) is legitimate and NOT
+        # hoistable.
+        for fn in analysis.traced_defs():
+            label = _fn_label(fn)
+            for node in iter_own_body(fn):
+                if (isinstance(node, ast.Call)
+                        and dotted_name(node.func) in self._JNP_CTORS
+                        and node.args
+                        and self._is_const_literal(node.args[0])):
+                    yield self.finding(
+                        ctx, node,
+                        f"{dotted_name(node.func)} of a Python literal inside "
+                        f"traced function '{label}' materializes a fresh "
+                        "constant every trace; hoist it to module scope or "
+                        "close over a precomputed array",
+                    )
+
+    @staticmethod
+    def _is_const_literal(node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, (ast.List, ast.Tuple)):
+            return bool(node.elts) and all(
+                RetraceRule._is_const_literal(e) for e in node.elts
+            )
+        if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.USub, ast.UAdd)
+        ):
+            return RetraceRule._is_const_literal(node.operand)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# JL005 — missing donation on state-carrying jitted steps
+
+
+class DonationRule(Rule):
+    """JL005: a jitted step whose arg 0 is a train/opt state, not donated.
+
+    Without ``donate_argnums`` the old state's buffers stay live across
+    the update, doubling optimizer-state HBM and costing a copy per step
+    — exactly the class of waste the fused-path work (docs/PERF.md)
+    hunted by hand.
+    """
+
+    rule_id = "JL005"
+    severity = Severity.WARNING
+    summary = "state-carrying jitted step without donate_argnums"
+
+    _STATE_HINTS = ("state", "carry", "opt")
+    _DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        by_name: dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name[node.name] = node
+
+        # Resolution is PER SCOPE, nearest-preceding-assignment wins: the
+        # repo's factories all bind a local ``sharded = jax.shard_map(...)``
+        # before ``return jax.jit(sharded)``, and a module-global map would
+        # resolve every one of them to whichever factory parsed last.
+        scopes: list[ast.AST] = [ctx.tree] + [
+            d for d in ast.walk(ctx.tree)
+            if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            events: list[tuple[int, int, str, ast.AST]] = []
+            if isinstance(scope, ast.Module):
+                nodes: list[ast.AST] = []
+                stack = list(scope.body)
+                while stack:
+                    node = stack.pop()
+                    nodes.append(node)
+                    if not isinstance(node, _SCOPE_NODES):
+                        stack.extend(ast.iter_child_nodes(node))
+            else:
+                nodes = list(iter_own_body(scope))
+            for node in nodes:
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Call)):
+                    events.append((node.lineno, node.col_offset, "assign", node))
+                elif (isinstance(node, ast.Call)
+                        and dotted_name(node.func) in {"jax.jit", "jit",
+                                                       "pjit", "jax.pjit"}):
+                    events.append((node.lineno, node.col_offset, "jit", node))
+            assigns: dict[str, ast.Call] = {}
+            for _, _, kind, node in sorted(events, key=lambda e: (e[0], e[1])):
+                if kind == "assign":
+                    assigns[node.targets[0].id] = node.value
+                    continue
+                yield from self._check_jit_call(ctx, node, by_name, assigns)
+
+    def _check_jit_call(self, ctx, node, by_name, assigns) -> Iterator[Finding]:
+        if any(kw.arg in self._DONATE_KWARGS for kw in node.keywords):
+            return
+        if not node.args:
+            return
+        target = self._resolve(node.args[0], by_name, assigns)
+        if target is None:
+            return
+        first_param = self._first_param(target)
+        if first_param is None:
+            return
+        if any(h in first_param.lower() for h in self._STATE_HINTS):
+            yield self.finding(
+                ctx, node,
+                f"jax.jit of '{_fn_label(target)}' carries "
+                f"'{first_param}' in arg 0 but has no donate_argnums; "
+                "donate the state so the old buffers are reused instead "
+                "of held live across the update",
+            )
+
+    def _resolve(self, arg, by_name, assigns, depth: int = 0):
+        """Follow ``jit(name)`` where name is a def or ``shard_map(def, …)``."""
+        if depth > 3 or not isinstance(arg, ast.Name):
+            return None
+        if arg.id in by_name:
+            return by_name[arg.id]
+        call = assigns.get(arg.id)
+        if call is not None and dotted_name(call.func) in _TRANSFORM_CALLS:
+            for inner in call.args:
+                resolved = self._resolve(inner, by_name, assigns, depth + 1)
+                if resolved is not None:
+                    return resolved
+        return None
+
+    @staticmethod
+    def _first_param(fn) -> str | None:
+        args = getattr(fn, "args", None)
+        if args is None:
+            return None
+        ordered = args.posonlyargs + args.args
+        if not ordered:
+            return None
+        first = ordered[0].arg
+        return None if first in {"self", "cls"} else first
+
+
+# ---------------------------------------------------------------------------
+# JL006 — device_get in hot loops
+
+
+class DeviceGetLoopRule(Rule):
+    """JL006: ``jax.device_get`` inside a Python loop.
+
+    Each call is a blocking D2H transfer; in a per-batch loop it
+    serializes the device pipeline every iteration (the round-2 "run_s
+    parked in print" effect).  Batch the reads, or read once after the
+    loop.
+    """
+
+    rule_id = "JL006"
+    severity = Severity.WARNING
+    summary = "blocking device_get inside a loop"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for sub in iter_loop_body_nodes(loop):
+                if (isinstance(sub, ast.Call)
+                        and dotted_name(sub.func) in {"jax.device_get",
+                                                      "device_get"}):
+                    yield self.finding(
+                        ctx, sub,
+                        "jax.device_get inside a loop blocks on a "
+                        "device-to-host transfer every iteration; batch "
+                        "the reads or move the transfer after the loop",
+                    )
+
+
+ALL_RULES: tuple[Rule, ...] = (
+    KeyReuseRule(),
+    HostSyncRule(),
+    SideEffectRule(),
+    RetraceRule(),
+    DonationRule(),
+    DeviceGetLoopRule(),
+)
+
+
+def rule_by_id(rule_id: str) -> Rule:
+    for rule in ALL_RULES:
+        if rule.rule_id == rule_id.upper():
+            return rule
+    raise KeyError(f"unknown rule id {rule_id!r}")

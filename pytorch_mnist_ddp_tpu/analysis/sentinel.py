@@ -1,0 +1,100 @@
+"""Runtime recompile sentinel: fail loudly when a jitted function
+retraces more often than its caller expects.
+
+Static analysis (JL004) catches the *structural* retrace generators;
+this catches the behavioral ones — a dtype that flips per batch, a shape
+that wobbles on the last partial batch, a Python scalar in the arg list
+— by watching the real trace cache of a ``jax.jit`` callable.  A train
+step that silently compiles 40 times instead of once is invisible in
+test assertions (the numbers are right!) and cost the round-3 bench
+investigation hours; wrapped in a sentinel, the second unexpected trace
+is a test failure with a pointed message.
+
+Usage::
+
+    step = RecompileSentinel(make_train_step(mesh), max_traces=1)
+    for batch in loader:
+        state, loss = step(state, *batch)   # raises RecompileError on trace 2
+
+The trace count is read from the jit callable's own cache
+(``_cache_size``), so the sentinel adds no tracing hooks and zero
+per-call device work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+
+class RecompileError(AssertionError):
+    """A jitted function exceeded its expected trace count.
+
+    Subclasses ``AssertionError`` so pytest renders it as a plain test
+    failure (with the sentinel's diagnosis) rather than an error.
+    """
+
+
+class RecompileSentinel:
+    """Wrap a jitted callable and bound its number of traces.
+
+    Parameters
+    ----------
+    fn:
+        The ``jax.jit`` (or ``pjit``) callable to guard.  Must expose a
+        trace-cache size (every ``jax.jit`` result does); wrapping a
+        plain Python function is a usage error and raises ``TypeError``
+        immediately rather than silently never failing.
+    max_traces:
+        The number of distinct traces the caller considers legitimate.
+        1 for a fixed-shape hot loop; 2 when e.g. a final partial batch
+        legitimately compiles a second program.
+    name:
+        Label used in error messages; defaults to the wrapped function's.
+    """
+
+    def __init__(
+        self, fn: Callable[..., Any], max_traces: int = 1, name: str | None = None
+    ):
+        cache_size = getattr(fn, "_cache_size", None)
+        if not callable(cache_size):
+            raise TypeError(
+                "RecompileSentinel needs a jax.jit-compiled callable (got "
+                f"{fn!r} with no trace cache); jit the function first"
+            )
+        if max_traces < 1:
+            raise ValueError(f"max_traces must be >= 1, got {max_traces}")
+        self._fn = fn
+        self.max_traces = max_traces
+        self.name = name or getattr(fn, "__name__", repr(fn))
+        self.calls = 0
+        functools.update_wrapper(self, fn, updated=())
+
+    def trace_count(self) -> int:
+        """Distinct traces the wrapped function has accumulated so far."""
+        return int(self._fn._cache_size())
+
+    def check(self) -> None:
+        """Assert the trace bound now (also runs after every call)."""
+        traces = self.trace_count()
+        if traces > self.max_traces:
+            raise RecompileError(
+                f"{self.name} retraced: {traces} traces after {self.calls} "
+                f"calls (expected <= {self.max_traces}). Something in the "
+                "call signature is unstable — look for changing shapes/"
+                "dtypes (last partial batch?), Python scalars that vary per "
+                "call (pass jnp scalars), or fresh non-array objects in "
+                "the args."
+            )
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        out = self._fn(*args, **kwargs)
+        self.calls += 1
+        self.check()
+        return out
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging sugar
+        return (
+            f"RecompileSentinel({self.name}, traces={self.trace_count()}/"
+            f"{self.max_traces}, calls={self.calls})"
+        )

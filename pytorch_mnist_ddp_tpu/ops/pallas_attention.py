@@ -57,6 +57,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .attention import NEG_INF
+from ..utils.jax_compat import shape_dtype_struct, tpu_compiler_params, typeof
 
 _LANES = 128
 _MAX_BLOCK = 128  # q/k block rows; small t uses one sublane-aligned block
@@ -114,8 +115,8 @@ def _out_struct(shape, dtype, *inputs) -> jax.ShapeDtypeStruct:
     empty and this is a plain ShapeDtypeStruct."""
     vma = frozenset()
     for x in inputs:
-        vma = vma | jax.typeof(x).vma
-    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        vma = vma | typeof(x).vma
+    return shape_dtype_struct(shape, dtype, vma=vma)
 
 
 def _fold_block(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
@@ -208,7 +209,7 @@ def _flash_fwd(q3, k3, v3, t_real: int, scale: float, interpret: bool):
             pltpu.VMEM((block, _LANES), jnp.float32),  # l
             pltpu.VMEM((block, dp), jnp.float32),      # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -323,7 +324,7 @@ def _flash_fwd_res(q, k, v):
     b, t, h, d = q.shape
     scale = 1.0 / float(d) ** 0.5
     interpret = jax.default_backend() != "tpu"
-    if interpret and jax.typeof(q).vma:
+    if interpret and typeof(q).vma:
         # Under VMA-tracked shard_map the interpreter cannot trace the
         # kernel (see _flash_partial); same exact-twin dispatch.
         return _dense_fwd_res(q, k, v, scale)
@@ -465,7 +466,7 @@ def _flash_partial(m, l, a, q3, k3, v3, t_kv, scale,
         # The state updates in place: (m0, l0, a0) buffers are dead after
         # the hop and become (m, l, a) out.
         input_output_aliases={3: 0, 4: 1, 5: 2},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -35,6 +35,7 @@ from ..ops.adadelta import AdadeltaState, adadelta_init
 from ..ops.loss import nll_loss
 from ..ops.pallas_adadelta import adadelta_update_best
 from .mesh import DATA_AXIS
+from ..utils.jax_compat import shard_map
 
 
 class TrainState(NamedTuple):
@@ -198,7 +199,7 @@ def make_train_step(
         )
         return new_state, loss[None]  # keep a per-shard loss axis
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
@@ -238,7 +239,7 @@ def make_eval_step(
         totals = jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
         return totals
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),

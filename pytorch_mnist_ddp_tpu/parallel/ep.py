@@ -45,6 +45,7 @@ from ..models.moe import (
 )
 from ..models.vit import ViTConfig, vit_moe_forward
 from .mesh import DATA_AXIS, place_tree
+from ..utils.jax_compat import shard_map
 
 AUX_LOSS_WEIGHT = 0.01  # standard Switch-style weighting of the balance loss
 
@@ -191,7 +192,7 @@ def make_ep_train_step(
         )
         return TrainState(params, opt, state.step + 1), nll[None]
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(state_specs, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
@@ -219,7 +220,7 @@ def make_ep_eval_step(mesh: Mesh, cfg: ViTConfig, use_flash: bool = False):
         correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(ep_param_specs(cfg), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),

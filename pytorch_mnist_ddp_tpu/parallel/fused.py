@@ -37,6 +37,7 @@ from ..ops.loss import nll_loss
 from ..ops.pallas_adadelta import adadelta_update_best
 from .ddp import TrainState, eval_variables
 from .mesh import DATA_AXIS
+from ..utils.jax_compat import shard_map
 
 
 def _normalize_dev(x_u8: jax.Array, compute_dtype) -> jax.Array:
@@ -255,7 +256,7 @@ def make_fused_train_epoch(
         state, losses = local_epoch(*a)
         return state, losses[:, None]  # per-shard loss column
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_epoch_col,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P(), P()),
@@ -349,7 +350,7 @@ def make_fused_eval(
         model, dataset_size, global_batch, n_shards, compute_dtype
     )
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(P(), P(), P()),
@@ -506,7 +507,7 @@ def make_fused_run(
     # (a from_key run has no state input — the key is replicated).
     state_out_spec = zero_state_spec() if zero else P()
     state_in_spec = P() if from_key else state_out_spec
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_run,
         mesh=mesh,
         in_specs=(state_in_spec, P(), P(), P(), P(), P(), P(), P()),

@@ -29,6 +29,7 @@ from .fused import (  # shared staging + scan skeletons
     device_put_dataset,
 )
 from .mesh import DATA_AXIS
+from ..utils.jax_compat import shard_map
 
 __all__ = ["device_put_dataset", "make_fused_vit_run"]
 
@@ -108,7 +109,7 @@ def make_fused_vit_run(
         return state, jnp.moveaxis(gathered, 0, -1), evals
 
     state_spec = zero_state_spec() if zero else P()
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_run,
         mesh=mesh,
         in_specs=(state_spec,) + (P(),) * 6,

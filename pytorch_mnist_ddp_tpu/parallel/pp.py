@@ -35,6 +35,7 @@ from ..ops.loss import nll_loss
 from .ddp import TrainState
 from .mesh import DATA_AXIS
 from .pipeline import NUM_STAGES, STAGE_AXIS, make_pipeline_loss
+from ..utils.jax_compat import shard_map
 
 _FLAT = 9216  # stage-boundary activation width (64 * 12 * 12)
 
@@ -139,7 +140,7 @@ def make_pp_train_step(
         params, opt = adadelta_update(state.params, grads, state.opt, lr, rho, eps)
         return TrainState(params, opt, state.step + 1), loss[None]
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),

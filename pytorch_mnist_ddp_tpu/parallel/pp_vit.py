@@ -43,6 +43,7 @@ from ..ops.loss import nll_loss
 from .ddp import TrainState
 from .mesh import DATA_AXIS
 from .pipeline import STAGE_AXIS, make_pipeline_loss_multi
+from ..utils.jax_compat import shard_map
 
 
 def _stage_bounds(depth: int, num_stages: int) -> list[int]:
@@ -154,7 +155,7 @@ def make_vit_pp_train_step(
         )
         return TrainState(params, opt, state.step + 1), loss[None]
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
@@ -183,7 +184,7 @@ def make_vit_eval_step(mesh: Mesh, cfg: ViTConfig, attention_fn=None):
         correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),

@@ -27,6 +27,7 @@ from ..ops.attention import (
     init_block_acc,
 )
 from .mesh import DATA_AXIS, make_2d_mesh
+from ..utils.jax_compat import axis_size, pcast, shard_map, typeof
 
 SEQ_AXIS = "seq"
 
@@ -61,7 +62,7 @@ def ring_attention(
     tests/test_sp.py.  One jnp-stacked carry keeps the scan body a single
     fused (matmul + rescale + ppermute) program per hop.
     """
-    size = jax.lax.axis_size(axis_name)
+    size = axis_size(axis_name)
     b, t_local, h, d = q.shape
     perm = [(i, (i + 1) % size) for i in range(size)]
 
@@ -81,17 +82,17 @@ def ring_attention(
     # invariant->variant.
     target_vma = (
         {axis_name}
-        | jax.typeof(q).vma
-        | jax.typeof(k).vma
-        | jax.typeof(v).vma
-        | (set() if kv_mask is None else jax.typeof(kv_mask).vma)
+        | typeof(q).vma
+        | typeof(k).vma
+        | typeof(v).vma
+        | (set() if kv_mask is None else typeof(kv_mask).vma)
     )
 
     def ensure_varying(leaf):
-        missing = tuple(sorted(target_vma - set(jax.typeof(leaf).vma)))
+        missing = tuple(sorted(target_vma - set(typeof(leaf).vma)))
         if not missing:
             return leaf
-        return jax.lax.pcast(leaf, missing, to="varying")
+        return pcast(leaf, missing, to="varying")
 
     if kv_mask is None:
         # Unmasked fast path: no mask travels the ring and block_update
@@ -140,7 +141,7 @@ def ring_attention_flash(
     parity pins: tests/test_flash.py."""
     from ..ops import pallas_attention as pa
 
-    size = jax.lax.axis_size(axis_name)
+    size = axis_size(axis_name)
     b, t_local, h, d = q.shape
     tp = pa.flash_pad_len(t_local)
     scale = 1.0 / float(d) ** 0.5
@@ -155,14 +156,14 @@ def ring_attention_flash(
     # steps keep check_vma=True — their transpose-inserted psums are
     # load-bearing); under a check_vma=False shard_map every vma is
     # empty and no cast exists to make.
-    input_vma = jax.typeof(q3).vma | jax.typeof(k3).vma | jax.typeof(v3).vma
+    input_vma = typeof(q3).vma | typeof(k3).vma | typeof(v3).vma
     target_vma = ({axis_name} | input_vma) if input_vma else set()
 
     def ensure_varying(leaf):
-        missing = tuple(sorted(target_vma - set(jax.typeof(leaf).vma)))
+        missing = tuple(sorted(target_vma - set(typeof(leaf).vma)))
         if not missing:
             return leaf
-        return jax.lax.pcast(leaf, missing, to="varying")
+        return pcast(leaf, missing, to="varying")
 
     def hop(carry, _):
         m, l, a, k3, v3 = carry
@@ -266,7 +267,7 @@ def _sp_vit_forward(
         tokens_to_logp,
     )
 
-    num_seq = jax.lax.axis_size(SEQ_AXIS)
+    num_seq = axis_size(SEQ_AXIS)
     t_local = cfg.num_tokens // num_seq
     start = jax.lax.axis_index(SEQ_AXIS) * t_local
 
@@ -345,7 +346,7 @@ def make_sp_train_step(mesh: Mesh, cfg, rho: float = 0.9, eps: float = 1e-6,
         )
         return TrainState(params, opt, state.step + 1), loss[None]
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
@@ -374,7 +375,7 @@ def make_sp_eval_step(mesh: Mesh, cfg, use_flash: bool = False,
         correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),

@@ -40,6 +40,7 @@ from .sp import (
     ring_attention,
     ring_attention_flash,
 )
+from ..utils.jax_compat import axis_size, shard_map
 from .tp_vit import (
     _check_head_divisibility,
     _tp_block,
@@ -87,8 +88,8 @@ def _sp3_vit_forward(
     this device embeds its ``T/S`` token slice (sp.py's slicing), projects
     its ``H/M`` heads (tp_vit's column split), rides the seq ring for
     attention, and completes proj/mlp_out with model-axis psums."""
-    num_seq = jax.lax.axis_size(SEQ_AXIS)
-    heads_local = cfg.heads // jax.lax.axis_size(MODEL_AXIS)
+    num_seq = axis_size(SEQ_AXIS)
+    heads_local = cfg.heads // axis_size(MODEL_AXIS)
     t_local = cfg.num_tokens // num_seq
     start = jax.lax.axis_index(SEQ_AXIS) * t_local
 
@@ -144,7 +145,7 @@ def make_sp3_train_step(
         )
         return TrainState(params, opt, state.step + 1), loss[None]
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(state_specs, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
@@ -164,7 +165,7 @@ def make_sp3_eval_step(mesh: Mesh, cfg: ViTConfig, use_flash: bool = False):
         correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(

@@ -40,6 +40,7 @@ from ..ops.adadelta import AdadeltaState, adadelta_update
 from ..ops.loss import nll_loss
 from .ddp import TrainState
 from .mesh import DATA_AXIS, MODEL_AXIS, place_tree
+from ..utils.jax_compat import shard_map
 
 
 def param_specs() -> dict:
@@ -132,7 +133,7 @@ def make_tp_eval_step(mesh: Mesh, compute_dtype: jnp.dtype = jnp.float32):
         correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(param_specs(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
@@ -182,7 +183,7 @@ def make_tp_train_step(
         )
         return TrainState(params, opt, state.step + 1), loss[None]
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(state_specs(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),

@@ -45,6 +45,7 @@ from ..ops.attention import full_attention
 from ..ops.loss import nll_loss
 from .ddp import TrainState
 from .mesh import DATA_AXIS, MODEL_AXIS, place_tree
+from ..utils.jax_compat import axis_size, shard_map
 
 
 def _check_head_divisibility(cfg: ViTConfig, mesh: Mesh) -> None:
@@ -145,7 +146,7 @@ def _tp_vit_forward(
     per-head-shard attention for the fused Pallas kernel
     (ops/pallas_attention.py — head-sharded local attention is exactly
     the kernel's shape, the ulysses composition again)."""
-    heads_local = cfg.heads // jax.lax.axis_size(MODEL_AXIS)
+    heads_local = cfg.heads // axis_size(MODEL_AXIS)
     from ..ops.pallas_attention import select_attention
 
     attention_fn = select_attention(use_flash)
@@ -191,7 +192,7 @@ def make_vit_tp_train_step(
         )
         return TrainState(params, opt, state.step + 1), loss[None]
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(state_specs, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
@@ -212,7 +213,7 @@ def make_vit_tp_eval_step(mesh: Mesh, cfg: ViTConfig, use_flash: bool = False):
         correct = ((jnp.argmax(logp, axis=1) == y) * w).sum()
         return jax.lax.psum(jnp.stack([loss_sum, correct]), DATA_AXIS)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(

@@ -54,6 +54,7 @@ from ..models.net import Net
 from ..ops.adadelta import AdadeltaState, adadelta_delta
 from .ddp import TrainState, forward_loss, fold_replica_step_key
 from .mesh import DATA_AXIS, place_tree
+from ..utils.jax_compat import shard_map
 
 
 class ZeroAdadeltaState(NamedTuple):
@@ -263,7 +264,7 @@ def make_zero_train_step(
         return new_state, loss[None]  # keep a per-shard loss axis
 
     state_spec = zero_state_spec()
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(state_spec, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
@@ -307,7 +308,7 @@ def make_zero_vit_train_step(mesh: Mesh, cfg, rho: float = 0.9,
         return new_state, loss[None]
 
     state_spec = zero_state_spec()
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(state_spec, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),

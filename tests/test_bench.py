@@ -273,6 +273,14 @@ def test_bench_program_hash_tool():
         outs.append(proc.stdout.strip())
     assert len(outs[0]) == 64 and set(outs[0]) <= set("0123456789abcdef")
     assert outs[0] == outs[1], "hash not deterministic"
+    from pytorch_mnist_ddp_tpu.utils.jax_compat import OLD_JAX_COMPAT
+
+    if OLD_JAX_COMPAT:
+        # The pin records the StableHLO modern jax lowers on the bench
+        # box; the pre-VMA fallback lowers a different (still
+        # deterministic, asserted above) program, so pin equality is
+        # meaningless here.
+        pytest.skip("HEADLINE_PROGRAM_SHA256 is pinned for modern jax")
     assert outs[0] == HEADLINE_PROGRAM_SHA256, (
         "the headline benchmark program's StableHLO changed — the warm "
         "TPU cache is invalidated; revert, or update "

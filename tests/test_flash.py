@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from pytorch_mnist_ddp_tpu.ops.attention import full_attention
+from pytorch_mnist_ddp_tpu.utils.jax_compat import shard_map
 from pytorch_mnist_ddp_tpu.ops.pallas_attention import (
     attention_best,
     flash_active,
@@ -161,7 +162,7 @@ def test_ring_flash_matches_dense(devices):
     def local(q, k, v):
         return ring_attention_flash(q, k, v, SEQ_AXIS)
 
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(shard_map(
         local, mesh=mesh,
         in_specs=(P(DATA_AXIS, SEQ_AXIS),) * 3,
         out_specs=P(DATA_AXIS, SEQ_AXIS),
@@ -234,7 +235,7 @@ def test_tp_forward_with_flash_matches_plain(devices):
     )
 
     def fwd(use_flash):
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             lambda p, x: _tp_vit_forward(p, x, cfg, use_flash=use_flash),
             mesh=mesh,
             in_specs=(vit_tp_param_specs(cfg), P(DATA_AXIS)),

@@ -85,7 +85,9 @@ def test_vit_flops_against_xla_costing():
     params = init_vit_params(jax.random.PRNGKey(0), cfg)
     x = jnp.zeros((200, 28, 28, 1), jnp.float32)
     comp = jax.jit(lambda p, x: vit_forward(p, x, cfg)).lower(params, x)
-    xla_flops = comp.compile().cost_analysis()["flops"]
+    ca = comp.compile().cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    xla_flops = ca["flops"]
     analytic = vit_forward_flops_per_sample(cfg) * 200
     # Looser than the CNN's 2%: the analytic model skips layernorm/gelu/
     # softmax elementwise work, a bigger share at dim-64 ViT scale.

@@ -1,0 +1,739 @@
+"""jaxlint analyzer tests: every rule fires on its bad fixture and stays
+silent on its good twin; suppressions are honored; the repo itself lints
+clean; the recompile sentinel catches real retraces.
+
+The fixtures are deliberately minimal — each bad snippet contains exactly
+one hazard, each good snippet the idiomatic fix, so a rule regression
+shows up as a precise fixture diff rather than a finding-count drift.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from pytorch_mnist_ddp_tpu.analysis import (
+    ALL_RULES,
+    LintEngine,
+    RecompileError,
+    RecompileSentinel,
+    Severity,
+)
+
+ENGINE = LintEngine(ALL_RULES)
+
+
+def findings_for(source: str, rule_id: str | None = None):
+    found, _ = ENGINE.check_source(source, "fixture.py")
+    if rule_id is None:
+        return found
+    return [f for f in found if f.rule_id == rule_id]
+
+
+def assert_fires(source: str, rule_id: str, line: int | None = None):
+    hits = findings_for(source, rule_id)
+    assert hits, f"{rule_id} did not fire on its bad fixture"
+    if line is not None:
+        assert line in [f.line for f in hits], (
+            f"{rule_id} fired at {[f.line for f in hits]}, expected {line}"
+        )
+
+
+def assert_silent(source: str, rule_id: str):
+    hits = findings_for(source, rule_id)
+    assert not hits, f"{rule_id} false-positive: {[f.format() for f in hits]}"
+
+
+# ---------------------------------------------------------------------------
+# JL001 — PRNG key reuse
+
+
+JL001_BAD = """\
+import jax
+
+def draw(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))
+    return a + b
+"""
+
+JL001_GOOD = """\
+import jax
+
+def draw(key):
+    ka, kb = jax.random.split(key)
+    a = jax.random.normal(ka, (4,))
+    b = jax.random.uniform(kb, (4,))
+    return a + b
+"""
+
+
+def test_jl001_fires_on_reuse():
+    assert_fires(JL001_BAD, "JL001", line=5)
+
+
+def test_jl001_silent_on_split():
+    assert_silent(JL001_GOOD, "JL001")
+
+
+def test_jl001_catches_reuse_across_loop_iterations():
+    assert_fires(
+        """\
+import jax
+
+def draws(key, n):
+    out = []
+    for _ in range(n):
+        out.append(jax.random.normal(key, (2,)))
+    return out
+""",
+        "JL001",
+    )
+
+
+def test_jl001_allows_resplit_in_loop():
+    assert_silent(
+        """\
+import jax
+
+def draws(key, n):
+    out = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        out.append(jax.random.normal(sub, (2,)))
+    return out
+""",
+        "JL001",
+    )
+
+
+def test_jl001_allows_fold_in_derivation():
+    # fold_in derives without consuming: repeated fold_in of one base key
+    # with distinct data is the repo's own per-step pattern (utils/rng.py).
+    assert_silent(
+        """\
+import jax
+
+def per_step(key, step):
+    k1 = jax.random.fold_in(key, step)
+    k2 = jax.random.fold_in(key, step + 1)
+    return jax.random.normal(k1, (2,)) + jax.random.normal(k2, (2,))
+""",
+        "JL001",
+    )
+
+
+def test_jl001_branches_are_exclusive():
+    # consumption on both sides of an if/else is NOT reuse.
+    assert_silent(
+        """\
+import jax
+
+def draw(key, flag):
+    if flag:
+        return jax.random.normal(key, (2,))
+    else:
+        return jax.random.uniform(key, (2,))
+""",
+        "JL001",
+    )
+
+
+# ---------------------------------------------------------------------------
+# JL002 — host-device sync under trace
+
+
+JL002_BAD = """\
+import jax
+
+@jax.jit
+def step(state, x):
+    loss = (x * x).sum()
+    return state, loss.item()
+"""
+
+JL002_GOOD = """\
+import jax
+
+@jax.jit
+def step(state, x):
+    loss = (x * x).sum()
+    return state, loss
+"""
+
+
+def test_jl002_fires_on_item():
+    assert_fires(JL002_BAD, "JL002", line=6)
+
+
+def test_jl002_silent_on_device_values():
+    assert_silent(JL002_GOOD, "JL002")
+
+
+def test_jl002_fires_on_np_asarray_in_transitive_callee():
+    # .item()/np.asarray two calls below the jitted entry point — the
+    # per-module call-graph closure must still see it.
+    assert_fires(
+        """\
+import jax
+import numpy as np
+
+def helper(x):
+    return np.asarray(x)
+
+def body(x):
+    return helper(x) + 1
+
+step = jax.jit(body)
+""",
+        "JL002",
+        line=5,
+    )
+
+
+def test_jl002_fires_on_float_of_tracer():
+    assert_fires(
+        """\
+import jax
+
+@jax.jit
+def f(x):
+    return float(x.sum())
+""",
+        "JL002",
+    )
+
+
+def test_jl002_allows_float_of_shape():
+    # b, t, h, d = q.shape are static Python ints under trace.
+    assert_silent(
+        """\
+import jax
+
+@jax.jit
+def f(q):
+    b, t, h, d = q.shape
+    scale = 1.0 / float(d) ** 0.5
+    return q * scale
+""",
+        "JL002",
+    )
+
+
+def test_jl002_fires_on_traced_bool_branch():
+    assert_fires(
+        """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    if jnp.any(x > 0):
+        return x
+    return -x
+""",
+        "JL002",
+    )
+
+
+def test_jl002_untraced_function_is_fine():
+    # Host code may sync freely; only traced context is policed.
+    assert_silent(
+        """\
+import numpy as np
+
+def log_loss(loss):
+    return float(np.asarray(loss))
+""",
+        "JL002",
+    )
+
+
+# ---------------------------------------------------------------------------
+# JL003 — Python side effects under trace
+
+
+JL003_BAD = """\
+import jax
+
+@jax.jit
+def step(state, x):
+    print("loss", x)
+    return state
+"""
+
+JL003_GOOD = """\
+import jax
+
+@jax.jit
+def step(state, x):
+    jax.debug.print("loss {}", x)
+    return state
+"""
+
+
+def test_jl003_fires_on_print():
+    assert_fires(JL003_BAD, "JL003", line=5)
+
+
+def test_jl003_silent_on_debug_print():
+    assert_silent(JL003_GOOD, "JL003")
+
+
+def test_jl003_fires_on_time_and_closure_mutation():
+    source = """\
+import jax
+import time
+
+history = []
+
+@jax.jit
+def step(x):
+    t = time.time()
+    history.append(x)
+    return x + t
+"""
+    assert_fires(source, "JL003", line=8)
+    assert_fires(source, "JL003", line=9)
+
+
+def test_jl003_fires_on_closed_over_subscript_assignment():
+    # `cache[k] = v` binds nothing — the closed-over dict must still be
+    # recognized as non-local (and the method branch must not be silenced
+    # by the subscript's base name).
+    source = """\
+import jax
+
+cache = {}
+
+@jax.jit
+def step(x):
+    cache["k"] = x
+    cache.clear()
+    return x
+"""
+    assert_fires(source, "JL003", line=7)
+    assert_fires(source, "JL003", line=8)
+
+
+def test_jl003_allows_local_accumulation():
+    # Appending to a list created INSIDE the traced function is a normal
+    # trace-time construction pattern (e.g. collecting layer outputs).
+    assert_silent(
+        """\
+import jax
+
+@jax.jit
+def f(x):
+    outs = []
+    for i in range(3):
+        outs.append(x * i)
+    return sum(outs)
+""",
+        "JL003",
+    )
+
+
+# ---------------------------------------------------------------------------
+# JL004 — retrace triggers
+
+
+JL004_BAD = """\
+import jax
+
+def sweep(xs):
+    outs = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)
+        outs.append(f(x))
+    return outs
+"""
+
+JL004_GOOD = """\
+import jax
+
+def sweep(xs):
+    f = jax.jit(lambda v: v * 2)
+    return [f(x) for x in xs]
+"""
+
+
+def test_jl004_fires_on_jit_in_loop():
+    assert_fires(JL004_BAD, "JL004", line=6)
+
+
+def test_jl004_silent_on_hoisted_jit():
+    assert_silent(JL004_GOOD, "JL004")
+
+
+def test_jl004_fires_on_literal_constant_under_trace():
+    assert_fires(
+        """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    mean = jnp.array([0.1307])
+    return x - mean
+""",
+        "JL004",
+        line=6,
+    )
+
+
+def test_jl004_allows_stacking_traced_values():
+    # jnp.array over TRACED elements is not a hoistable constant — the
+    # idiomatic scalar-stacking pattern must stay clean.
+    assert_silent(
+        """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x, y):
+    return jnp.array([x.sum(), y.sum()])
+""",
+        "JL004",
+    )
+
+
+def test_jl004_allows_array_conversion_of_argument():
+    assert_silent(
+        """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    return jnp.asarray(x) + 1
+""",
+        "JL004",
+    )
+
+
+# ---------------------------------------------------------------------------
+# JL005 — missing donation on state-carrying steps
+
+
+JL005_BAD = """\
+import jax
+
+def make_step(mesh):
+    def local_step(state, x):
+        return state, x
+    sharded = jax.shard_map(local_step, mesh=mesh, in_specs=None, out_specs=None)
+    return jax.jit(sharded)
+"""
+
+JL005_GOOD = """\
+import jax
+
+def make_step(mesh):
+    def local_step(state, x):
+        return state, x
+    sharded = jax.shard_map(local_step, mesh=mesh, in_specs=None, out_specs=None)
+    return jax.jit(sharded, donate_argnums=(0,))
+"""
+
+
+def test_jl005_fires_on_undonated_state_step():
+    assert_fires(JL005_BAD, "JL005", line=7)
+
+
+def test_jl005_silent_with_donation():
+    assert_silent(JL005_GOOD, "JL005")
+
+
+def test_jl005_eval_steps_not_flagged():
+    # No state in arg 0 -> nothing to donate; eval factories stay clean
+    # even when a SIBLING factory in the same module binds the same
+    # ``sharded`` name to a state-carrying step (per-scope resolution).
+    assert_silent(
+        """\
+import jax
+
+def make_step(mesh):
+    def local_step(state, x):
+        return state, x
+    sharded = jax.shard_map(local_step, mesh=mesh, in_specs=None, out_specs=None)
+    return jax.jit(sharded, donate_argnums=(0,))
+
+def make_eval(mesh):
+    def local_eval(params, x):
+        return x
+    sharded = jax.shard_map(local_eval, mesh=mesh, in_specs=None, out_specs=None)
+    return jax.jit(sharded)
+""",
+        "JL005",
+    )
+
+
+# ---------------------------------------------------------------------------
+# JL006 — device_get in hot loops
+
+
+JL006_BAD = """\
+import jax
+
+def epoch(step, state, batches):
+    for batch in batches:
+        state, loss = step(state, batch)
+        log(jax.device_get(loss))
+    return state
+"""
+
+JL006_GOOD = """\
+import jax
+
+def epoch(step, state, batches):
+    losses = []
+    for batch in batches:
+        state, loss = step(state, batch)
+        losses.append(loss)
+    log(jax.device_get(losses))
+    return state
+"""
+
+
+def test_jl006_fires_on_device_get_in_loop():
+    assert_fires(JL006_BAD, "JL006", line=6)
+
+
+def test_jl006_silent_on_batched_read():
+    assert_silent(JL006_GOOD, "JL006")
+
+
+def test_jl006_def_inside_loop_not_flagged():
+    # A function merely DEFINED in a loop runs elsewhere; its body is not
+    # per-iteration work.
+    assert_silent(
+        """\
+import jax
+
+def build(names):
+    cbs = {}
+    for name in names:
+        def reader(x):
+            return jax.device_get(x)
+        cbs[name] = reader
+    return cbs
+""",
+        "JL006",
+    )
+
+
+def test_nested_loops_yield_one_finding_per_hazard():
+    hits = findings_for(
+        """\
+import jax
+
+def sweep(xs):
+    for i in xs:
+        for j in xs:
+            f = jax.jit(lambda v: v * 2)
+""",
+        "JL004",
+    )
+    assert len(hits) == 1, [h.format() for h in hits]
+
+
+def test_jl001_generic_bare_names_are_not_samplers():
+    # `t(a)` twice is an ordinary helper call, not PRNG key reuse; only
+    # unambiguous sampler names match without a jax.random prefix.
+    assert_silent(
+        """\
+def wrap(t, a):
+    x = t(a)
+    y = t(a)
+    return x + y
+""",
+        "JL001",
+    )
+    assert_fires(  # the unambiguous bare spelling still counts
+        """\
+from jax.random import split, bernoulli
+
+def draw(key):
+    k1, k2 = split(key)
+    return bernoulli(key, 0.5)  # key already consumed by split
+""",
+        "JL001",
+        line=5,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Suppressions + engine behavior
+
+
+def test_inline_suppression_is_honored():
+    suppressed_src = JL002_BAD.replace(
+        "loss.item()",
+        "loss.item()  # jaxlint: disable=JL002 -- fixture waiver",
+    )
+    found, suppressed = ENGINE.check_source(suppressed_src, "fixture.py")
+    assert not [f for f in found if f.rule_id == "JL002"]
+    assert suppressed == 1
+
+
+def test_suppression_on_multiline_statement_closing_line():
+    # The waiver naturally trails the closing paren of a multi-line call;
+    # it must cover the finding anchored at the opening line.
+    src = """\
+import jax
+
+def make_step(mesh):
+    def local_step(state, x):
+        return state, x
+    sharded = jax.shard_map(local_step, mesh=mesh, in_specs=None, out_specs=None)
+    return jax.jit(
+        sharded,
+    )  # jaxlint: disable=JL005 -- state reused by the caller on purpose
+"""
+    found, suppressed = ENGINE.check_source(src, "fixture.py")
+    assert not [f for f in found if f.rule_id == "JL005"]
+    assert suppressed == 1
+
+
+def test_suppression_all_is_case_insensitive():
+    src = JL001_BAD.replace(
+        "jax.random.uniform(key, (4,))",
+        "jax.random.uniform(key, (4,))  # jaxlint: disable=ALL -- fixture",
+    )
+    assert_silent(src, "JL001")
+
+
+def test_suppression_is_rule_specific():
+    # Waiving JL003 must not waive the JL002 hit on the same line.
+    src = """\
+import jax
+
+@jax.jit
+def f(x):
+    return x.item()  # jaxlint: disable=JL003 -- wrong rule on purpose
+"""
+    assert_fires(src, "JL002")
+
+
+def test_file_wide_suppression():
+    src = "# jaxlint: disable-file=JL001\n" + JL001_BAD
+    assert_silent(src, "JL001")
+
+
+def test_suppression_inside_string_is_ignored():
+    src = JL001_BAD + '\nNOTE = "# jaxlint: disable=JL001"\n'
+    assert_fires(src, "JL001")
+
+
+def test_overlapping_paths_lint_each_file_once(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(JL001_BAD)
+    found, _ = ENGINE.run([str(bad), str(tmp_path), str(bad)])
+    assert len([f for f in found if f.rule_id == "JL001"]) == 1
+
+
+def test_syntax_error_reports_jl000():
+    found, _ = ENGINE.check_source("def broken(:\n", "bad.py")
+    assert [f.rule_id for f in found] == ["JL000"]
+    assert found[0].severity is Severity.ERROR
+
+
+def test_findings_carry_location_and_serialize():
+    found = findings_for(JL001_BAD, "JL001")
+    d = found[0].to_dict()
+    assert d["path"] == "fixture.py" and d["rule"] == "JL001"
+    assert d["line"] == 5 and d["col"] > 0 and d["severity"] == "error"
+
+
+# ---------------------------------------------------------------------------
+# The repo itself lints clean (the CI gate, runnable locally the same way)
+
+
+@pytest.mark.lint
+def test_repo_lints_clean():
+    """`python -m pytorch_mnist_ddp_tpu.analysis --fail-on-warning` exits 0:
+    every real finding in first-party code is fixed or carries a reviewed
+    inline waiver.  This test IS the local equivalent of the CI lint job."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytorch_mnist_ddp_tpu.analysis",
+         "--fail-on-warning"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.lint
+def test_cli_json_and_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(JL001_BAD)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytorch_mnist_ddp_tpu.analysis",
+         str(bad), "--json"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1  # JL001 is an error
+    import json
+
+    report = json.loads(proc.stdout)
+    assert report["errors"] == 1 and report["warnings"] == 0
+    assert report["findings"][0]["rule"] == "JL001"
+
+    good = tmp_path / "good.py"
+    good.write_text(JL001_GOOD)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytorch_mnist_ddp_tpu.analysis", str(good)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# RecompileSentinel (runtime half of the guardrail)
+
+
+def test_sentinel_passes_stable_signature():
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda x: x * 2)
+    guarded = RecompileSentinel(fn, max_traces=1)
+    for i in range(4):
+        out = guarded(jnp.full((8,), float(i)))
+    assert float(out[0]) == 6.0
+    assert guarded.trace_count() == 1 and guarded.calls == 4
+
+
+def test_sentinel_raises_on_shape_retrace():
+    import jax
+    import jax.numpy as jnp
+
+    guarded = RecompileSentinel(jax.jit(lambda x: x + 1), max_traces=1)
+    guarded(jnp.ones((4,)))
+    with pytest.raises(RecompileError, match="retraced: 2 traces"):
+        guarded(jnp.ones((5,)))  # last-partial-batch shape wobble
+
+
+def test_sentinel_raises_on_scalar_dtype_retrace():
+    import jax
+    import jax.numpy as jnp
+
+    guarded = RecompileSentinel(jax.jit(lambda x, lr: x * lr), max_traces=1)
+    guarded(jnp.ones(3), 1)
+    with pytest.raises(RecompileError):
+        guarded(jnp.ones(3), 0.5)  # int -> float scalar flips the aval
+
+
+def test_sentinel_budget_allows_expected_extra_trace():
+    import jax
+    import jax.numpy as jnp
+
+    guarded = RecompileSentinel(jax.jit(lambda x: x + 1), max_traces=2)
+    guarded(jnp.ones((16,)))
+    guarded(jnp.ones((7,)))  # the legitimate final partial batch
+    assert guarded.trace_count() == 2
+
+
+def test_sentinel_rejects_unjitted_function():
+    with pytest.raises(TypeError, match="jit"):
+        RecompileSentinel(lambda x: x)

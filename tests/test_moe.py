@@ -28,6 +28,7 @@ from pytorch_mnist_ddp_tpu.parallel.ep import (
     shard_ep_state,
 )
 from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+from pytorch_mnist_ddp_tpu.utils.jax_compat import shard_map
 
 # capacity_factor >= num_experts => no token can overflow its expert
 # (worst case: every token picks the same expert), so the EP path (which
@@ -91,7 +92,7 @@ def test_moe_ep_matches_dense(devices, num_devices):
 
     moe_specs = ep_param_specs(CFG)["blocks"]["0"]["moe"]
     ep = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda mp, x: moe_mlp_ep(mp, x, CFG),
             mesh=mesh,
             in_specs=(moe_specs, P("data")),

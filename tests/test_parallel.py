@@ -7,6 +7,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from pytorch_mnist_ddp_tpu.analysis import RecompileSentinel
 from pytorch_mnist_ddp_tpu.models.net import init_params
 from pytorch_mnist_ddp_tpu.parallel.ddp import (
     make_eval_step,
@@ -36,11 +37,47 @@ def test_train_step_runs_and_counts(devices):
     mesh = make_mesh()
     params = init_params(jax.random.PRNGKey(0))
     state = replicate_params(make_train_state(params), mesh)
-    step = make_train_step(mesh)
-    x, y, w = _batch(16)
-    state, losses = step(state, x, y, w, jax.random.PRNGKey(1), jnp.float32(1.0))
+    # Recompile sentinel (analysis/sentinel.py): the DDP step must compile
+    # exactly once for a fixed-shape batch stream — a second trace here
+    # means an unstable call signature, failing loudly instead of as a
+    # silent per-step compile stall.
+    step = RecompileSentinel(make_train_step(mesh), max_traces=1)
+    for i in range(3):
+        x, y, w = _batch(16, seed=i)
+        state, losses = step(
+            state, x, y, w, jax.random.PRNGKey(1), jnp.float32(1.0)
+        )
     assert losses.shape == (8,)  # one local loss per data shard
-    assert int(state.step) == 1
+    assert int(state.step) == 3
+    assert step.trace_count() == 1
+
+
+def test_trainer_epoch_under_recompile_sentinel(devices):
+    """train_one_epoch through a sentinel-guarded step: the whole epoch
+    loop (DataLoader batches, log-step host reads, lr threading) must
+    drive exactly ONE trace of the jitted DDP step.  Guards the trainer
+    against regressions that pass a per-call-varying Python value into
+    the step signature — numerically invisible, 40x compile cost."""
+    from pytorch_mnist_ddp_tpu.data.loader import DataLoader
+    from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
+    from pytorch_mnist_ddp_tpu.trainer import train_one_epoch
+
+    mesh = make_mesh()
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, (64, 28, 28), dtype=np.uint8)  # raw MNIST u8
+    labels = rng.randint(0, 10, 64).astype(np.uint8)
+    loader = DataLoader(images, labels, 16, mesh=mesh, shuffle=True, seed=0)
+    state = replicate_params(
+        make_train_state(init_params(jax.random.PRNGKey(0))), mesh
+    )
+    step = RecompileSentinel(make_train_step(mesh), max_traces=1)
+    dist = DistState(world_size=8, devices=list(jax.devices()))
+    state = train_one_epoch(
+        step, state, loader, epoch=1, dropout_key=jax.random.PRNGKey(2),
+        lr=1.0, dist=dist, log_interval=2,
+    )
+    assert int(state.step) == 4  # 64 samples / 16 global batch
+    assert step.trace_count() == 1
 
 
 def test_single_vs_sharded_parity(devices):

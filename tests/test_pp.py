@@ -21,6 +21,7 @@ from pytorch_mnist_ddp_tpu.parallel.ddp import (
 )
 from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
 from pytorch_mnist_ddp_tpu.parallel.pp import make_pp_train_step
+from pytorch_mnist_ddp_tpu.utils.jax_compat import shard_map
 
 
 def _batch(n=32, seed=0):
@@ -255,7 +256,7 @@ def test_pipeline_engine_three_stages_toy(devices):
         w_mbs = w.reshape(2, 4)
         return pipeline_loss(p, x_mbs, y_mbs, w_mbs, jax.random.PRNGKey(0))
 
-    grad_fn = jax.jit(jax.shard_map(
+    grad_fn = jax.jit(shard_map(
         jax.value_and_grad(local), mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=(P(), P()),

@@ -12,6 +12,10 @@ CONTRACTS over the whole input space the components claim to support:
 
 import numpy as np
 import pytest
+
+# Optional dep: without hypothesis this module must SKIP, not error at
+# collection (an error fails --continue-on-collection-errors runs).
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 import jax

@@ -19,6 +19,7 @@ from pytorch_mnist_ddp_tpu.models.vit import (
     vit_forward,
 )
 from pytorch_mnist_ddp_tpu.ops.attention import full_attention
+from pytorch_mnist_ddp_tpu.utils.jax_compat import OLD_JAX_COMPAT, shard_map
 from pytorch_mnist_ddp_tpu.parallel.sp import (
     SEQ_AXIS,
     make_sp_eval_step,
@@ -78,7 +79,7 @@ def test_ring_attention_matches_full(devices, num_seq):
     q, k, v = _qkv(jax.random.PRNGKey(2), b=2, t=16, h=4, d=8)
 
     ring = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS),
             mesh=mesh,
             in_specs=(P(None, SEQ_AXIS),) * 3,
@@ -98,7 +99,7 @@ def test_ring_attention_mask_travels_the_ring(devices):
     mask = jnp.broadcast_to(jnp.arange(16) < 13, (2, 16))
 
     ring = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v, m: ring_attention(q, k, v, SEQ_AXIS, kv_mask=m),
             mesh=mesh,
             in_specs=(P(None, SEQ_AXIS),) * 4,
@@ -146,7 +147,7 @@ def test_sp_forward_matches_single_device(devices):
     from pytorch_mnist_ddp_tpu.parallel.sp import _sp_vit_forward
 
     sp_fwd = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda p, x: _sp_vit_forward(p, x, CFG),
             mesh=mesh,
             in_specs=(P(), P("data")),
@@ -241,7 +242,7 @@ def test_ring_attention_long_sequence(devices):
     q, k, v = _qkv(jax.random.PRNGKey(4), b=1, t=1024, h=2, d=16)
 
     ring = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS),
             mesh=mesh,
             in_specs=(P(None, SEQ_AXIS),) * 3,
@@ -285,7 +286,7 @@ def test_sp_bf16_forward_matches_single_device(devices):
     params = init_vit_params(jax.random.PRNGKey(0), cfg16)
     x = jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28, 1))
     sp_fwd = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda p, x: _sp_vit_forward(p, x, cfg16),
             mesh=mesh,
             in_specs=(P(), P("data")),
@@ -311,7 +312,7 @@ def test_ulysses_attention_matches_full(devices):
         jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
         for _ in range(3)
     )
-    ul = jax.jit(jax.shard_map(
+    ul = jax.jit(shard_map(
         lambda q, k, v: ulysses_attention(q, k, v, SEQ_AXIS),
         mesh=mesh, in_specs=(P("data", SEQ_AXIS),) * 3,
         out_specs=P("data", SEQ_AXIS),
@@ -329,7 +330,7 @@ def test_ulysses_sp_forward_matches_single_device(devices):
     mesh = make_sp_mesh(num_data=2, num_seq=4, devices=devices)
     params = init_vit_params(jax.random.PRNGKey(0), CFG)
     x = jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28, 1))
-    sp_fwd = jax.jit(jax.shard_map(
+    sp_fwd = jax.jit(shard_map(
         lambda p, x: _sp_vit_forward(p, x, CFG, impl="ulysses"),
         mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"),
     ))
@@ -389,6 +390,12 @@ def test_ulysses_rejects_indivisible_heads(devices):
         make_sp_train_step(mesh, cfg3, impl="ulysses")
 
 
+@pytest.mark.xfail(
+    OLD_JAX_COMPAT, strict=True,
+    reason="pre-VMA jax: remat-under-shard_map recomputation order differs "
+    "on the check_rep=False fallback, breaking bit-exactness "
+    "(utils/jax_compat.py)",
+)
 def test_remat_is_numerically_invisible(devices):
     """--remat (jax.checkpoint around each block) recomputes the SAME
     values: loss and grads match the un-remat'd forward exactly, on both
@@ -420,7 +427,7 @@ def test_remat_is_numerically_invisible(devices):
             logp = _sp_vit_forward(p, x, cfg)
             return nll_loss(logp, y, w, reduction="mean")
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             jax.grad(local), mesh=mesh,
             in_specs=(P(), P("data"), P("data"), P("data")),
             out_specs=P(),

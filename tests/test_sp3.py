@@ -27,6 +27,7 @@ from pytorch_mnist_ddp_tpu.parallel.sp3 import (
     shard_sp3_state,
 )
 from pytorch_mnist_ddp_tpu.parallel.tp_vit import vit_tp_param_specs
+from pytorch_mnist_ddp_tpu.utils.jax_compat import shard_map
 
 CFG = ViTConfig()
 
@@ -42,7 +43,7 @@ def test_sp3_forward_matches_single_device(devices):
         make_train_state(params), mesh, CFG
     ).params
     fwd = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda p, x: _sp3_vit_forward(p, x, CFG),
             mesh=mesh,
             in_specs=(vit_tp_param_specs(CFG), P("data")),

@@ -8,11 +8,13 @@ the identity transformation they claim to be.
 """
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
 
 from pytorch_mnist_ddp_tpu.models.net import init_params
+from pytorch_mnist_ddp_tpu.utils.jax_compat import OLD_JAX_COMPAT
 from pytorch_mnist_ddp_tpu.parallel.ddp import (
     make_train_state,
     make_train_step,
@@ -30,6 +32,12 @@ def _batch(n=32, seed=0):
     return jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)
 
 
+@pytest.mark.xfail(
+    OLD_JAX_COMPAT, strict=True,
+    reason="pre-VMA jax (check_rep=False fallback) places the model-axis "
+    "gradient psums differently — exact TP/DP parity needs the modern "
+    "shard_map transpose (utils/jax_compat.py)",
+)
 def test_tp_matches_dp_exactly(devices):
     """3 steps of (4 data x 2 model) TP == 3 steps of 8-way pure DP ==
     (by the existing parity suite) the single-device step."""

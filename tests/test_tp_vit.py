@@ -21,6 +21,7 @@ from pytorch_mnist_ddp_tpu.models.vit import (
 )
 from pytorch_mnist_ddp_tpu.parallel.ddp import make_train_state
 from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+from pytorch_mnist_ddp_tpu.utils.jax_compat import shard_map
 from pytorch_mnist_ddp_tpu.parallel.tp_vit import (
     _tp_vit_forward,
     make_vit_tp_eval_step,
@@ -34,7 +35,7 @@ CFG = ViTConfig()
 
 def _tp_forward_fn(mesh, cfg):
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda p, x: _tp_vit_forward(p, x, cfg),
             mesh=mesh,
             in_specs=(vit_tp_param_specs(cfg), P("data")),

@@ -24,6 +24,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from pytorch_mnist_ddp_tpu.utils.jax_compat import shard_map  # noqa: E402
+
 # (batch, tokens, heads, head_dim): the ViT's own tiny geometry, then
 # long-context shapes where flash is the point (at t=8192 the dense
 # path materializes a 512 MB f32 score tensor; flash keeps O(t)).
@@ -173,11 +175,11 @@ def _bench_shape(opts, timed, shape_tuple):
         ):
             qd, kd, vd = (a.astype(dt) for a in (q, k, v))
             fwd_err = max_err(
-                jax.jit(flash_attention)(qd, kd, vd),
-                jax.jit(full_attention)(qd, kd, vd),
+                jax.jit(flash_attention)(qd, kd, vd),  # jaxlint: disable=JL004 -- 2-dtype parity sweep, one deliberate compile per dtype
+                jax.jit(full_attention)(qd, kd, vd),  # jaxlint: disable=JL004 -- 2-dtype parity sweep, one deliberate compile per dtype
             )
-            gf = jax.jit(jax.grad(flash_l, argnums=(0, 1, 2)))(qd, kd, vd)
-            gd = jax.jit(jax.grad(dense_l, argnums=(0, 1, 2)))(qd, kd, vd)
+            gf = jax.jit(jax.grad(flash_l, argnums=(0, 1, 2)))(qd, kd, vd)  # jaxlint: disable=JL004 -- 2-dtype parity sweep, one deliberate compile per dtype
+            gd = jax.jit(jax.grad(dense_l, argnums=(0, 1, 2)))(qd, kd, vd)  # jaxlint: disable=JL004 -- 2-dtype parity sweep, one deliberate compile per dtype
             grad_err = max(max_err(a, b) for a, b in zip(gf, gd))
             parity[label] = {
                 "fwd_max_err": fwd_err,
@@ -217,7 +219,7 @@ def _ring_smoke():
         # device-VARYING so the kernel traces with the non-empty vma a
         # real --sp N --flash run produces (replicated P() inputs would
         # smoke a different, trivially-easier trace).
-        ring = jax.jit(jax.shard_map(
+        ring = jax.jit(shard_map(
             lambda q, k, v: ring_attention_flash(q, k, v, SEQ_AXIS),
             mesh=mesh, in_specs=(P(DATA_AXIS, SEQ_AXIS),) * 3,
             out_specs=P(DATA_AXIS, SEQ_AXIS),
@@ -228,7 +230,7 @@ def _ring_smoke():
         # always routes to the pure twin, so hardware is its only trace.
         from pytorch_mnist_ddp_tpu.parallel.sp import ulysses_attention
 
-        ul = jax.jit(jax.shard_map(
+        ul = jax.jit(shard_map(
             lambda q, k, v: ulysses_attention(
                 q, k, v, SEQ_AXIS, use_flash=True
             ),

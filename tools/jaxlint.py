@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Repo entry point for the jaxlint analyzer (thin shim).
+
+Equivalent to ``python -m pytorch_mnist_ddp_tpu.analysis``; exists so the
+analyzer is runnable from a checkout without installing the package
+(``python tools/jaxlint.py pytorch_mnist_ddp_tpu/ --fail-on-warning``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_mnist_ddp_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

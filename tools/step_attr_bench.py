@@ -296,7 +296,7 @@ def main() -> int:
         # us per ITERATION of that variant's scan ("eval" iterates
         # eval-steps batches; everything else `steps` train steps).
         iters = args.eval_steps if name == "eval" else args.steps
-        jitted = jax.jit(fn)
+        jitted = jax.jit(fn)  # jaxlint: disable=JL004 -- one compile per variant IS the measurement (compile_s below)
         try:
             t_c0 = time.perf_counter()
             jax.block_until_ready(jitted())  # compile (or cache load)
