@@ -1,5 +1,6 @@
-"""The jaxlint rule set: JL001–JL006, the JAX hazards this repo has
-actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work).
+"""The jaxlint rule set: JL001–JL007, the JAX hazards this repo has
+actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, and
+the serving layer's per-request-shape retrace class).
 
 Every rule is a heuristic over one module's AST — no type inference, no
 cross-file call graph.  "Traced context" below means: a function that is
@@ -786,6 +787,133 @@ class DeviceGetLoopRule(Rule):
                     )
 
 
+# ---------------------------------------------------------------------------
+# JL007 — raw len()-dependent shapes fed to a jitted callable
+
+
+class BucketShapeRule(Rule):
+    """JL007: a jit-compiled callable fed ``len(batch)``-dependent data
+    outside a bucket helper.
+
+    The serving retrace class: ``predict(params, buf[:len(batch)])``
+    compiles one executable per distinct request size — unbounded
+    executables under real traffic, tens of seconds each on TPU.  The fix
+    is shape bucketing (serving/buckets.py): quantize ``len(batch)`` to a
+    fixed ladder and pad, so jit only ever sees bucket shapes.
+
+    Heuristics (per scope, same resolution style as JL005): a name is
+    "jitted" when bound from ``jax.jit``/``pjit``/``pmap`` (directly or
+    through ``RecompileSentinel(...)``); an argument is "len-dependent"
+    when it lexically contains ``len(...)`` or a name previously bound
+    from a bare ``len(...)``.  Subtrees inside a call whose name mentions
+    ``bucket`` (``bucket_for(len(batch))``, ``pad_to_bucket(...)``) are
+    exempt — that is the sanctioned laundering point for raw sizes.
+    """
+
+    rule_id = "JL007"
+    severity = Severity.WARNING
+    summary = "jit-compiled call fed raw len()-dependent shapes; bucket them"
+
+    @staticmethod
+    def _is_jit_value(value: ast.AST) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        name = dotted_name(value.func)
+        if name in _JIT_CONSTRUCTORS:
+            return True
+        # RecompileSentinel(jit_fn, ...) wraps a jitted callable by
+        # contract (sentinel.py rejects anything else at runtime).
+        return bool(name) and name.split(".")[-1] == "RecompileSentinel"
+
+    @staticmethod
+    def _is_bucket_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = dotted_name(node.func)
+        return bool(name) and "bucket" in name.split(".")[-1].lower()
+
+    @classmethod
+    def _len_taint(cls, node: ast.AST, len_names: set[str]) -> ast.AST | None:
+        """The first raw-len use inside ``node``, skipping bucket calls."""
+        if cls._is_bucket_call(node):
+            return None
+        if isinstance(node, ast.Call) and dotted_name(node.func) == "len":
+            return node
+        if isinstance(node, ast.Name) and node.id in len_names:
+            return node
+        for child in ast.iter_child_nodes(node):
+            hit = cls._len_taint(child, len_names)
+            if hit is not None:
+                return hit
+        return None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        # Module-level jit bindings are visible inside every function
+        # (the `predict = jax.jit(...)` -> `def serve(...)` shape).
+        module_jit: set[str] = set()
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and self._is_jit_value(node.value)):
+                module_jit.add(node.targets[0].id)
+
+        scopes: list[ast.AST] = [ctx.tree] + [
+            d for d in ast.walk(ctx.tree)
+            if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            label = "<module>" if isinstance(scope, ast.Module) else scope.name
+            # Bucket/pad helpers are where raw sizes legitimately live.
+            if any(tag in label.lower() for tag in ("bucket", "pad")):
+                continue
+            if isinstance(scope, ast.Module):
+                nodes = []
+                stack = list(scope.body)
+                while stack:
+                    node = stack.pop()
+                    nodes.append(node)
+                    if not isinstance(node, _SCOPE_NODES):
+                        stack.extend(ast.iter_child_nodes(node))
+            else:
+                nodes = list(iter_own_body(scope))
+            nodes.sort(key=lambda n: (getattr(n, "lineno", 0),
+                                      getattr(n, "col_offset", 0)))
+            jit_names = set(module_jit)
+            len_names: set[str] = set()
+            for node in nodes:
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    target = node.targets[0].id
+                    if self._is_jit_value(node.value):
+                        jit_names.add(target)
+                        continue
+                    if (isinstance(node.value, ast.Call)
+                            and dotted_name(node.value.func) == "len"):
+                        len_names.add(target)
+                    else:
+                        len_names.discard(target)
+                    continue
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in jit_names):
+                    continue
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    hit = self._len_taint(arg, len_names)
+                    if hit is not None:
+                        yield self.finding(
+                            ctx, node,
+                            f"jitted '{node.func.id}' called with a raw "
+                            "len()-dependent argument in "
+                            f"'{label}': every distinct size compiles a new "
+                            "executable; quantize to fixed shape buckets "
+                            "and pad (serving/buckets.py: bucket_for + "
+                            "pad_to_bucket)",
+                        )
+                        break
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -793,6 +921,7 @@ ALL_RULES: tuple[Rule, ...] = (
     RetraceRule(),
     DonationRule(),
     DeviceGetLoopRule(),
+    BucketShapeRule(),
 )
 
 

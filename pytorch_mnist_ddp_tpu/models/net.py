@@ -66,6 +66,12 @@ def _bias_init_like(fan_in: int) -> nn.initializers.Initializer:
 DROPOUT1_RATE = 0.25
 DROPOUT2_RATE = 0.5
 
+# The model's per-sample I/O contract, in one place so the serving layer
+# (request validation, bucket padding) and the training pipeline cannot
+# disagree about it: NHWC single-channel 28x28 in, 10 log-probs out.
+INPUT_SHAPE = (28, 28, 1)
+NUM_CLASSES = 10
+
 
 # Net.conv_impl values: which convolution lowering the forward uses.
 # "conv" is the shipped default (XLA's native conv); the im2col variants

@@ -209,6 +209,44 @@ def make_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
+def make_predict_step(
+    mesh: Mesh, compute_dtype: jnp.dtype = jnp.float32, use_bn: bool = False,
+    conv_impl: str = "conv",
+):
+    """Build the jitted forward-only step for the serving path.
+
+    Returns ``predict_fn(params, x) -> log_probs`` — per-sample ``[N, 10]``
+    log-probabilities for a global batch sharded over the ``data`` axis,
+    output sharded the same way (the host reads the full array once per
+    dispatch).  Unlike :func:`make_eval_step` there is no label reduction:
+    serving needs the per-request rows back, and padded rows are sliced
+    off on the host (rows are per-sample independent through the whole
+    eval-mode forward, so padding cannot perturb real rows).
+
+    ``params`` follows :func:`eval_variables`: the full variable dict for
+    BN-bearing checkpoints (eval-mode normalization by running averages),
+    bare params otherwise.  One trace per input shape — the serving
+    engine only ever calls this at its warmed bucket shapes, enforced by
+    a RecompileSentinel (serving/engine.py).
+    """
+    model = Net(
+        compute_dtype=compute_dtype, use_bn=use_bn, conv_impl=conv_impl
+    )
+
+    def local_predict(params, x):
+        variables = params if use_bn else {"params": params}
+        return model.apply(variables, x, train=False)
+
+    sharded = shard_map(
+        local_predict,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
 def make_eval_step(
     mesh: Mesh, compute_dtype: jnp.dtype = jnp.float32, use_bn: bool = False,
     conv_impl: str = "conv",

@@ -1,0 +1,45 @@
+"""Inference serving: the checkpoint -> answering-requests path.
+
+Everything else in this repo is training-side; this package grows the
+eval seed (``parallel/ddp.make_eval_step``; PAPER.md's survey calls it
+the inference entry point) into a request-serving engine shaped for the
+ROADMAP's "heavy traffic" north star:
+
+- :mod:`.buckets` — the power-of-two shape-bucket policy.  Serving
+  traffic arrives at arbitrary batch sizes; jit retraces on every new
+  shape, so the engine only ever dispatches a fixed set of bucket
+  shapes, padding up and slicing back down.  This is the serving twin of
+  the training loader's pad-the-final-partial-batch rule
+  (data/loader.py), enforced at runtime by a RecompileSentinel.
+- :mod:`.engine` — :class:`InferenceEngine`: loads a checkpoint
+  (either surface: ``--save-model`` or ``--save-state``), warms every
+  bucket exactly once, and runs the forward on the data-parallel mesh.
+- :mod:`.batcher` — :class:`MicroBatcher`: coalesces queued requests up
+  to a max batch or a linger deadline, with a bounded admission queue,
+  per-request deadlines, reject-don't-queue backpressure, and graceful
+  drain.
+- :mod:`.metrics` — queue depth, batch occupancy, padding waste,
+  latency percentiles, throughput (string-returning report helpers,
+  utils/logging.py convention).
+- :mod:`.server` — stdlib-only ``http.server`` JSON endpoint; run it
+  with ``python -m pytorch_mnist_ddp_tpu.serving``.
+
+Load-test with ``tools/serve_loadgen.py``; see docs/SERVING.md.
+"""
+
+from .batcher import MicroBatcher, RejectedError, RequestTimeout
+from .buckets import bucket_for, pad_to_bucket, pow2_buckets, validate_buckets
+from .engine import InferenceEngine
+from .metrics import ServingMetrics
+
+__all__ = [
+    "InferenceEngine",
+    "MicroBatcher",
+    "RejectedError",
+    "RequestTimeout",
+    "ServingMetrics",
+    "bucket_for",
+    "pad_to_bucket",
+    "pow2_buckets",
+    "validate_buckets",
+]

@@ -1,0 +1,190 @@
+"""Serving CLI: ``python -m pytorch_mnist_ddp_tpu.serving``.
+
+Startup order matters: the persistent XLA compile cache is enabled
+FIRST (utils/compile_cache) so the bucket warmup compiles land in — or
+load from — the on-disk cache, meaning a restarted server skips the
+warmup compile cost entirely on backends where the cache is usable (it
+is deliberately disabled on CPU; see compile_cache.py).  Then the engine
+loads the checkpoint, warms every bucket exactly once (sentinel-
+verified, printed per bucket), and only then does the HTTP socket open —
+a server that accepts traffic before warmup would serve its first
+requests at compile latency.
+
+``--warmup-only`` stops after the warmup report: the smoke-test mode CI
+and operators use to verify the bucket ladder compiles exactly once per
+rung before shipping a config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from ..utils.compile_cache import enable_persistent_cache
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m pytorch_mnist_ddp_tpu.serving",
+        description="MNIST inference server: dynamic micro-batching over "
+        "power-of-two shape buckets on the data-parallel mesh "
+        "(docs/SERVING.md)",
+    )
+    parser.add_argument(
+        "--checkpoint", default=None,
+        help="trained model to serve: a --save-model file (torch/npz) or a "
+        "--save-state archive; omitted = fresh seed-init weights (smoke "
+        "runs and load tests)",
+    )
+    parser.add_argument(
+        "--seed", type=int, default=1,
+        help="init seed when no --checkpoint is given (default 1, the "
+        "reference's)",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument(
+        "--buckets", default=None,
+        help="comma-separated batch-size ladder (each a power of two, "
+        "divisible by the data-axis size); default: powers of two from "
+        "the data-axis size to --max-bucket",
+    )
+    parser.add_argument(
+        "--max-bucket", type=int, default=None,
+        help="top of the default bucket ladder (default 128)",
+    )
+    parser.add_argument(
+        "--linger-ms", type=float, default=2.0,
+        help="max time the batcher waits to coalesce a non-full batch",
+    )
+    parser.add_argument(
+        "--queue-depth", type=int, default=64,
+        help="admission queue bound; a full queue rejects with 503",
+    )
+    parser.add_argument(
+        "--timeout-ms", type=float, default=1000.0,
+        help="per-request deadline (queued past it -> 504)",
+    )
+    parser.add_argument(
+        "--bf16", action="store_true",
+        help="serve the forward in bfloat16 (params stay fp32; the "
+        "log_softmax tail is fp32 either way — models/net.py)",
+    )
+    parser.add_argument(
+        "--conv-impl", default="conv",
+        help="convolution lowering, as in training (models/net.py "
+        "CONV_IMPLS)",
+    )
+    parser.add_argument(
+        "--cache-dir", default=None,
+        help="persistent XLA compile cache directory (default: the "
+        "JAX_COMPILATION_CACHE_DIR env var, else the utils/cache_dir "
+        "root)",
+    )
+    parser.add_argument(
+        "--warmup-only", action="store_true",
+        help="compile + verify every bucket, print the sentinel report, "
+        "exit without opening the HTTP socket",
+    )
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    # Satellite wiring: the cache must be configured before the first jit
+    # compile or the warmup programs miss it.  Log the directory actually
+    # in use — "it should be cached" bugs are undebuggable without it.
+    cache_dir = enable_persistent_cache(args.cache_dir)
+    if cache_dir:
+        print(f"persistent compile cache: {cache_dir}")
+    else:
+        print(
+            "persistent compile cache: disabled "
+            "(cpu backend, or cache dir not writable)"
+        )
+
+    import jax.numpy as jnp
+
+    from .engine import InferenceEngine
+    from .metrics import ServingMetrics
+    from .server import make_server
+
+    metrics = ServingMetrics()
+    engine_kwargs = dict(
+        buckets=(
+            [int(b) for b in args.buckets.split(",")] if args.buckets else None
+        ),
+        max_bucket=None if args.buckets else args.max_bucket,
+        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        conv_impl=args.conv_impl,
+        metrics=metrics,
+    )
+    if args.checkpoint:
+        print(f"loading checkpoint {args.checkpoint}")
+        engine = InferenceEngine.from_checkpoint(args.checkpoint, **engine_kwargs)
+    else:
+        print(
+            f"no --checkpoint; serving fresh seed-{args.seed} weights "
+            "(smoke/load-test mode)"
+        )
+        engine = InferenceEngine.from_seed(args.seed, **engine_kwargs)
+
+    print(
+        f"warming buckets {list(engine.buckets)} on a "
+        f"{engine.mesh.devices.size}-device mesh"
+        + (" (BatchNorm checkpoint)" if engine.use_bn else "")
+    )
+    engine.warmup(
+        on_bucket=lambda bucket, traces: print(
+            f"  bucket {bucket:4d}: compiled (trace {traces})", flush=True
+        )
+    )
+    print(
+        f"warmup verified: {engine.compile_count()} traces for "
+        f"{len(engine.buckets)} buckets, second pass hit the cache "
+        "(sentinel-enforced)"
+    )
+    if args.warmup_only:
+        return 0
+
+    server = make_server(
+        engine,
+        metrics,
+        host=args.host,
+        port=args.port,
+        linger_ms=args.linger_ms,
+        queue_depth=args.queue_depth,
+        timeout_ms=args.timeout_ms,
+    )
+    host, port = server.server_address[:2]
+    print(f"serving on http://{host}:{port} (POST /predict, GET /metrics)")
+
+    def _shutdown(signum, frame):
+        # serve_forever must be unblocked from another thread; the drain
+        # itself runs below, after the accept loop exits.
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    try:
+        server.serve_forever()
+    finally:
+        # Graceful drain: stop accepting, finish everything admitted,
+        # then report.  (Handler threads for in-flight requests are
+        # daemons; their waiters complete during the drain.)
+        print("draining admitted requests...")
+        server.batcher.stop(drain=True)
+        server.server_close()
+        print(metrics.report_lines(
+            queue_depth=server.batcher.depth(),
+            compiles=engine.compile_count(),
+            buckets=engine.buckets,
+        ))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,268 @@
+"""Dynamic micro-batching with bounded admission and backpressure.
+
+The serving trade: one 64-sample dispatch costs barely more device time
+than a 1-sample dispatch (the forward is launch-bound at these shapes),
+so coalescing concurrent requests multiplies throughput — but waiting to
+coalesce adds latency.  The batcher resolves it the standard way: take
+the first queued request, then keep pulling until the batch would exceed
+the top bucket or a **linger deadline** (a few ms) passes, whichever
+comes first.  Under load, batches fill before the linger expires and
+occupancy approaches 100%; when idle, a lone request pays at most the
+linger.
+
+Admission is a **bounded** queue: a full queue rejects immediately
+(:class:`RejectedError`, the HTTP 503) instead of queueing unboundedly —
+queued-forever requests time out anyway and waste the device work, so
+shedding at admission is strictly better (the backpressure contract,
+docs/SERVING.md).  Each request also carries a deadline; requests that
+expire while queued are completed with :class:`RequestTimeout` (504)
+without being dispatched.
+
+Shutdown is a graceful drain: ``stop()`` closes admission (new submits
+get 503) and, by default, lets the worker finish everything already
+admitted before joining.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .engine import InferenceEngine
+from .metrics import ServingMetrics
+
+
+class RejectedError(RuntimeError):
+    """Admission refused (queue full or server draining) — HTTP 503."""
+
+
+class RequestTimeout(RuntimeError):
+    """Deadline expired before a result was produced — HTTP 504."""
+
+
+class PendingRequest:
+    """One admitted request: input rows + deadline + a result slot."""
+
+    __slots__ = ("x", "deadline", "t_submit", "_event", "_value", "_error")
+
+    def __init__(self, x: np.ndarray, deadline: float):
+        self.x = x
+        self.deadline = deadline
+        self.t_submit = time.perf_counter()
+        self._event = threading.Event()
+        self._value: np.ndarray | None = None
+        self._error: BaseException | None = None
+
+    @property
+    def n(self) -> int:
+        return len(self.x)
+
+    def expired(self, now: float | None = None) -> bool:
+        return (now if now is not None else time.perf_counter()) > self.deadline
+
+    # -- completion (worker side) -------------------------------------------
+
+    def set_result(self, value: np.ndarray) -> None:
+        self._value = value
+        self._event.set()
+
+    def set_error(self, error: BaseException) -> None:
+        self._error = error
+        self._event.set()
+
+    # -- consumption (handler side) -----------------------------------------
+
+    def result(self, grace_s: float = 1.0) -> np.ndarray:
+        """Block until completed; raises the worker's error if it set one.
+
+        Waits until the request deadline plus ``grace_s`` (the worker
+        expires overdue requests itself; the grace only covers a dispatch
+        already in flight when the deadline passed).
+        """
+        timeout = max(0.0, self.deadline - time.perf_counter()) + grace_s
+        if not self._event.wait(timeout):
+            raise RequestTimeout("request deadline expired")
+        if self._error is not None:
+            raise self._error
+        assert self._value is not None
+        return self._value
+
+
+class MicroBatcher:
+    """Coalesce admitted requests into bucket-padded engine dispatches.
+
+    Exactly one worker thread touches the engine (jax dispatch is not
+    re-entrant here); HTTP handler threads only ``submit()`` and wait.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        metrics: ServingMetrics | None = None,
+        max_batch: int | None = None,
+        linger_ms: float = 2.0,
+        queue_depth: int = 64,
+        timeout_ms: float = 1000.0,
+    ):
+        top = engine.buckets[-1]
+        self.engine = engine
+        self.metrics = metrics if metrics is not None else engine.metrics
+        self.max_batch = min(max_batch or top, top)
+        self.linger_s = linger_ms / 1e3
+        self.timeout_s = timeout_ms / 1e3
+        self._queue: queue.Queue[PendingRequest] = queue.Queue(maxsize=queue_depth)
+        self._closed = threading.Event()
+        self._worker: threading.Thread | None = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        if self._worker is not None:
+            raise RuntimeError("batcher already started")
+        self._worker = threading.Thread(
+            target=self._run, name="micro-batcher", daemon=True
+        )
+        self._worker.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Close admission; by default let the worker finish the queue.
+
+        ``drain=False`` abandons queued requests — each is completed with
+        :class:`RejectedError` so no handler thread is left hanging.
+        """
+        self._closed.set()
+        if not drain:
+            self._flush_rejected()
+        if self._worker is not None:
+            self._worker.join()
+            self._worker = None
+        # A submit() racing stop() can land a request AFTER the worker saw
+        # the empty queue and exited; without this flush that request would
+        # sit unserviced until its client's deadline expired (504 during a
+        # "graceful" drain).  Post-join the queue is ours alone.
+        self._flush_rejected()
+
+    def _flush_rejected(self) -> None:
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            req.set_error(RejectedError("server shutting down"))
+            if self.metrics is not None:
+                self.metrics.record_rejected()
+
+    def depth(self) -> int:
+        """Current admission-queue depth (the /metrics gauge)."""
+        return self._queue.qsize()
+
+    # -- admission (any thread) ----------------------------------------------
+
+    def submit(self, x: np.ndarray, timeout_ms: float | None = None) -> PendingRequest:
+        """Admit one request of ``[n, 28, 28, 1]`` rows or reject now.
+
+        Raises :class:`RejectedError` when draining, when the request is
+        bigger than one maximal batch (it would never fit a dispatch), or
+        when the bounded queue is full — the reject-don't-queue
+        backpressure contract.
+        """
+        x = np.asarray(x, np.float32)
+        if self._closed.is_set():
+            if self.metrics is not None:
+                self.metrics.record_rejected()
+            raise RejectedError("server draining; not accepting requests")
+        if not 1 <= len(x) <= self.max_batch:
+            if self.metrics is not None:
+                self.metrics.record_rejected()
+            raise RejectedError(
+                f"request of {len(x)} samples outside [1, {self.max_batch}]"
+            )
+        timeout_s = self.timeout_s if timeout_ms is None else timeout_ms / 1e3
+        req = PendingRequest(x, deadline=time.perf_counter() + timeout_s)
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            if self.metrics is not None:
+                self.metrics.record_rejected()
+            raise RejectedError(
+                f"admission queue full ({self._queue.maxsize} deep)"
+            ) from None
+        if self.metrics is not None:
+            self.metrics.record_admitted()
+        return req
+
+    # -- worker ----------------------------------------------------------------
+
+    def _expire(self, req: PendingRequest) -> None:
+        req.set_error(RequestTimeout("expired in queue before dispatch"))
+        if self.metrics is not None:
+            self.metrics.record_timeout()
+
+    def _run(self) -> None:
+        carry: PendingRequest | None = None
+        while True:
+            if carry is not None:
+                first, carry = carry, None
+            else:
+                try:
+                    first = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    if self._closed.is_set():
+                        return
+                    continue
+            if first.expired():
+                self._expire(first)
+                continue
+            batch = [first]
+            total = first.n
+            # Linger: coalesce until the batch is full or the deadline
+            # passes.  A draining batcher skips the linger — nothing new
+            # is being admitted, so waiting only delays shutdown.
+            deadline = time.perf_counter() + (
+                0.0 if self._closed.is_set() else self.linger_s
+            )
+            while total < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                try:
+                    nxt = (
+                        self._queue.get_nowait()
+                        if remaining <= 0
+                        else self._queue.get(timeout=remaining)
+                    )
+                except queue.Empty:
+                    break
+                if nxt.expired():
+                    self._expire(nxt)
+                    continue
+                if total + nxt.n > self.max_batch:
+                    carry = nxt  # doesn't fit; leads the next batch
+                    break
+                batch.append(nxt)
+                total += nxt.n
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: list[PendingRequest]) -> None:
+        xs = (
+            batch[0].x
+            if len(batch) == 1
+            else np.concatenate([r.x for r in batch])
+        )
+        try:
+            logits = self.engine.predict_logits(xs)
+        except BaseException as e:  # complete every waiter, then keep serving
+            for req in batch:
+                req.set_error(e)
+            if self.metrics is not None:
+                self.metrics.record_failed(len(batch))
+            return
+        offset = 0
+        done = time.perf_counter()
+        for req in batch:
+            req.set_result(logits[offset : offset + req.n])
+            offset += req.n
+            if self.metrics is not None:
+                self.metrics.record_completed(done - req.t_submit)

@@ -1,0 +1,107 @@
+"""Power-of-two shape buckets: the serving layer's retrace firewall.
+
+A jitted forward compiles one executable per distinct input shape.  Real
+request traffic arrives at every batch size from 1 to whatever the
+micro-batcher coalesced, so dispatching raw request shapes would compile
+continuously — the exact hazard class jaxlint JL004/JL007 and the
+RecompileSentinel exist for, paid at tens of seconds per retrace on TPU.
+The policy here is the standard fix: a small fixed ladder of power-of-two
+batch sizes, every dispatch padded UP to the nearest rung and the results
+sliced back down.  Power-of-two spacing bounds padding waste below 50%
+in the worst case (amortized far lower under coalescing, since the
+batcher fills toward the max bucket) while keeping the number of warmed
+executables logarithmic in the max batch.
+
+Pure host-side numpy; no jax import, so bucket policy is unit-testable
+without device init.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+# Default ladder ceiling: 128 matches the training eval batch order of
+# magnitude; ~8 executables from bucket 1, 5 from bucket 8.
+DEFAULT_MAX_BUCKET = 128
+
+
+def pow2_buckets(
+    min_bucket: int = 1, max_bucket: int = DEFAULT_MAX_BUCKET
+) -> tuple[int, ...]:
+    """The power-of-two ladder covering [min_bucket, max_bucket].
+
+    ``min_bucket`` rounds UP to a power of two (serving on an N-way data
+    mesh needs every bucket divisible by N, so callers pass N here).
+    """
+    if min_bucket < 1 or max_bucket < min_bucket:
+        raise ValueError(
+            f"need 1 <= min_bucket <= max_bucket, got "
+            f"{min_bucket}..{max_bucket}"
+        )
+    b = 1
+    while b < min_bucket:
+        b *= 2
+    out = []
+    while b <= max_bucket:
+        out.append(b)
+        b *= 2
+    if not out:
+        raise ValueError(
+            f"no power of two in [{min_bucket}, {max_bucket}]"
+        )
+    return tuple(out)
+
+
+def validate_buckets(buckets: Sequence[int], n_shards: int = 1) -> tuple[int, ...]:
+    """Sorted, deduplicated, sanity-checked bucket ladder.
+
+    Every bucket must be positive, a power of two (the policy this module
+    is named for — a free-form ladder silently reintroduces unbounded
+    executable counts), and divisible by the data-axis size so padded
+    batches shard evenly over the mesh.
+    """
+    out = sorted(set(int(b) for b in buckets))
+    if not out:
+        raise ValueError("empty bucket list")
+    for b in out:
+        if b < 1 or (b & (b - 1)):
+            raise ValueError(f"bucket {b} is not a positive power of two")
+        if b % n_shards:
+            raise ValueError(
+                f"bucket {b} not divisible by the {n_shards}-way data axis"
+            )
+    return tuple(out)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """The smallest bucket >= n (the shape actually dispatched).
+
+    ``n`` larger than the top bucket is the caller's error — the
+    micro-batcher caps coalescing at the top bucket, and the engine
+    chunks oversized direct calls before asking for a bucket.
+    """
+    if n < 1:
+        raise ValueError(f"batch size must be >= 1, got {n}")
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(f"batch of {n} exceeds the top bucket {buckets[-1]}")
+
+
+def pad_to_bucket(x: np.ndarray, bucket: int) -> np.ndarray:
+    """Zero-pad rows so ``len(x) == bucket`` (jit sees only bucket shapes).
+
+    Rows are per-sample independent through the whole forward (conv,
+    dense, eval-mode BN all act within a sample), so padding rows cannot
+    perturb real rows — the same invariant the training loader's
+    final-partial-batch padding relies on.
+    """
+    n = len(x)
+    if n > bucket:
+        raise ValueError(f"batch of {n} does not fit bucket {bucket}")
+    if n == bucket:
+        return x
+    pad = np.zeros((bucket - n, *x.shape[1:]), x.dtype)
+    return np.concatenate([x, pad])

@@ -1,0 +1,192 @@
+"""The inference engine: checkpoint -> warmed bucket executables -> logits.
+
+Lifecycle: construct (variables placed replicated on the data-parallel
+mesh), :meth:`warmup` (compile every bucket exactly once, then verify a
+second pass is pure cache hits), then :meth:`predict_logits` from the
+dispatch thread.  The jitted forward is wrapped in a RecompileSentinel
+budgeted at exactly ``len(buckets)`` traces, so ANY post-warmup shape
+leak — the silent per-request compile stall this subsystem exists to
+prevent — raises ``RecompileError`` with a pointed message instead of
+serving at 1000x latency.
+
+Threading contract: jax dispatch is not guarded here; exactly one thread
+(the micro-batcher worker, or the caller in direct use) may call
+``predict_logits``.  The HTTP handler threads never touch the engine —
+they talk to the batcher's queue.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+from ..analysis.sentinel import RecompileError, RecompileSentinel
+from ..models.net import INPUT_SHAPE, NUM_CLASSES, init_params, init_variables
+from ..parallel.ddp import make_predict_step, replicate_params
+from ..parallel.mesh import DATA_AXIS, make_mesh
+from .buckets import bucket_for, pad_to_bucket, pow2_buckets, validate_buckets
+from .metrics import ServingMetrics
+
+
+class InferenceEngine:
+    """Bucket-warmed forward over a data-parallel mesh.
+
+    Parameters
+    ----------
+    variables:
+        Flax variable dict — ``{"params": ...}`` plus ``{"batch_stats":
+        ...}`` for BN-bearing checkpoints (``use_bn`` is inferred from
+        the tree, never guessed from flags, so a ``--syncbn`` checkpoint
+        serves correctly without the operator knowing it was one).
+    mesh:
+        The data-parallel mesh to dispatch on; defaults to every visible
+        device on the ``data`` axis (parallel/mesh.make_mesh).
+    buckets:
+        Batch-size ladder to warm; defaults to the power-of-two ladder
+        from the data-axis size up to ``max_bucket``.  Validated against
+        the mesh (every bucket must shard evenly).
+    metrics:
+        Optional :class:`ServingMetrics`; per-dispatch occupancy is
+        recorded when present.
+    """
+
+    def __init__(
+        self,
+        variables: dict[str, Any],
+        mesh=None,
+        buckets: Sequence[int] | None = None,
+        max_bucket: int | None = None,
+        compute_dtype=None,
+        conv_impl: str = "conv",
+        metrics: ServingMetrics | None = None,
+    ):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        n_shards = self.mesh.shape[DATA_AXIS]
+        if buckets is None:
+            from .buckets import DEFAULT_MAX_BUCKET
+
+            buckets = pow2_buckets(n_shards, max_bucket or DEFAULT_MAX_BUCKET)
+        elif max_bucket is not None:
+            raise ValueError("pass buckets or max_bucket, not both")
+        self.buckets = validate_buckets(buckets, n_shards)
+        self.use_bn = "bn1" in variables.get("params", {})
+        if self.use_bn and "batch_stats" not in variables:
+            # A BN model without running averages would eval-normalize by
+            # garbage; init defaults (mean 0 / var 1) are torch's
+            # never-trained behavior and at least well-defined.
+            variables = dict(variables)
+            variables["batch_stats"] = init_variables(
+                jax.random.PRNGKey(0), use_bn=True
+            )["batch_stats"]
+        served = (
+            {"params": variables["params"],
+             "batch_stats": variables["batch_stats"]}
+            if self.use_bn
+            else variables["params"]
+        )
+        self._variables = replicate_params(served, self.mesh)
+        fn = make_predict_step(
+            self.mesh,
+            compute_dtype=compute_dtype or jax.numpy.float32,
+            use_bn=self.use_bn,
+            conv_impl=conv_impl,
+        )
+        # One trace per bucket, ever.  A post-warmup retrace means a
+        # request shape escaped the bucket policy.
+        self._predict = RecompileSentinel(
+            fn, max_traces=len(self.buckets), name="predict_step"
+        )
+        self.metrics = metrics
+        self.warmed = False
+
+    # -- construction helpers ------------------------------------------------
+
+    @classmethod
+    def from_checkpoint(cls, path: str, **kwargs) -> "InferenceEngine":
+        """Load either checkpoint surface (``--save-model`` torch/npz file
+        or a ``--save-state`` archive) and build the engine around it."""
+        from ..utils.checkpoint import load_inference_variables
+
+        return cls(load_inference_variables(path), **kwargs)
+
+    @classmethod
+    def from_seed(cls, seed: int = 1, **kwargs) -> "InferenceEngine":
+        """Fresh reference-init params (utils/rng stream layout) — the
+        no-checkpoint path used by ``--warmup-only`` smoke runs and load
+        tests, where serving mechanics matter and weights don't."""
+        from ..utils.rng import root_key, split_streams
+
+        key = split_streams(root_key(seed))["init"]
+        return cls({"params": init_params(key)}, **kwargs)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def compile_count(self) -> int:
+        """Distinct traces of the forward so far (== warmed buckets once
+        warmup has run; the /metrics ``compiles`` field)."""
+        return self._predict.trace_count()
+
+    def warmup(self, on_bucket=None) -> list[tuple[int, int]]:
+        """Compile every bucket exactly once; verify the second pass hits.
+
+        Returns ``[(bucket, cumulative_trace_count), ...]`` — strictly
+        counting up by one per bucket on a healthy engine, which the
+        ``--warmup-only`` CLI prints as its sentinel-verified evidence.
+        ``on_bucket(bucket, traces)`` fires as each bucket finishes
+        compiling, so callers can report progress DURING the slow phase
+        (a TPU ladder is tens of seconds per rung; silence until the end
+        reads as a hang).  A second sweep over the ladder must add zero
+        traces; the sentinel raises otherwise, and a final count check
+        catches the inverse failure (two buckets aliasing to one
+        executable would silently under-warm).
+        """
+        report: list[tuple[int, int]] = []
+        for b in self.buckets:
+            x = np.zeros((b, *INPUT_SHAPE), np.float32)
+            self._predict(self._variables, x)
+            report.append((b, self._predict.trace_count()))
+            if on_bucket is not None:
+                on_bucket(b, self._predict.trace_count())
+        for b in self.buckets:
+            self._predict(self._variables, np.zeros((b, *INPUT_SHAPE), np.float32))
+        if self._predict.trace_count() != len(self.buckets):
+            raise RecompileError(
+                f"warmup traced {self._predict.trace_count()} executables "
+                f"for {len(self.buckets)} buckets {self.buckets}; the "
+                "bucket ladder does not map 1:1 onto compiled programs"
+            )
+        self.warmed = True
+        return report
+
+    # -- serving --------------------------------------------------------------
+
+    def predict_logits(self, x: np.ndarray) -> np.ndarray:
+        """``[n, 28, 28, 1]`` normalized float32 -> ``[n, 10]`` log-probs.
+
+        Pads to the nearest bucket, dispatches, slices padding back off.
+        ``n`` above the top bucket is chunked (direct callers only — the
+        batcher never coalesces past the top bucket).
+        """
+        x = np.asarray(x, np.float32)
+        if x.ndim != 1 + len(INPUT_SHAPE) or x.shape[1:] != INPUT_SHAPE:
+            raise ValueError(
+                f"expected [n, {', '.join(map(str, INPUT_SHAPE))}] input, "
+                f"got shape {x.shape}"
+            )
+        n = len(x)
+        if n == 0:
+            raise ValueError("empty batch")
+        top = self.buckets[-1]
+        outs = []
+        for start in range(0, n, top):
+            chunk = x[start : start + top]
+            bucket = bucket_for(len(chunk), self.buckets)
+            logits = self._predict(self._variables, pad_to_bucket(chunk, bucket))
+            if self.metrics is not None:
+                self.metrics.record_batch(len(chunk), bucket)
+            outs.append(np.asarray(logits)[: len(chunk)])
+        out = outs[0] if len(outs) == 1 else np.concatenate(outs)
+        assert out.shape == (n, NUM_CLASSES)
+        return out

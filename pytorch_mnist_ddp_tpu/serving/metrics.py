@@ -1,0 +1,166 @@
+"""Serving metrics: queue depth, occupancy, latency percentiles, waste.
+
+The training side's observability contract (utils/logging.py) is
+string-returning helpers with the caller deciding where they print; this
+module follows it — :meth:`ServingMetrics.report_lines` renders, callers
+print.  Counters are updated from the HTTP handler threads and the
+batcher worker concurrently, so every mutation takes the one lock; reads
+snapshot under the same lock and format outside it.
+
+Latencies are kept in a bounded ring (newest ``reservoir`` observations)
+— serving metrics must not grow without bound over a long-lived process,
+and tail percentiles over the recent window are what an operator acts
+on anyway.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+
+def percentile(sorted_values: list[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending-sorted list (no numpy
+    interpolation surprises in operator-facing numbers)."""
+    if not sorted_values:
+        return 0.0
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"percentile out of range: {q}")
+    rank = max(1, int(-(-q * len(sorted_values) // 100)))  # ceil, 1-based
+    return sorted_values[min(rank, len(sorted_values)) - 1]
+
+
+class ServingMetrics:
+    """Counters + latency reservoir for one serving process."""
+
+    def __init__(self, reservoir: int = 8192):
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._latencies: deque[float] = deque(maxlen=reservoir)
+        self.admitted = 0
+        self.completed = 0
+        self.rejected = 0       # admission-queue backpressure (503)
+        self.timed_out = 0      # deadline expired before dispatch (504)
+        self.failed = 0         # engine/dispatch errors (500)
+        self.batches = 0
+        self.samples_real = 0   # real samples dispatched
+        self.samples_padded = 0  # bucket slots dispatched (real + padding)
+
+    # -- recording (any thread) ---------------------------------------------
+
+    def record_admitted(self, n: int = 1) -> None:
+        with self._lock:
+            self.admitted += n
+
+    def record_rejected(self, n: int = 1) -> None:
+        with self._lock:
+            self.rejected += n
+
+    def record_timeout(self, n: int = 1) -> None:
+        with self._lock:
+            self.timed_out += n
+
+    def record_failed(self, n: int = 1) -> None:
+        with self._lock:
+            self.failed += n
+
+    def record_batch(self, real: int, bucket: int) -> None:
+        """One engine dispatch: ``real`` live samples padded to ``bucket``."""
+        with self._lock:
+            self.batches += 1
+            self.samples_real += real
+            self.samples_padded += bucket
+
+    def record_completed(self, latency_s: float) -> None:
+        """One request finished; ``latency_s`` spans submit -> result set."""
+        with self._lock:
+            self.completed += 1
+            self._latencies.append(latency_s)
+
+    # -- reading -------------------------------------------------------------
+
+    def snapshot(
+        self,
+        queue_depth: int | None = None,
+        compiles: int | None = None,
+        buckets: tuple[int, ...] | None = None,
+    ) -> dict:
+        """One consistent dict of everything (the /metrics payload).
+
+        ``queue_depth``/``compiles``/``buckets`` are owned by the batcher
+        and engine; callers pass the current values so this module stays
+        free of back-references.
+        """
+        with self._lock:
+            lat = sorted(self._latencies)
+            uptime = time.perf_counter() - self._t0
+            occupancy = (
+                100.0 * self.samples_real / self.samples_padded
+                if self.samples_padded
+                else 0.0
+            )
+            snap = {
+                "uptime_s": uptime,
+                "requests": {
+                    "admitted": self.admitted,
+                    "completed": self.completed,
+                    "rejected": self.rejected,
+                    "timed_out": self.timed_out,
+                    "failed": self.failed,
+                },
+                "batches": self.batches,
+                "samples": {
+                    "real": self.samples_real,
+                    "dispatched": self.samples_padded,
+                },
+                "batch_occupancy_pct": occupancy,
+                "padding_waste_pct": 100.0 - occupancy if self.batches else 0.0,
+                "throughput_rps": self.completed / uptime if uptime > 0 else 0.0,
+                "samples_per_s": (
+                    self.samples_real / uptime if uptime > 0 else 0.0
+                ),
+                "latency_ms": {
+                    "count": len(lat),
+                    "p50": 1e3 * percentile(lat, 50),
+                    "p95": 1e3 * percentile(lat, 95),
+                    "p99": 1e3 * percentile(lat, 99),
+                    "mean": 1e3 * sum(lat) / len(lat) if lat else 0.0,
+                    "max": 1e3 * lat[-1] if lat else 0.0,
+                },
+            }
+        if queue_depth is not None:
+            snap["queue_depth"] = queue_depth
+        if compiles is not None:
+            snap["compiles"] = compiles
+        if buckets is not None:
+            snap["buckets"] = list(buckets)
+        return snap
+
+    def report_lines(self, **snapshot_kwargs) -> str:
+        """Human-readable multi-line summary (caller prints; see module
+        docstring for the convention)."""
+        s = self.snapshot(**snapshot_kwargs)
+        r, lat = s["requests"], s["latency_ms"]
+        lines = [
+            "serving metrics "
+            f"(uptime {s['uptime_s']:.1f}s, {s['throughput_rps']:.1f} req/s, "
+            f"{s['samples_per_s']:.1f} samples/s):",
+            f"  requests: {r['completed']} ok / {r['rejected']} rejected / "
+            f"{r['timed_out']} timed out / {r['failed']} failed "
+            f"(admitted {r['admitted']})",
+            f"  batches: {s['batches']} dispatched, occupancy "
+            f"{s['batch_occupancy_pct']:.1f}%, padding waste "
+            f"{s['padding_waste_pct']:.1f}%",
+            f"  latency: p50 {lat['p50']:.2f} ms, p95 {lat['p95']:.2f} ms, "
+            f"p99 {lat['p99']:.2f} ms, max {lat['max']:.2f} ms "
+            f"over {lat['count']} requests",
+        ]
+        if "queue_depth" in s:
+            lines.append(f"  queue depth: {s['queue_depth']}")
+        if "compiles" in s:
+            lines.append(
+                f"  compiles: {s['compiles']}"
+                + (f" (buckets {s['buckets']})" if "buckets" in s else "")
+            )
+        return "\n".join(lines)

@@ -1,0 +1,179 @@
+"""stdlib-only JSON serving endpoint over ``http.server``.
+
+Endpoints:
+
+- ``POST /predict`` — body ``{"instances": [...]}`` where each instance
+  is a flat 784-list or a 28x28 (optionally ...x1) nested list of pixel
+  values.  By default instances are RAW pixels (0..255) and the server
+  applies the training pipeline's exact ToTensor∘Normalize affine
+  (data/transforms.normalize — serving must see the distribution the
+  model trained on); send ``"normalized": true`` to submit pre-normalized
+  float inputs verbatim.  Response: ``{"predictions": [digit, ...]}``,
+  plus per-class ``"log_probs"`` when ``"return_log_probs": true``.
+- ``GET /metrics`` — the full ServingMetrics snapshot (queue depth,
+  occupancy, p50/p95/p99 latency, compile count) as JSON.
+- ``GET /healthz`` — liveness + readiness (warmed buckets).
+
+Status mapping (the backpressure contract, docs/SERVING.md): 400 malformed
+input, 503 admission rejected (queue full or draining — retry later),
+504 deadline expired, 500 engine failure.
+
+``ThreadingHTTPServer`` gives one handler thread per in-flight request;
+handlers only parse, ``submit()`` to the batcher's bounded queue, and
+wait — the single batcher worker owns all jax dispatch, so concurrency
+here costs no device-side contention.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..data.transforms import normalize
+from ..models.net import INPUT_SHAPE
+from .batcher import MicroBatcher, RejectedError, RequestTimeout
+from .engine import InferenceEngine
+from .metrics import ServingMetrics
+
+
+def decode_instances(body: dict) -> np.ndarray:
+    """Request JSON -> model-ready ``[n, 28, 28, 1]`` float32 rows.
+
+    Raises ``ValueError`` (-> 400) on anything malformed; the message is
+    returned to the client so a bad integration fails debuggably.
+    """
+    if not isinstance(body, dict):
+        raise ValueError("request body must be a JSON object")
+    instances = body.get("instances")
+    if instances is None:
+        raise ValueError('missing "instances"')
+    try:
+        x = np.asarray(instances, np.float32)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"instances are not a rectangular numeric array: {e}")
+    if x.ndim == 1 or x.ndim == 2 and x.shape[1:] == (28,):
+        raise ValueError(
+            "instances must be a LIST of samples (wrap a single sample in "
+            "an outer list)"
+        )
+    h, w, c = INPUT_SHAPE
+    if x.ndim == 2 and x.shape[1] == h * w:
+        x = x.reshape(-1, h, w)
+    elif x.ndim == 3 and x.shape[1:] == (h, w):
+        pass
+    elif x.ndim == 4 and x.shape[1:] == INPUT_SHAPE:
+        x = x[..., 0]
+    else:
+        raise ValueError(
+            f"each instance must be {h * w} flat, {h}x{w}, or {h}x{w}x{c} "
+            f"pixels; got array shape {x.shape}"
+        )
+    if bool(body.get("normalized", False)):
+        return x[..., None]
+    return normalize(x)
+
+
+class ServingHandler(BaseHTTPRequestHandler):
+    server_version = "mnist-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    # Per-request stdout lines would swamp the metrics surface at serving
+    # rates; /metrics is the observability story.
+    def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+        pass
+
+    def _send_json(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - stdlib casing
+        srv: ServingHTTPServer = self.server  # type: ignore[assignment]
+        if self.path == "/healthz":
+            self._send_json(
+                200,
+                {
+                    "status": "ok",
+                    "warmed": srv.engine.warmed,
+                    "buckets": list(srv.engine.buckets),
+                },
+            )
+        elif self.path == "/metrics":
+            self._send_json(200, srv.snapshot())
+        else:
+            self._send_json(404, {"error": f"no such path {self.path!r}"})
+
+    def do_POST(self):  # noqa: N802 - stdlib casing
+        srv: ServingHTTPServer = self.server  # type: ignore[assignment]
+        if self.path != "/predict":
+            self._send_json(404, {"error": f"no such path {self.path!r}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            x = decode_instances(body)
+        except ValueError as e:
+            self._send_json(400, {"error": str(e)})
+            return
+        try:
+            request = srv.batcher.submit(x)
+            logits = request.result()
+        except RejectedError as e:
+            self._send_json(503, {"error": str(e)})
+            return
+        except RequestTimeout as e:
+            self._send_json(504, {"error": str(e)})
+            return
+        except Exception as e:  # engine failure propagated by the worker
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        payload: dict = {
+            "predictions": [int(p) for p in logits.argmax(axis=1)]
+        }
+        if bool(body.get("return_log_probs", False)):
+            payload["log_probs"] = [[float(v) for v in row] for row in logits]
+        self._send_json(200, payload)
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer carrying the serving objects for its handlers."""
+
+    daemon_threads = True
+
+    def __init__(
+        self,
+        address: tuple[str, int],
+        engine: InferenceEngine,
+        batcher: MicroBatcher,
+        metrics: ServingMetrics,
+    ):
+        super().__init__(address, ServingHandler)
+        self.engine = engine
+        self.batcher = batcher
+        self.metrics = metrics
+
+    def snapshot(self) -> dict:
+        return self.metrics.snapshot(
+            queue_depth=self.batcher.depth(),
+            compiles=self.engine.compile_count(),
+            buckets=self.engine.buckets,
+        )
+
+
+def make_server(
+    engine: InferenceEngine,
+    metrics: ServingMetrics,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    **batcher_kwargs,
+) -> ServingHTTPServer:
+    """Wire engine + metrics + a started batcher into a ready-to-run
+    server (port 0 = OS-assigned, for tests and the in-process loadgen;
+    the bound port is ``server.server_address[1]``)."""
+    batcher = MicroBatcher(engine, metrics=metrics, **batcher_kwargs).start()
+    return ServingHTTPServer((host, port), engine, batcher, metrics)

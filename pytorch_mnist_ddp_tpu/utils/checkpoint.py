@@ -325,6 +325,37 @@ def load_variables(path: str) -> dict[str, Any]:
     return variables_from_state_dict(load_state_dict(path))
 
 
+def load_inference_variables(path: str) -> dict[str, Any]:
+    """Any trained-model artifact -> eval-ready Flax variables (the
+    serving engine's load entry point, serving/engine.py).
+
+    Accepts BOTH checkpoint surfaces: the torch-compatible model-only
+    files ``--save-model`` writes (torch zip / legacy pickle / npz, via
+    :func:`load_variables`) and the full ``--save-state`` training
+    archives — from which only params and BN running statistics are kept
+    (serving never needs optimizer accumulators, and dropping them here
+    means an operator can point the server at whichever file the training
+    run produced without re-exporting)."""
+    is_state_archive = False
+    try:
+        with np.load(path) as archive:
+            files = set(archive.files)
+            is_state_archive = "step" in files and any(
+                k.startswith("params.") for k in files
+            )
+            if is_state_archive:
+                flat = {k: archive[k] for k in files}
+    except (OSError, ValueError):
+        pass  # not npz at all; load_variables sniffs the torch formats
+    if not is_state_archive:
+        return load_variables(path)
+    out: dict[str, Any] = {"params": _unflatten(flat, "params.")}
+    batch_stats = _unflatten(flat, "batch_stats.")
+    if batch_stats:
+        out["batch_stats"] = batch_stats
+    return out
+
+
 def params_from_state_dict(state: Mapping[str, np.ndarray]) -> dict[str, Any]:
     """Rebuild a nested Flax param tree from a flat torch-style state dict,
     accepting (and stripping) the ``module.`` prefix quirk.  BN running

@@ -564,6 +564,116 @@ def draw(key):
 
 
 # ---------------------------------------------------------------------------
+# JL007 — raw len()-dependent shapes into a jitted callable
+
+
+JL007_BAD = """\
+import jax
+
+predict = jax.jit(lambda p, x: x)
+
+def serve(params, batch, buf):
+    return predict(params, buf[:len(batch)])
+"""
+
+JL007_GOOD = """\
+import jax
+
+predict = jax.jit(lambda p, x: x)
+
+def serve(params, batch, buf):
+    bucket = bucket_for(len(batch), BUCKETS)
+    return predict(params, pad_to_bucket(buf, bucket))
+"""
+
+
+def test_jl007_fires_on_raw_len_shape():
+    assert_fires(JL007_BAD, "JL007", line=6)
+
+
+def test_jl007_silent_when_bucketed():
+    # len() consumed inside bucket_for, and the jitted call's argument
+    # goes through pad_to_bucket — the sanctioned path stays clean.
+    assert_silent(JL007_GOOD, "JL007")
+
+
+def test_jl007_tracks_len_bound_names():
+    # `n = len(batch)` then slicing by n is the same hazard, one hop away.
+    assert_fires(
+        """\
+import jax
+
+predict = jax.jit(lambda p, x: x)
+
+def serve(params, batch, buf):
+    n = len(batch)
+    return predict(params, buf[:n])
+""",
+        "JL007",
+        line=7,
+    )
+
+
+def test_jl007_scope_local_jit_binding():
+    assert_fires(
+        """\
+import jax
+import numpy as np
+
+def serve(params, batch):
+    fwd = jax.jit(lambda p, x: x)
+    return fwd(params, np.zeros((len(batch), 28)))
+""",
+        "JL007",
+    )
+
+
+def test_jl007_sentinel_wrapped_jit_is_tracked():
+    # RecompileSentinel(jax.jit(...)) is still a jitted callable; feeding
+    # it raw sizes defeats the very sentinel wrapping it.
+    assert_fires(
+        """\
+import jax
+from pytorch_mnist_ddp_tpu.analysis import RecompileSentinel
+
+predict = RecompileSentinel(jax.jit(lambda p, x: x), max_traces=1)
+
+def serve(params, batch, buf):
+    return predict(params, buf[:len(batch)])
+""",
+        "JL007",
+    )
+
+
+def test_jl007_unjitted_callee_is_fine():
+    # Host helpers slice by len() constantly; only jitted callables care.
+    assert_silent(
+        """\
+def serve(params, batch, buf):
+    return summarize(params, buf[:len(batch)])
+""",
+        "JL007",
+    )
+
+
+def test_jl007_len_in_non_shape_position_without_jit_name():
+    # Rebinding the name to something non-len clears the taint.
+    assert_silent(
+        """\
+import jax
+
+predict = jax.jit(lambda p, x: x)
+
+def serve(params, batch, buf):
+    n = len(batch)
+    n = bucket_for(n, BUCKETS)
+    return predict(params, buf[:n])
+""",
+        "JL007",
+    )
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

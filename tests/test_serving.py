@@ -1,0 +1,499 @@
+"""Serving subsystem tests (ISSUE 2): bucket policy, micro-batcher
+coalescing/backpressure/drain, engine warmup under the recompile
+sentinel, the checkpoint -> serve round trip, the HTTP surface, and the
+load generator's report.
+
+Run alone with ``pytest -m serving`` (the CI serving job); everything
+here also rides the default smoke tier.  Batcher/bucket/metrics tests
+use a fake engine — no jax dispatch — so the concurrency logic is
+exercised at interactive speed; the engine/server/loadgen tests compile
+real bucket executables on the 8-virtual-device CPU mesh (conftest.py).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.models.net import NUM_CLASSES, init_params
+from pytorch_mnist_ddp_tpu.parallel.ddp import (
+    make_eval_step,
+    make_train_state,
+    make_train_step,
+    replicate_params,
+)
+from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+from pytorch_mnist_ddp_tpu.serving import (
+    InferenceEngine,
+    MicroBatcher,
+    RejectedError,
+    RequestTimeout,
+    ServingMetrics,
+    bucket_for,
+    pad_to_bucket,
+    pow2_buckets,
+    validate_buckets,
+)
+from pytorch_mnist_ddp_tpu.serving.metrics import percentile
+from pytorch_mnist_ddp_tpu.serving.server import decode_instances, make_server
+
+pytestmark = pytest.mark.serving
+
+
+# ---------------------------------------------------------------------------
+# Bucket policy (pure host-side)
+
+
+def test_pow2_ladder():
+    assert pow2_buckets(1, 16) == (1, 2, 4, 8, 16)
+    assert pow2_buckets(8, 128) == (8, 16, 32, 64, 128)
+    assert pow2_buckets(5, 64) == (8, 16, 32, 64)  # min rounds UP to pow2
+
+
+def test_bucket_for_picks_smallest_fit():
+    buckets = (8, 16, 32)
+    assert bucket_for(1, buckets) == 8
+    assert bucket_for(8, buckets) == 8
+    assert bucket_for(9, buckets) == 16
+    assert bucket_for(32, buckets) == 32
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_for(33, buckets)
+    with pytest.raises(ValueError):
+        bucket_for(0, buckets)
+
+
+def test_validate_buckets_rejects_bad_ladders():
+    assert validate_buckets([16, 8, 8], n_shards=8) == (8, 16)
+    with pytest.raises(ValueError, match="power of two"):
+        validate_buckets([8, 12])
+    with pytest.raises(ValueError, match="data axis"):
+        validate_buckets([4], n_shards=8)
+    with pytest.raises(ValueError, match="empty"):
+        validate_buckets([])
+
+
+def test_pad_to_bucket_rows():
+    x = np.ones((3, 28, 28, 1), np.float32)
+    padded = pad_to_bucket(x, 8)
+    assert padded.shape == (8, 28, 28, 1)
+    np.testing.assert_array_equal(padded[:3], x)
+    assert not padded[3:].any()
+    assert pad_to_bucket(x, 3) is x  # exact fit: no copy
+    with pytest.raises(ValueError):
+        pad_to_bucket(x, 2)
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+
+
+def test_percentile_nearest_rank():
+    vals = sorted(float(v) for v in range(1, 101))
+    assert percentile(vals, 50) == 50.0
+    assert percentile(vals, 95) == 95.0
+    assert percentile(vals, 99) == 99.0
+    assert percentile(vals, 100) == 100.0
+    assert percentile([], 50) == 0.0
+
+
+def test_metrics_snapshot_occupancy_and_latency():
+    m = ServingMetrics()
+    m.record_admitted(3)
+    m.record_batch(real=6, bucket=8)
+    for lat in (0.010, 0.020, 0.030):
+        m.record_completed(lat)
+    m.record_rejected()
+    snap = m.snapshot(queue_depth=2, compiles=1, buckets=(8,))
+    assert snap["requests"] == {
+        "admitted": 3, "completed": 3, "rejected": 1,
+        "timed_out": 0, "failed": 0,
+    }
+    assert snap["batch_occupancy_pct"] == pytest.approx(75.0)
+    assert snap["padding_waste_pct"] == pytest.approx(25.0)
+    assert snap["latency_ms"]["p50"] == pytest.approx(20.0)
+    assert snap["queue_depth"] == 2 and snap["compiles"] == 1
+    report = m.report_lines(queue_depth=2, compiles=1, buckets=(8,))
+    assert "p95" in report and "occupancy" in report
+
+
+# ---------------------------------------------------------------------------
+# Micro-batcher (fake engine: pure concurrency logic, no jax)
+
+
+class FakeEngine:
+    """Engine stand-in recording dispatch sizes; rows carry their input's
+    first value so per-request unsplitting is checkable."""
+
+    def __init__(self, buckets=(8,), delay_s: float = 0.0):
+        self.buckets = tuple(buckets)
+        self.metrics = None
+        self.delay_s = delay_s
+        self.dispatches: list[int] = []
+
+    def predict_logits(self, x):
+        self.dispatches.append(len(x))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        out = np.zeros((len(x), NUM_CLASSES), np.float32)
+        out[:, 0] = x.reshape(len(x), -1)[:, 0]
+        return out
+
+
+def _rows(n, tag=1.0):
+    x = np.zeros((n, 28, 28, 1), np.float32)
+    x[:, 0, 0, 0] = tag
+    return x
+
+
+def test_batcher_coalesces_queued_requests():
+    engine = FakeEngine(buckets=(8,))
+    m = ServingMetrics()
+    batcher = MicroBatcher(engine, metrics=m, linger_ms=20.0)
+    # Submit BEFORE starting the worker: everything is queued, so the
+    # first wakeup must coalesce all four into one 8-sample dispatch.
+    reqs = [batcher.submit(_rows(2, tag=i)) for i in range(4)]
+    batcher.start()
+    outs = [r.result() for r in reqs]
+    batcher.stop()
+    assert engine.dispatches == [8]
+    for i, out in enumerate(outs):
+        assert out.shape == (2, NUM_CLASSES)
+        assert out[0, 0] == pytest.approx(float(i))  # unsplit to the right waiter
+    assert m.completed == 4 and m.admitted == 4
+
+
+def test_batcher_carry_request_that_does_not_fit():
+    engine = FakeEngine(buckets=(8,))
+    batcher = MicroBatcher(engine, metrics=ServingMetrics(), linger_ms=5.0)
+    reqs = [batcher.submit(_rows(3)) for _ in range(3)]
+    batcher.start()
+    for r in reqs:
+        r.result()
+    batcher.stop()
+    # 3+3 fits in 8, the third 3 does not -> it leads the next batch.
+    assert engine.dispatches == [6, 3]
+
+
+def test_batcher_backpressure_rejects_when_full():
+    engine = FakeEngine()
+    m = ServingMetrics()
+    batcher = MicroBatcher(engine, metrics=m, queue_depth=2)  # not started
+    batcher.submit(_rows(1))
+    batcher.submit(_rows(1))
+    with pytest.raises(RejectedError, match="queue full"):
+        batcher.submit(_rows(1))
+    assert m.rejected == 1 and m.admitted == 2
+    batcher.stop(drain=False)
+
+
+def test_batcher_rejects_oversized_request():
+    m = ServingMetrics()
+    batcher = MicroBatcher(FakeEngine(buckets=(8,)), metrics=m)
+    with pytest.raises(RejectedError, match="outside"):
+        batcher.submit(_rows(9))
+    assert m.rejected == 1  # every 503 path feeds the same gauge
+    batcher.stop(drain=False)
+
+
+def test_batcher_stop_flushes_requests_the_worker_never_saw():
+    # The submit()/stop() race shape: a request lands in the queue after
+    # the worker exits (here: no worker at all).  stop() must complete it
+    # with a rejection rather than strand its waiter until deadline.
+    m = ServingMetrics()
+    batcher = MicroBatcher(FakeEngine(), metrics=m)
+    req = batcher.submit(_rows(1))
+    batcher.stop(drain=True)
+    with pytest.raises(RejectedError, match="shutting down"):
+        req.result()
+    assert m.rejected == 1
+
+
+def test_batcher_expires_overdue_requests():
+    engine = FakeEngine()
+    m = ServingMetrics()
+    batcher = MicroBatcher(engine, metrics=m, timeout_ms=5.0)  # not started yet
+    req = batcher.submit(_rows(1))
+    time.sleep(0.03)  # deadline passes while queued
+    batcher.start()
+    with pytest.raises(RequestTimeout):
+        req.result()
+    batcher.stop()
+    assert m.timed_out == 1
+    assert engine.dispatches == []  # never wasted a dispatch on it
+
+
+def test_batcher_graceful_drain_completes_admitted_work():
+    engine = FakeEngine(delay_s=0.005)
+    batcher = MicroBatcher(engine, metrics=ServingMetrics(), linger_ms=0.0)
+    reqs = [batcher.submit(_rows(1)) for _ in range(5)]
+    batcher.start()
+    batcher.stop(drain=True)  # close admission, finish the queue, join
+    for r in reqs:
+        assert r.result().shape == (1, NUM_CLASSES)
+    with pytest.raises(RejectedError, match="draining"):
+        batcher.submit(_rows(1))
+
+
+def test_batcher_engine_failure_completes_all_waiters():
+    class ExplodingEngine(FakeEngine):
+        def predict_logits(self, x):
+            raise RuntimeError("boom")
+
+    m = ServingMetrics()
+    batcher = MicroBatcher(ExplodingEngine(), metrics=m)
+    req = batcher.submit(_rows(2))
+    batcher.start()
+    with pytest.raises(RuntimeError, match="boom"):
+        req.result()
+    batcher.stop()
+    assert m.failed == 1
+
+
+# ---------------------------------------------------------------------------
+# Engine: warmed buckets under the sentinel (real jax, 8-device CPU mesh)
+
+
+def test_engine_warmup_compiles_each_bucket_once(devices):
+    m = ServingMetrics()
+    engine = InferenceEngine.from_seed(buckets=(8, 16), metrics=m)
+    report = engine.warmup()
+    assert report == [(8, 1), (16, 2)]  # strictly one new trace per bucket
+    assert engine.compile_count() == 2 and engine.warmed
+    # Mixed post-warmup sizes ride the warmed executables: ZERO new traces.
+    for n in (1, 3, 8, 11, 16):
+        logits = engine.predict_logits(
+            np.random.RandomState(n).rand(n, 28, 28, 1).astype(np.float32)
+        )
+        assert logits.shape == (n, NUM_CLASSES)
+    assert engine.compile_count() == 2
+    # Oversized direct calls chunk through the top bucket, still no trace.
+    out = engine.predict_logits(np.zeros((20, 28, 28, 1), np.float32))
+    assert out.shape == (20, NUM_CLASSES)
+    assert engine.compile_count() == 2
+    assert m.batches == 7 and m.samples_real == 1 + 3 + 8 + 11 + 16 + 20
+
+
+def test_engine_rejects_bad_input_shapes(devices):
+    engine = InferenceEngine.from_seed(buckets=(8,))
+    with pytest.raises(ValueError, match="expected"):
+        engine.predict_logits(np.zeros((2, 27, 28, 1), np.float32))
+    with pytest.raises(ValueError, match="empty"):
+        engine.predict_logits(np.zeros((0, 28, 28, 1), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint -> serve round trip (the satellite's end-to-end contract)
+
+
+def _tiny_trained_state(mesh, steps=3, batch=16):
+    """A few real DDP train steps on synthetic data — enough for params
+    to leave init, cheap enough for the smoke tier."""
+    rng = np.random.RandomState(0)
+    params = init_params(jax.random.PRNGKey(0))
+    state = replicate_params(make_train_state(params), mesh)
+    step = make_train_step(mesh)
+    for i in range(steps):
+        x = jnp.asarray(rng.rand(batch, 28, 28, 1).astype(np.float32))
+        y = jnp.asarray(rng.randint(0, 10, batch).astype(np.int32))
+        w = jnp.ones((batch,), jnp.float32)
+        state, _ = step(state, x, y, w, jax.random.PRNGKey(1), jnp.float32(1.0))
+    return state
+
+
+def test_checkpoint_serve_roundtrip(devices, tmp_path):
+    from pytorch_mnist_ddp_tpu.ops.loss import nll_loss
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import (
+        model_state_dict,
+        save_state_dict,
+    )
+
+    mesh = make_mesh()
+    state = _tiny_trained_state(mesh)
+    params_host = jax.device_get(state.params)
+    path = str(tmp_path / "mnist_cnn.pt")
+    save_state_dict(model_state_dict(params_host), path)
+
+    buckets = (8, 16)
+    engine_ckpt = InferenceEngine.from_checkpoint(path, mesh=mesh, buckets=buckets)
+    engine_mem = InferenceEngine({"params": params_host}, mesh=mesh, buckets=buckets)
+    # Exactly one compile per warmed bucket, sentinel-verified, on both.
+    for engine in (engine_ckpt, engine_mem):
+        engine.warmup()
+        assert engine.compile_count() == len(buckets)
+
+    rng = np.random.RandomState(7)
+    x = rng.rand(16, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, 16).astype(np.int32)
+
+    # The round trip is lossless: logits from the checkpoint-loaded engine
+    # are BIT-identical to the in-memory-params engine (same executable,
+    # params round-tripped through the checkpoint byte-exactly).
+    logits_ckpt = engine_ckpt.predict_logits(x)
+    logits_mem = engine_mem.predict_logits(x)
+    np.testing.assert_array_equal(logits_ckpt, logits_mem)
+
+    # And the served numbers agree with the training-side eval step on the
+    # same batch: identical correct-count, loss_sum to float32 tolerance
+    # (the eval step fuses its reduction; the engine reduces on host).
+    eval_fn = make_eval_step(mesh)
+    totals = np.asarray(
+        eval_fn(
+            replicate_params(params_host, mesh),
+            jnp.asarray(x), jnp.asarray(y), jnp.ones((16,), jnp.float32),
+        )
+    )
+    loss_sum = float(
+        nll_loss(jnp.asarray(logits_ckpt), jnp.asarray(y),
+                 jnp.ones((16,), jnp.float32), reduction="sum")
+    )
+    correct = int((logits_ckpt.argmax(axis=1) == y).sum())
+    assert correct == int(totals[1])
+    assert loss_sum == pytest.approx(float(totals[0]), rel=1e-5)
+    # No stray compiles from serving the comparison batch.
+    assert engine_ckpt.compile_count() == len(buckets)
+
+
+def test_engine_loads_save_state_archive(devices, tmp_path):
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import save_train_state
+
+    mesh = make_mesh()
+    state = _tiny_trained_state(mesh)
+    path = str(tmp_path / "train_state.npz")
+    save_train_state(jax.device_get(state), path, epoch=1)
+    engine = InferenceEngine.from_checkpoint(path, mesh=mesh, buckets=(8,))
+    engine.warmup()
+    engine_mem = InferenceEngine(
+        {"params": jax.device_get(state.params)}, mesh=mesh, buckets=(8,)
+    )
+    x = np.random.RandomState(3).rand(5, 28, 28, 1).astype(np.float32)
+    np.testing.assert_array_equal(
+        engine.predict_logits(x), engine_mem.predict_logits(x)
+    )
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+
+
+def _post(url, payload, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def test_server_end_to_end(devices):
+    m = ServingMetrics()
+    engine = InferenceEngine.from_seed(buckets=(8,), metrics=m)
+    engine.warmup()
+    server = make_server(engine, m, port=0, linger_ms=1.0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        status, body = _post(
+            f"{base}/predict",
+            {
+                "instances": np.random.RandomState(0)
+                .randint(0, 255, (3, 784)).tolist(),
+                "return_log_probs": True,
+            },
+        )
+        assert status == 200
+        assert len(body["predictions"]) == 3
+        assert len(body["log_probs"][0]) == NUM_CLASSES
+        # log-probs: each row sums to ~1 in probability space
+        assert sum(np.exp(body["log_probs"][0])) == pytest.approx(1.0, rel=1e-3)
+
+        status, body = _post(f"{base}/predict", {"instances": "nope"})
+        assert status == 400 and "error" in body
+
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as resp:
+            health = json.load(resp)
+        assert health["warmed"] and health["buckets"] == [8]
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            snap = json.load(resp)
+        assert snap["compiles"] == 1
+        assert snap["requests"]["completed"] == 1
+        assert snap["queue_depth"] == 0
+
+        # Draining batcher -> 503 backpressure semantics on the wire.
+        server.batcher.stop(drain=True)
+        status, body = _post(
+            f"{base}/predict", {"instances": [[0.0] * 784], "normalized": True}
+        )
+        assert status == 503 and "draining" in body["error"]
+    finally:
+        server.shutdown()
+        server.server_close()
+    # The whole HTTP exchange added zero compiles.
+    assert engine.compile_count() == 1
+
+
+def test_decode_instances_shapes_and_errors():
+    flat = decode_instances({"instances": [[10] * 784]})
+    assert flat.shape == (1, 28, 28, 1)
+    nested = decode_instances({"instances": np.zeros((2, 28, 28)).tolist()})
+    assert nested.shape == (2, 28, 28, 1)
+    pre = decode_instances(
+        {"instances": np.zeros((2, 28, 28, 1)).tolist(), "normalized": True}
+    )
+    assert pre.dtype == np.float32 and float(pre.max()) == 0.0
+    # Raw pixels go through the training normalize (mean shift: zeros map
+    # to a negative constant, not 0).
+    raw = decode_instances({"instances": np.zeros((1, 784)).tolist()})
+    assert float(raw[0, 0, 0, 0]) < 0.0
+    for bad in (
+        {"instances": [0.0] * 784},        # bare sample, not a list of them
+        {"instances": [[1, 2, 3]]},        # wrong width
+        {"no_instances": []},
+        [],
+    ):
+        with pytest.raises(ValueError):
+            decode_instances(bad)
+
+
+# ---------------------------------------------------------------------------
+# Load generator (in-process, the CI-able smoke of the acceptance run)
+
+
+def test_loadgen_self_serve_report(devices, tmp_path):
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "serve_loadgen", os.path.join(root, "tools", "serve_loadgen.py")
+    )
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+
+    report_path = str(tmp_path / "BENCH_serving.json")
+    rc = loadgen.main([
+        "--requests", "24", "--concurrency", "4", "--max-request", "8",
+        "--buckets", "8", "--report", report_path,
+    ])
+    assert rc == 0
+    with open(report_path) as f:
+        report = json.load(f)
+    # The acceptance surface: latency percentiles, occupancy, rejection
+    # count, and the zero-additional-compiles verdict all present.
+    assert report["requests"] == 24
+    assert report["additional_compiles"] == 0
+    for q in ("p50", "p95", "p99"):
+        assert report["latency_ms"][q] > 0.0
+    assert 0.0 < report["server_batch_occupancy_pct"] <= 100.0
+    assert report["rejected"] == 0
+    assert report["status_counts"].get("200") == 24

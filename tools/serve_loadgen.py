@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Load generator for the serving subsystem (docs/SERVING.md).
+
+Fires mixed-size /predict requests from concurrent client threads at a
+serving endpoint and writes a ``BENCH_serving.json``-style report:
+client-side p50/p95/p99 latency, throughput, per-status counts
+(including 503 rejections — the backpressure signal), and the server's
+own /metrics snapshot before and after the run.
+
+The headline assertion is the retrace firewall: mixed request sizes must
+cause ZERO additional compiles beyond the warmed buckets.  The tool
+reads the server's ``compiles`` gauge before and after and exits nonzero
+if it moved (disable with --no-check-compiles when deliberately probing
+an unwarmed ladder).
+
+Default mode (``--self-serve``) spins the whole stack up in-process on a
+loopback port with fresh seed weights — no checkpoint, no running server,
+no network needed: the CI-able smoke path.  Point --url at a real server
+to load-test a deployment.
+
+Usage::
+
+    python tools/serve_loadgen.py                       # self-contained
+    python tools/serve_loadgen.py --url http://host:8000 \
+        --requests 2000 --concurrency 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fetch_json(url: str, payload: dict | None = None, timeout: float = 30.0) -> tuple[int, dict]:
+    """One HTTP exchange -> (status, parsed body); HTTP errors are data
+    here (503 IS the backpressure measurement), so they don't raise."""
+    req = urllib.request.Request(
+        url,
+        data=None if payload is None else json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.load(e)
+        except Exception:
+            body = {}
+        return e.code, body
+
+
+def run_load(
+    url: str,
+    requests: int,
+    concurrency: int,
+    max_request: int,
+    seed: int,
+    timeout_s: float,
+) -> dict:
+    """Drive the endpoint; returns raw per-request (status, latency_s)."""
+    rng = random.Random(seed)
+    # Pre-generate request sizes so the mix is reproducible from --seed.
+    sizes = [rng.randint(1, max_request) for _ in range(requests)]
+    results: list[tuple[int, float]] = []
+    lock = threading.Lock()
+    cursor = [0]
+
+    def worker(wid: int) -> None:
+        wrng = random.Random(seed * 1000 + wid)
+        while True:
+            with lock:
+                i = cursor[0]
+                if i >= requests:
+                    return
+                cursor[0] += 1
+            n = sizes[i]
+            instances = [
+                [wrng.randint(0, 255) for _ in range(784)] for _ in range(n)
+            ]
+            t0 = time.perf_counter()
+            status, _body = fetch_json(
+                f"{url}/predict", {"instances": instances}, timeout=timeout_s
+            )
+            elapsed = time.perf_counter() - t0
+            with lock:
+                results.append((status, elapsed))
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(concurrency)
+    ]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    return {"results": results, "wall_s": wall, "sizes": sizes}
+
+
+def summarize(raw: dict, before: dict, after: dict) -> dict:
+    from pytorch_mnist_ddp_tpu.serving.metrics import percentile
+
+    results = raw["results"]
+    ok = sorted(lat for status, lat in results if status == 200)
+    by_status: dict[str, int] = {}
+    for status, _ in results:
+        by_status[str(status)] = by_status.get(str(status), 0) + 1
+    compiles_before = before.get("compiles")
+    compiles_after = after.get("compiles")
+    additional = (
+        compiles_after - compiles_before
+        if compiles_before is not None and compiles_after is not None
+        else None
+    )
+    return {
+        "requests": len(results),
+        "request_size_range": [min(raw["sizes"]), max(raw["sizes"])],
+        "wall_s": raw["wall_s"],
+        "throughput_rps": len(ok) / raw["wall_s"] if raw["wall_s"] else 0.0,
+        "status_counts": by_status,
+        "rejected": by_status.get("503", 0),
+        "timed_out": by_status.get("504", 0),
+        "latency_ms": {
+            "p50": 1e3 * percentile(ok, 50),
+            "p95": 1e3 * percentile(ok, 95),
+            "p99": 1e3 * percentile(ok, 99),
+            "mean": 1e3 * sum(ok) / len(ok) if ok else 0.0,
+        },
+        "server_batch_occupancy_pct": after.get("batch_occupancy_pct"),
+        "server_padding_waste_pct": after.get("padding_waste_pct"),
+        "server_queue_depth_final": after.get("queue_depth"),
+        "compiles_before": compiles_before,
+        "compiles_after": compiles_after,
+        "additional_compiles": additional,
+        "server_metrics_before": before,
+        "server_metrics_after": after,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    parser.add_argument(
+        "--url", default=None,
+        help="serving endpoint (http://host:port); omitted = --self-serve",
+    )
+    parser.add_argument(
+        "--self-serve", action="store_true",
+        help="spin up an in-process server on a loopback port (fresh "
+        "seed weights; the default when --url is omitted)",
+    )
+    parser.add_argument("--requests", type=int, default=200)
+    parser.add_argument("--concurrency", type=int, default=8)
+    parser.add_argument(
+        "--max-request", type=int, default=16,
+        help="request sizes are drawn uniformly from [1, this]",
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--timeout-s", type=float, default=30.0)
+    parser.add_argument(
+        "--buckets", default="8,16,32",
+        help="bucket ladder for --self-serve mode",
+    )
+    parser.add_argument(
+        "--linger-ms", type=float, default=2.0,
+        help="batcher linger for --self-serve mode",
+    )
+    parser.add_argument(
+        "--queue-depth", type=int, default=64,
+        help="admission bound for --self-serve mode",
+    )
+    parser.add_argument("--report", default="BENCH_serving.json")
+    parser.add_argument(
+        "--no-check-compiles", action="store_true",
+        help="don't fail when the run triggered additional compiles",
+    )
+    args = parser.parse_args(argv)
+
+    server = None
+    if args.url and not args.self_serve:
+        url = args.url.rstrip("/")
+    else:
+        from pytorch_mnist_ddp_tpu.serving import InferenceEngine, ServingMetrics
+        from pytorch_mnist_ddp_tpu.serving.server import make_server
+
+        metrics = ServingMetrics()
+        engine = InferenceEngine.from_seed(
+            buckets=[int(b) for b in args.buckets.split(",")], metrics=metrics
+        )
+        print(f"self-serve: warming buckets {list(engine.buckets)}")
+        engine.warmup()
+        server = make_server(
+            engine, metrics, port=0,
+            linger_ms=args.linger_ms, queue_depth=args.queue_depth,
+        )
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        print(f"self-serve: {url}")
+
+    try:
+        _status, before = fetch_json(f"{url}/metrics")
+        print(
+            f"driving {args.requests} requests of 1..{args.max_request} "
+            f"samples at concurrency {args.concurrency}"
+        )
+        raw = run_load(
+            url, args.requests, args.concurrency, args.max_request,
+            args.seed, args.timeout_s,
+        )
+        _status, after = fetch_json(f"{url}/metrics")
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.batcher.stop(drain=True)
+            server.server_close()
+
+    report = summarize(raw, before, after)
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=2)
+
+    lat = report["latency_ms"]
+    print(
+        f"done in {report['wall_s']:.2f}s: "
+        f"{report['throughput_rps']:.1f} req/s, "
+        f"p50 {lat['p50']:.2f} ms / p95 {lat['p95']:.2f} ms / "
+        f"p99 {lat['p99']:.2f} ms, "
+        f"{report['rejected']} rejected (503), "
+        f"occupancy {report['server_batch_occupancy_pct']:.1f}%"
+        if report["server_batch_occupancy_pct"] is not None
+        else "done (no server occupancy reported)"
+    )
+    print(f"report: {args.report}")
+    extra = report["additional_compiles"]
+    if extra is None:
+        print("warning: endpoint reports no compile gauge; retrace check skipped")
+    elif extra > 0:
+        print(
+            f"RETRACE: {extra} additional compile(s) during the run — "
+            "request shapes escaped the bucket policy"
+        )
+        if not args.no_check_compiles:
+            return 1
+    else:
+        print("zero additional compiles (bucket firewall held)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
