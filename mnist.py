@@ -82,6 +82,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--step-stats", action="store_true", default=False,
                    help="print per-epoch host-side step latency summaries "
                         "(per-batch path only)")
+    p.add_argument("--telemetry-dir", type=str, default=None, metavar="DIR",
+                   help="write structured telemetry into DIR: JSONL "
+                        "step/epoch/eval events (chief-only in distributed "
+                        "mode) plus a Prometheus text exposition "
+                        "(metrics.prom) at end of run; stdout is unchanged "
+                        "(docs/OBSERVABILITY.md)")
     return p
 
 

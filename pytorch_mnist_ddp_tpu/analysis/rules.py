@@ -1,6 +1,7 @@
-"""The jaxlint rule set: JL001–JL007, the JAX hazards this repo has
-actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, and
-the serving layer's per-request-shape retrace class).
+"""The jaxlint rule set: JL001–JL008, the JAX hazards this repo has
+actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, the
+serving layer's per-request-shape retrace class, and the telemetry
+layer's record-at-trace-time class).
 
 Every rule is a heuristic over one module's AST — no type inference, no
 cross-file call graph.  "Traced context" below means: a function that is
@@ -788,6 +789,73 @@ class DeviceGetLoopRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# JL008 — telemetry recorded at trace time
+
+
+_TRACE_CLOCK_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.process_time",
+    "time.time_ns", "time.perf_counter_ns", "time.monotonic_ns",
+}
+_METRIC_RECORD_METHODS = {"inc", "dec", "observe", "emit", "mark"}
+
+
+class TelemetryUnderTraceRule(Rule):
+    """JL008: clock reads / metrics-recording calls inside traced code.
+
+    The observability-layer twin of JL003: a ``time.perf_counter()`` or
+    ``counter.inc()`` under ``jit`` executes ONCE, at trace time, with
+    tracers — the "latency" is the compile-time timestamp baked in as a
+    constant, and the counter moves once per compile instead of once per
+    step.  Telemetry that silently measures nothing is worse than none:
+    the dashboard looks alive.  Record at the host boundary instead —
+    around the jitted call (obs/spans.span, StepStats.mark), never
+    inside it.
+
+    Matched: the ``time`` module's clock calls, the obs recording
+    methods (``.inc``/``.dec``/``.observe``/``.emit``/``.mark``), and
+    any ``.record_*`` method (the ServingMetrics surface).  Clock reads
+    overlap JL003's impure-call set deliberately — JL003 says "this is
+    a side effect", this rule says what the broken telemetry will look
+    like and where the recording belongs.
+    """
+
+    rule_id = "JL008"
+    severity = Severity.WARNING
+    summary = "telemetry (clock read / metric record) inside a traced function"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        analysis = get_trace_analysis(ctx)
+        for fn in analysis.traced_defs():
+            label = _fn_label(fn)
+            for node in iter_own_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name in _TRACE_CLOCK_CALLS:
+                    yield self.finding(
+                        ctx, node,
+                        f"{name}() inside traced function '{label}' reads "
+                        "the clock once at trace time — the value is a "
+                        "compile-time constant, so the timing records "
+                        "nothing at runtime; time around the jitted call "
+                        "at the host boundary (obs/spans.span, "
+                        "StepStats.mark)",
+                    )
+                elif isinstance(node.func, ast.Attribute) and (
+                    node.func.attr in _METRIC_RECORD_METHODS
+                    or node.func.attr.startswith("record_")
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        f".{node.func.attr}() inside traced function "
+                        f"'{label}' records at trace time only (once per "
+                        "compile, with tracers, not once per step); move "
+                        "the recording outside the jitted boundary and "
+                        "feed it values the function returns",
+                    )
+
+
+# ---------------------------------------------------------------------------
 # JL007 — raw len()-dependent shapes fed to a jitted callable
 
 
@@ -922,6 +990,7 @@ ALL_RULES: tuple[Rule, ...] = (
     DonationRule(),
     DeviceGetLoopRule(),
     BucketShapeRule(),
+    TelemetryUnderTraceRule(),
 )
 
 

@@ -51,10 +51,22 @@ class RecompileSentinel:
         legitimately compiles a second program.
     name:
         Label used in error messages; defaults to the wrapped function's.
+    registry:
+        Optional obs registry (duck-typed: anything with ``.counter(name,
+        help=..., **labels)``) — every observed trace increments
+        ``jax_compiles_total{fn=name}``, so retraces become a scrapeable
+        counter (serving exposes it via ``GET /metrics``) instead of a
+        number that only surfaces when the budget is already blown.
+        Kept duck-typed so this module stays importable with zero
+        package dependencies (analysis/engine.py contract).
     """
 
     def __init__(
-        self, fn: Callable[..., Any], max_traces: int = 1, name: str | None = None
+        self,
+        fn: Callable[..., Any],
+        max_traces: int = 1,
+        name: str | None = None,
+        registry=None,
     ):
         cache_size = getattr(fn, "_cache_size", None)
         if not callable(cache_size):
@@ -68,15 +80,34 @@ class RecompileSentinel:
         self.max_traces = max_traces
         self.name = name or getattr(fn, "__name__", repr(fn))
         self.calls = 0
+        self._compile_counter = (
+            registry.counter(
+                "jax_compiles_total",
+                help="distinct traces of sentinel-guarded jitted functions",
+                fn=self.name,
+            )
+            if registry is not None
+            else None
+        )
+        self._reported_traces = 0
         functools.update_wrapper(self, fn, updated=())
 
     def trace_count(self) -> int:
         """Distinct traces the wrapped function has accumulated so far."""
         return int(self._fn._cache_size())
 
+    def _report_compiles(self, traces: int) -> None:
+        # Registry reporting happens BEFORE the bound check, so the
+        # over-budget trace is on the counter even when check() raises —
+        # the scrape shows what actually compiled, not what was allowed.
+        if self._compile_counter is not None and traces > self._reported_traces:
+            self._compile_counter.inc(traces - self._reported_traces)
+            self._reported_traces = traces
+
     def check(self) -> None:
         """Assert the trace bound now (also runs after every call)."""
         traces = self.trace_count()
+        self._report_compiles(traces)
         if traces > self.max_traces:
             raise RecompileError(
                 f"{self.name} retraced: {traces} traces after {self.calls} "

@@ -1,0 +1,97 @@
+"""obs: the unified telemetry layer (docs/OBSERVABILITY.md).
+
+PR 3 collapses the repo's three disconnected instrumentation islands —
+``utils/profiling.StepStats``, ``serving/metrics.ServingMetrics`` (each
+previously with its own, semantically different percentile), and the
+``analysis/sentinel`` trace counts — onto one dependency-free core:
+
+- :mod:`.registry` — named counters / gauges / reservoir histograms
+  with label support and THE shared linear-interpolation
+  :func:`~.registry.percentile`.
+- :mod:`.events` — structured JSONL event sink (monotonic ``ts``, run
+  id, rank; chief-only by default in distributed mode).
+- :mod:`.spans` — ``span("name")`` context manager emitting
+  start/end/duration events with nesting, optionally wrapping the
+  XProf capture (``utils.profiling.trace``) so timing and profiling
+  share one API.
+- :mod:`.export` — Prometheus text exposition rendered from the
+  registry (served by ``GET /metrics``, written as ``metrics.prom`` by
+  training runs).
+
+Training runs opt in with ``--telemetry-dir DIR`` (default stdout stays
+byte-identical to the reference); the serving process is always on.
+Everything here is stdlib-only — no jax import, same rationale as
+analysis/engine.py: observability must never pay a device-init cost.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .events import EventSink, NullSink, open_sink, read_events
+from .export import render_prometheus, write_prometheus
+from .registry import Counter, Gauge, Histogram, Registry, percentile
+from .spans import current_span, span
+
+__all__ = [
+    "Counter",
+    "EventSink",
+    "Gauge",
+    "Histogram",
+    "NullSink",
+    "Registry",
+    "Telemetry",
+    "current_span",
+    "open_sink",
+    "percentile",
+    "read_events",
+    "render_prometheus",
+    "span",
+    "write_prometheus",
+]
+
+
+class Telemetry:
+    """One run's telemetry bundle: a registry + an event sink + spans.
+
+    The trainer's ``--telemetry-dir`` object (trainer.fit).  Events and
+    the end-of-run exposition file are chief-gated in distributed mode
+    (the registry still records on every rank, for in-process readers);
+    ``span`` binds this bundle's sink and registry so call sites just
+    say ``with telemetry.span("epoch", epoch=3):``.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        rank: int = 0,
+        distributed: bool = False,
+        registry: Registry | None = None,
+        run_id: str | None = None,
+    ):
+        self.directory = directory
+        self.registry = registry if registry is not None else Registry()
+        self.events = open_sink(
+            directory, rank=rank, distributed=distributed, run_id=run_id
+        )
+
+    def span(self, name: str, trace_dir: str | None = None, **fields):
+        return span(
+            name,
+            sink=self.events,
+            registry=self.registry,
+            trace_dir=trace_dir,
+            **fields,
+        )
+
+    def write_exposition(self, filename: str = "metrics.prom") -> str | None:
+        """Render the registry to ``<dir>/metrics.prom`` (chief only, the
+        same gate as events); returns the path, or None when gated."""
+        if not self.events:
+            return None
+        path = os.path.join(self.directory, filename)
+        write_prometheus(self.registry, path)
+        return path
+
+    def close(self) -> None:
+        self.events.close()

@@ -1,0 +1,133 @@
+"""Structured JSONL event sink.
+
+The registry (obs/registry.py) answers "what is the value now"; this
+module answers "what happened, when" — one JSON object per line, written
+as events occur, so a run that dies mid-epoch still leaves every step it
+completed on disk (the append-and-flush discipline the bench artifacts
+learned the hard way in round 3).
+
+Schema: every record carries
+
+- ``ts`` — ``time.monotonic()`` at emit.  Monotonic, not wall: event
+  DELTAS are the measurement (step latency, span duration) and must not
+  jump when NTP steps the clock.
+- ``wall`` — ``time.time()`` at emit, for correlating against logs and
+  other hosts (never subtract two ``wall`` values; that is what ``ts``
+  is for).
+- ``run_id`` — one opaque id per sink, so a directory accumulating
+  several runs stays separable (tools/perf_report.py --telemetry).
+- ``rank`` — the emitting process (0 in single-process runs).
+- ``event`` — the event name; remaining keys are event-specific.
+
+Rank gating: in distributed mode only the chief writes by default
+(``open_sink``), mirroring the stdout convention (utils/logging.py —
+"callers decide rank-gating, process 0 only").  Non-chief ranks get a
+:class:`NullSink` so call sites stay unconditional.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class NullSink:
+    """Swallows events; falsy so ``if sink:`` gates chief-only work."""
+
+    path = None
+    run_id = None
+
+    def emit(self, event: str, **fields) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __bool__(self) -> bool:
+        return False
+
+
+def _make_run_id() -> str:
+    # Wall-clock prefix for human sorting + random suffix for uniqueness
+    # (two runs starting within one second must not interleave as one).
+    return time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()) + "-" + os.urandom(3).hex()
+
+
+class EventSink:
+    """Append-mode JSONL writer; ``emit`` is thread-safe and flushes per
+    line (a crashed run keeps everything already emitted)."""
+
+    def __init__(
+        self,
+        directory: str,
+        run_id: str | None = None,
+        rank: int = 0,
+        filename: str | None = None,
+    ):
+        os.makedirs(directory, exist_ok=True)
+        self.run_id = run_id or _make_run_id()
+        self.rank = int(rank)
+        self.path = os.path.join(
+            directory, filename or f"events-rank{self.rank}.jsonl"
+        )
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def emit(self, event: str, **fields) -> None:
+        record = {
+            "ts": time.monotonic(),
+            "wall": time.time(),
+            "run_id": self.run_id,
+            "rank": self.rank,
+            "event": event,
+        }
+        record.update(fields)
+        line = json.dumps(record, sort_keys=False)
+        with self._lock:
+            if self._f.closed:
+                return  # late emit after close (daemon thread tail): drop
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    def __bool__(self) -> bool:
+        return True
+
+
+def open_sink(
+    directory: str | None,
+    rank: int = 0,
+    distributed: bool = False,
+    chief_only: bool = True,
+    run_id: str | None = None,
+) -> EventSink | NullSink:
+    """The one constructor call sites use: falsy ``directory`` or a
+    non-chief rank (distributed + ``chief_only``) yields a NullSink, so
+    telemetry code never branches on mode."""
+    if not directory:
+        return NullSink()
+    if distributed and chief_only and rank != 0:
+        return NullSink()
+    return EventSink(directory, run_id=run_id, rank=rank)
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse one JSONL file, skipping blank and torn lines (a live run's
+    last line may be mid-write; a summarizer must not crash on it)."""
+    out: list[dict] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out

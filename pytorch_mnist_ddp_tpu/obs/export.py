@@ -1,0 +1,99 @@
+"""Prometheus text-format exposition rendered from a Registry.
+
+Text format 0.0.4 (``# HELP`` / ``# TYPE`` / samples), the thing every
+scraper in existence parses.  Counters and gauges render directly;
+reservoir histograms render as Prometheus *summaries* — ``{quantile=
+"0.5|0.95|0.99"}`` samples plus lifetime ``_sum``/``_count`` — because
+quantiles over the recent window are exactly what the reservoir holds
+(fixed-bucket ``histogram`` series would impose a bucket ladder the
+recording sites never chose).
+
+Consumed by serving/server.py's ``GET /metrics`` (``Accept: text/plain``
+or ``?format=prom``) and by :meth:`obs.Telemetry.write_exposition`,
+which drops ``metrics.prom`` into the ``--telemetry-dir`` at end of run
+for offline scraping/grepping (the CI smoke does exactly that).
+"""
+
+from __future__ import annotations
+
+from .registry import Registry, percentile
+
+_QUANTILES = (("0.5", 50.0), ("0.95", 95.0), ("0.99", 99.0))
+
+
+def _escape_label(value: str) -> str:
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _labels_str(labels: dict[str, str], extra: tuple[str, str] | None = None) -> str:
+    items = list(labels.items())
+    if extra is not None:
+        items.append(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in items)
+    return "{" + body + "}"
+
+
+def _fmt_value(v) -> str:
+    # Integral values print as integers (counter idiom); floats use repr
+    # so no precision is invented or lost.
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def render_prometheus(registry: Registry) -> str:
+    """The full exposition document (trailing newline included).
+
+    Rendered under the registry-wide lock, so one scrape is a consistent
+    cut across every metric (a request completing mid-render cannot show
+    a completed count without its latency observation)."""
+    lines: list[str] = []
+    with registry.locked():
+        _render_into(lines, registry)
+    return "\n".join(lines) + "\n"
+
+
+def _render_into(lines: list[str], registry: Registry) -> None:
+    for name, type_str, help_text, children in registry.collect():
+        if help_text:
+            lines.append(f"# HELP {name} {_escape_help(help_text)}")
+        # Reservoir histograms expose as the summary metric type (module
+        # docstring); counters/gauges map 1:1.
+        lines.append(
+            f"# TYPE {name} {'summary' if type_str == 'histogram' else type_str}"
+        )
+        for labels, metric in children:
+            if type_str == "histogram":
+                sorted_window = sorted(metric.values())
+                for q_label, q in _QUANTILES:
+                    lines.append(
+                        f"{name}{_labels_str(labels, ('quantile', q_label))} "
+                        f"{_fmt_value(percentile(sorted_window, q))}"
+                    )
+                lines.append(
+                    f"{name}_sum{_labels_str(labels)} {_fmt_value(metric.sum)}"
+                )
+                lines.append(
+                    f"{name}_count{_labels_str(labels)} {_fmt_value(metric.count)}"
+                )
+            else:
+                lines.append(
+                    f"{name}{_labels_str(labels)} {_fmt_value(metric.value)}"
+                )
+
+
+def write_prometheus(registry: Registry, path: str) -> None:
+    """Atomic-enough single write (scrapers re-read whole files)."""
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(render_prometheus(registry))

@@ -1,0 +1,232 @@
+"""Named metrics registry: counters, gauges, reservoir histograms.
+
+Before this module the repo had THREE percentile implementations and
+three disconnected places numbers lived (utils/profiling.StepStats,
+serving/metrics.ServingMetrics, analysis/sentinel trace counts) — none
+scrapeable, none correlatable.  This registry is the one place a number
+goes to become observable: every metric is named, optionally labeled
+(rank/bucket/phase/...), thread-safe, and renderable as Prometheus text
+(obs/export.py) or readable in-process.
+
+Deliberately dependency-free (stdlib only, no jax import) for the same
+reason as analysis/engine.py: observability must never pay a device-init
+cost, and the serving HTTP handlers scrape it from plain threads.
+
+Conventions
+-----------
+- One :class:`Registry` per process surface (the serving process owns
+  one via ``ServingMetrics.registry``; a ``--telemetry-dir`` training
+  run owns one via ``obs.Telemetry``).  Module-global state is avoided
+  so tests compose freely.
+- A *family* is one metric name with one type and one label-key set;
+  children are distinguished by label values, exactly the Prometheus
+  data model.  Re-registering a name with a conflicting type or label
+  keys raises immediately — silent aliasing is how metrics lie.
+- All percentiles in the repo go through :func:`percentile` (linear
+  interpolation, the numpy default).  The previous split — StepStats'
+  rounded nearest-index vs serving's ceil nearest-rank — meant "p95"
+  was two different statistics depending on which subsystem printed it.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+
+def percentile(sorted_values: list[float], q: float) -> float:
+    """Linear-interpolation percentile over an ascending-sorted list.
+
+    ``q`` is in [0, 100].  Empty input returns 0.0 (metrics surfaces
+    render before the first observation).  This is THE percentile of the
+    repo: StepStats, ServingMetrics, and the telemetry reports all call
+    it, so a p95 means the same thing on every surface.
+    """
+    if not sorted_values:
+        return 0.0
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"percentile out of range: {q}")
+    if len(sorted_values) == 1:
+        return sorted_values[0]
+    pos = (q / 100.0) * (len(sorted_values) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_values) - 1)
+    frac = pos - lo
+    return sorted_values[lo] * (1.0 - frac) + sorted_values[hi] * frac
+
+
+class Counter:
+    """Monotonically increasing count.  ``inc`` only; a counter that can
+    go down is a gauge wearing the wrong type and breaks rate() math."""
+
+    def __init__(self, lock: threading.RLock):
+        self._lock = lock
+        self._value = 0
+
+    def inc(self, n: int | float = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter increment must be >= 0, got {n}")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """A value that goes up and down (queue depth, samples/sec, ...)."""
+
+    def __init__(self, lock: threading.RLock):
+        self._lock = lock
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def add(self, delta: float) -> None:
+        with self._lock:
+            self._value += delta
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Reservoir histogram: the newest ``reservoir`` observations plus
+    lifetime count/sum.
+
+    Same bounded-window rationale as the old ServingMetrics ring: a
+    long-lived process must not grow without bound, and tail percentiles
+    over the recent window are what an operator acts on.  ``count`` and
+    ``sum`` are lifetime totals (Prometheus summary semantics);
+    percentiles come from the window.
+    """
+
+    def __init__(self, lock: threading.RLock, reservoir: int = 8192):
+        self._lock = lock
+        self._window: deque[float] = deque(maxlen=reservoir)
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._window.append(float(v))
+            self._count += 1
+            self._sum += v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def values(self) -> list[float]:
+        """Snapshot of the current window (unsorted, insertion order)."""
+        with self._lock:
+            return list(self._window)
+
+    def percentile(self, q: float) -> float:
+        return percentile(sorted(self.values()), q)
+
+
+_TYPES = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
+
+
+class _Family:
+    """One metric name: its type, help text, label-key set, children."""
+
+    def __init__(self, name: str, cls, help: str, label_keys: tuple[str, ...]):
+        self.name = name
+        self.cls = cls
+        self.help = help
+        self.label_keys = label_keys
+        self.children: dict[tuple[str, ...], object] = {}
+
+
+_NAME_OK = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
+
+
+class Registry:
+    """Thread-safe named metric store.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create: callers hold
+    the returned metric for hot-path recording, or re-look it up by name
+    + labels (cheap, one dict hit under the lock).  One registry-wide
+    RLock covers creation AND every metric mutation/read, so a
+    ``collect()`` (the exposition path) sees a consistent cut.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: dict[str, _Family] = {}
+
+    def locked(self):
+        """The registry-wide lock, for multi-metric consistent reads
+        (``with registry.locked(): ...``).  Reentrant, so metric
+        reads/mutations inside the block still work."""
+        return self._lock
+
+    # -- get-or-create --------------------------------------------------------
+
+    def counter(self, name: str, help: str = "", **labels: object) -> Counter:
+        return self._child(name, Counter, help, labels)
+
+    def gauge(self, name: str, help: str = "", **labels: object) -> Gauge:
+        return self._child(name, Gauge, help, labels)
+
+    def histogram(
+        self, name: str, help: str = "", reservoir: int = 8192, **labels: object
+    ) -> Histogram:
+        return self._child(name, Histogram, help, labels, reservoir=reservoir)
+
+    def _child(self, name, cls, help, labels, **metric_kwargs):
+        if not name or not set(name) <= _NAME_OK or name[0].isdigit():
+            raise ValueError(f"invalid metric name {name!r}")
+        label_keys = tuple(sorted(labels))
+        label_values = tuple(str(labels[k]) for k in label_keys)
+        with self._lock:
+            family = self._families.get(name)
+            if family is None:
+                family = _Family(name, cls, help, label_keys)
+                self._families[name] = family
+            elif family.cls is not cls:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{_TYPES[family.cls]}, not {_TYPES[cls]}"
+                )
+            elif family.label_keys != label_keys:
+                raise ValueError(
+                    f"metric {name!r} registered with labels "
+                    f"{list(family.label_keys)}, got {list(label_keys)}; one "
+                    "family, one label-key set (the Prometheus data model)"
+                )
+            child = family.children.get(label_values)
+            if child is None:
+                child = cls(self._lock, **metric_kwargs)
+                family.children[label_values] = child
+            return child
+
+    # -- reading --------------------------------------------------------------
+
+    def collect(self):
+        """``[(name, type_str, help, [(labels_dict, metric), ...]), ...]``
+        sorted by name — the exposition input (obs/export.py)."""
+        with self._lock:
+            out = []
+            for name in sorted(self._families):
+                family = self._families[name]
+                children = [
+                    (dict(zip(family.label_keys, values)), metric)
+                    for values, metric in sorted(family.children.items())
+                ]
+                out.append((name, _TYPES[family.cls], family.help, children))
+            return out

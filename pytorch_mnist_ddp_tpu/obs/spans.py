@@ -1,0 +1,79 @@
+"""``span("name")``: one API for timed regions, events, and XProf.
+
+A span emits ``span_start``/``span_end`` events (obs/events.py), records
+its duration into the registry histogram ``span_duration_seconds{span=
+name}``, and — when ``trace_dir`` is set — wraps the region in the
+existing ``utils.profiling.trace`` XProf capture, so "time this" and
+"profile this" are the same call site with one extra argument instead of
+two nested context managers that can drift apart.
+
+Nesting is tracked per thread: a child span's events carry
+``parent``/``depth``, so the JSONL reconstructs the call tree without
+any end-time matching heuristics.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+_stack = threading.local()
+
+
+def current_span() -> str | None:
+    """Name of the innermost open span on this thread, or None."""
+    stack = getattr(_stack, "names", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    sink=None,
+    registry=None,
+    trace_dir: str | None = None,
+    **fields,
+):
+    """Time a region; emit start/end events; optionally XProf it.
+
+    ``sink`` and ``registry`` are both optional — a span with neither is
+    still a correct (if silent) timer, so library code can open spans
+    unconditionally and let the caller decide where they land.  Extra
+    ``fields`` ride on both events (``epoch=3`` etc.).
+    """
+    stack = getattr(_stack, "names", None)
+    if stack is None:
+        stack = _stack.names = []
+    parent = stack[-1] if stack else None
+    depth = len(stack)
+    if sink is not None:
+        sink.emit("span_start", span=name, parent=parent, depth=depth, **fields)
+    stack.append(name)
+    t0 = time.perf_counter()
+    try:
+        if trace_dir:
+            from ..utils.profiling import trace
+
+            with trace(trace_dir):
+                yield
+        else:
+            yield
+    finally:
+        duration = time.perf_counter() - t0
+        stack.pop()
+        if registry is not None:
+            registry.histogram(
+                "span_duration_seconds",
+                help="wall duration of obs.span regions",
+                span=name,
+            ).observe(duration)
+        if sink is not None:
+            sink.emit(
+                "span_end",
+                span=name,
+                parent=parent,
+                depth=depth,
+                duration_s=duration,
+                **fields,
+            )

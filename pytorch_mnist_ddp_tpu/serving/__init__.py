@@ -20,9 +20,13 @@ ROADMAP's "heavy traffic" north star:
   drain.
 - :mod:`.metrics` — queue depth, batch occupancy, padding waste,
   latency percentiles, throughput (string-returning report helpers,
-  utils/logging.py convention).
-- :mod:`.server` — stdlib-only ``http.server`` JSON endpoint; run it
-  with ``python -m pytorch_mnist_ddp_tpu.serving``.
+  utils/logging.py convention), rebuilt on the shared telemetry
+  registry (obs/registry.py) so the same numbers back the JSON
+  snapshot AND the Prometheus exposition.
+- :mod:`.server` — stdlib-only ``http.server`` JSON endpoint
+  (``/metrics`` also serves Prometheus text with ``Accept: text/plain``
+  or ``?format=prom``); run it with
+  ``python -m pytorch_mnist_ddp_tpu.serving``.
 
 Load-test with ``tools/serve_loadgen.py``; see docs/SERVING.md.
 """

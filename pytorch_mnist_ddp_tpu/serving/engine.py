@@ -94,9 +94,14 @@ class InferenceEngine:
             conv_impl=conv_impl,
         )
         # One trace per bucket, ever.  A post-warmup retrace means a
-        # request shape escaped the bucket policy.
+        # request shape escaped the bucket policy.  Compile events land
+        # on the shared registry (jax_compiles_total{fn="predict_step"})
+        # so /metrics exposes the count Prometheus-side too.
         self._predict = RecompileSentinel(
-            fn, max_traces=len(self.buckets), name="predict_step"
+            fn,
+            max_traces=len(self.buckets),
+            name="predict_step",
+            registry=metrics.registry if metrics is not None else None,
         )
         self.metrics = metrics
         self.warmed = False

@@ -1,82 +1,130 @@
 """Serving metrics: queue depth, occupancy, latency percentiles, waste.
 
-The training side's observability contract (utils/logging.py) is
-string-returning helpers with the caller deciding where they print; this
-module follows it — :meth:`ServingMetrics.report_lines` renders, callers
-print.  Counters are updated from the HTTP handler threads and the
-batcher worker concurrently, so every mutation takes the one lock; reads
-snapshot under the same lock and format outside it.
+Rebuilt (PR 3) on the shared telemetry registry (obs/registry.py): every
+counter and the latency reservoir are named registry metrics, so the
+same numbers back BOTH ``/metrics`` surfaces — the JSON snapshot below
+and the Prometheus text exposition (``?format=prom``; obs/export.py) —
+plus the ``jax_compiles_total`` counter the engine's RecompileSentinel
+reports into the same registry.
 
-Latencies are kept in a bounded ring (newest ``reservoir`` observations)
-— serving metrics must not grow without bound over a long-lived process,
-and tail percentiles over the recent window are what an operator acts
-on anyway.
+The training side's observability contract (utils/logging.py) still
+holds — :meth:`ServingMetrics.report_lines` renders, callers print.
+Mutations arrive from the HTTP handler threads and the batcher worker
+concurrently; the registry's one lock covers every metric, so reads are
+a consistent cut.
+
+Latencies keep the bounded-reservoir semantics (newest ``reservoir``
+observations): serving metrics must not grow without bound over a
+long-lived process, and tail percentiles over the recent window are
+what an operator acts on anyway.  Percentiles are the repo-shared
+linear interpolation — previously this module ceil'd a nearest rank
+while StepStats rounded an index, two different "p95"s.
 """
 
 from __future__ import annotations
 
-import threading
 import time
-from collections import deque
 
+from ..obs.registry import Registry
+from ..obs.registry import percentile as percentile  # noqa: F401 - shared impl, re-exported
 
-def percentile(sorted_values: list[float], q: float) -> float:
-    """Nearest-rank percentile over an ascending-sorted list (no numpy
-    interpolation surprises in operator-facing numbers)."""
-    if not sorted_values:
-        return 0.0
-    if not 0.0 <= q <= 100.0:
-        raise ValueError(f"percentile out of range: {q}")
-    rank = max(1, int(-(-q * len(sorted_values) // 100)))  # ceil, 1-based
-    return sorted_values[min(rank, len(sorted_values)) - 1]
+_OUTCOMES = ("admitted", "completed", "rejected", "timed_out", "failed")
 
 
 class ServingMetrics:
-    """Counters + latency reservoir for one serving process."""
+    """Counters + latency reservoir for one serving process, all living
+    in ``self.registry`` (shareable with the engine's sentinel)."""
 
-    def __init__(self, reservoir: int = 8192):
-        self._lock = threading.Lock()
+    def __init__(self, reservoir: int = 8192, registry: Registry | None = None):
+        self.registry = registry if registry is not None else Registry()
         self._t0 = time.perf_counter()
-        self._latencies: deque[float] = deque(maxlen=reservoir)
-        self.admitted = 0
-        self.completed = 0
-        self.rejected = 0       # admission-queue backpressure (503)
-        self.timed_out = 0      # deadline expired before dispatch (504)
-        self.failed = 0         # engine/dispatch errors (500)
-        self.batches = 0
-        self.samples_real = 0   # real samples dispatched
-        self.samples_padded = 0  # bucket slots dispatched (real + padding)
+        self._requests = {
+            outcome: self.registry.counter(
+                "serving_requests_total",
+                help="requests by lifecycle outcome "
+                "(admitted intake; completed/rejected/timed_out/failed exits)",
+                outcome=outcome,
+            )
+            for outcome in _OUTCOMES
+        }
+        self._batches = self.registry.counter(
+            "serving_batches_total", help="engine dispatches"
+        )
+        self._samples_real = self.registry.counter(
+            "serving_samples_total",
+            help="samples by kind (real = live rows, dispatched = bucket "
+            "slots incl. padding)",
+            kind="real",
+        )
+        self._samples_padded = self.registry.counter(
+            "serving_samples_total",
+            help="",
+            kind="dispatched",
+        )
+        self._latency = self.registry.histogram(
+            "serving_request_latency_seconds",
+            help="request latency, submit -> result set (reservoir window)",
+            reservoir=reservoir,
+        )
+
+    # -- counter views (back-compat attribute surface) ------------------------
+
+    @property
+    def admitted(self) -> int:
+        return self._requests["admitted"].value
+
+    @property
+    def completed(self) -> int:
+        return self._requests["completed"].value
+
+    @property
+    def rejected(self) -> int:
+        return self._requests["rejected"].value
+
+    @property
+    def timed_out(self) -> int:
+        return self._requests["timed_out"].value
+
+    @property
+    def failed(self) -> int:
+        return self._requests["failed"].value
+
+    @property
+    def batches(self) -> int:
+        return self._batches.value
+
+    @property
+    def samples_real(self) -> int:
+        return self._samples_real.value
+
+    @property
+    def samples_padded(self) -> int:
+        return self._samples_padded.value
 
     # -- recording (any thread) ---------------------------------------------
 
     def record_admitted(self, n: int = 1) -> None:
-        with self._lock:
-            self.admitted += n
+        self._requests["admitted"].inc(n)
 
     def record_rejected(self, n: int = 1) -> None:
-        with self._lock:
-            self.rejected += n
+        self._requests["rejected"].inc(n)
 
     def record_timeout(self, n: int = 1) -> None:
-        with self._lock:
-            self.timed_out += n
+        self._requests["timed_out"].inc(n)
 
     def record_failed(self, n: int = 1) -> None:
-        with self._lock:
-            self.failed += n
+        self._requests["failed"].inc(n)
 
     def record_batch(self, real: int, bucket: int) -> None:
         """One engine dispatch: ``real`` live samples padded to ``bucket``."""
-        with self._lock:
-            self.batches += 1
-            self.samples_real += real
-            self.samples_padded += bucket
+        self._batches.inc()
+        self._samples_real.inc(real)
+        self._samples_padded.inc(bucket)
 
     def record_completed(self, latency_s: float) -> None:
         """One request finished; ``latency_s`` spans submit -> result set."""
-        with self._lock:
-            self.completed += 1
-            self._latencies.append(latency_s)
+        self._requests["completed"].inc()
+        self._latency.observe(latency_s)
 
     # -- reading -------------------------------------------------------------
 
@@ -86,55 +134,74 @@ class ServingMetrics:
         compiles: int | None = None,
         buckets: tuple[int, ...] | None = None,
     ) -> dict:
-        """One consistent dict of everything (the /metrics payload).
+        """One consistent dict of everything (the /metrics JSON payload).
 
         ``queue_depth``/``compiles``/``buckets`` are owned by the batcher
         and engine; callers pass the current values so this module stays
-        free of back-references.
+        free of back-references.  Passed values are also mirrored into
+        registry gauges, so the Prometheus exposition carries them too.
+
+        All reads happen under the registry-wide lock (reentrant), so the
+        snapshot is a consistent cut — a record_batch landing mid-read
+        cannot skew occupancy by tearing real vs dispatched.
         """
-        with self._lock:
-            lat = sorted(self._latencies)
-            uptime = time.perf_counter() - self._t0
-            occupancy = (
-                100.0 * self.samples_real / self.samples_padded
-                if self.samples_padded
-                else 0.0
-            )
-            snap = {
-                "uptime_s": uptime,
-                "requests": {
-                    "admitted": self.admitted,
-                    "completed": self.completed,
-                    "rejected": self.rejected,
-                    "timed_out": self.timed_out,
-                    "failed": self.failed,
-                },
-                "batches": self.batches,
-                "samples": {
-                    "real": self.samples_real,
-                    "dispatched": self.samples_padded,
-                },
-                "batch_occupancy_pct": occupancy,
-                "padding_waste_pct": 100.0 - occupancy if self.batches else 0.0,
-                "throughput_rps": self.completed / uptime if uptime > 0 else 0.0,
-                "samples_per_s": (
-                    self.samples_real / uptime if uptime > 0 else 0.0
-                ),
-                "latency_ms": {
-                    "count": len(lat),
-                    "p50": 1e3 * percentile(lat, 50),
-                    "p95": 1e3 * percentile(lat, 95),
-                    "p99": 1e3 * percentile(lat, 99),
-                    "mean": 1e3 * sum(lat) / len(lat) if lat else 0.0,
-                    "max": 1e3 * lat[-1] if lat else 0.0,
-                },
+        with self.registry.locked():
+            lat = sorted(self._latency.values())
+            completed = self.completed
+            samples_real = self.samples_real
+            samples_padded = self.samples_padded
+            batches = self.batches
+            requests = {
+                "admitted": self.admitted,
+                "completed": completed,
+                "rejected": self.rejected,
+                "timed_out": self.timed_out,
+                "failed": self.failed,
             }
+        uptime = time.perf_counter() - self._t0
+        occupancy = (
+            100.0 * samples_real / samples_padded if samples_padded else 0.0
+        )
+        throughput = completed / uptime if uptime > 0 else 0.0
+        snap = {
+            "uptime_s": uptime,
+            "requests": requests,
+            "batches": batches,
+            "samples": {
+                "real": samples_real,
+                "dispatched": samples_padded,
+            },
+            "batch_occupancy_pct": occupancy,
+            "padding_waste_pct": 100.0 - occupancy if batches else 0.0,
+            "throughput_rps": throughput,
+            "samples_per_s": samples_real / uptime if uptime > 0 else 0.0,
+            "latency_ms": {
+                "count": len(lat),
+                "p50": 1e3 * percentile(lat, 50),
+                "p95": 1e3 * percentile(lat, 95),
+                "p99": 1e3 * percentile(lat, 99),
+                "mean": 1e3 * sum(lat) / len(lat) if lat else 0.0,
+                "max": 1e3 * lat[-1] if lat else 0.0,
+            },
+        }
+        gauges = [
+            ("serving_uptime_seconds", "process uptime", uptime),
+            ("serving_batch_occupancy_pct", "real samples / dispatched slots",
+             occupancy),
+            ("serving_throughput_rps", "completed requests per second",
+             throughput),
+        ]
         if queue_depth is not None:
             snap["queue_depth"] = queue_depth
+            gauges.append(
+                ("serving_queue_depth", "admission queue depth", queue_depth)
+            )
         if compiles is not None:
             snap["compiles"] = compiles
         if buckets is not None:
             snap["buckets"] = list(buckets)
+        for name, help_text, value in gauges:
+            self.registry.gauge(name, help=help_text).set(value)
         return snap
 
     def report_lines(self, **snapshot_kwargs) -> str:

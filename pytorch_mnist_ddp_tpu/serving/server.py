@@ -11,7 +11,10 @@ Endpoints:
   float inputs verbatim.  Response: ``{"predictions": [digit, ...]}``,
   plus per-class ``"log_probs"`` when ``"return_log_probs": true``.
 - ``GET /metrics`` — the full ServingMetrics snapshot (queue depth,
-  occupancy, p50/p95/p99 latency, compile count) as JSON.
+  occupancy, p50/p95/p99 latency, compile count) as JSON; with
+  ``Accept: text/plain`` or ``?format=prom``, the same registry renders
+  as Prometheus text exposition (obs/export.py) instead — including the
+  ``jax_compiles_total`` counter the engine's RecompileSentinel reports.
 - ``GET /healthz`` — liveness + readiness (warmed buckets).
 
 Status mapping (the backpressure contract, docs/SERVING.md): 400 malformed
@@ -28,10 +31,12 @@ from __future__ import annotations
 
 import json
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
 from ..data.transforms import normalize
+from ..obs.export import render_prometheus
 from ..models.net import INPUT_SHAPE
 from .batcher import MicroBatcher, RejectedError, RequestTimeout
 from .engine import InferenceEngine
@@ -94,7 +99,8 @@ class ServingHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 - stdlib casing
         srv: ServingHTTPServer = self.server  # type: ignore[assignment]
-        if self.path == "/healthz":
+        url = urlsplit(self.path)
+        if url.path == "/healthz":
             self._send_json(
                 200,
                 {
@@ -103,8 +109,26 @@ class ServingHandler(BaseHTTPRequestHandler):
                     "buckets": list(srv.engine.buckets),
                 },
             )
-        elif self.path == "/metrics":
-            self._send_json(200, srv.snapshot())
+        elif url.path == "/metrics":
+            # Content negotiation: JSON stays the default (the PR-2
+            # surface, nothing breaks); Prometheus text is selected by
+            # the scraper convention (Accept: text/plain) or explicitly
+            # (?format=prom) for curl-without-headers ergonomics.
+            wants_prom = (
+                parse_qs(url.query).get("format", [""])[0] == "prom"
+                or "text/plain" in self.headers.get("Accept", "")
+            )
+            if wants_prom:
+                body = srv.prometheus().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._send_json(200, srv.snapshot())
         else:
             self._send_json(404, {"error": f"no such path {self.path!r}"})
 
@@ -163,6 +187,13 @@ class ServingHTTPServer(ThreadingHTTPServer):
             compiles=self.engine.compile_count(),
             buckets=self.engine.buckets,
         )
+
+    def prometheus(self) -> str:
+        # snapshot() first: it mirrors the batcher/engine-owned values
+        # (queue depth, uptime, occupancy) into registry gauges, so the
+        # exposition is as current as the JSON surface.
+        self.snapshot()
+        return render_prometheus(self.metrics.registry)
 
 
 def make_server(

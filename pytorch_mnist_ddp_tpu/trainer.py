@@ -9,6 +9,9 @@ exact analogue of the reference's "Not using distributed mode" degradation
 
 from __future__ import annotations
 
+import contextlib
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -140,6 +143,7 @@ def train_one_epoch(
     dry_run: bool = False,
     per_rank_batch: int | None = None,
     step_stats=None,
+    telemetry=None,
 ) -> TrainState:
     """One training epoch (reference train(), mnist_ddp.py:65-86).
 
@@ -148,6 +152,12 @@ def train_one_epoch(
     ``world_size * batch_idx * per_rank_batch`` (mnist_ddp.py:78), and the
     logged loss is the FIRST replica's local loss — fetched from device
     only on log steps, so there is no per-step sync stall (SURVEY.md §3.2).
+
+    ``telemetry`` (obs.Telemetry, --telemetry-dir) records per-step loss,
+    step latency, and samples into the registry and the JSONL sink.  Like
+    --step-stats, it blocks on each step's output to timestamp it — one
+    device sync per step, the accepted trade for an opt-in diagnostic;
+    the default path is untouched.
     """
     lr_arr = jnp.float32(lr)
     num_batches = len(loader)
@@ -155,10 +165,48 @@ def train_one_epoch(
         per_rank_batch = loader.global_batch // max(dist.world_size, 1)
     if step_stats is not None:
         step_stats.start()
+    step_counter = sample_counter = latency_hist = None
+    steps_recorded = samples_recorded = 0
+    if telemetry is not None:
+        step_counter = telemetry.registry.counter(
+            "train_steps_total", help="optimizer steps executed"
+        )
+        sample_counter = telemetry.registry.counter(
+            "train_samples_total", help="global training samples consumed"
+        )
+        latency_hist = telemetry.registry.histogram(
+            "train_step_latency_seconds",
+            help="host-observed per-step latency (blocking read)",
+        )
+        epoch_t0 = step_t0 = time.perf_counter()
     for batch_idx, (x, y, w) in enumerate(loader.epoch(epoch)):
         state, losses = step_fn(state, x, y, w, dropout_key, lr_arr)
+        loss0 = None
         if step_stats is not None:
             step_stats.mark(losses)
+        if telemetry is not None:
+            jax.block_until_ready(losses)
+            now = time.perf_counter()
+            # The chief's own first local replica, same local-shard read
+            # (and same no-collective rationale) as the log path below.
+            loss0 = float(np.asarray(losses.addressable_shards[0].data)[0])
+            global_batch = per_rank_batch * (
+                dist.world_size if dist.distributed else 1
+            )
+            step_counter.inc()
+            sample_counter.inc(global_batch)
+            steps_recorded += 1
+            samples_recorded += global_batch
+            latency_hist.observe(now - step_t0)
+            telemetry.events.emit(
+                "step",
+                epoch=epoch,
+                step=batch_idx,
+                loss=loss0,
+                latency_s=now - step_t0,
+                samples=global_batch,
+            )
+            step_t0 = time.perf_counter()
         if dist.is_chief and batch_idx % log_interval == 0:
             samples = dist.world_size * batch_idx * per_rank_batch
             if not dist.distributed:
@@ -168,7 +216,9 @@ def train_one_epoch(
             # array compiles a gather over the whole mesh, and a
             # chief-only collective deadlocks/corrupts multi-process runs
             # (every process must enqueue the same programs in order).
-            loss0 = float(np.asarray(losses.addressable_shards[0].data)[0])
+            # (Reused from the telemetry block when it already read it.)
+            if loss0 is None:
+                loss0 = float(np.asarray(losses.addressable_shards[0].data)[0])
             print(
                 train_log_line(
                     epoch,
@@ -181,6 +231,21 @@ def train_one_epoch(
             )
         if dry_run:
             break
+    if telemetry is not None:
+        duration = time.perf_counter() - epoch_t0
+        sps = samples_recorded / duration if duration > 0 else 0.0
+        telemetry.registry.gauge(
+            "train_samples_per_second",
+            help="throughput of the most recent epoch",
+        ).set(sps)
+        telemetry.events.emit(
+            "epoch_train_end",
+            epoch=epoch,
+            steps=steps_recorded,
+            samples=samples_recorded,
+            duration_s=duration,
+            samples_per_s=sps,
+        )
     return state
 
 
@@ -189,20 +254,28 @@ def evaluate(
     params,
     loader: DataLoader,
     dist: DistState,
+    telemetry=None,
 ) -> tuple[float, int]:
     """Distributed eval (reference test(), mnist_ddp.py:89-105): sums NLL
     and correct counts over the full test set, psum'd across the mesh, and
     prints the reference's summary on the chief.  Returns (avg_loss,
-    correct)."""
+    correct).  With ``telemetry``, the pass runs inside an ``evaluate``
+    span (duration event + span_duration_seconds histogram)."""
+    eval_span = (
+        telemetry.span("evaluate")
+        if telemetry is not None
+        else contextlib.nullcontext()
+    )
     loss_sum = 0.0
     correct = 0.0
-    for x, y, w in loader.epoch(0):
-        # np.asarray on the fully-replicated psum output reads the local
-        # copy — no traced indexing, safe on every process of a
-        # multi-controller world.
-        totals = np.asarray(eval_fn(params, x, y, w))
-        loss_sum += float(totals[0])
-        correct += float(totals[1])
+    with eval_span:
+        for x, y, w in loader.epoch(0):
+            # np.asarray on the fully-replicated psum output reads the
+            # local copy — no traced indexing, safe on every process of a
+            # multi-controller world.
+            totals = np.asarray(eval_fn(params, x, y, w))
+            loss_sum += float(totals[0])
+            correct += float(totals[1])
     n = loader.dataset_len
     avg = loss_sum / n
     if dist.is_chief:
@@ -232,15 +305,50 @@ def fit(
     split bench.py reports.  Both paths also record
     ``epoch1_test_accuracy`` / ``final_test_accuracy`` (fractions), so the
     recorded benchmark carries the >=99% accuracy target of BASELINE.json
-    alongside the wall clock."""
+    alongside the wall clock.
+
+    ``--telemetry-dir DIR`` (obs package, docs/OBSERVABILITY.md) opts the
+    run into structured telemetry: JSONL step/epoch/eval events plus a
+    Prometheus exposition (``metrics.prom``) written at end of run.  The
+    run-duration event carries a correctly-labeled ``wall_seconds`` field
+    — the stdout ``Total cost time:... ms`` line keeps its byte-matched
+    label quirk, the telemetry surface does not inherit it.  Default
+    (flagless) stdout is byte-identical to the reference either way."""
     from .utils.profiling import trace
 
-    with trace(getattr(args, "profile", None)):
-        return _fit_body(args, dist, save_path, timings)
+    telemetry = None
+    telemetry_dir = getattr(args, "telemetry_dir", None)
+    if telemetry_dir:
+        from .obs import Telemetry
+
+        telemetry = Telemetry(
+            telemetry_dir,
+            rank=dist.process_rank,
+            distributed=dist.distributed,
+        )
+    t0 = time.perf_counter()
+    try:
+        with trace(getattr(args, "profile", None)):
+            if telemetry is None:
+                return _fit_body(args, dist, save_path, timings)
+            with telemetry.span("run"):
+                state = _fit_body(args, dist, save_path, timings, telemetry)
+        telemetry.events.emit(
+            "run_complete", wall_seconds=time.perf_counter() - t0
+        )
+        telemetry.write_exposition()
+        return state
+    finally:
+        if telemetry is not None:
+            telemetry.close()
 
 
 def _fit_body(
-    args, dist: DistState, save_path: str | None, timings: dict | None = None
+    args,
+    dist: DistState,
+    save_path: str | None,
+    timings: dict | None = None,
+    telemetry=None,
 ) -> TrainState:
     # Model-axis modes (beyond reference parity): --tp N tensor-shards the
     # dense head over a (data, model) mesh; --pp pipelines the two stages
@@ -517,6 +625,32 @@ def _fit_body(
                         len(test_set),
                     )
                 )
+            if telemetry is not None:
+                # The fused run is ONE device call — there is no per-step
+                # host boundary to time, so the telemetry records the
+                # per-epoch curve from the host-materialized outputs
+                # (chief-side, where they land anyway).
+                telemetry.registry.counter(
+                    "train_steps_total", help="optimizer steps executed"
+                ).inc(num_batches * args.epochs)
+                telemetry.registry.counter(
+                    "train_samples_total",
+                    help="global training samples consumed",
+                ).inc(num_batches * args.epochs * global_batch)
+                acc_gauge = telemetry.registry.gauge(
+                    "test_accuracy", help="accuracy of the latest eval pass"
+                )
+                for epoch in range(epoch0 + 1, epoch0 + args.epochs + 1):
+                    row = epoch - epoch0 - 1
+                    acc = float(evals_host[row, 1]) / len(test_set)
+                    acc_gauge.set(acc)
+                    telemetry.events.emit(
+                        "eval",
+                        epoch=epoch,
+                        avg_loss=float(evals_host[row, 0]) / len(test_set),
+                        correct=int(evals_host[row, 1]),
+                        accuracy=acc,
+                    )
     else:
         resume_path = getattr(args, "resume", None)
         resume_step = 0
@@ -617,27 +751,47 @@ def _fit_body(
         want_stats = bool(getattr(args, "step_stats", False))
         for epoch in range(epoch0 + 1, epoch0 + args.epochs + 1):
             stats = StepStats() if want_stats else None
-            state = train_one_epoch(
-                step_fn,
-                state,
-                train_loader,
-                epoch,
-                keys["dropout"],
-                lr_fn(epoch),
-                dist,
-                log_interval=args.log_interval,
-                dry_run=args.dry_run,
-                per_rank_batch=args.batch_size,
-                step_stats=stats,
+            epoch_span = (
+                telemetry.span("epoch", epoch=epoch)
+                if telemetry is not None
+                else contextlib.nullcontext()
             )
-            if stats is not None and dist.is_chief:
-                print(stats.summary_line(epoch))
-            _, correct = evaluate(
-                eval_fn,
-                eval_variables(state.params, state.batch_stats, syncbn),
-                test_loader,
-                dist,
-            )
+            with epoch_span:
+                state = train_one_epoch(
+                    step_fn,
+                    state,
+                    train_loader,
+                    epoch,
+                    keys["dropout"],
+                    lr_fn(epoch),
+                    dist,
+                    log_interval=args.log_interval,
+                    dry_run=args.dry_run,
+                    per_rank_batch=args.batch_size,
+                    step_stats=stats,
+                    telemetry=telemetry,
+                )
+                if stats is not None and dist.is_chief:
+                    print(stats.summary_line(epoch))
+                avg_loss, correct = evaluate(
+                    eval_fn,
+                    eval_variables(state.params, state.batch_stats, syncbn),
+                    test_loader,
+                    dist,
+                    telemetry=telemetry,
+                )
+            if telemetry is not None:
+                acc = correct / len(test_set)
+                telemetry.registry.gauge(
+                    "test_accuracy", help="accuracy of the latest eval pass"
+                ).set(acc)
+                telemetry.events.emit(
+                    "eval",
+                    epoch=epoch,
+                    avg_loss=avg_loss,
+                    correct=correct,
+                    accuracy=acc,
+                )
             if timings is not None:
                 acc = correct / len(test_set)
                 timings.setdefault("epoch1_test_accuracy", acc)

@@ -62,11 +62,14 @@ class StepStats:
         self._last = now
 
     def _percentile(self, q: float) -> float:
-        xs = sorted(self._times)
-        if not xs:
-            return 0.0
-        idx = min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))
-        return xs[idx]
+        # ``q`` in [0, 1] for backward compatibility; the math is the
+        # repo-wide shared linear interpolation (obs/registry.py) — this
+        # class previously rounded to the nearest index while the
+        # serving metrics ceil'd a nearest rank, so "p95" was a
+        # different statistic per subsystem.
+        from ..obs.registry import percentile
+
+        return percentile(sorted(self._times), 100.0 * q)
 
     def summary_line(self, epoch: int) -> str:
         n = len(self._times)

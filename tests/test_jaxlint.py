@@ -674,6 +674,82 @@ def serve(params, batch, buf):
 
 
 # ---------------------------------------------------------------------------
+# JL008 — telemetry recorded at trace time
+
+
+JL008_BAD_CLOCK = """\
+import time
+import jax
+
+@jax.jit
+def step(state, x):
+    t0 = time.perf_counter()
+    out = state * x
+    return out, time.perf_counter() - t0
+"""
+
+JL008_BAD_METRIC = """\
+import jax
+
+@jax.jit
+def step(state, x, counter):
+    counter.inc(1)
+    return state * x
+"""
+
+JL008_BAD_RECORD = """\
+import jax
+
+@jax.jit
+def step(state, x, metrics):
+    metrics.record_completed(0.5)
+    return state * x
+"""
+
+JL008_GOOD = """\
+import time
+import jax
+
+@jax.jit
+def step(state, x):
+    return state * x
+
+def run(state, x, metrics):
+    t0 = time.perf_counter()
+    out = step(state, x)
+    out.block_until_ready()
+    metrics.observe(time.perf_counter() - t0)
+    return out
+"""
+
+
+def test_jl008_fires_on_clock_read_under_trace():
+    assert_fires(JL008_BAD_CLOCK, "JL008", line=6)
+
+
+def test_jl008_fires_on_metric_record_under_trace():
+    assert_fires(JL008_BAD_METRIC, "JL008", line=5)
+
+
+def test_jl008_fires_on_record_method_under_trace():
+    assert_fires(JL008_BAD_RECORD, "JL008", line=5)
+
+
+def test_jl008_silent_on_host_boundary_recording():
+    # run() calls the jitted step but is not itself traced: timing and
+    # recording around the call is exactly the sanctioned pattern.
+    assert_silent(JL008_GOOD, "JL008")
+
+
+def test_jl008_waiver():
+    waived = JL008_BAD_METRIC.replace(
+        "counter.inc(1)",
+        "counter.inc(1)  # jaxlint: disable=JL008 -- trace-time count is the point",
+    )
+    assert_silent(waived, "JL008")
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

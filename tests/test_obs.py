@@ -1,0 +1,439 @@
+"""Unified telemetry tests (obs/; docs/OBSERVABILITY.md): registry
+counter/gauge/histogram semantics, the repo-shared percentile, JSONL
+event schema round-trip, Prometheus exposition format, span nesting,
+the sentinel→registry compile counter, and a trainer smoke asserting
+``--telemetry-dir`` leaves default stdout byte-identical.
+
+All under the ``obs`` marker (pytest.ini; CI runs ``pytest -m obs``).
+"""
+
+import json
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.analysis.sentinel import (
+    RecompileError,
+    RecompileSentinel,
+)
+from pytorch_mnist_ddp_tpu.obs import (
+    EventSink,
+    NullSink,
+    Registry,
+    Telemetry,
+    open_sink,
+    percentile,
+    read_events,
+    render_prometheus,
+    span,
+)
+from pytorch_mnist_ddp_tpu.utils.logging import total_time_line
+from pytorch_mnist_ddp_tpu.utils.profiling import StepStats
+
+pytestmark = pytest.mark.obs
+
+
+# ---------------------------------------------------------------------------
+# The shared percentile (satellite: one implementation, everywhere)
+
+
+def test_percentile_pinned_on_known_sample():
+    """Linear interpolation, pinned: 1..100 has p50 = 50.5 (the midpoint
+    between the 50th and 51st order statistic), p95 = 95.05 — NOT the
+    old nearest-rank 50.0/95.0."""
+    vals = [float(v) for v in range(1, 101)]
+    assert percentile(vals, 50) == pytest.approx(50.5)
+    assert percentile(vals, 95) == pytest.approx(95.05)
+    assert percentile(vals, 0) == 1.0
+    assert percentile(vals, 100) == 100.0
+    assert percentile([], 99) == 0.0
+    assert percentile([7.0], 95) == 7.0
+    with pytest.raises(ValueError):
+        percentile(vals, 101)
+
+
+def test_step_stats_uses_shared_percentile_and_keeps_format():
+    """StepStats migrated off its rounded-index percentile; the
+    summary_line FORMAT is unchanged (callers grep it), the p50/p95
+    values are now the shared linear interpolation."""
+    s = StepStats()
+    s._times = [i / 1000.0 for i in range(1, 11)]  # 1..10 ms
+    line = s.summary_line(2)
+    assert line.startswith("Step stats epoch 2: 10 steps")
+    assert "p50 5.50 ms" in line      # interpolated; nearest-index gave 6.00
+    assert "p95 9.55 ms" in line      # interpolated; nearest-index gave 10.00
+    assert "steps/s" in line and "mean" in line
+
+
+def test_serving_metrics_share_the_implementation():
+    from pytorch_mnist_ddp_tpu.obs.registry import percentile as shared
+    from pytorch_mnist_ddp_tpu.serving.metrics import percentile as serving_p
+
+    assert serving_p is shared
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+
+
+def test_counter_inc_and_value():
+    reg = Registry()
+    c = reg.counter("requests_total", help="h")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert reg.counter("requests_total") is c  # get-or-create
+    with pytest.raises(ValueError):
+        c.inc(-1)  # counters are monotonic
+
+
+def test_gauge_set_and_add():
+    reg = Registry()
+    g = reg.gauge("depth")
+    g.set(3)
+    g.add(-1)
+    assert g.value == 2
+
+
+def test_histogram_reservoir_and_lifetime_totals():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", reservoir=4)
+    for v in range(1, 11):
+        h.observe(float(v))
+    # Window keeps the newest 4; count/sum are lifetime.
+    assert sorted(h.values()) == [7.0, 8.0, 9.0, 10.0]
+    assert h.count == 10
+    assert h.sum == pytest.approx(55.0)
+    assert h.percentile(50) == pytest.approx(8.5)
+
+
+def test_labels_make_distinct_children():
+    reg = Registry()
+    a = reg.counter("compiles_total", fn="train_step")
+    b = reg.counter("compiles_total", fn="eval_step")
+    a.inc(2)
+    b.inc(1)
+    assert a is not b
+    assert reg.counter("compiles_total", fn="train_step").value == 2
+    (name, type_str, _help, children) = reg.collect()[0]
+    assert name == "compiles_total" and type_str == "counter"
+    assert [labels for labels, _ in children] == [
+        {"fn": "eval_step"}, {"fn": "train_step"},
+    ]
+
+
+def test_registry_rejects_type_and_label_conflicts():
+    reg = Registry()
+    reg.counter("x_total")
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")  # one name, one type
+    reg.counter("y_total", phase="a")
+    with pytest.raises(ValueError):
+        reg.counter("y_total", rank="0")  # one family, one label-key set
+    with pytest.raises(ValueError):
+        reg.counter("bad name")  # invalid exposition name
+
+
+def test_registry_is_thread_safe():
+    reg = Registry()
+    c = reg.counter("n_total")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            reg.histogram("h_seconds").observe(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    assert reg.histogram("h_seconds").count == 8000
+
+
+# ---------------------------------------------------------------------------
+# JSONL events
+
+
+def test_event_schema_round_trip(tmp_path):
+    sink = EventSink(str(tmp_path), run_id="r1", rank=0)
+    sink.emit("step", epoch=1, step=0, loss=2.3, latency_s=0.01)
+    sink.emit("eval", epoch=1, accuracy=0.99)
+    sink.close()
+    events = read_events(sink.path)
+    assert [e["event"] for e in events] == ["step", "eval"]
+    for e in events:
+        assert set(e) >= {"ts", "wall", "run_id", "rank", "event"}
+        assert e["run_id"] == "r1" and e["rank"] == 0
+    assert events[0]["loss"] == 2.3 and events[0]["latency_s"] == 0.01
+    # Monotonic timestamps: ordering on ts is emission ordering.
+    assert events[1]["ts"] >= events[0]["ts"]
+
+
+def test_read_events_skips_torn_tail_line(tmp_path):
+    path = tmp_path / "events.jsonl"
+    path.write_text('{"event": "a", "ts": 1}\n{"event": "b", "ts"')
+    events = read_events(str(path))
+    assert [e["event"] for e in events] == ["a"]
+
+
+def test_open_sink_rank_gating(tmp_path):
+    assert isinstance(open_sink(None), NullSink)
+    assert isinstance(
+        open_sink(str(tmp_path), rank=1, distributed=True), NullSink
+    )
+    chief = open_sink(str(tmp_path), rank=0, distributed=True)
+    assert isinstance(chief, EventSink) and chief  # truthy = really writes
+    chief.close()
+    every = open_sink(str(tmp_path), rank=3, distributed=True, chief_only=False)
+    assert isinstance(every, EventSink)
+    assert every.path.endswith("events-rank3.jsonl")
+    every.close()
+
+
+def test_total_time_quirk_and_wall_seconds_are_separate_surfaces(tmp_path):
+    """Satellite: stdout keeps the reference's byte-matched 'ms' label
+    quirk (the value is seconds); the telemetry event carries a
+    correctly-labeled wall_seconds field and no quirk."""
+    assert total_time_line(73.6) == "Total cost time:73.6 ms"
+    sink = EventSink(str(tmp_path), run_id="r", rank=0)
+    sink.emit("run_complete", wall_seconds=73.6)
+    sink.close()
+    [event] = read_events(sink.path)
+    assert event["wall_seconds"] == 73.6
+    assert "ms" not in json.dumps(event)
+
+
+# ---------------------------------------------------------------------------
+# Spans
+
+
+def test_span_nesting_and_duration(tmp_path):
+    reg = Registry()
+    sink = EventSink(str(tmp_path), run_id="r")
+    with span("outer", sink=sink, registry=reg, epoch=1):
+        with span("inner", sink=sink, registry=reg):
+            pass
+    sink.close()
+    events = read_events(sink.path)
+    assert [(e["event"], e["span"]) for e in events] == [
+        ("span_start", "outer"),
+        ("span_start", "inner"),
+        ("span_end", "inner"),
+        ("span_end", "outer"),
+    ]
+    inner_start, inner_end = events[1], events[2]
+    assert inner_start["parent"] == "outer" and inner_start["depth"] == 1
+    assert events[0]["parent"] is None and events[0]["depth"] == 0
+    assert inner_end["duration_s"] >= 0.0
+    assert events[0]["epoch"] == 1 and events[3]["epoch"] == 1
+    # Durations land in the registry histogram, per span name.
+    assert reg.histogram("span_duration_seconds", span="inner").count == 1
+    assert reg.histogram("span_duration_seconds", span="outer").count == 1
+
+
+def test_span_without_sink_or_registry_is_a_silent_timer():
+    with span("quiet"):
+        pass  # no crash, no output — library code can span unconditionally
+
+
+def test_span_pops_stack_on_exception(tmp_path):
+    sink = EventSink(str(tmp_path), run_id="r")
+    with pytest.raises(RuntimeError):
+        with span("failing", sink=sink):
+            raise RuntimeError("boom")
+    with span("after", sink=sink):
+        pass
+    sink.close()
+    events = read_events(sink.path)
+    # The failing span still emitted its end, and "after" is NOT nested
+    # under it (the thread-local stack was unwound).
+    assert [(e["event"], e["span"]) for e in events] == [
+        ("span_start", "failing"),
+        ("span_end", "failing"),
+        ("span_start", "after"),
+        ("span_end", "after"),
+    ]
+    assert events[2]["parent"] is None and events[2]["depth"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+(inf|nan)?$"
+)
+
+
+def test_prometheus_exposition_format():
+    reg = Registry()
+    reg.counter("serving_requests_total", help="requests", outcome="completed").inc(3)
+    reg.gauge("serving_queue_depth").set(2)
+    h = reg.histogram("latency_seconds", help="lat")
+    for v in (0.01, 0.02, 0.03):
+        h.observe(v)
+    text = render_prometheus(reg)
+    assert text.endswith("\n")
+    assert "# HELP serving_requests_total requests" in text
+    assert "# TYPE serving_requests_total counter" in text
+    assert 'serving_requests_total{outcome="completed"} 3' in text
+    assert "# TYPE serving_queue_depth gauge" in text
+    assert "serving_queue_depth 2" in text
+    # Reservoir histograms expose as summaries: quantiles + _sum/_count.
+    assert "# TYPE latency_seconds summary" in text
+    assert 'latency_seconds{quantile="0.5"} 0.02' in text
+    assert "latency_seconds_count 3" in text
+    assert "latency_seconds_sum" in text
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert _SAMPLE_RE.match(line), f"unparseable sample line: {line!r}"
+
+
+def test_prometheus_label_escaping():
+    reg = Registry()
+    reg.counter("odd_total", path='a"b\\c\nd').inc()
+    text = render_prometheus(reg)
+    assert 'odd_total{path="a\\"b\\\\c\\nd"} 1' in text
+
+
+def test_serving_metrics_render_on_shared_registry():
+    from pytorch_mnist_ddp_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics()
+    m.record_admitted(2)
+    m.record_batch(real=6, bucket=8)
+    m.record_completed(0.010)
+    m.snapshot(queue_depth=1)  # mirrors owner-passed values into gauges
+    text = render_prometheus(m.registry)
+    assert 'serving_requests_total{outcome="admitted"} 2' in text
+    assert 'serving_samples_total{kind="real"} 6' in text
+    assert 'serving_samples_total{kind="dispatched"} 8' in text
+    assert "serving_queue_depth 1" in text
+    assert "serving_request_latency_seconds_count 1" in text
+
+
+# ---------------------------------------------------------------------------
+# Sentinel → registry compile counter
+
+
+def test_sentinel_reports_compiles_into_registry():
+    reg = Registry()
+    guarded = RecompileSentinel(
+        jax.jit(lambda x: x + 1), max_traces=2, name="step", registry=reg
+    )
+    counter = reg.counter("jax_compiles_total", fn="step")
+    guarded(jnp.ones((2,)))
+    assert counter.value == 1
+    guarded(jnp.ones((2,)))  # cache hit: no new trace
+    assert counter.value == 1
+    guarded(jnp.ones((3,)))  # second legitimate shape
+    assert counter.value == 2
+    with pytest.raises(RecompileError):
+        guarded(jnp.ones((4,)))
+    # The over-budget trace is ON the counter — the scrape shows what
+    # actually compiled, not what was allowed.
+    assert counter.value == 3
+
+
+def test_sentinel_without_registry_unchanged():
+    guarded = RecompileSentinel(jax.jit(lambda x: x + 1), max_traces=1)
+    guarded(jnp.ones((2,)))
+    assert guarded.trace_count() == 1
+
+
+# ---------------------------------------------------------------------------
+# Trainer smoke: --telemetry-dir writes events + exposition, stdout is
+# byte-identical to the flagless run
+
+
+def _tiny_mnist(monkeypatch):
+    import pytorch_mnist_ddp_tpu.data.mnist as M
+
+    rng = np.random.RandomState(0)
+    train = (
+        rng.randint(0, 256, (64, 28, 28), np.uint8),
+        rng.randint(0, 10, 64).astype(np.uint8),
+    )
+    test = (
+        rng.randint(0, 256, (32, 28, 28), np.uint8),
+        rng.randint(0, 10, 32).astype(np.uint8),
+    )
+
+    def tiny(root="./data", split="train", *a, return_source=False, **kw):
+        arrays = train if split == "train" else test
+        return (*arrays, "idx") if return_source else arrays
+
+    monkeypatch.setattr(M, "load_mnist_arrays", tiny)
+
+
+def _fit_args(**overrides):
+    from argparse import Namespace
+
+    base = dict(
+        batch_size=16, test_batch_size=16, epochs=1, lr=1.0, gamma=0.7,
+        seed=1, log_interval=2, dry_run=True, save_model=False, fused=False,
+        data_root="./data", profile=None, step_stats=False,
+        telemetry_dir=None,
+    )
+    base.update(overrides)
+    return Namespace(**base)
+
+
+@pytest.mark.slow  # compile-heavy (two fit() runs); full tier + obs job
+def test_fit_telemetry_dir_smoke(tmp_path, monkeypatch, capsys):
+    from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
+    from pytorch_mnist_ddp_tpu.trainer import fit
+
+    _tiny_mnist(monkeypatch)
+    dist = DistState(devices=jax.devices()[:1])
+
+    fit(_fit_args(), dist)
+    default_out = capsys.readouterr().out
+
+    telemetry_dir = str(tmp_path / "telemetry")
+    fit(_fit_args(telemetry_dir=telemetry_dir), dist)
+    telemetry_out = capsys.readouterr().out
+
+    # The telemetry flag must not perturb the reference stdout surface.
+    assert telemetry_out == default_out
+
+    events = read_events(str(tmp_path / "telemetry" / "events-rank0.jsonl"))
+    names = [e["event"] for e in events]
+    assert names[0] == ("span_start")
+    steps = [e for e in events if e["event"] == "step"]
+    assert len(steps) == 1  # dry_run: one batch
+    assert {"epoch", "step", "loss", "latency_s", "samples"} <= set(steps[0])
+    assert steps[0]["latency_s"] > 0
+    spans_seen = {e["span"] for e in events if "span" in e}
+    assert {"run", "epoch", "evaluate"} <= spans_seen
+    [run_complete] = [e for e in events if e["event"] == "run_complete"]
+    assert run_complete["wall_seconds"] > 0
+    [evl] = [e for e in events if e["event"] == "eval"]
+    assert 0.0 <= evl["accuracy"] <= 1.0
+
+    prom = (tmp_path / "telemetry" / "metrics.prom").read_text()
+    assert re.search(r"^train_steps_total 1$", prom, re.M)
+    assert "train_step_latency_seconds_count 1" in prom
+    assert "test_accuracy" in prom
+
+    # The JSONL directory is summarizable (tools/perf_report.py).
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "perf_report.py"),
+         "--telemetry", telemetry_dir],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "steps: 1" in proc.stdout

@@ -94,12 +94,21 @@ def test_pad_to_bucket_rows():
 # Metrics
 
 
-def test_percentile_nearest_rank():
+def test_percentile_linear_interpolation():
+    """PR 3 migrated serving onto the repo-shared linear-interpolation
+    percentile (obs/registry.py) — previously this module ceil'd a
+    nearest rank while StepStats rounded an index, so "p95" was a
+    different statistic per subsystem.  test_obs.py pins the shared
+    implementation; this pins that serving really uses it."""
+    from pytorch_mnist_ddp_tpu.obs.registry import percentile as shared
+
+    assert percentile is shared
     vals = sorted(float(v) for v in range(1, 101))
-    assert percentile(vals, 50) == 50.0
-    assert percentile(vals, 95) == 95.0
-    assert percentile(vals, 99) == 99.0
+    assert percentile(vals, 50) == pytest.approx(50.5)
+    assert percentile(vals, 95) == pytest.approx(95.05)
+    assert percentile(vals, 99) == pytest.approx(99.01)
     assert percentile(vals, 100) == 100.0
+    assert percentile(vals, 0) == 1.0
     assert percentile([], 50) == 0.0
 
 
@@ -428,6 +437,21 @@ def test_server_end_to_end(devices):
         assert snap["compiles"] == 1
         assert snap["requests"]["completed"] == 1
         assert snap["queue_depth"] == 0
+
+        # Prometheus exposition from the SAME registry: Accept header or
+        # ?format=prom, sentinel compile counter included (PR 3).
+        req = urllib.request.Request(
+            f"{base}/metrics", headers={"Accept": "text/plain"}
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert "text/plain" in resp.headers.get("Content-Type", "")
+            prom = resp.read().decode()
+        assert 'jax_compiles_total{fn="predict_step"} 1' in prom
+        assert 'serving_requests_total{outcome="completed"} 1' in prom
+        assert "serving_queue_depth 0" in prom
+        assert "# TYPE serving_request_latency_seconds summary" in prom
+        with urllib.request.urlopen(f"{base}/metrics?format=prom", timeout=10) as resp:
+            assert "jax_compiles_total" in resp.read().decode()
 
         # Draining batcher -> 503 backpressure semantics on the wire.
         server.batcher.stop(drain=True)

@@ -12,6 +12,13 @@ interactive session died (the round-3 failure mode for evidence).
 
 Usage: python tools/perf_report.py [--no-write]
 Exit 0 with a block if at least the ladder artifact exists; 1 otherwise.
+
+PR 3: ``--telemetry DIR`` instead summarizes a ``--telemetry-dir``
+telemetry directory (the obs package's JSONL events, docs/OBSERVABILITY
+.md): step count/latency percentiles (the repo-shared linear
+interpolation), per-epoch throughput, eval accuracy, run wall time.
+Stdout-only — telemetry summaries are operator reads, not PERF.md
+verdicts.
 """
 
 from __future__ import annotations
@@ -212,10 +219,101 @@ def build_report() -> str | None:
     return "\n".join(lines)
 
 
+def summarize_telemetry(directory: str) -> str | None:
+    """Digest every ``*.jsonl`` event file in ``directory`` (obs/events
+    schema) into an operator summary, or None when nothing parses."""
+    import glob
+
+    sys.path.insert(0, REPO)  # tools/ runs from anywhere; obs is stdlib-only
+    from pytorch_mnist_ddp_tpu.obs.events import read_events
+    from pytorch_mnist_ddp_tpu.obs.registry import percentile
+
+    files = sorted(glob.glob(os.path.join(directory, "*.jsonl")))
+    events: list[dict] = []
+    for path in files:
+        events.extend(read_events(path))
+    if not events:
+        return None
+
+    lines = [
+        f"telemetry summary: {directory} "
+        f"({len(events)} events, {len(files)} file(s), "
+        f"{len({e.get('run_id') for e in events})} run(s))"
+    ]
+    steps = [e for e in events if e.get("event") == "step"]
+    if steps:
+        lats = sorted(e["latency_s"] for e in steps if "latency_s" in e)
+        if lats:
+            lines.append(
+                f"  steps: {len(steps)}, "
+                f"mean {1e3 * sum(lats) / len(lats):.2f} ms, "
+                f"p50 {1e3 * percentile(lats, 50):.2f} ms, "
+                f"p95 {1e3 * percentile(lats, 95):.2f} ms"
+            )
+        else:
+            lines.append(f"  steps: {len(steps)} (no latency fields)")
+        losses = [e["loss"] for e in steps if e.get("loss") is not None]
+        if losses:
+            lines.append(
+                f"  loss: first {losses[0]:.6f}, last {losses[-1]:.6f}"
+            )
+    epochs = [e for e in events if e.get("event") == "epoch_train_end"]
+    if epochs:
+        last = epochs[-1]
+        lines.append(
+            f"  epochs: {len(epochs)}, last "
+            f"{last.get('samples_per_s', 0.0):.1f} samples/s "
+            f"({last.get('samples', 0)} samples in "
+            f"{last.get('duration_s', 0.0):.2f} s)"
+        )
+    evals = [e for e in events if e.get("event") == "eval"]
+    if evals:
+        lines.append(
+            f"  eval: {len(evals)} pass(es), final accuracy "
+            f"{evals[-1].get('accuracy', 0.0):.4f} "
+            f"(avg loss {evals[-1].get('avg_loss', 0.0):.4f})"
+        )
+    span_ends = [e for e in events if e.get("event") == "span_end"]
+    if span_ends:
+        by_span: dict[str, list[float]] = {}
+        for e in span_ends:
+            by_span.setdefault(e.get("span", "?"), []).append(
+                e.get("duration_s", 0.0)
+            )
+        rendered = ", ".join(
+            f"{name} x{len(ds)} ({sum(ds):.2f} s)"
+            for name, ds in sorted(by_span.items())
+        )
+        lines.append(f"  spans: {rendered}")
+    runs = [e for e in events if e.get("event") == "run_complete"]
+    if runs:
+        # Correctly-labeled seconds — the telemetry surface does NOT
+        # inherit the stdout line's "ms" label quirk (utils/logging.py).
+        lines.append(
+            f"  run wall: {runs[-1].get('wall_seconds', 0.0):.2f} s"
+        )
+    return "\n".join(lines)
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--no-write", action="store_true")
+    p.add_argument(
+        "--telemetry", metavar="DIR", default=None,
+        help="summarize a --telemetry-dir JSONL directory instead of the "
+        "bench artifacts (stdout only, never writes PERF.md)",
+    )
     args = p.parse_args()
+    if args.telemetry:
+        summary = summarize_telemetry(args.telemetry)
+        if summary is None:
+            print(
+                f"perf_report: no parseable *.jsonl events in "
+                f"{args.telemetry}", file=sys.stderr,
+            )
+            return 1
+        print(summary)
+        return 0
     report = build_report()
     if report is None:
         print("perf_report: no ladder artifact (bench_r*_stepattr.json) "
