@@ -9,7 +9,7 @@ equivalent guardrail, run as part of the test suite and CI:
 
 - :mod:`.engine` — AST rule engine: file walker, per-rule visitors,
   structured findings, inline ``# jaxlint: disable=RULE`` suppressions.
-- :mod:`.rules` — the JL001–JL008 rule set (see docs/ANALYSIS.md).
+- :mod:`.rules` — the JL001–JL009 rule set (see docs/ANALYSIS.md).
 - :mod:`.sentinel` — :class:`RecompileSentinel`, a runtime wrapper that
   fails tests when a jitted function retraces more than expected.
 

@@ -24,7 +24,7 @@ def _default_target() -> str:
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="jaxlint",
-        description="AST-based JAX correctness analyzer (rules JL001-JL007; "
+        description="AST-based JAX correctness analyzer (rules JL001-JL009; "
         "see docs/ANALYSIS.md)",
     )
     parser.add_argument(

@@ -1,7 +1,8 @@
-"""The jaxlint rule set: JL001–JL008, the JAX hazards this repo has
+"""The jaxlint rule set: JL001–JL009, the JAX hazards this repo has
 actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, the
-serving layer's per-request-shape retrace class, and the telemetry
-layer's record-at-trace-time class).
+serving layer's per-request-shape retrace class, the telemetry layer's
+record-at-trace-time class, and the serving pipeline's
+blocking-read-in-dispatch-loop class).
 
 Every rule is a heuristic over one module's AST — no type inference, no
 cross-file call graph.  "Traced context" below means: a function that is
@@ -982,6 +983,155 @@ class BucketShapeRule(Rule):
                         break
 
 
+# ---------------------------------------------------------------------------
+# JL009 — blocking host reads of jit outputs inside dispatch loops
+
+
+_BLOCKING_READ_CALLS = _NP_HOST_CALLS | {"jax.device_get", "device_get"}
+
+
+class BlockingReadLoopRule(Rule):
+    """JL009: ``np.asarray`` / ``jax.device_get`` / ``.block_until_ready``
+    on a jitted function's output inside the loop that dispatched it.
+
+    The serving-pipeline hazard class (docs/SERVING.md): a dispatch loop
+    that launches the jitted forward and immediately reads the result
+    back serializes the whole chain — device compute, host padding, H2D
+    and D2H never overlap, because jax's async dispatch is thrown away
+    one call later by the blocking read.  The fix is to decouple
+    completion from dispatch: launch inside the loop, hand the device
+    array to a completion worker (or read once after the loop) so batch
+    N+1's host work overlaps batch N's compute — the pipelined batcher's
+    whole design.  A deliberate same-iteration read (a serial path, a
+    benchmark timing one dispatch) is waived inline with a reason.
+
+    Heuristics (per scope, same resolution style as JL007): a callable is
+    "jitted" when bound from ``jax.jit``/``pjit``/``pmap`` — directly,
+    through ``RecompileSentinel(...)``, or onto a ``self.attr`` (the
+    engine shape); an expression is a "jit output" when it calls such a
+    name, or names a variable assigned from one *inside the same loop
+    body* (a handle produced before the loop is prefetched, not
+    pipelined-away — reading it per iteration is not this hazard).
+    """
+
+    rule_id = "JL009"
+    severity = Severity.WARNING
+    summary = "blocking host read of a jit output inside its dispatch loop"
+
+    @staticmethod
+    def _jit_attr_names(tree: ast.Module) -> set[str]:
+        """Attribute names bound to jitted callables anywhere in the
+        module (``self._predict = RecompileSentinel(jax.jit(...))``)."""
+        out: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and BucketShapeRule._is_jit_value(
+                node.value
+            ):
+                for target in node.targets:
+                    if isinstance(target, ast.Attribute):
+                        out.add(target.attr)
+        return out
+
+    @staticmethod
+    def _is_jit_call(node: ast.AST, jit_names: set[str], jit_attrs: set[str]) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        if isinstance(node.func, ast.Name) and node.func.id in jit_names:
+            return True
+        return isinstance(node.func, ast.Attribute) and node.func.attr in jit_attrs
+
+    @classmethod
+    def _jit_output_taint(
+        cls, node: ast.AST, jit_names, jit_attrs, out_names
+    ) -> bool:
+        """Does ``node`` lexically contain a jit call or a loop-local
+        name bound from one?"""
+        if cls._is_jit_call(node, jit_names, jit_attrs):
+            return True
+        if isinstance(node, ast.Name) and node.id in out_names:
+            return True
+        return any(
+            cls._jit_output_taint(child, jit_names, jit_attrs, out_names)
+            for child in ast.iter_child_nodes(node)
+        )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        module_jit: set[str] = set()
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and BucketShapeRule._is_jit_value(node.value)):
+                module_jit.add(node.targets[0].id)
+        jit_attrs = self._jit_attr_names(ctx.tree)
+
+        scopes: list[ast.AST] = [ctx.tree] + [
+            d for d in ast.walk(ctx.tree)
+            if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            if isinstance(scope, ast.Module):
+                nodes: list[ast.AST] = []
+                stack = list(scope.body)
+                while stack:
+                    node = stack.pop()
+                    nodes.append(node)
+                    if not isinstance(node, _SCOPE_NODES):
+                        stack.extend(ast.iter_child_nodes(node))
+            else:
+                nodes = list(iter_own_body(scope))
+            jit_names = set(module_jit)
+            for node in nodes:
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and BucketShapeRule._is_jit_value(node.value)):
+                    jit_names.add(node.targets[0].id)
+            for node in nodes:
+                if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                    yield from self._check_loop(ctx, node, jit_names, jit_attrs)
+
+    def _check_loop(self, ctx, loop, jit_names, jit_attrs) -> Iterator[Finding]:
+        body = list(iter_loop_body_nodes(loop))
+        # Names bound from a jit call WITHIN this loop body: reading one
+        # of these in the same loop is the dispatch-then-stall shape.
+        out_names: set[str] = set()
+        for node in body:
+            if isinstance(node, ast.Assign):
+                if self._is_jit_call(node.value, jit_names, jit_attrs):
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            out_names.add(target.id)
+        for node in body:
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in _BLOCKING_READ_CALLS:
+                if any(
+                    self._jit_output_taint(a, jit_names, jit_attrs, out_names)
+                    for a in node.args
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        f"{name}(...) on a jit output inside its dispatch "
+                        "loop blocks the loop on device compute + D2H every "
+                        "iteration — async dispatch is wasted; hand the "
+                        "device array to a completion worker or read after "
+                        "the loop (serving/batcher.py)",
+                    )
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"
+                    and self._jit_output_taint(
+                        node.func.value, jit_names, jit_attrs, out_names)):
+                yield self.finding(
+                    ctx, node,
+                    ".block_until_ready() on a jit output inside its "
+                    "dispatch loop serializes the pipeline every iteration; "
+                    "bound in-flight work with a window and complete "
+                    "asynchronously instead (serving/batcher.py)",
+                )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -991,6 +1141,7 @@ ALL_RULES: tuple[Rule, ...] = (
     DeviceGetLoopRule(),
     BucketShapeRule(),
     TelemetryUnderTraceRule(),
+    BlockingReadLoopRule(),
 )
 
 

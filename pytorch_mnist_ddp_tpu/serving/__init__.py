@@ -17,7 +17,12 @@ ROADMAP's "heavy traffic" north star:
 - :mod:`.batcher` — :class:`MicroBatcher`: coalesces queued requests up
   to a max batch or a linger deadline, with a bounded admission queue,
   per-request deadlines, reject-don't-queue backpressure, and graceful
-  drain.
+  drain.  Pipelined (PR 4): a dispatch worker pads into preallocated
+  staging buffers and launches async; a completion worker does the
+  blocking D2H read — a bounded in-flight window (``max_inflight``)
+  overlaps batch N+1's host work with batch N's device compute, and an
+  :class:`AdaptiveLinger` controller shrinks the linger toward 0 when
+  the queue is deep.
 - :mod:`.metrics` — queue depth, batch occupancy, padding waste,
   latency percentiles, throughput (string-returning report helpers,
   utils/logging.py convention), rebuilt on the shared telemetry
@@ -31,17 +36,25 @@ ROADMAP's "heavy traffic" north star:
 Load-test with ``tools/serve_loadgen.py``; see docs/SERVING.md.
 """
 
-from .batcher import MicroBatcher, RejectedError, RequestTimeout
-from .buckets import bucket_for, pad_to_bucket, pow2_buckets, validate_buckets
+from .batcher import AdaptiveLinger, MicroBatcher, RejectedError, RequestTimeout
+from .buckets import (
+    StagingPool,
+    bucket_for,
+    pad_to_bucket,
+    pow2_buckets,
+    validate_buckets,
+)
 from .engine import InferenceEngine
 from .metrics import ServingMetrics
 
 __all__ = [
+    "AdaptiveLinger",
     "InferenceEngine",
     "MicroBatcher",
     "RejectedError",
     "RequestTimeout",
     "ServingMetrics",
+    "StagingPool",
     "bucket_for",
     "pad_to_bucket",
     "pow2_buckets",

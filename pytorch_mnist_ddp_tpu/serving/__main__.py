@@ -57,7 +57,26 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--linger-ms", type=float, default=2.0,
-        help="max time the batcher waits to coalesce a non-full batch",
+        help="max time the batcher waits to coalesce a non-full batch "
+        "(the adaptive controller's ceiling)",
+    )
+    parser.add_argument(
+        "--max-inflight", type=int, default=2,
+        help="bound on batches launched but not yet read back; 2 overlaps "
+        "batch N+1's host work with batch N's device compute, 1 restores "
+        "the serial PR-3 pipeline",
+    )
+    parser.add_argument(
+        "--no-adaptive-linger", action="store_true",
+        help="pin the linger at --linger-ms instead of shrinking it toward "
+        "0 while the admission queue is deep",
+    )
+    parser.add_argument(
+        "--telemetry-dir", default=None,
+        help="write serving JSONL telemetry (serving_request/serving_batch "
+        "events, pad/dispatch/complete spans) into this directory "
+        "(docs/OBSERVABILITY.md; summarize with tools/perf_report.py "
+        "--telemetry)",
     )
     parser.add_argument(
         "--queue-depth", type=int, default=64,
@@ -150,6 +169,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.warmup_only:
         return 0
 
+    from ..obs.events import open_sink
+
+    sink = open_sink(args.telemetry_dir)
+    if sink:
+        print(f"serving telemetry: {sink.path}")
     server = make_server(
         engine,
         metrics,
@@ -158,9 +182,16 @@ def main(argv: list[str] | None = None) -> int:
         linger_ms=args.linger_ms,
         queue_depth=args.queue_depth,
         timeout_ms=args.timeout_ms,
+        max_inflight=args.max_inflight,
+        adaptive_linger=not args.no_adaptive_linger,
+        sink=sink,
     )
     host, port = server.server_address[:2]
-    print(f"serving on http://{host}:{port} (POST /predict, GET /metrics)")
+    print(
+        f"serving on http://{host}:{port} (POST /predict, GET /metrics; "
+        f"in-flight window {args.max_inflight}, adaptive linger "
+        f"{'off' if args.no_adaptive_linger else 'on'})"
+    )
 
     def _shutdown(signum, frame):
         # serve_forever must be unblocked from another thread; the drain
@@ -175,13 +206,17 @@ def main(argv: list[str] | None = None) -> int:
         # Graceful drain: stop accepting, finish everything admitted,
         # then report.  (Handler threads for in-flight requests are
         # daemons; their waiters complete during the drain.)
-        print("draining admitted requests...")
+        print("draining admitted requests and the in-flight window...")
         server.batcher.stop(drain=True)
         server.server_close()
+        sink.close()
         print(metrics.report_lines(
             queue_depth=server.batcher.depth(),
             compiles=engine.compile_count(),
             buckets=engine.buckets,
+            inflight=server.batcher.inflight(),
+            max_inflight=server.batcher.max_inflight,
+            linger_ms=server.batcher.current_linger_ms,
         ))
     return 0
 

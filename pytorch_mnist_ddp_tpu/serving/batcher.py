@@ -1,26 +1,48 @@
-"""Dynamic micro-batching with bounded admission and backpressure.
+"""Pipelined micro-batching: bounded admission, overlap, backpressure.
 
 The serving trade: one 64-sample dispatch costs barely more device time
 than a 1-sample dispatch (the forward is launch-bound at these shapes),
 so coalescing concurrent requests multiplies throughput — but waiting to
 coalesce adds latency.  The batcher resolves it the standard way: take
 the first queued request, then keep pulling until the batch would exceed
-the top bucket or a **linger deadline** (a few ms) passes, whichever
-comes first.  Under load, batches fill before the linger expires and
-occupancy approaches 100%; when idle, a lone request pays at most the
-linger.
+the top bucket or a **linger deadline** passes, whichever comes first.
 
-Admission is a **bounded** queue: a full queue rejects immediately
-(:class:`RejectedError`, the HTTP 503) instead of queueing unboundedly —
-queued-forever requests time out anyway and waste the device work, so
-shedding at admission is strictly better (the backpressure contract,
-docs/SERVING.md).  Each request also carries a deadline; requests that
-expire while queued are completed with :class:`RequestTimeout` (504)
-without being dispatched.
+PR 4 splits the formerly serial submit→pad→H2D→compute→D2H→complete
+chain into a two-thread pipeline (the Orca/Clipper lesson: throughput
+lives in keeping a bounded window of batches in flight, not in a faster
+serial loop):
+
+- the **dispatch worker** coalesces, pads into preallocated per-bucket
+  staging buffers (:class:`~.buckets.StagingPool` — zero allocation at
+  steady state), and launches the jitted forward WITHOUT reading the
+  result back — jax's async dispatch returns immediately;
+- the **completion worker** performs the blocking D2H read, slices
+  per-request results to their waiters, and recycles the staging buffer.
+
+A semaphore bounds the launched-not-yet-read window (``max_inflight``,
+default 2): batch N+1's host work (coalesce + pad + H2D) overlaps batch
+N's device compute, but device memory for in-flight batches stays
+bounded.  Time the dispatch thread spends blocked on a full window is
+recorded as **pipeline stall** — the signal that the device, not the
+host, is the bottleneck.
+
+The **adaptive linger controller** closes the remaining latency knob:
+when the admission queue is deep, waiting to coalesce is pure added
+latency (the next batch fills instantly anyway), so the linger shrinks
+toward 0; when traffic goes idle it relaxes back toward the configured
+ceiling so lone requests still get coalescing's benefit.  Disable it
+(``adaptive_linger=False``) for the fixed-linger PR 3 behavior.
+
+Admission is unchanged: a bounded queue that rejects immediately when
+full (:class:`RejectedError`, the HTTP 503) — the backpressure contract,
+docs/SERVING.md.  Requests that expire while queued are completed with
+:class:`RequestTimeout` (504) without being dispatched.
 
 Shutdown is a graceful drain: ``stop()`` closes admission (new submits
-get 503) and, by default, lets the worker finish everything already
-admitted before joining.
+get 503) and, by default, lets the dispatch worker finish everything
+already admitted AND the completion worker read back everything already
+launched before joining — nothing in the queue or the in-flight window
+is lost.
 """
 
 from __future__ import annotations
@@ -31,6 +53,8 @@ import time
 
 import numpy as np
 
+from ..obs.spans import span
+from .buckets import StagingPool
 from .engine import InferenceEngine
 from .metrics import ServingMetrics
 
@@ -91,11 +115,101 @@ class PendingRequest:
         return self._value
 
 
-class MicroBatcher:
-    """Coalesce admitted requests into bucket-padded engine dispatches.
+class AdaptiveLinger:
+    """Queue-depth-driven linger: shrink under load, relax when idle.
 
-    Exactly one worker thread touches the engine (jax dispatch is not
-    re-entrant here); HTTP handler threads only ``submit()`` and wait.
+    The linger only buys throughput while the queue is SHALLOW — it is
+    the time spent hoping more requests arrive.  A deep queue already
+    holds the next batch, so every lingered millisecond there is pure
+    added latency.  The controller halves the linger whenever the
+    admission queue is at least ``deep_depth`` requests deep (snapping to
+    0 below ``floor_s`` — half-lives below a tenth of a millisecond are
+    indistinguishable from none) and relaxes it additively back toward
+    the configured ceiling on an empty queue; in-between depths hold.
+    Multiplicative decrease / additive increase reacts in O(log) batches
+    to a burst and recovers smoothly, and both moves keep the value
+    inside ``[0, ceiling_s]`` by construction (the bound the property
+    test pins).
+
+    State is published to the obs registry as the
+    ``serving_linger_seconds`` gauge, so /metrics shows what the
+    controller is currently doing.
+    """
+
+    def __init__(
+        self,
+        ceiling_s: float,
+        enabled: bool = True,
+        registry=None,
+        deep_depth: int = 4,
+        shrink: float = 0.5,
+        relax_frac: float = 0.25,
+        floor_s: float = 1e-4,
+    ):
+        if not 0.0 < shrink < 1.0:
+            raise ValueError(f"shrink factor must be in (0, 1), got {shrink}")
+        if not 0.0 < relax_frac <= 1.0:
+            raise ValueError(f"relax_frac must be in (0, 1], got {relax_frac}")
+        self.ceiling_s = max(0.0, ceiling_s)
+        self.enabled = enabled
+        self.deep_depth = max(1, deep_depth)
+        self.shrink = shrink
+        self.relax_frac = relax_frac
+        self.floor_s = floor_s
+        self.current_s = self.ceiling_s
+        self._gauge = (
+            registry.gauge(
+                "serving_linger_seconds",
+                help="current adaptive linger (shrinks under queue depth, "
+                "relaxes toward the configured ceiling when idle)",
+            )
+            if registry is not None
+            else None
+        )
+        if self._gauge is not None:
+            self._gauge.set(self.current_s)
+
+    def update(self, queue_depth: int) -> float:
+        """Observe the admission depth; return the linger to use now."""
+        if not self.enabled:
+            return self.ceiling_s
+        if queue_depth >= self.deep_depth:
+            self.current_s *= self.shrink
+            if self.current_s < self.floor_s:
+                self.current_s = 0.0
+        elif queue_depth == 0:
+            self.current_s = min(
+                self.ceiling_s,
+                self.current_s + self.relax_frac * self.ceiling_s,
+            )
+        if self._gauge is not None:
+            self._gauge.set(self.current_s)
+        return self.current_s
+
+
+class _InFlight:
+    """One launched batch riding the dispatch→completion queue."""
+
+    __slots__ = ("batch", "logits", "staged", "bucket", "n", "stall_s")
+
+    def __init__(self, batch, logits, staged, bucket, n, stall_s):
+        self.batch = batch
+        self.logits = logits
+        self.staged = staged
+        self.bucket = bucket
+        self.n = n
+        self.stall_s = stall_s
+
+
+class MicroBatcher:
+    """Coalesce admitted requests into a pipelined engine dispatch chain.
+
+    Exactly one dispatch worker touches ``engine.launch`` (jax dispatch
+    is not re-entrant here) and exactly one completion worker reads
+    results back; HTTP handler threads only ``submit()`` and wait.  The
+    engine contract is ``engine.buckets`` plus ``engine.launch(staged,
+    n)`` returning an object ``np.asarray`` resolves to ``[bucket,
+    classes]`` logits (tests substitute a fake).
     """
 
     def __init__(
@@ -106,16 +220,38 @@ class MicroBatcher:
         linger_ms: float = 2.0,
         queue_depth: int = 64,
         timeout_ms: float = 1000.0,
+        max_inflight: int = 2,
+        adaptive_linger: bool = True,
+        sink=None,
     ):
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
         top = engine.buckets[-1]
         self.engine = engine
         self.metrics = metrics if metrics is not None else engine.metrics
         self.max_batch = min(max_batch or top, top)
         self.linger_s = linger_ms / 1e3
         self.timeout_s = timeout_ms / 1e3
+        self.max_inflight = max_inflight
+        self._registry = self.metrics.registry if self.metrics is not None else None
+        self._sink = sink
+        self._linger = AdaptiveLinger(
+            self.linger_s, enabled=adaptive_linger, registry=self._registry
+        )
         self._queue: queue.Queue[PendingRequest] = queue.Queue(maxsize=queue_depth)
+        # Launched-but-unread batches; the semaphore IS the window bound,
+        # the queue just carries them to the completion worker in order.
+        self._window = threading.Semaphore(max_inflight)
+        self._completions: queue.Queue[_InFlight | None] = queue.Queue()
+        # One spare staging slot beyond the window so batch N+1 pads
+        # while the window is still full with batches N-k..N.
+        self._staging: StagingPool | None = None
+        self._inflight_lock = threading.Lock()
+        self._inflight = 0
+        self.peak_inflight = 0
         self._closed = threading.Event()
         self._worker: threading.Thread | None = None
+        self._completer: threading.Thread | None = None
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -123,16 +259,22 @@ class MicroBatcher:
         if self._worker is not None:
             raise RuntimeError("batcher already started")
         self._worker = threading.Thread(
-            target=self._run, name="micro-batcher", daemon=True
+            target=self._run, name="serve-dispatch", daemon=True
         )
+        self._completer = threading.Thread(
+            target=self._complete_loop, name="serve-complete", daemon=True
+        )
+        self._completer.start()
         self._worker.start()
         return self
 
     def stop(self, drain: bool = True) -> None:
-        """Close admission; by default let the worker finish the queue.
+        """Close admission; by default finish the queue AND the window.
 
         ``drain=False`` abandons queued requests — each is completed with
         :class:`RejectedError` so no handler thread is left hanging.
+        Batches already launched on the device are always read back and
+        completed (abandoning them would waste finished device work).
         """
         self._closed.set()
         if not drain:
@@ -140,6 +282,13 @@ class MicroBatcher:
         if self._worker is not None:
             self._worker.join()
             self._worker = None
+        # The dispatch worker has exited, so every launched batch is
+        # already enqueued; the sentinel lands strictly after them and
+        # the join below proves the in-flight window fully drained.
+        if self._completer is not None:
+            self._completions.put(None)
+            self._completer.join()
+            self._completer = None
         # A submit() racing stop() can land a request AFTER the worker saw
         # the empty queue and exited; without this flush that request would
         # sit unserviced until its client's deadline expired (504 during a
@@ -159,6 +308,18 @@ class MicroBatcher:
     def depth(self) -> int:
         """Current admission-queue depth (the /metrics gauge)."""
         return self._queue.qsize()
+
+    def inflight(self) -> int:
+        """Batches launched but not yet read back (the /metrics gauge)."""
+        with self._inflight_lock:
+            return self._inflight
+
+    @property
+    def current_linger_ms(self) -> float:
+        """What the adaptive controller is currently waiting (ms)."""
+        return 1e3 * (
+            self._linger.current_s if self._linger.enabled else self.linger_s
+        )
 
     # -- admission (any thread) ----------------------------------------------
 
@@ -195,7 +356,7 @@ class MicroBatcher:
             self.metrics.record_admitted()
         return req
 
-    # -- worker ----------------------------------------------------------------
+    # -- dispatch worker ------------------------------------------------------
 
     def _expire(self, req: PendingRequest) -> None:
         req.set_error(RequestTimeout("expired in queue before dispatch"))
@@ -213,6 +374,9 @@ class MicroBatcher:
                 except queue.Empty:
                     if self._closed.is_set():
                         return
+                    # Idle tick: let the controller relax back toward the
+                    # ceiling even when no batch is forming.
+                    self._linger.update(0)
                     continue
             if first.expired():
                 self._expire(first)
@@ -221,10 +385,15 @@ class MicroBatcher:
             total = first.n
             # Linger: coalesce until the batch is full or the deadline
             # passes.  A draining batcher skips the linger — nothing new
-            # is being admitted, so waiting only delays shutdown.
-            deadline = time.perf_counter() + (
-                0.0 if self._closed.is_set() else self.linger_s
+            # is being admitted, so waiting only delays shutdown.  The
+            # adaptive controller sets the deadline from the CURRENT
+            # queue depth: deep queue -> the next batch is already here,
+            # lingering is pure latency.
+            linger = (
+                0.0 if self._closed.is_set()
+                else self._linger.update(self._queue.qsize())
             )
+            deadline = time.perf_counter() + linger
             while total < self.max_batch:
                 remaining = deadline - time.perf_counter()
                 try:
@@ -246,23 +415,103 @@ class MicroBatcher:
             self._dispatch(batch)
 
     def _dispatch(self, batch: list[PendingRequest]) -> None:
-        xs = (
-            batch[0].x
-            if len(batch) == 1
-            else np.concatenate([r.x for r in batch])
-        )
+        """Pad into staging, launch async, hand off to completion.
+
+        Runs entirely on the dispatch worker; never blocks on device
+        compute — only (briefly) on a full in-flight window, which is
+        recorded as pipeline stall.
+        """
+        parts = [r.x for r in batch]
+        total = sum(len(p) for p in parts)
+        if self._staging is None:
+            # Sized lazily from the first request's row shape so fakes
+            # with arbitrary item shapes work; window+1 slots so padding
+            # the next batch overlaps a full in-flight window.
+            self._staging = StagingPool(
+                self.engine.buckets,
+                parts[0].shape[1:],
+                slots=self.max_inflight + 1,
+                dtype=np.float32,
+            )
+        with span("serving_pad", sink=self._sink, registry=self._registry):
+            staged, bucket = self._staging.stage(parts)
+        if self._window.acquire(blocking=False):
+            stall_s = 0.0  # free slot: the common, fully overlapped case
+        else:
+            t0 = time.perf_counter()
+            self._window.acquire()
+            stall_s = time.perf_counter() - t0
+            if self.metrics is not None:
+                self.metrics.record_stall(stall_s)
         try:
-            logits = self.engine.predict_logits(xs)
-        except BaseException as e:  # complete every waiter, then keep serving
+            with span("serving_dispatch", sink=self._sink,
+                      registry=self._registry):
+                logits = self.engine.launch(staged, total)
+        except BaseException as e:  # complete every waiter, keep serving
+            self._staging.release(staged, bucket)
+            self._window.release()
             for req in batch:
                 req.set_error(e)
             if self.metrics is not None:
                 self.metrics.record_failed(len(batch))
             return
-        offset = 0
-        done = time.perf_counter()
-        for req in batch:
-            req.set_result(logits[offset : offset + req.n])
-            offset += req.n
+        with self._inflight_lock:
+            self._inflight += 1
+            self.peak_inflight = max(self.peak_inflight, self._inflight)
+            # Gauge set under the SAME lock as the counter: a set outside
+            # it can lose the increment/decrement race and leave a stale
+            # depth on /metrics?format=prom (which never recomputes).
             if self.metrics is not None:
-                self.metrics.record_completed(done - req.t_submit)
+                self.metrics.set_inflight(self._inflight)
+        self._completions.put(
+            _InFlight(batch, logits, staged, bucket, total, stall_s)
+        )
+
+    # -- completion worker ----------------------------------------------------
+
+    def _complete_loop(self) -> None:
+        """Read launched batches back and complete their waiters.
+
+        The ONLY place the pipeline blocks on device results — moving
+        this read off the dispatch thread is the whole optimization:
+        while np.asarray waits on batch N's compute + D2H, the dispatch
+        worker is already coalescing and padding batch N+1.
+        """
+        while True:
+            item = self._completions.get()
+            if item is None:
+                return
+            try:
+                with span("serving_complete", sink=self._sink,
+                          registry=self._registry):
+                    host = np.asarray(item.logits)  # jaxlint: disable=JL009 -- the completion worker IS the sanctioned D2H point; this read overlaps the dispatch thread's next batch
+            except BaseException as e:
+                for req in item.batch:
+                    req.set_error(e)
+                if self.metrics is not None:
+                    self.metrics.record_failed(len(item.batch))
+            else:
+                done = time.perf_counter()
+                offset = 0
+                for req in item.batch:
+                    req.set_result(host[offset : offset + req.n])
+                    offset += req.n
+                    if self.metrics is not None:
+                        self.metrics.record_completed(done - req.t_submit)
+                    if self._sink:
+                        self._sink.emit(
+                            "serving_request", n=req.n,
+                            latency_s=done - req.t_submit,
+                        )
+            finally:
+                self._staging.release(item.staged, item.bucket)
+                with self._inflight_lock:
+                    self._inflight -= 1
+                    if self.metrics is not None:
+                        self.metrics.set_inflight(self._inflight)
+                self._window.release()
+            if self._sink:
+                self._sink.emit(
+                    "serving_batch", real=item.n, bucket=item.bucket,
+                    fill_ratio=item.n / item.bucket, stall_s=item.stall_s,
+                )

@@ -18,6 +18,7 @@ without device init.
 
 from __future__ import annotations
 
+import threading
 from typing import Sequence
 
 import numpy as np
@@ -105,3 +106,74 @@ def pad_to_bucket(x: np.ndarray, bucket: int) -> np.ndarray:
         return x
     pad = np.zeros((bucket - n, *x.shape[1:]), x.dtype)
     return np.concatenate([x, pad])
+
+
+class StagingPool:
+    """Preallocated per-bucket pad targets, recycled through a free list.
+
+    :func:`pad_to_bucket` allocates a fresh padded array per dispatch —
+    fine for a script, garbage-per-request on the serving hot path.  The
+    pool allocates every buffer once up front (``slots`` per bucket) and
+    steady-state staging is then pure ``memcpy``: copy the live rows in,
+    zero the tail, dispatch, :meth:`release` when the device has consumed
+    the batch.  ``slots`` must cover the maximum number of batches
+    simultaneously staged-or-in-flight (the batcher sizes it to its
+    in-flight window + 1 so padding batch N+1 overlaps batch N's
+    compute); :meth:`acquire` blocks if a caller overruns that bound
+    rather than silently allocating.
+
+    A buffer is only safe to release once its dispatch's RESULT has been
+    read back (D2H completing proves the compute consumed the input) —
+    releasing right after the launch would let the next batch overwrite
+    rows a backend that aliases host memory may still be reading.
+    """
+
+    def __init__(
+        self,
+        buckets: Sequence[int],
+        item_shape: Sequence[int],
+        slots: int = 1,
+        dtype=np.float32,
+    ):
+        if slots < 1:
+            raise ValueError(f"need >= 1 staging slot per bucket, got {slots}")
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.slots = slots
+        self._cond = threading.Condition()
+        self._free: dict[int, list[np.ndarray]] = {
+            b: [np.zeros((b, *item_shape), dtype) for _ in range(slots)]
+            for b in self.buckets
+        }
+
+    def acquire(self, bucket: int) -> np.ndarray:
+        """A free ``[bucket, *item_shape]`` buffer (blocks until one is
+        released; the batcher's in-flight bound makes the wait momentary)."""
+        with self._cond:
+            free = self._free[bucket]  # KeyError = unknown bucket, loudly
+            while not free:
+                self._cond.wait()
+            return free.pop()
+
+    def release(self, buf: np.ndarray, bucket: int) -> None:
+        with self._cond:
+            self._free[bucket].append(buf)
+            self._cond.notify()
+
+    def stage(self, parts: Sequence[np.ndarray]) -> tuple[np.ndarray, int]:
+        """Copy ``parts`` row-blocks into one bucket-shaped buffer.
+
+        Returns ``(buffer, bucket)`` with the live rows at the front and
+        a zeroed tail — exactly :func:`pad_to_bucket` of the concatenated
+        parts, without the per-call concatenate + pad allocations.  The
+        caller owns the buffer until :meth:`release`.
+        """
+        total = sum(len(p) for p in parts)
+        bucket = bucket_for(total, self.buckets)
+        buf = self.acquire(bucket)
+        offset = 0
+        for p in parts:
+            buf[offset : offset + len(p)] = p
+            offset += len(p)
+        if offset < bucket:
+            buf[offset:] = 0.0
+        return buf, bucket

@@ -10,9 +10,13 @@ prevent — raises ``RecompileError`` with a pointed message instead of
 serving at 1000x latency.
 
 Threading contract: jax dispatch is not guarded here; exactly one thread
-(the micro-batcher worker, or the caller in direct use) may call
-``predict_logits``.  The HTTP handler threads never touch the engine —
-they talk to the batcher's queue.
+(the micro-batcher dispatch worker, or the caller in direct use) may
+call ``launch``/``predict_logits``.  Reading a previously launched
+batch's result (``np.asarray`` on the returned device array) is safe
+from a second thread — that is the batcher's completion worker, which
+overlaps D2H + unsplitting with the next batch's pad + dispatch.  The
+HTTP handler threads never touch the engine — they talk to the
+batcher's queue.
 """
 
 from __future__ import annotations
@@ -26,7 +30,7 @@ from ..analysis.sentinel import RecompileError, RecompileSentinel
 from ..models.net import INPUT_SHAPE, NUM_CLASSES, init_params, init_variables
 from ..parallel.ddp import make_predict_step, replicate_params
 from ..parallel.mesh import DATA_AXIS, make_mesh
-from .buckets import bucket_for, pad_to_bucket, pow2_buckets, validate_buckets
+from .buckets import StagingPool, pow2_buckets, validate_buckets
 from .metrics import ServingMetrics
 
 
@@ -105,6 +109,11 @@ class InferenceEngine:
         )
         self.metrics = metrics
         self.warmed = False
+        # Direct-call staging: one preallocated pad target per bucket, so
+        # the serial predict_logits path allocates nothing per dispatch
+        # (one slot suffices — the result is read back before the next
+        # chunk stages, so the buffer is always free again by then).
+        self._staging = StagingPool(self.buckets, INPUT_SHAPE, slots=1)
 
     # -- construction helpers ------------------------------------------------
 
@@ -167,12 +176,39 @@ class InferenceEngine:
 
     # -- serving --------------------------------------------------------------
 
+    def launch(self, staged: np.ndarray, n: int):
+        """Dispatch one already-bucket-shaped batch WITHOUT reading back.
+
+        ``staged`` must be exactly a warmed bucket shape (the batcher and
+        :meth:`predict_logits` stage through a :class:`StagingPool`, so
+        jit only ever sees bucket shapes) and carry ``n`` live rows at
+        the front.  Returns the on-device ``[bucket, 10]`` log-probs —
+        jax's async dispatch means this does NOT wait for the compute, so
+        the caller can overlap host work (padding the next batch) with
+        device execution and read the result later with ``np.asarray``.
+        """
+        bucket = len(staged)
+        if bucket not in self.buckets:
+            raise ValueError(
+                f"staged batch of {bucket} rows is not a warmed bucket "
+                f"{self.buckets}; stage through StagingPool/bucket_for"
+            )
+        if not 1 <= n <= bucket:
+            raise ValueError(f"live rows {n} outside [1, {bucket}]")
+        logits = self._predict(self._variables, staged)
+        if self.metrics is not None:
+            self.metrics.record_batch(n, bucket)
+        return logits
+
     def predict_logits(self, x: np.ndarray) -> np.ndarray:
         """``[n, 28, 28, 1]`` normalized float32 -> ``[n, 10]`` log-probs.
 
-        Pads to the nearest bucket, dispatches, slices padding back off.
-        ``n`` above the top bucket is chunked (direct callers only — the
-        batcher never coalesces past the top bucket).
+        Pads into the engine's preallocated staging buffers (zero-alloc
+        steady state), dispatches, slices padding back off.  ``n`` above
+        the top bucket is chunked (direct callers only — the batcher
+        never coalesces past the top bucket).  Serial by design: each
+        chunk's result is read before the next stages; the overlapped
+        path is the pipelined batcher (serving/batcher.py).
         """
         x = np.asarray(x, np.float32)
         if x.ndim != 1 + len(INPUT_SHAPE) or x.shape[1:] != INPUT_SHAPE:
@@ -187,11 +223,12 @@ class InferenceEngine:
         outs = []
         for start in range(0, n, top):
             chunk = x[start : start + top]
-            bucket = bucket_for(len(chunk), self.buckets)
-            logits = self._predict(self._variables, pad_to_bucket(chunk, bucket))
-            if self.metrics is not None:
-                self.metrics.record_batch(len(chunk), bucket)
-            outs.append(np.asarray(logits)[: len(chunk)])
+            staged, bucket = self._staging.stage([chunk])
+            try:
+                logits = self.launch(staged, len(chunk))
+                outs.append(np.asarray(logits)[: len(chunk)])  # jaxlint: disable=JL009 -- serial direct-call path: each chunk is read inline by contract; the overlapped read lives in the batcher's completion worker
+            finally:
+                self._staging.release(staged, bucket)
         out = outs[0] if len(outs) == 1 else np.concatenate(outs)
         assert out.shape == (n, NUM_CLASSES)
         return out

@@ -66,6 +66,27 @@ class ServingMetrics:
             help="request latency, submit -> result set (reservoir window)",
             reservoir=reservoir,
         )
+        # Pipeline surface (ISSUE 4): per-dispatch fill/waste plus the
+        # stall the dispatch thread pays waiting for an in-flight slot.
+        self._fill = self.registry.histogram(
+            "serving_batch_fill_ratio",
+            help="live rows / bucket slots per dispatch (1.0 = no padding)",
+            reservoir=reservoir,
+        )
+        self._padding_rows = self.registry.histogram(
+            "serving_padding_waste_rows",
+            help="padding rows per dispatch (bucket - live)",
+            reservoir=reservoir,
+        )
+        self._stall = self.registry.histogram(
+            "serving_pipeline_stall_seconds",
+            help="dispatch-thread wait for a free in-flight window slot",
+            reservoir=reservoir,
+        )
+        self._inflight = self.registry.gauge(
+            "serving_inflight_batches",
+            help="batches launched on the device, result not yet read back",
+        )
 
     # -- counter views (back-compat attribute surface) ------------------------
 
@@ -120,6 +141,16 @@ class ServingMetrics:
         self._batches.inc()
         self._samples_real.inc(real)
         self._samples_padded.inc(bucket)
+        self._fill.observe(real / bucket if bucket else 0.0)
+        self._padding_rows.observe(bucket - real)
+
+    def record_stall(self, stall_s: float) -> None:
+        """Dispatch thread blocked ``stall_s`` on a full in-flight window."""
+        self._stall.observe(stall_s)
+
+    def set_inflight(self, depth: int) -> None:
+        """Current launched-not-yet-completed batch count (gauge)."""
+        self._inflight.set(depth)
 
     def record_completed(self, latency_s: float) -> None:
         """One request finished; ``latency_s`` spans submit -> result set."""
@@ -133,6 +164,9 @@ class ServingMetrics:
         queue_depth: int | None = None,
         compiles: int | None = None,
         buckets: tuple[int, ...] | None = None,
+        inflight: int | None = None,
+        max_inflight: int | None = None,
+        linger_ms: float | None = None,
     ) -> dict:
         """One consistent dict of everything (the /metrics JSON payload).
 
@@ -147,6 +181,9 @@ class ServingMetrics:
         """
         with self.registry.locked():
             lat = sorted(self._latency.values())
+            fills = self._fill.values()
+            stalls = sorted(self._stall.values())
+            stall_count, stall_sum = self._stall.count, self._stall.sum
             completed = self.completed
             samples_real = self.samples_real
             samples_padded = self.samples_padded
@@ -183,6 +220,12 @@ class ServingMetrics:
                 "mean": 1e3 * sum(lat) / len(lat) if lat else 0.0,
                 "max": 1e3 * lat[-1] if lat else 0.0,
             },
+            "pipeline": {
+                "fill_ratio_mean": sum(fills) / len(fills) if fills else 0.0,
+                "stalls": stall_count,
+                "stall_s_total": stall_sum,
+                "stall_ms_p95": 1e3 * percentile(stalls, 95),
+            },
         }
         gauges = [
             ("serving_uptime_seconds", "process uptime", uptime),
@@ -196,6 +239,15 @@ class ServingMetrics:
             gauges.append(
                 ("serving_queue_depth", "admission queue depth", queue_depth)
             )
+        if inflight is not None:
+            # JSON field only — the gauge itself is maintained by the
+            # batcher under its in-flight lock; setting it here from this
+            # unlocked read could overwrite a newer value with a stale one.
+            snap["pipeline"]["inflight"] = inflight
+        if max_inflight is not None:
+            snap["pipeline"]["max_inflight"] = max_inflight
+        if linger_ms is not None:
+            snap["pipeline"]["linger_ms"] = linger_ms
         if compiles is not None:
             snap["compiles"] = compiles
         if buckets is not None:
@@ -225,6 +277,21 @@ class ServingMetrics:
         ]
         if "queue_depth" in s:
             lines.append(f"  queue depth: {s['queue_depth']}")
+        pipe = s["pipeline"]
+        if pipe["stalls"] or "inflight" in pipe:
+            lines.append(
+                "  pipeline: "
+                + (f"in-flight {pipe['inflight']}"
+                   + (f"/{pipe['max_inflight']}" if "max_inflight" in pipe
+                      else "")
+                   + ", " if "inflight" in pipe else "")
+                + (f"linger {pipe['linger_ms']:.2f} ms, "
+                   if "linger_ms" in pipe else "")
+                + f"mean fill {100.0 * pipe['fill_ratio_mean']:.1f}%, "
+                f"{pipe['stalls']} stalls "
+                f"({pipe['stall_s_total']:.3f} s total, "
+                f"p95 {pipe['stall_ms_p95']:.2f} ms)"
+            )
         if "compiles" in s:
             lines.append(
                 f"  compiles: {s['compiles']}"

@@ -186,6 +186,9 @@ class ServingHTTPServer(ThreadingHTTPServer):
             queue_depth=self.batcher.depth(),
             compiles=self.engine.compile_count(),
             buckets=self.engine.buckets,
+            inflight=self.batcher.inflight(),
+            max_inflight=self.batcher.max_inflight,
+            linger_ms=self.batcher.current_linger_ms,
         )
 
     def prometheus(self) -> str:

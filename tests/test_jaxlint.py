@@ -750,6 +750,145 @@ def test_jl008_waiver():
 
 
 # ---------------------------------------------------------------------------
+# JL009 — blocking host read of a jit output inside its dispatch loop
+
+
+JL009_BAD_ASARRAY = """\
+import numpy as np
+import jax
+
+predict = jax.jit(lambda p, x: x)
+
+def serve(params, batches):
+    outs = []
+    for b in batches:
+        logits = predict(params, b)
+        outs.append(np.asarray(logits))
+    return outs
+"""
+
+JL009_BAD_BLOCK = """\
+import jax
+
+predict = jax.jit(lambda p, x: x)
+
+def serve(params, batches):
+    for b in batches:
+        predict(params, b).block_until_ready()
+"""
+
+JL009_BAD_DEVICE_GET = """\
+import jax
+
+predict = jax.jit(lambda p, x: x)
+
+def serve(params, batches):
+    out = []
+    for b in batches:
+        out.append(jax.device_get(predict(params, b)))
+    return out
+"""
+
+JL009_GOOD_READ_AFTER_LOOP = """\
+import numpy as np
+import jax
+
+predict = jax.jit(lambda p, x: x)
+
+def serve(params, batches):
+    handles = []
+    for b in batches:
+        handles.append(predict(params, b))
+    return [np.asarray(h) for h in handles]
+"""
+
+JL009_GOOD_HOST_ASARRAY = """\
+import numpy as np
+
+def summarize(rows):
+    out = []
+    for r in rows:
+        out.append(np.asarray(r))
+    return out
+"""
+
+
+def test_jl009_fires_on_asarray_in_dispatch_loop():
+    assert_fires(JL009_BAD_ASARRAY, "JL009", line=10)
+
+
+def test_jl009_fires_on_block_until_ready_in_loop():
+    assert_fires(JL009_BAD_BLOCK, "JL009", line=7)
+
+
+def test_jl009_fires_on_device_get_of_jit_output_in_loop():
+    assert_fires(JL009_BAD_DEVICE_GET, "JL009", line=8)
+
+
+def test_jl009_silent_when_reads_happen_after_the_loop():
+    # Launch-in-loop, read-after-loop is the pipelined GOOD shape: async
+    # dispatch overlaps; the single read at the end pays one sync.
+    assert_silent(JL009_GOOD_READ_AFTER_LOOP, "JL009")
+
+
+def test_jl009_silent_on_host_arrays():
+    # np.asarray over plain host data in a loop is everyday numpy.
+    assert_silent(JL009_GOOD_HOST_ASARRAY, "JL009")
+
+
+def test_jl009_tracks_sentinel_wrapped_attributes():
+    # The engine shape: a RecompileSentinel-wrapped jit bound onto self,
+    # dispatched and read in the same loop.
+    assert_fires(
+        """\
+import numpy as np
+import jax
+from pytorch_mnist_ddp_tpu.analysis import RecompileSentinel
+
+class Engine:
+    def __init__(self, fn):
+        self._predict = RecompileSentinel(jax.jit(fn), max_traces=1)
+
+    def serve(self, params, batches):
+        outs = []
+        for b in batches:
+            logits = self._predict(params, b)
+            outs.append(np.asarray(logits))
+        return outs
+""",
+        "JL009",
+        line=13,
+    )
+
+
+def test_jl009_prefetched_handle_is_not_flagged():
+    # A handle produced BEFORE the loop is a prefetch being consumed, not
+    # a dispatch being serialized.
+    assert_silent(
+        """\
+import numpy as np
+import jax
+
+predict = jax.jit(lambda p, x: x)
+
+def serve(params, x, rounds):
+    logits = predict(params, x)
+    for _ in range(rounds):
+        print(np.asarray(logits).sum())
+""",
+        "JL009",
+    )
+
+
+def test_jl009_waiver():
+    waived = JL009_BAD_ASARRAY.replace(
+        "outs.append(np.asarray(logits))",
+        "outs.append(np.asarray(logits))  # jaxlint: disable=JL009 -- serial benchmark: one dispatch per timing sample is the point",
+    )
+    assert_silent(waived, "JL009")
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

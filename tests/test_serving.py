@@ -31,11 +31,13 @@ from pytorch_mnist_ddp_tpu.parallel.ddp import (
 )
 from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
 from pytorch_mnist_ddp_tpu.serving import (
+    AdaptiveLinger,
     InferenceEngine,
     MicroBatcher,
     RejectedError,
     RequestTimeout,
     ServingMetrics,
+    StagingPool,
     bucket_for,
     pad_to_bucket,
     pow2_buckets,
@@ -90,6 +92,41 @@ def test_pad_to_bucket_rows():
         pad_to_bucket(x, 2)
 
 
+def test_staging_pool_matches_pad_to_bucket_and_reuses_buffers():
+    pool = StagingPool((4, 8), item_shape=(2,), slots=1)
+    parts = [np.ones((2, 2), np.float32), 2 * np.ones((3, 2), np.float32)]
+    buf, bucket = pool.stage(parts)
+    assert bucket == 8
+    np.testing.assert_array_equal(buf, pad_to_bucket(np.concatenate(parts), 8))
+    pool.release(buf, bucket)
+    # Steady state is zero-alloc: the SAME buffer comes back, tail
+    # re-zeroed even when the previous batch dirtied more rows.
+    buf2, bucket2 = pool.stage([np.full((1, 2), 7.0, np.float32)])
+    assert bucket2 == 4  # smaller total -> smaller bucket, its own buffer
+    pool.release(buf2, bucket2)
+    buf3, _ = pool.stage([np.ones((5, 2), np.float32)])
+    assert buf3 is buf  # recycled, not reallocated
+    assert not buf3[5:].any()  # previous rows 5..7 (2.0s) were re-zeroed
+    pool.release(buf3, 8)
+
+
+def test_staging_pool_acquire_blocks_until_release():
+    pool = StagingPool((4,), item_shape=(1,), slots=1)
+    held = pool.acquire(4)
+    got = []
+
+    def taker():
+        got.append(pool.acquire(4))
+
+    t = threading.Thread(target=taker)
+    t.start()
+    time.sleep(0.02)
+    assert not got  # blocked: the single slot is held
+    pool.release(held, 4)
+    t.join(timeout=2.0)
+    assert got and got[0] is held
+
+
 # ---------------------------------------------------------------------------
 # Metrics
 
@@ -132,12 +169,53 @@ def test_metrics_snapshot_occupancy_and_latency():
     assert "p95" in report and "occupancy" in report
 
 
+def test_metrics_pipeline_snapshot():
+    m = ServingMetrics()
+    m.record_batch(real=6, bucket=8)
+    m.record_batch(real=8, bucket=8)
+    m.record_stall(0.004)
+    snap = m.snapshot(inflight=1, max_inflight=2, linger_ms=1.5)
+    pipe = snap["pipeline"]
+    assert pipe["fill_ratio_mean"] == pytest.approx((0.75 + 1.0) / 2)
+    assert pipe["stalls"] == 1
+    assert pipe["stall_s_total"] == pytest.approx(0.004)
+    assert pipe["inflight"] == 1 and pipe["max_inflight"] == 2
+    assert pipe["linger_ms"] == pytest.approx(1.5)
+    report = m.report_lines(inflight=1, max_inflight=2, linger_ms=1.5)
+    assert "pipeline:" in report and "in-flight 1/2" in report
+
+
 # ---------------------------------------------------------------------------
 # Micro-batcher (fake engine: pure concurrency logic, no jax)
 
 
+class _LazyLogits:
+    """Fake on-device result with real async-dispatch semantics:
+    ``launch`` returns instantly and the "compute" completes ``delay_s``
+    after launch regardless of when anyone looks — ``np.asarray`` blocks
+    only for the remainder, exactly like reading a jax array.  Batches
+    launched while earlier ones are in flight therefore compute
+    concurrently (the accelerator behavior the pipeline exists to
+    exploit), which a sleep-in-the-read fake would hide."""
+
+    def __init__(self, rows: np.ndarray, delay_s: float):
+        # Snapshot at launch, like a real H2D copy: the staging buffer is
+        # recycled for the next batch while this one is still in flight.
+        self._rows = np.array(rows, copy=True)
+        self._t_ready = time.perf_counter() + delay_s
+
+    def __array__(self, dtype=None, copy=None):
+        wait = self._t_ready - time.perf_counter()
+        if wait > 0:
+            time.sleep(wait)
+        out = np.zeros((len(self._rows), NUM_CLASSES), np.float32)
+        out[:, 0] = self._rows.reshape(len(self._rows), -1)[:, 0]
+        return out if dtype is None else out.astype(dtype)
+
+
 class FakeEngine:
-    """Engine stand-in recording dispatch sizes; rows carry their input's
+    """Engine stand-in for the pipeline contract (``buckets`` +
+    ``launch``), recording LIVE dispatch sizes; rows carry their input's
     first value so per-request unsplitting is checkable."""
 
     def __init__(self, buckets=(8,), delay_s: float = 0.0):
@@ -146,13 +224,9 @@ class FakeEngine:
         self.delay_s = delay_s
         self.dispatches: list[int] = []
 
-    def predict_logits(self, x):
-        self.dispatches.append(len(x))
-        if self.delay_s:
-            time.sleep(self.delay_s)
-        out = np.zeros((len(x), NUM_CLASSES), np.float32)
-        out[:, 0] = x.reshape(len(x), -1)[:, 0]
-        return out
+    def launch(self, staged, n):
+        self.dispatches.append(n)
+        return _LazyLogits(staged, self.delay_s)
 
 
 def _rows(n, tag=1.0):
@@ -252,7 +326,7 @@ def test_batcher_graceful_drain_completes_admitted_work():
 
 def test_batcher_engine_failure_completes_all_waiters():
     class ExplodingEngine(FakeEngine):
-        def predict_logits(self, x):
+        def launch(self, staged, n):
             raise RuntimeError("boom")
 
     m = ServingMetrics()
@@ -263,6 +337,154 @@ def test_batcher_engine_failure_completes_all_waiters():
         req.result()
     batcher.stop()
     assert m.failed == 1
+
+
+def test_batcher_read_failure_completes_all_waiters():
+    # A failure on the COMPLETION side (the D2H read) must also complete
+    # every waiter and free the window for later batches.
+    class ExplodingRead:
+        def __array__(self, dtype=None, copy=None):
+            raise RuntimeError("d2h boom")
+
+    class BadReadEngine(FakeEngine):
+        def launch(self, staged, n):
+            self.dispatches.append(n)
+            return ExplodingRead()
+
+    m = ServingMetrics()
+    batcher = MicroBatcher(BadReadEngine(), metrics=m, max_inflight=1)
+    req = batcher.submit(_rows(2))
+    batcher.start()
+    with pytest.raises(RuntimeError, match="d2h boom"):
+        req.result()
+    batcher.stop()
+    assert m.failed == 1
+    assert batcher.inflight() == 0  # slot + staging buffer were released
+
+
+# ---------------------------------------------------------------------------
+# Pipelining: overlap, drain correctness, adaptive linger
+
+
+def test_pipeline_overlaps_batches_in_flight():
+    # Slow D2H reads (30 ms) + instant launches: the dispatch worker must
+    # run ahead of the completion worker, so the observed in-flight depth
+    # exceeds 1 — the overlap the pipelined executor exists to create.
+    engine = FakeEngine(buckets=(8,), delay_s=0.03)
+    m = ServingMetrics()
+    batcher = MicroBatcher(engine, metrics=m, linger_ms=0.0, max_inflight=3)
+    reqs = [batcher.submit(_rows(8, tag=i)) for i in range(6)]
+    batcher.start()
+    outs = [r.result() for r in reqs]
+    batcher.stop()
+    assert batcher.peak_inflight > 1
+    assert batcher.inflight() == 0
+    for i, out in enumerate(outs):  # completion still unsplits correctly
+        assert out.shape == (8, NUM_CLASSES)
+        assert out[0, 0] == pytest.approx(float(i))
+    assert m.completed == 6
+
+
+def _drive_full_batches(max_inflight: int, n_batches: int, delay_s: float) -> float:
+    """Wall time to serve ``n_batches`` full batches through a fake
+    device with ``delay_s`` compute latency."""
+    engine = FakeEngine(buckets=(8,), delay_s=delay_s)
+    batcher = MicroBatcher(
+        engine, metrics=ServingMetrics(), linger_ms=0.0,
+        max_inflight=max_inflight, adaptive_linger=False,
+    )
+    reqs = [batcher.submit(_rows(8, tag=i)) for i in range(n_batches)]
+    t0 = time.perf_counter()
+    batcher.start()
+    outs = [r.result() for r in reqs]
+    wall = time.perf_counter() - t0
+    batcher.stop()
+    for i, out in enumerate(outs):
+        assert out[0, 0] == pytest.approx(float(i))
+    return wall
+
+
+def test_pipeline_throughput_beats_serial_window():
+    # The throughput acceptance, on a device whose compute time is real
+    # concurrency (the fake completes delay_s after launch, like an
+    # accelerator): max_inflight=1 serializes compute behind each read
+    # (structural floor n_batches x delay), a window of 3 overlaps them.
+    # CPU-only hosts can't show this end-to-end — "device" compute there
+    # steals the same cores the host threads run on.
+    delay, n = 0.04, 6
+    serial = _drive_full_batches(1, n, delay)
+    pipelined = _drive_full_batches(3, n, delay)
+    assert serial >= n * delay  # window 1: compute N+1 waits for read N
+    assert pipelined < 0.75 * serial  # overlap is a wall-clock win
+
+
+def test_pipeline_window_bounds_inflight():
+    engine = FakeEngine(buckets=(8,), delay_s=0.02)
+    batcher = MicroBatcher(
+        engine, metrics=ServingMetrics(), linger_ms=0.0, max_inflight=2
+    )
+    reqs = [batcher.submit(_rows(8)) for _ in range(6)]
+    batcher.start()
+    for r in reqs:
+        r.result()
+    batcher.stop()
+    assert 1 < batcher.peak_inflight <= 2  # overlapped, but never past the bound
+
+
+def test_pipelined_drain_loses_and_duplicates_nothing():
+    # stop(drain=True) with work in BOTH stages: queued requests not yet
+    # dispatched and launched batches not yet read back.  Every waiter
+    # resolves exactly once with the value serial execution would give.
+    engine = FakeEngine(buckets=(8,), delay_s=0.01)
+    m = ServingMetrics()
+    batcher = MicroBatcher(engine, metrics=m, linger_ms=0.0, max_inflight=2)
+    reqs = [batcher.submit(_rows(3, tag=i)) for i in range(12)]
+    batcher.start()
+    batcher.stop(drain=True)  # close admission; drain queue + window
+    for i, req in enumerate(reqs):
+        out = req.result()  # second .result() on a resolved request is a
+        out2 = req.result()  # re-read of the same slot, not a re-compute
+        assert out is out2
+        assert out.shape == (3, NUM_CLASSES)
+        assert out[0, 0] == pytest.approx(float(i))
+    assert m.completed == 12 and m.timed_out == 0 and m.failed == 0
+    assert sum(engine.dispatches) == 36  # every admitted row dispatched once
+    assert batcher.inflight() == 0
+
+
+def test_adaptive_linger_shrinks_deep_relaxes_idle():
+    al = AdaptiveLinger(0.010, deep_depth=4)
+    assert al.current_s == 0.010
+    for _ in range(64):
+        al.update(10)  # deep queue: halve toward 0, snap to exactly 0
+    assert al.current_s == 0.0
+    al.update(2)  # in-between depth: hold
+    assert al.current_s == 0.0
+    for _ in range(10):
+        al.update(0)  # idle: relax back up, capped at the ceiling
+    assert al.current_s == pytest.approx(0.010)
+    disabled = AdaptiveLinger(0.010, enabled=False)
+    assert disabled.update(100) == 0.010  # fixed-linger PR 3 behavior
+
+
+def test_adaptive_linger_bounds_property():
+    pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=200, deadline=None)
+    @given(
+        ceiling_ms=st.floats(0.0, 50.0, allow_nan=False),
+        deep_depth=st.integers(1, 16),
+        depths=st.lists(st.integers(0, 256), max_size=100),
+    )
+    def run(ceiling_ms, deep_depth, depths):
+        al = AdaptiveLinger(ceiling_ms / 1e3, deep_depth=deep_depth)
+        for d in depths:
+            v = al.update(d)
+            assert 0.0 <= v <= al.ceiling_s
+            assert 0.0 <= al.current_s <= al.ceiling_s
+
+    run()
 
 
 # ---------------------------------------------------------------------------
@@ -295,6 +517,62 @@ def test_engine_rejects_bad_input_shapes(devices):
         engine.predict_logits(np.zeros((2, 27, 28, 1), np.float32))
     with pytest.raises(ValueError, match="empty"):
         engine.predict_logits(np.zeros((0, 28, 28, 1), np.float32))
+    with pytest.raises(ValueError, match="not a warmed bucket"):
+        engine.launch(np.zeros((4, 28, 28, 1), np.float32), 4)
+    with pytest.raises(ValueError, match="live rows"):
+        engine.launch(np.zeros((8, 28, 28, 1), np.float32), 9)
+
+
+def test_engine_staging_is_zero_alloc_and_matches_pad_to_bucket(devices):
+    # The direct-call path now pads into preallocated staging buffers;
+    # results must be BIT-identical to the old pad_to_bucket allocation
+    # path (same values, same bucket shape -> same executable).
+    engine = InferenceEngine.from_seed(buckets=(8, 16))
+    engine.warmup()
+    staging_ids = {
+        b: id(engine._staging._free[b][0]) for b in engine.buckets
+    }
+    for n in (1, 5, 8, 11, 16):
+        x = np.random.RandomState(n).rand(n, 28, 28, 1).astype(np.float32)
+        got = engine.predict_logits(x)
+        bucket = bucket_for(n, engine.buckets)
+        want = np.asarray(
+            engine._predict(engine._variables, pad_to_bucket(x, bucket))
+        )[:n]
+        np.testing.assert_array_equal(got, want)
+        # Same preallocated buffer keeps being recycled: nothing new was
+        # allocated for staging at steady state.
+        assert id(engine._staging._free[bucket][0]) == staging_ids[bucket]
+    assert engine.compile_count() == 2  # staging added zero traces
+
+
+def test_pipelined_batcher_matches_serial_engine_bitwise(devices):
+    # The acceptance pin: max_inflight=1 + adaptive linger off must give
+    # responses bit-identical to the serial PR 3 path (predict_logits on
+    # the same coalesced batch), and a pipelined run (max_inflight=2)
+    # must give those same bits too.
+    engine = InferenceEngine.from_seed(buckets=(8, 16))
+    engine.warmup()
+    rng = np.random.RandomState(42)
+    sizes = (3, 5, 2, 6)  # coalesces to one 16-bucket batch
+    xs = [rng.rand(n, 28, 28, 1).astype(np.float32) for n in sizes]
+    serial = engine.predict_logits(np.concatenate(xs))
+
+    for max_inflight, adaptive in ((1, False), (2, True)):
+        batcher = MicroBatcher(
+            engine, metrics=ServingMetrics(), linger_ms=50.0,
+            max_inflight=max_inflight, adaptive_linger=adaptive,
+        )
+        # Submit BEFORE starting: deterministic coalescing into one batch.
+        reqs = [batcher.submit(x) for x in xs]
+        batcher.start()
+        outs = [r.result() for r in reqs]
+        batcher.stop()
+        offset = 0
+        for x, out in zip(xs, outs):
+            np.testing.assert_array_equal(out, serial[offset : offset + len(x)])
+            offset += len(x)
+    assert engine.compile_count() == 2  # pipelining added zero traces
 
 
 # ---------------------------------------------------------------------------
@@ -450,6 +728,12 @@ def test_server_end_to_end(devices):
         assert 'serving_requests_total{outcome="completed"} 1' in prom
         assert "serving_queue_depth 0" in prom
         assert "# TYPE serving_request_latency_seconds summary" in prom
+        # Pipeline surface (PR 4): in-flight gauge, adaptive-linger gauge,
+        # fill-ratio/stall histograms all ride the same exposition.
+        assert "serving_inflight_batches 0" in prom
+        assert "serving_linger_seconds" in prom
+        assert "serving_batch_fill_ratio" in prom
+        assert "serving_pipeline_stall_seconds" in prom
         with urllib.request.urlopen(f"{base}/metrics?format=prom", timeout=10) as resp:
             assert "jax_compiles_total" in resp.read().decode()
 
@@ -493,16 +777,21 @@ def test_decode_instances_shapes_and_errors():
 # Load generator (in-process, the CI-able smoke of the acceptance run)
 
 
-def test_loadgen_self_serve_report(devices, tmp_path):
+def _load_tool(name):
     import importlib.util
     import os
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     spec = importlib.util.spec_from_file_location(
-        "serve_loadgen", os.path.join(root, "tools", "serve_loadgen.py")
+        name, os.path.join(root, "tools", f"{name}.py")
     )
-    loadgen = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(loadgen)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_loadgen_self_serve_report(devices, tmp_path):
+    loadgen = _load_tool("serve_loadgen")
 
     report_path = str(tmp_path / "BENCH_serving.json")
     rc = loadgen.main([
@@ -514,6 +803,7 @@ def test_loadgen_self_serve_report(devices, tmp_path):
         report = json.load(f)
     # The acceptance surface: latency percentiles, occupancy, rejection
     # count, and the zero-additional-compiles verdict all present.
+    assert report["mode"] == "closed-loop"
     assert report["requests"] == 24
     assert report["additional_compiles"] == 0
     for q in ("p50", "p95", "p99"):
@@ -521,3 +811,59 @@ def test_loadgen_self_serve_report(devices, tmp_path):
     assert 0.0 < report["server_batch_occupancy_pct"] <= 100.0
     assert report["rejected"] == 0
     assert report["status_counts"].get("200") == 24
+    assert report["server_pipeline"]["max_inflight"] == 2
+
+
+def test_loadgen_open_loop_report_and_artifacts(devices, tmp_path):
+    # Open-loop mode: Poisson arrivals, prom dump carries the pipeline
+    # families, JSONL telemetry summarizes through perf_report's serving
+    # section — the CI smoke, in-process.
+    loadgen = _load_tool("serve_loadgen")
+
+    report_path = str(tmp_path / "BENCH_open.json")
+    prom_path = str(tmp_path / "serving.prom")
+    tel_dir = str(tmp_path / "telemetry")
+    rc = loadgen.main([
+        "--open-loop", "--rate", "300", "--requests", "24",
+        "--max-request", "8", "--buckets", "8",
+        "--report", report_path, "--prom-dump", prom_path,
+        "--telemetry-dir", tel_dir,
+    ])
+    assert rc == 0
+    with open(report_path) as f:
+        report = json.load(f)
+    assert report["mode"] == "open-loop"
+    assert report["offered_rate_rps"] == pytest.approx(300.0)
+    assert report["achieved_arrival_rate_rps"] > 0.0
+    assert report["additional_compiles"] == 0  # pipelining adds no traces
+    with open(prom_path) as f:
+        prom = f.read()
+    assert "serving_inflight_batches" in prom
+    assert "serving_pipeline_stall_seconds" in prom
+    assert "serving_linger_seconds" in prom
+    assert "serving_batch_fill_ratio" in prom
+
+    perf_report = _load_tool("perf_report")
+    summary = perf_report.summarize_telemetry(tel_dir)
+    assert summary is not None
+    assert "serving batches:" in summary and "mean fill" in summary
+    assert "serving:" in summary and "p95" in summary
+
+
+def test_perf_report_serving_section_from_synthetic_events(tmp_path):
+    # The serving section parses the documented event schema alone — no
+    # server needed (the offline-operator contract).
+    events = [
+        {"event": "serving_request", "n": 2, "latency_s": 0.010},
+        {"event": "serving_request", "n": 3, "latency_s": 0.030},
+        {"event": "serving_batch", "real": 5, "bucket": 8,
+         "fill_ratio": 0.625, "stall_s": 0.002},
+    ]
+    with open(tmp_path / "events-rank0.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    perf_report = _load_tool("perf_report")
+    summary = perf_report.summarize_telemetry(str(tmp_path))
+    assert "serving: 2 requests" in summary
+    assert "serving batches: 1, mean fill 62.5%" in summary
+    assert "1 stalled dispatches" in summary

@@ -285,6 +285,32 @@ def summarize_telemetry(directory: str) -> str | None:
             for name, ds in sorted(by_span.items())
         )
         lines.append(f"  spans: {rendered}")
+    # Serving pipeline telemetry (serving/batcher.py under --telemetry-dir):
+    # per-request latency plus per-batch fill/stall — the operator's view
+    # of how well the in-flight window is overlapping.
+    sreqs = [e for e in events if e.get("event") == "serving_request"]
+    if sreqs:
+        lats = sorted(e["latency_s"] for e in sreqs if "latency_s" in e)
+        if lats:
+            lines.append(
+                f"  serving: {len(sreqs)} requests, "
+                f"p50 {1e3 * percentile(lats, 50):.2f} ms, "
+                f"p95 {1e3 * percentile(lats, 95):.2f} ms, "
+                f"p99 {1e3 * percentile(lats, 99):.2f} ms"
+            )
+    sbatches = [e for e in events if e.get("event") == "serving_batch"]
+    if sbatches:
+        fills = [e["fill_ratio"] for e in sbatches if "fill_ratio" in e]
+        stalls = [e.get("stall_s", 0.0) for e in sbatches]
+        stalled = [s for s in stalls if s > 0]
+        lines.append(
+            f"  serving batches: {len(sbatches)}, mean fill "
+            f"{100.0 * sum(fills) / len(fills):.1f}%, "
+            f"{len(stalled)} stalled dispatches "
+            f"({sum(stalls):.3f} s total stall)"
+            if fills else
+            f"  serving batches: {len(sbatches)}"
+        )
     runs = [e for e in events if e.get("event") == "run_complete"]
     if runs:
         # Correctly-labeled seconds — the telemetry surface does NOT

@@ -13,14 +13,29 @@ reads the server's ``compiles`` gauge before and after and exits nonzero
 if it moved (disable with --no-check-compiles when deliberately probing
 an unwarmed ladder).
 
+Two arrival models:
+
+- **closed loop** (default): ``--concurrency`` client threads, each
+  firing its next request when the previous answers.  Simple, but the
+  server's own latency throttles the offered load — a pipelining win
+  shows up as lower latency, not higher pressure.
+- **open loop** (``--open-loop``): requests arrive on a Poisson process
+  at ``--rate`` req/s *regardless of completions*, the arrival model
+  real traffic actually has (and the one that exposes overlap: the
+  server must absorb arrivals while earlier batches are still in
+  flight).  Offered vs achieved rate both land in the report.
+
 Default mode (``--self-serve``) spins the whole stack up in-process on a
 loopback port with fresh seed weights — no checkpoint, no running server,
 no network needed: the CI-able smoke path.  Point --url at a real server
-to load-test a deployment.
+to load-test a deployment.  ``--prom-dump PATH`` saves the endpoint's
+final Prometheus exposition (the in-flight gauge, stall/fill histograms)
+for offline grepping — the CI smoke's hook.
 
 Usage::
 
     python tools/serve_loadgen.py                       # self-contained
+    python tools/serve_loadgen.py --open-loop --rate 500 --requests 1000
     python tools/serve_loadgen.py --url http://host:8000 \
         --requests 2000 --concurrency 32
 """
@@ -59,6 +74,85 @@ def fetch_json(url: str, payload: dict | None = None, timeout: float = 30.0) -> 
         return e.code, body
 
 
+def fetch_text(url: str, timeout: float = 30.0) -> str:
+    """GET a text body (the Prometheus exposition for --prom-dump)."""
+    req = urllib.request.Request(url, headers={"Accept": "text/plain"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _request_payload(rng: random.Random, n: int) -> dict:
+    return {
+        "instances": [
+            [rng.randint(0, 255) for _ in range(784)] for _ in range(n)
+        ]
+    }
+
+
+def run_open_loop(
+    url: str,
+    requests: int,
+    rate: float,
+    max_request: int,
+    seed: int,
+    timeout_s: float,
+    max_workers: int,
+) -> dict:
+    """Poisson arrivals at ``rate`` req/s, fired independently of
+    completions, bounded by ``max_workers`` outstanding requests.
+
+    Latency is measured from each request's SCHEDULED arrival, not from
+    when an executor thread picks it up — otherwise a saturated worker
+    pool silently re-closes the loop and hides client-side queueing from
+    the percentiles (the coordinated-omission trap open-loop load
+    generation exists to avoid).
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    rng = random.Random(seed)
+    sizes = [rng.randint(1, max_request) for _ in range(requests)]
+    # Pre-draw the whole arrival schedule so the trace is reproducible
+    # from --seed and the firing loop does no RNG work.
+    arrivals: list[float] = []
+    t = 0.0
+    for _ in range(requests):
+        t += rng.expovariate(rate)
+        arrivals.append(t)
+
+    def one(i: int, scheduled: float) -> tuple[int, float]:
+        wrng = random.Random(seed * 1000 + i)
+        status, _body = fetch_json(
+            f"{url}/predict", _request_payload(wrng, sizes[i]),
+            timeout=timeout_s,
+        )
+        return status, time.perf_counter() - scheduled
+
+    t_start = time.perf_counter()
+    last_fired = t_start
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        futures = []
+        for i in range(requests):
+            delay = t_start + arrivals[i] - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            last_fired = time.perf_counter()
+            futures.append(pool.submit(one, i, t_start + arrivals[i]))
+        results = [f.result() for f in futures]
+    wall = time.perf_counter() - t_start
+    # achieved rate from real fire times — if the submission loop could
+    # not keep up with the schedule, the report must say so rather than
+    # echo the offered rate back.
+    fired_span = last_fired - t_start
+    return {
+        "results": results,
+        "wall_s": wall,
+        "sizes": sizes,
+        "mode": "open-loop",
+        "offered_rate_rps": rate,
+        "achieved_arrival_rate_rps": requests / fired_span if fired_span > 0 else 0.0,
+    }
+
+
 def run_load(
     url: str,
     requests: int,
@@ -83,13 +177,10 @@ def run_load(
                 if i >= requests:
                     return
                 cursor[0] += 1
-            n = sizes[i]
-            instances = [
-                [wrng.randint(0, 255) for _ in range(784)] for _ in range(n)
-            ]
             t0 = time.perf_counter()
             status, _body = fetch_json(
-                f"{url}/predict", {"instances": instances}, timeout=timeout_s
+                f"{url}/predict", _request_payload(wrng, sizes[i]),
+                timeout=timeout_s,
             )
             elapsed = time.perf_counter() - t0
             with lock:
@@ -104,7 +195,10 @@ def run_load(
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
-    return {"results": results, "wall_s": wall, "sizes": sizes}
+    return {
+        "results": results, "wall_s": wall, "sizes": sizes,
+        "mode": "closed-loop",
+    }
 
 
 def summarize(raw: dict, before: dict, after: dict) -> dict:
@@ -123,6 +217,9 @@ def summarize(raw: dict, before: dict, after: dict) -> dict:
         else None
     )
     return {
+        "mode": raw.get("mode", "closed-loop"),
+        "offered_rate_rps": raw.get("offered_rate_rps"),
+        "achieved_arrival_rate_rps": raw.get("achieved_arrival_rate_rps"),
         "requests": len(results),
         "request_size_range": [min(raw["sizes"]), max(raw["sizes"])],
         "wall_s": raw["wall_s"],
@@ -139,6 +236,7 @@ def summarize(raw: dict, before: dict, after: dict) -> dict:
         "server_batch_occupancy_pct": after.get("batch_occupancy_pct"),
         "server_padding_waste_pct": after.get("padding_waste_pct"),
         "server_queue_depth_final": after.get("queue_depth"),
+        "server_pipeline": after.get("pipeline"),
         "compiles_before": compiles_before,
         "compiles_after": compiles_after,
         "additional_compiles": additional,
@@ -159,7 +257,23 @@ def main(argv: list[str] | None = None) -> int:
         "seed weights; the default when --url is omitted)",
     )
     parser.add_argument("--requests", type=int, default=200)
-    parser.add_argument("--concurrency", type=int, default=8)
+    parser.add_argument(
+        "--concurrency", type=int, default=8,
+        help="closed-loop client threads; in --open-loop mode, the cap on "
+        "simultaneously outstanding requests (size it above rate x "
+        "latency — a saturated pool shows up as client-side queueing in "
+        "the latency percentiles, which are measured from the scheduled "
+        "arrival)",
+    )
+    parser.add_argument(
+        "--open-loop", action="store_true",
+        help="Poisson arrivals at --rate req/s, independent of "
+        "completions (closed-loop client threads otherwise)",
+    )
+    parser.add_argument(
+        "--rate", type=float, default=200.0,
+        help="open-loop offered arrival rate, requests/second",
+    )
     parser.add_argument(
         "--max-request", type=int, default=16,
         help="request sizes are drawn uniformly from [1, this]",
@@ -178,6 +292,31 @@ def main(argv: list[str] | None = None) -> int:
         "--queue-depth", type=int, default=64,
         help="admission bound for --self-serve mode",
     )
+    parser.add_argument(
+        "--timeout-ms", type=float, default=1000.0,
+        help="per-request server-side deadline for --self-serve mode; "
+        "raise it (with --queue-depth) for no-shed capacity A/Bs where "
+        "every request must complete",
+    )
+    parser.add_argument(
+        "--max-inflight", type=int, default=2,
+        help="in-flight window for --self-serve mode (1 = serial PR-3 "
+        "pipeline, for A/B throughput comparisons)",
+    )
+    parser.add_argument(
+        "--no-adaptive-linger", action="store_true",
+        help="pin the linger at --linger-ms in --self-serve mode",
+    )
+    parser.add_argument(
+        "--telemetry-dir", default=None,
+        help="--self-serve mode: write serving JSONL telemetry here "
+        "(summarize with tools/perf_report.py --telemetry)",
+    )
+    parser.add_argument(
+        "--prom-dump", default=None,
+        help="after the run, save the endpoint's Prometheus exposition "
+        "(/metrics?format=prom) to this file",
+    )
     parser.add_argument("--report", default="BENCH_serving.json")
     parser.add_argument(
         "--no-check-compiles", action="store_true",
@@ -186,9 +325,11 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     server = None
+    sink = None
     if args.url and not args.self_serve:
         url = args.url.rstrip("/")
     else:
+        from pytorch_mnist_ddp_tpu.obs.events import open_sink
         from pytorch_mnist_ddp_tpu.serving import InferenceEngine, ServingMetrics
         from pytorch_mnist_ddp_tpu.serving.server import make_server
 
@@ -198,30 +339,55 @@ def main(argv: list[str] | None = None) -> int:
         )
         print(f"self-serve: warming buckets {list(engine.buckets)}")
         engine.warmup()
+        sink = open_sink(args.telemetry_dir)
         server = make_server(
             engine, metrics, port=0,
             linger_ms=args.linger_ms, queue_depth=args.queue_depth,
+            timeout_ms=args.timeout_ms,
+            max_inflight=args.max_inflight,
+            adaptive_linger=not args.no_adaptive_linger,
+            sink=sink,
         )
         threading.Thread(target=server.serve_forever, daemon=True).start()
         url = f"http://127.0.0.1:{server.server_address[1]}"
-        print(f"self-serve: {url}")
+        print(
+            f"self-serve: {url} (in-flight window {args.max_inflight}, "
+            f"adaptive linger {'off' if args.no_adaptive_linger else 'on'})"
+        )
 
     try:
         _status, before = fetch_json(f"{url}/metrics")
-        print(
-            f"driving {args.requests} requests of 1..{args.max_request} "
-            f"samples at concurrency {args.concurrency}"
-        )
-        raw = run_load(
-            url, args.requests, args.concurrency, args.max_request,
-            args.seed, args.timeout_s,
-        )
+        if args.open_loop:
+            print(
+                f"driving {args.requests} open-loop Poisson arrivals of "
+                f"1..{args.max_request} samples at {args.rate:.0f} req/s"
+            )
+            raw = run_open_loop(
+                url, args.requests, args.rate, args.max_request,
+                args.seed, args.timeout_s,
+                max_workers=args.concurrency,
+            )
+        else:
+            print(
+                f"driving {args.requests} requests of 1..{args.max_request} "
+                f"samples at concurrency {args.concurrency}"
+            )
+            raw = run_load(
+                url, args.requests, args.concurrency, args.max_request,
+                args.seed, args.timeout_s,
+            )
         _status, after = fetch_json(f"{url}/metrics")
+        if args.prom_dump:
+            with open(args.prom_dump, "w") as f:
+                f.write(fetch_text(f"{url}/metrics?format=prom"))
+            print(f"prometheus exposition: {args.prom_dump}")
     finally:
         if server is not None:
             server.shutdown()
             server.batcher.stop(drain=True)
             server.server_close()
+        if sink is not None:
+            sink.close()
 
     report = summarize(raw, before, after)
     with open(args.report, "w") as f:
@@ -229,7 +395,10 @@ def main(argv: list[str] | None = None) -> int:
 
     lat = report["latency_ms"]
     print(
-        f"done in {report['wall_s']:.2f}s: "
+        f"done in {report['wall_s']:.2f}s ({report['mode']}"
+        + (f", offered {report['offered_rate_rps']:.0f} req/s"
+           if report["offered_rate_rps"] else "")
+        + "): "
         f"{report['throughput_rps']:.1f} req/s, "
         f"p50 {lat['p50']:.2f} ms / p95 {lat['p95']:.2f} ms / "
         f"p99 {lat['p99']:.2f} ms, "
