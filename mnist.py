@@ -88,6 +88,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "mode) plus a Prometheus text exposition "
                         "(metrics.prom) at end of run; stdout is unchanged "
                         "(docs/OBSERVABILITY.md)")
+    p.add_argument("--aot-cache", type=str, default=None, metavar="DIR",
+                   help="(--fused) persist the compiled run as a "
+                        "serialized AOT executable in DIR: a warm start "
+                        "deserializes instead of re-tracing + re-lowering, "
+                        "falling back to a fresh compile on any config/"
+                        "source/jax mismatch (docs/COMPILE.md)")
+    p.add_argument("--compile-cache-dir", type=str, default=None,
+                   metavar="DIR",
+                   help="persistent XLA compile-cache directory (default: "
+                        "JAX_COMPILATION_CACHE_DIR, else the utils/"
+                        "cache_dir root); naming one explicitly also "
+                        "enables the cache on the CPU backend, which is "
+                        "otherwise skipped (single-host CI use)")
+    p.add_argument("--train-limit", type=int, default=0, metavar="N",
+                   help="smoke-only: truncate train/test sets to N samples "
+                        "(exercises the full program shape in seconds; "
+                        "never a headline number — bench.py refuses to "
+                        "snapshot truncated runs)")
     return p
 
 
@@ -103,7 +121,9 @@ def main() -> None:
     from pytorch_mnist_ddp_tpu.trainer import fit
     from pytorch_mnist_ddp_tpu.utils.compile_cache import enable_persistent_cache
 
-    enable_persistent_cache()
+    enable_persistent_cache(
+        args.compile_cache_dir, force=args.compile_cache_dir is not None
+    )
 
     # Single-device semantics, like the reference mnist.py (one device, no
     # collectives); the reference saves to mnist_cnn.pt (mnist.py:133).

@@ -65,7 +65,9 @@ def main() -> None:
     from pytorch_mnist_ddp_tpu.trainer import fit
     from pytorch_mnist_ddp_tpu.utils.compile_cache import enable_persistent_cache
 
-    enable_persistent_cache()
+    enable_persistent_cache(
+        args.compile_cache_dir, force=args.compile_cache_dir is not None
+    )
 
     dist = init_distributed_mode(dist_url=args.dist_url)
     # Checkpoint filename quirk preserved: distributed saves mnist_cnn.pt,

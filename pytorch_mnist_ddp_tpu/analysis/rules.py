@@ -1,8 +1,9 @@
-"""The jaxlint rule set: JL001–JL009, the JAX hazards this repo has
+"""The jaxlint rule set: JL001–JL010, the JAX hazards this repo has
 actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, the
 serving layer's per-request-shape retrace class, the telemetry layer's
-record-at-trace-time class, and the serving pipeline's
-blocking-read-in-dispatch-loop class).
+record-at-trace-time class, the serving pipeline's
+blocking-read-in-dispatch-loop class, and the startup phase's
+serial-warmup class).
 
 Every rule is a heuristic over one module's AST — no type inference, no
 cross-file call graph.  "Traced context" below means: a function that is
@@ -1132,6 +1133,149 @@ class BlockingReadLoopRule(Rule):
                 )
 
 
+# ---------------------------------------------------------------------------
+# JL010 — serial warmup of independent compile jobs
+
+
+class SerialWarmupRule(Rule):
+    """JL010: a loop that compiles one executable per iteration, serially.
+
+    The startup-latency hazard class (docs/COMPILE.md): a warmup loop
+    that calls a jitted function once per ladder rung — or runs
+    ``.lower(...).compile()`` per iteration — pays trace+compile for N
+    independent programs ONE AT A TIME on the calling thread, when XLA
+    compilation releases the GIL and the jobs would happily build
+    concurrently.  At TPU compile times (tens of seconds per program)
+    a serial ladder turns seconds of startup into minutes.  Fan the
+    jobs out over the background compile service instead
+    (compile/service.py; the serving engine's warmup is the worked
+    example).
+
+    Heuristics (per scope, same jit-name resolution as JL009): a loop
+    iteration is a *warmup* when it (a) calls a known-jitted callable as
+    a bare expression statement — the result is discarded, so the call
+    exists only for its compile/cache side effect — or (b) compiles
+    explicitly via ``.lower(...).compile()`` (directly chained or
+    through a loop-local name).  It is flagged only when the call's
+    arguments depend on the loop variable (directly or through names
+    derived from it): distinct per-iteration arguments mean distinct
+    programs, i.e. independent jobs.  Re-running one program for
+    burn-in (``for _ in range(3): f(x)``) compiles nothing after the
+    first call and is exempt.  A deliberately serial ladder (debugging
+    compile order) is waived inline with a reason.
+    """
+
+    rule_id = "JL010"
+    severity = Severity.WARNING
+    summary = "serial per-iteration warmup compile; fan out over the compile service"
+
+    @staticmethod
+    def _names_in(node: ast.AST) -> set[str]:
+        return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+    @classmethod
+    def _args_tainted(cls, call: ast.Call, tainted: set[str]) -> bool:
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if cls._names_in(arg) & tainted:
+                return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        module_jit: set[str] = set()
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and BucketShapeRule._is_jit_value(node.value)):
+                module_jit.add(node.targets[0].id)
+        jit_attrs = BlockingReadLoopRule._jit_attr_names(ctx.tree)
+
+        scopes: list[ast.AST] = [ctx.tree] + [
+            d for d in ast.walk(ctx.tree)
+            if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            if isinstance(scope, ast.Module):
+                nodes: list[ast.AST] = []
+                stack = list(scope.body)
+                while stack:
+                    node = stack.pop()
+                    nodes.append(node)
+                    if not isinstance(node, _SCOPE_NODES):
+                        stack.extend(ast.iter_child_nodes(node))
+            else:
+                nodes = list(iter_own_body(scope))
+            jit_names = set(module_jit)
+            for node in nodes:
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and BucketShapeRule._is_jit_value(node.value)):
+                    jit_names.add(node.targets[0].id)
+            for node in nodes:
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    yield from self._check_loop(ctx, node, jit_names, jit_attrs)
+
+    def _check_loop(self, ctx, loop, jit_names, jit_attrs) -> Iterator[Finding]:
+        body = sorted(
+            iter_loop_body_nodes(loop),
+            key=lambda n: (getattr(n, "lineno", 0), getattr(n, "col_offset", 0)),
+        )
+        # Loop-variable taint: the target itself plus names assigned from
+        # expressions that reference a tainted name (x = np.zeros((b, ...))).
+        tainted = self._names_in(loop.target)
+        lower_names: set[str] = set()
+        for node in body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            if self._names_in(node.value) & tainted:
+                tainted.add(target.id)
+                value = node.value
+                if (isinstance(value, ast.Call)
+                        and isinstance(value.func, ast.Attribute)
+                        and value.func.attr == "lower"):
+                    lower_names.add(target.id)
+        for node in body:
+            # (a) discarded jit call with per-iteration arguments.
+            if (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)
+                    and BlockingReadLoopRule._is_jit_call(
+                        node.value, jit_names, jit_attrs)
+                    and self._args_tainted(node.value, tainted)):
+                yield self.finding(
+                    ctx, node.value,
+                    "jitted call discarded inside a loop with per-iteration "
+                    "arguments: a serial warmup ladder that trace+compiles "
+                    "one program per rung on this thread; submit the rungs "
+                    "to the background compile service instead "
+                    "(compile/service.py; serving/engine.py warmup)",
+                )
+                continue
+            # (b) explicit .lower(...).compile() per iteration.
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "compile"):
+                recv = node.func.value
+                chained = (
+                    isinstance(recv, ast.Call)
+                    and isinstance(recv.func, ast.Attribute)
+                    and recv.func.attr == "lower"
+                    and self._args_tainted(recv, tainted)
+                )
+                via_name = isinstance(recv, ast.Name) and recv.id in lower_names
+                if chained or via_name:
+                    yield self.finding(
+                        ctx, node,
+                        ".lower(...).compile() inside a loop builds one "
+                        "executable per iteration serially; the jobs are "
+                        "independent — run them concurrently on the "
+                        "background compile service (compile/service.py)",
+                    )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -1142,6 +1286,7 @@ ALL_RULES: tuple[Rule, ...] = (
     BucketShapeRule(),
     TelemetryUnderTraceRule(),
     BlockingReadLoopRule(),
+    SerialWarmupRule(),
 )
 
 

@@ -24,6 +24,7 @@ per-call device work.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Any, Callable
 
 
@@ -80,6 +81,11 @@ class RecompileSentinel:
         self.max_traces = max_traces
         self.name = name or getattr(fn, "__name__", repr(fn))
         self.calls = 0
+        # Parallel warmup (compile/service.py) calls the sentinel from
+        # several threads at once; the call counter and the reported-trace
+        # high-water mark are read-modify-write state, so both go under a
+        # lock or jax_compiles_total over-counts on concurrent completions.
+        self._lock = threading.Lock()
         self._compile_counter = (
             registry.counter(
                 "jax_compiles_total",
@@ -100,9 +106,13 @@ class RecompileSentinel:
         # Registry reporting happens BEFORE the bound check, so the
         # over-budget trace is on the counter even when check() raises —
         # the scrape shows what actually compiled, not what was allowed.
-        if self._compile_counter is not None and traces > self._reported_traces:
-            self._compile_counter.inc(traces - self._reported_traces)
-            self._reported_traces = traces
+        if self._compile_counter is None:
+            return
+        with self._lock:
+            delta = traces - self._reported_traces
+            if delta > 0:
+                self._compile_counter.inc(delta)
+                self._reported_traces = traces
 
     def check(self) -> None:
         """Assert the trace bound now (also runs after every call)."""
@@ -120,7 +130,8 @@ class RecompileSentinel:
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         out = self._fn(*args, **kwargs)
-        self.calls += 1
+        with self._lock:
+            self.calls += 1
         self.check()
         return out
 

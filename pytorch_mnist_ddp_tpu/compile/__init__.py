@@ -1,0 +1,34 @@
+"""compile: the startup accelerator (docs/COMPILE.md).
+
+Three capabilities, one goal — get from process start to step 0 (or to
+an open serving socket) as fast as the hardware allows:
+
+- :mod:`.service` — :class:`CompileService`, a thread pool that runs
+  ``lower().compile()`` jobs off the main thread (XLA compilation
+  releases the GIL), so independent programs — the fused run, the DDP
+  step, every serving bucket — build CONCURRENTLY.  Each job is timed
+  onto ``compile_seconds_total{fn=}`` and a ``compile`` span.
+- :mod:`.aot` — :class:`ExecutableStore`, serialized AOT executables
+  keyed by config + package-source digest + environment; a warm start
+  deserializes instead of re-tracing + re-lowering, with a hard
+  correctness gate that falls back to a fresh compile on any mismatch.
+- :mod:`.overlap` — :class:`StartupTasks`, named concurrent startup
+  jobs with a measuring rendezvous (``startup_overlap_ratio``).
+
+The service and overlap runner are stdlib-only (jobs are opaque
+callables); only the AOT store touches jax, lazily.
+"""
+
+from __future__ import annotations
+
+from .aot import ExecutableStore, source_digest
+from .overlap import StartupTasks
+from .service import CompileJob, CompileService
+
+__all__ = [
+    "CompileJob",
+    "CompileService",
+    "ExecutableStore",
+    "StartupTasks",
+    "source_digest",
+]

@@ -1,0 +1,232 @@
+"""Serialized AOT executables: warm starts skip trace + lower entirely.
+
+The persistent XLA cache (utils/compile_cache.py) removes the *compile*
+from a warm start but still pays trace + lower every run — Python work
+that for the fused whole-run program is seconds of pure startup.  This
+store persists the COMPILED executable itself
+(``jax.experimental.serialize_executable``), keyed so a warm start goes
+disk → executable with no tracing at all.
+
+Keying — the round-1 postmortem class ("a last-minute RNG flip silently
+invalidated the warm cache") is the hazard, so the key must change
+whenever the program could:
+
+- a **config key**: every argument that parameterizes the program
+  (protocol sizes, flags, arg avals — the caller provides the dict), so
+  two configs never alias;
+- a **source digest** over every ``.py`` file in this package — any
+  commit that touches the model/step/fused code invalidates every entry
+  (the same conservatism as hashing the StableHLO, per
+  tools/bench_program_hash.py, but computable WITHOUT tracing — which
+  is the whole point);
+- the environment: jax version, backend platform, device kind, device
+  count.
+
+Each entry also stores that metadata in its header, verified again at
+load (belt and suspenders): ANY mismatch, unpickling error, or
+deserialization failure falls back to a fresh trace + compile and
+rewrites the entry — the store is an optimization, never a correctness
+surface.  Outcomes land on ``aot_executables_total{outcome=hit|miss|
+fallback}`` and as ``aot_executable`` JSONL events.
+
+Trust model: entries are pickles (``jax.experimental.
+serialize_executable`` is pickle-based end to end), and unpickling
+attacker-controlled bytes executes code — the header gate runs AFTER
+the unpickle and cannot protect against a hostile file.  Point
+``--aot-cache`` only at a directory you own (the store creates missing
+directories mode 0700); never at a shared world-writable location on a
+multi-user host.  Same trust boundary as jax's own persistent compile
+cache directory.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+
+_FORMAT = 1
+
+_source_digest_cache: str | None = None
+
+
+def source_digest() -> str:
+    """SHA-256 over every ``.py`` file of this package (sorted relative
+    paths + contents).  Cached per process — the tree does not change
+    under a running program."""
+    global _source_digest_cache
+    if _source_digest_cache is not None:
+        return _source_digest_cache
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths: list[str] = []
+    for dirpath, _dirnames, filenames in os.walk(pkg_root):
+        paths.extend(
+            os.path.join(dirpath, f) for f in filenames if f.endswith(".py")
+        )
+    digest = hashlib.sha256()
+    for path in sorted(paths):
+        digest.update(os.path.relpath(path, pkg_root).encode())
+        with open(path, "rb") as f:
+            digest.update(f.read())
+    _source_digest_cache = digest.hexdigest()
+    return _source_digest_cache
+
+
+def _environment() -> dict:
+    import jax
+
+    devices = jax.devices()
+    return {
+        "jax_version": jax.__version__,
+        "platform": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+        "num_devices": len(devices),
+    }
+
+
+class ExecutableStore:
+    """Directory of serialized executables, one file per program key.
+
+    ``load_or_compile(name, config, build_compiled)`` is the whole API:
+    ``build_compiled()`` must return a ``jax.stages.Compiled`` (i.e. the
+    caller's ``fn.lower(*args).compile()``); the store either
+    deserializes a prior run's executable for the same key ("hit") or
+    builds fresh and persists ("miss"; "fallback" when an entry existed
+    but failed its gate).
+    """
+
+    MAX_ENTRIES = 8  # newest kept; key churn (source edits) orphans the rest
+
+    def __init__(self, directory: str, registry=None, sink=None):
+        self.directory = directory
+        self._registry = registry
+        self._sink = sink
+        # 0700 on creation: entries are pickles (see the module trust
+        # model); a directory this process creates must not be writable
+        # — or readable — by other users.  Pre-existing directories keep
+        # their modes (the operator owns that decision).
+        os.makedirs(directory, mode=0o700, exist_ok=True)
+
+    # -- keying ---------------------------------------------------------------
+
+    def key_for(self, config: dict) -> str:
+        """Deterministic key: config + source digest + environment."""
+        material = {
+            "format": _FORMAT,
+            "config": config,
+            "source_digest": source_digest(),
+            **_environment(),
+        }
+        blob = json.dumps(material, sort_keys=True, default=str)
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.directory, f"{key}.jexec")
+
+    def _record(self, name: str, outcome: str, seconds: float) -> None:
+        if self._registry is not None:
+            self._registry.counter(
+                "aot_executables_total",
+                help="serialized-executable store outcomes per load_or_compile",
+                outcome=outcome,
+            ).inc()
+        if self._sink is not None:
+            self._sink.emit(
+                "aot_executable", fn=name, outcome=outcome, seconds=seconds
+            )
+
+    # -- the API --------------------------------------------------------------
+
+    def load_or_compile(self, name: str, config: dict, build_compiled):
+        """Return ``(compiled, outcome)``; outcome ∈ hit/miss/fallback.
+
+        A "hit" produced zero traces this process; the returned
+        executable is bit-identical in behavior to a fresh compile of
+        the same program (pinned by test).  Any problem with the stored
+        entry — missing, wrong header, undeserializable — silently
+        becomes a fresh compile whose result replaces the entry.
+        """
+        import time
+
+        t0 = time.perf_counter()
+        key = self.key_for(config)
+        path = self._path(key)
+        outcome = "miss"
+        if os.path.exists(path):
+            try:
+                compiled = self._load(path, key)
+                self._record(name, "hit", time.perf_counter() - t0)
+                return compiled, "hit"
+            except Exception:
+                # Stale jax, different machine features, torn write,
+                # tampered header: all one answer — recompile.
+                outcome = "fallback"
+        compiled = build_compiled()
+        try:
+            self._save(path, key, compiled)
+            self._prune()
+        except Exception:
+            # Not serializable on this backend / unwritable directory:
+            # the fresh executable is still perfectly usable.
+            pass
+        self._record(name, outcome, time.perf_counter() - t0)
+        return compiled, outcome
+
+    # -- disk format ----------------------------------------------------------
+
+    def _save(self, path: str, key: str, compiled) -> None:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        entry = {
+            "format": _FORMAT,
+            "key": key,
+            **_environment(),
+            "payload": payload,
+            "in_tree": in_tree,
+            "out_tree": out_tree,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(entry, f)
+        os.replace(tmp, path)  # atomic: a concurrent reader never sees a torn file
+
+    def _prune(self) -> None:
+        """Keep the newest :attr:`MAX_ENTRIES` entries.  Key churn —
+        every source edit changes the digest, every config tweak the
+        key — orphans the previous multi-megabyte executable; without
+        a bound, an iterating developer's cache grows one serialized
+        program per edit, forever."""
+        entries = []
+        for fname in os.listdir(self.directory):
+            if not fname.endswith(".jexec"):
+                continue
+            full = os.path.join(self.directory, fname)
+            try:
+                entries.append((os.path.getmtime(full), full))
+            except OSError:
+                continue
+        entries.sort(reverse=True)
+        for _, full in entries[self.MAX_ENTRIES:]:
+            try:
+                os.remove(full)
+            except OSError:
+                pass
+
+    def _load(self, path: str, key: str):
+        from jax.experimental.serialize_executable import deserialize_and_load
+
+        with open(path, "rb") as f:
+            entry = pickle.load(f)
+        env = _environment()
+        expected = {"format": _FORMAT, "key": key, **env}
+        for field, want in expected.items():
+            if entry.get(field) != want:
+                raise ValueError(
+                    f"aot entry {os.path.basename(path)} gate mismatch on "
+                    f"{field!r}: stored {entry.get(field)!r}, need {want!r}"
+                )
+        return deserialize_and_load(
+            entry["payload"], entry["in_tree"], entry["out_tree"]
+        )

@@ -1,0 +1,152 @@
+"""Background compile service: a small thread pool for `(trace, lower,
+compile)` work, so startup programs build CONCURRENTLY instead of one at
+a time.
+
+Why threads work here: XLA compilation releases the GIL for the long
+middle of the job (the C++ compiler), and jax's dispatch/trace caches
+are thread-safe, so N independent programs — the fused train run, the
+DDP step, every serving bucket — compile in parallel on a multi-core
+host while the main thread keeps doing startup work (dataset H2D,
+checkpoint restore).  Tracing itself is Python-under-GIL, but it is the
+short prefix of each job; the wall-clock win is the compile overlap, and
+the structural test pins it with a GIL-releasing fake compiler
+(tests/test_compile.py).
+
+This module is deliberately jax-free (stdlib only): jobs are opaque
+callables, so the fake-compiler tests exercise the real scheduling
+machinery, and importing the service never pays a device-init cost —
+the same contract as obs/ and analysis/engine.py.
+
+Every job is timed and reported:
+
+- ``compile_seconds_total{fn=<name>}`` — registry counter accumulating
+  wall seconds per named program (the CI startup smoke asserts this
+  DROPS between a cold and a warm run);
+- a ``compile`` span (obs/spans) with the job name as the ``fn`` field,
+  so JSONL telemetry reconstructs what compiled when, and for how long.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable
+
+from ..obs.spans import span
+
+
+class CompileJob:
+    """Handle to one submitted job; ``result()`` blocks and re-raises."""
+
+    __slots__ = ("name", "_future")
+
+    def __init__(self, name: str, future: Future):
+        self.name = name
+        self._future = future
+
+    def result(self, timeout: float | None = None) -> Any:
+        return self._future.result(timeout)
+
+    def done(self) -> bool:
+        return self._future.done()
+
+
+class CompileService:
+    """Run compile jobs off the main thread, several at a time.
+
+    Parameters
+    ----------
+    max_workers:
+        Concurrent jobs; defaults to ``min(8, cpu_count)``.  Compilation
+        is CPU-bound in the XLA backend, so more workers than cores only
+        adds contention.
+    registry:
+        Optional obs registry: each job's wall time lands on
+        ``compile_seconds_total{fn=name}``.
+    sink:
+        Optional obs event sink: each job runs inside a ``compile`` span
+        (start/end JSONL events carrying ``fn=name``).
+
+    Thread-safety contract for jax jobs: concurrent ``jit`` calls (and
+    ``lower().compile()``) with DISTINCT signatures are safe and compile
+    in parallel; submitting the same (fn, shape) twice concurrently is
+    merely wasteful, not wrong (jax dedupes on its own cache).  The
+    service never imports jax — callers close over it.
+    """
+
+    def __init__(self, max_workers: int | None = None, registry=None, sink=None):
+        if max_workers is None:
+            import os
+
+            max_workers = min(8, max(2, os.cpu_count() or 1))
+        if max_workers < 1:
+            raise ValueError(f"max_workers must be >= 1, got {max_workers}")
+        self.max_workers = max_workers
+        self._registry = registry
+        self._sink = sink
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="compile"
+        )
+        self._lock = threading.Lock()
+        self._jobs: list[CompileJob] = []
+
+    # -- submission -----------------------------------------------------------
+
+    def submit(
+        self,
+        name: str,
+        fn: Callable[..., Any],
+        *args,
+        kind: str = "compile",
+        **kwargs,
+    ) -> CompileJob:
+        """Queue ``fn(*args, **kwargs)`` under the label ``name``.
+
+        The label is the telemetry identity (``compile_seconds_total{fn=
+        name}``, the span's ``fn`` field); keep it stable across runs so
+        cold/warm comparisons line up.  ``kind`` is the span name and
+        defaults to ``compile``; non-compile startup work sharing the
+        pool (checkpoint restore, H2D rendezvous) passes e.g.
+        ``kind="startup_task"`` so it never pollutes the compile
+        counter.
+        """
+
+        def run():
+            import time
+
+            t0 = time.perf_counter()
+            with span(kind, sink=self._sink, registry=self._registry,
+                      fn=name):
+                out = fn(*args, **kwargs)
+            if kind == "compile" and self._registry is not None:
+                self._registry.counter(
+                    "compile_seconds_total",
+                    help="wall seconds spent building executables, per program",
+                    fn=name,
+                ).inc(time.perf_counter() - t0)
+            return out
+
+        job = CompileJob(name, self._pool.submit(run))
+        with self._lock:
+            self._jobs.append(job)
+        return job
+
+    # -- rendezvous -----------------------------------------------------------
+
+    def wait_all(self, timeout: float | None = None) -> list[Any]:
+        """Block until every job submitted so far finishes; results in
+        submission order.  The first job error re-raises here (later
+        jobs still run to completion — the pool is not cancelled, so a
+        failed startup reports the FIRST cause, not a cascade)."""
+        with self._lock:
+            jobs = list(self._jobs)
+        return [j.result(timeout) for j in jobs]
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._pool.shutdown(wait=wait)
+
+    def __enter__(self) -> "CompileService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(wait=True)

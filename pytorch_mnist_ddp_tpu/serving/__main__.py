@@ -100,12 +100,20 @@ def build_parser() -> argparse.ArgumentParser:
         "--cache-dir", default=None,
         help="persistent XLA compile cache directory (default: the "
         "JAX_COMPILATION_CACHE_DIR env var, else the utils/cache_dir "
-        "root)",
+        "root); naming one explicitly also enables the cache on the CPU "
+        "backend, which is otherwise skipped — same operator-intent "
+        "semantics as the trainer CLIs' --compile-cache-dir",
     )
     parser.add_argument(
         "--warmup-only", action="store_true",
         help="compile + verify every bucket, print the sentinel report, "
         "exit without opening the HTTP socket",
+    )
+    parser.add_argument(
+        "--serial-warmup", action="store_true",
+        help="warm the bucket ladder one rung at a time instead of "
+        "fanning all buckets out over the background compile service "
+        "(docs/COMPILE.md); deterministic compile order, slower startup",
     )
     return parser
 
@@ -116,7 +124,9 @@ def main(argv: list[str] | None = None) -> int:
     # Satellite wiring: the cache must be configured before the first jit
     # compile or the warmup programs miss it.  Log the directory actually
     # in use — "it should be cached" bugs are undebuggable without it.
-    cache_dir = enable_persistent_cache(args.cache_dir)
+    cache_dir = enable_persistent_cache(
+        args.cache_dir, force=args.cache_dir is not None
+    )
     if cache_dir:
         print(f"persistent compile cache: {cache_dir}")
     else:
@@ -151,29 +161,38 @@ def main(argv: list[str] | None = None) -> int:
         )
         engine = InferenceEngine.from_seed(args.seed, **engine_kwargs)
 
+    from ..obs.events import open_sink
+    from ..obs.spans import span
+
+    sink = open_sink(args.telemetry_dir)
+    if sink:
+        print(f"serving telemetry: {sink.path}")
+
     print(
-        f"warming buckets {list(engine.buckets)} on a "
+        f"warming buckets {list(engine.buckets)} "
+        f"{'serially' if args.serial_warmup else 'concurrently'} on a "
         f"{engine.mesh.devices.size}-device mesh"
         + (" (BatchNorm checkpoint)" if engine.use_bn else "")
     )
-    engine.warmup(
-        on_bucket=lambda bucket, traces: print(
-            f"  bucket {bucket:4d}: compiled (trace {traces})", flush=True
+    # The warmup span + the compile service's per-bucket compile spans
+    # land in the JSONL telemetry (and span_duration_seconds on the
+    # registry /metrics serves), so cold-start cost is observable.
+    with span("warmup", sink=sink, registry=metrics.registry):
+        engine.warmup(
+            on_bucket=lambda bucket, traces: print(
+                f"  bucket {bucket:4d}: compiled (trace {traces})", flush=True
+            ),
+            parallel=not args.serial_warmup,
+            sink=sink,
         )
-    )
     print(
         f"warmup verified: {engine.compile_count()} traces for "
         f"{len(engine.buckets)} buckets, second pass hit the cache "
         "(sentinel-enforced)"
     )
     if args.warmup_only:
+        sink.close()
         return 0
-
-    from ..obs.events import open_sink
-
-    sink = open_sink(args.telemetry_dir)
-    if sink:
-        print(f"serving telemetry: {sink.path}")
     server = make_server(
         engine,
         metrics,

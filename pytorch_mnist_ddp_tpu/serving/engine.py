@@ -142,29 +142,72 @@ class InferenceEngine:
         warmup has run; the /metrics ``compiles`` field)."""
         return self._predict.trace_count()
 
-    def warmup(self, on_bucket=None) -> list[tuple[int, int]]:
+    def warmup(
+        self,
+        on_bucket=None,
+        parallel: bool = True,
+        max_workers: int | None = None,
+        sink=None,
+    ) -> list[tuple[int, int]]:
         """Compile every bucket exactly once; verify the second pass hits.
 
-        Returns ``[(bucket, cumulative_trace_count), ...]`` — strictly
-        counting up by one per bucket on a healthy engine, which the
-        ``--warmup-only`` CLI prints as its sentinel-verified evidence.
-        ``on_bucket(bucket, traces)`` fires as each bucket finishes
-        compiling, so callers can report progress DURING the slow phase
-        (a TPU ladder is tens of seconds per rung; silence until the end
-        reads as a hang).  A second sweep over the ladder must add zero
+        ``parallel=True`` (the default) fans the ladder out over a
+        :class:`~..compile.CompileService` thread pool: XLA compilation
+        releases the GIL and jit's caches are thread-safe, so N buckets
+        compile in the wall time of the slowest one instead of the sum —
+        the startup win the fake-compiler structural test pins
+        (tests/test_compile.py).  The RecompileSentinel budget is
+        untouched: concurrent or not, warmup produces exactly
+        ``len(buckets)`` traces, and the serial verification sweep below
+        proves every rung is a cache hit afterwards.
+
+        Returns ``[(bucket, cumulative_trace_count), ...]`` in ladder
+        order.  Serially the counts step up one per rung; under parallel
+        warmup each entry records the trace count observed when THAT
+        bucket finished (concurrent completions may see later counts) —
+        monotonicity per rung is no longer meaningful, the invariant is
+        the final count.  ``on_bucket(bucket, traces)`` fires as each
+        bucket finishes compiling — from worker threads in parallel mode
+        — so callers can report progress DURING the slow phase (a TPU
+        ladder is tens of seconds per rung; silence until the end reads
+        as a hang).  A second sweep over the ladder must add zero
         traces; the sentinel raises otherwise, and a final count check
         catches the inverse failure (two buckets aliasing to one
         executable would silently under-warm).
+
+        ``sink`` (obs event sink) receives the per-bucket ``compile``
+        spans from the service, so JSONL telemetry shows which rung took
+        how long (`tools/perf_report.py --telemetry` "startup compiles").
         """
-        report: list[tuple[int, int]] = []
-        for b in self.buckets:
-            x = np.zeros((b, *INPUT_SHAPE), np.float32)
-            self._predict(self._variables, x)
-            report.append((b, self._predict.trace_count()))
-            if on_bucket is not None:
-                on_bucket(b, self._predict.trace_count())
-        for b in self.buckets:
+        registry = self.metrics.registry if self.metrics is not None else None
+        done: dict[int, int] = {}
+
+        def warm_one(b: int) -> None:
             self._predict(self._variables, np.zeros((b, *INPUT_SHAPE), np.float32))
+            traces = self._predict.trace_count()
+            done[b] = traces
+            if on_bucket is not None:
+                on_bucket(b, traces)
+
+        if parallel and len(self.buckets) > 1:
+            from ..compile import CompileService
+
+            with CompileService(
+                max_workers=min(len(self.buckets), max_workers or 8),
+                registry=registry,
+                sink=sink,
+            ) as svc:
+                for b in self.buckets:
+                    svc.submit(f"predict_step[{b}]", warm_one, b)
+                svc.wait_all()
+        else:
+            # The opt-in serial fallback (parallel=False): deterministic
+            # rung-by-rung compile order for debugging ladder issues.
+            for b in self.buckets:
+                warm_one(b)
+        report = [(b, done[b]) for b in self.buckets]
+        for b in self.buckets:
+            self._predict(self._variables, np.zeros((b, *INPUT_SHAPE), np.float32))  # jaxlint: disable=JL010 -- verification sweep, not warmup: every call here MUST be a cache hit (the sentinel raises otherwise), so there is nothing to parallelize
         if self._predict.trace_count() != len(self.buckets):
             raise RecompileError(
                 f"warmup traced {self._predict.trace_count()} executables "

@@ -15,12 +15,20 @@ import os
 from .cache_dir import cache_root
 
 
-def enable_persistent_cache(path: str | None = None) -> str | None:
+def enable_persistent_cache(
+    path: str | None = None, force: bool = False
+) -> str | None:
     """Turn on the persistent compilation cache (idempotent).  Returns the
     cache directory in use, or None when the cache can't be set up (e.g.
     read-only home) — the cache is an optimization, never a startup
     requirement.  Must be called before the first jit compile to benefit
-    that compile; safe to call any time."""
+    that compile; safe to call any time.
+
+    ``force=True`` skips the CPU-platform gate below — the escape hatch
+    for single-host CPU CI (the startup smoke job) and local cache
+    experiments, where the cross-host SIGILL hazard the gate exists for
+    cannot occur.  The trainer CLIs pass it when ``--compile-cache-dir``
+    is given explicitly: naming a directory is operator intent."""
     import jax
 
     cache_dir = (
@@ -41,7 +49,7 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
             or getattr(jax.config, "jax_platforms", None)
             or ""
         )
-        if platforms.split(",")[0].strip().lower() == "cpu":
+        if not force and platforms.split(",")[0].strip().lower() == "cpu":
             return None
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -889,6 +889,142 @@ def test_jl009_waiver():
 
 
 # ---------------------------------------------------------------------------
+# JL010 — serial per-iteration warmup of independent compile jobs
+
+
+JL010_BAD_LADDER = """\
+import numpy as np
+import jax
+
+predict = jax.jit(lambda p, x: x)
+
+def warmup(params, buckets):
+    for b in buckets:
+        x = np.zeros((b, 28), np.float32)
+        predict(params, x)
+"""
+
+JL010_BAD_LOWER_COMPILE = """\
+import numpy as np
+
+def aot_warmup(fn, buckets):
+    outs = []
+    for b in buckets:
+        outs.append(fn.lower(np.zeros((b, 28))).compile())
+    return outs
+"""
+
+JL010_BAD_TWO_STEP_LOWER = """\
+import numpy as np
+
+def aot_warmup(fn, buckets):
+    outs = []
+    for b in buckets:
+        lowered = fn.lower(np.zeros((b, 28)))
+        outs.append(lowered.compile())
+    return outs
+"""
+
+JL010_GOOD_BURN_IN = """\
+import jax
+
+predict = jax.jit(lambda p, x: x)
+
+def burn_in(params, x):
+    for _ in range(3):
+        predict(params, x)
+"""
+
+JL010_GOOD_FAN_OUT = """\
+import numpy as np
+import jax
+
+predict = jax.jit(lambda p, x: x)
+
+def warmup(params, buckets, svc):
+    jobs = [
+        svc.submit(str(b), lambda b=b: predict(params, np.zeros((b, 28))))
+        for b in buckets
+    ]
+    for job in jobs:
+        job.result()
+"""
+
+JL010_GOOD_RESULT_USED = """\
+import jax
+
+predict = jax.jit(lambda p, x: x)
+
+def serve(params, batches):
+    outs = []
+    for b in batches:
+        logits = predict(params, b)
+        outs.append(logits)
+    return outs
+"""
+
+
+def test_jl010_fires_on_serial_bucket_ladder():
+    assert_fires(JL010_BAD_LADDER, "JL010", line=9)
+
+
+def test_jl010_fires_on_lower_compile_in_loop():
+    assert_fires(JL010_BAD_LOWER_COMPILE, "JL010", line=6)
+
+
+def test_jl010_fires_on_two_step_lower_compile():
+    assert_fires(JL010_BAD_TWO_STEP_LOWER, "JL010", line=7)
+
+
+def test_jl010_tracks_sentinel_wrapped_attributes():
+    # The engine shape: the sentinel-wrapped jitted forward warmed one
+    # bucket at a time from self._predict.
+    assert_fires(
+        """\
+import numpy as np
+import jax
+from pytorch_mnist_ddp_tpu.analysis import RecompileSentinel
+
+class Engine:
+    def __init__(self, fn):
+        self._predict = RecompileSentinel(jax.jit(fn), max_traces=4)
+
+    def warmup(self, params, buckets):
+        for b in buckets:
+            self._predict(params, np.zeros((b, 28)))
+""",
+        "JL010",
+        line=11,
+    )
+
+
+def test_jl010_silent_on_same_shape_burn_in():
+    # Re-running ONE program compiles nothing after the first call — a
+    # burn-in loop is not a compile ladder.
+    assert_silent(JL010_GOOD_BURN_IN, "JL010")
+
+
+def test_jl010_silent_on_fan_out():
+    # The fix shape: rungs submitted to the background compile service;
+    # the jit call lives in a nested scope, the loop only joins.
+    assert_silent(JL010_GOOD_FAN_OUT, "JL010")
+
+
+def test_jl010_silent_when_result_is_used():
+    # A dispatch loop that CONSUMES its outputs is serving, not warmup
+    # (JL009's territory when it also reads inline).
+    assert_silent(JL010_GOOD_RESULT_USED, "JL010")
+
+
+def test_jl010_waiver():
+    waived = JL010_BAD_LADDER.replace(
+        "predict(params, x)",
+        "predict(params, x)  # jaxlint: disable=JL010 -- deterministic rung order while debugging ladder aliasing",
+    )
+    assert_silent(waived, "JL010")
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

@@ -285,6 +285,47 @@ def summarize_telemetry(directory: str) -> str | None:
             for name, ds in sorted(by_span.items())
         )
         lines.append(f"  spans: {rendered}")
+    # Startup section (docs/COMPILE.md): per-program compile durations
+    # (the compile service's spans), the measured overlap win, and the
+    # serialized-executable store's hit/miss/fallback tallies — the
+    # operator's view of what a cold vs warm start actually paid.
+    compiles = [
+        e for e in events
+        if e.get("event") == "span_end" and e.get("span") == "compile"
+    ]
+    if compiles:
+        by_fn: dict[str, list[float]] = {}
+        for e in compiles:
+            by_fn.setdefault(e.get("fn", "?"), []).append(
+                e.get("duration_s", 0.0)
+            )
+        rendered = ", ".join(
+            f"{fn} x{len(ds)} ({sum(ds):.2f} s)"
+            for fn, ds in sorted(by_fn.items())
+        )
+        lines.append(f"  startup compiles: {rendered}")
+    overlaps = [e for e in events if e.get("event") == "startup_overlap"]
+    if overlaps:
+        last = overlaps[-1]
+        tasks = last.get("tasks") or {}
+        rendered = ", ".join(
+            f"{name} {dur:.2f} s" for name, dur in sorted(tasks.items())
+        )
+        lines.append(
+            f"  startup overlap: ratio {last.get('overlap_ratio', 0.0):.2f} "
+            f"(wall {last.get('wall_s', 0.0):.2f} s; {rendered})"
+        )
+    aots = [e for e in events if e.get("event") == "aot_executable"]
+    if aots:
+        counts: dict[str, int] = {}
+        for e in aots:
+            counts[e.get("outcome", "?")] = counts.get(e.get("outcome", "?"), 0) + 1
+        lines.append(
+            "  aot executables: "
+            + ", ".join(
+                f"{counts.get(k, 0)} {k}" for k in ("hit", "miss", "fallback")
+            )
+        )
     # Serving pipeline telemetry (serving/batcher.py under --telemetry-dir):
     # per-request latency plus per-batch fill/stall — the operator's view
     # of how well the in-flight window is overlapping.
