@@ -101,6 +101,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "cache_dir root); naming one explicitly also "
                         "enables the cache on the CPU backend, which is "
                         "otherwise skipped (single-host CI use)")
+    p.add_argument("--prefetch-depth", type=int, default=2, metavar="N",
+                   help="device-resident input batches kept in flight "
+                        "ahead of the step loop (per-batch path; "
+                        "data/prefetch.py): 2 double-buffers the next "
+                        "shard's H2D under the current step, 0 restores "
+                        "the synchronous serial feed — batches (and all "
+                        "printed output) are bit-identical either way. "
+                        "The --fused path keeps the whole dataset "
+                        "HBM-resident, so the flag is a no-op there "
+                        "(docs/DATA.md)")
     p.add_argument("--train-limit", type=int, default=0, metavar="N",
                    help="smoke-only: truncate train/test sets to N samples "
                         "(exercises the full program shape in seconds; "

@@ -1,9 +1,9 @@
-"""The jaxlint rule set: JL001–JL010, the JAX hazards this repo has
+"""The jaxlint rule set: JL001–JL011, the JAX hazards this repo has
 actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, the
 serving layer's per-request-shape retrace class, the telemetry layer's
 record-at-trace-time class, the serving pipeline's
-blocking-read-in-dispatch-loop class, and the startup phase's
-serial-warmup class).
+blocking-read-in-dispatch-loop class, the startup phase's serial-warmup
+class, and the steady-state input pipeline's host-blocking-feed class).
 
 Every rule is a heuristic over one module's AST — no type inference, no
 cross-file call graph.  "Traced context" below means: a function that is
@@ -1276,6 +1276,155 @@ class SerialWarmupRule(Rule):
                     )
 
 
+# ---------------------------------------------------------------------------
+# JL011 — host-blocking data feeds between jitted step calls
+
+
+_FEED_CALLS = {"next"} | _NP_HOST_CALLS
+
+
+class HostBlockingFeedRule(Rule):
+    """JL011: the next batch materialized on the critical path between
+    two jitted step calls, with no prefetch wrapper in scope.
+
+    The steady-state input hazard class (docs/DATA.md): a training loop
+    shaped ``x = np.asarray(next(it)); state = step(state, x)`` pays the
+    whole assemble + H2D cost INSIDE the gap between step k's dispatch
+    and step k+1's — the device idles exactly that long every iteration
+    (BENCH_r05's missing third of wall clock).  The fix is a prefetch
+    wrapper (data/prefetch.DevicePrefetcher, or DataLoader.epoch which
+    wraps it): batch k+1 assembles and starts its transfer on a
+    background thread while step k runs, so the loop's per-batch cost
+    collapses to a buffer swap.
+
+    Heuristics (per scope, same jit-name resolution as JL009/JL010): a
+    loop iteration is a *blocking feed* when its body (a) calls a
+    known-jitted callable AND (b) materializes host data via ``next(...)``
+    or ``np.asarray``/``np.array`` whose result flows into that jitted
+    call's arguments — directly, or through a name assigned in the same
+    loop body.  Feeds whose source expression mentions a prefetch
+    wrapper (any name containing ``prefetch``) are exempt: that is the
+    sanctioned hand-off point, and ``next()`` on a prefetcher is a
+    buffer swap, not a materialization.  ``np.asarray`` on a jit OUTPUT
+    is JL009's territory, not this rule's (it only fires on the input
+    side).  A deliberately serial feed (a benchmark timing the
+    end-to-end chain) is waived inline with a reason.
+    """
+
+    rule_id = "JL011"
+    severity = Severity.WARNING
+    summary = "host-blocking data feed between jitted step calls; prefetch it"
+
+    @staticmethod
+    def _mentions_prefetch(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and "prefetch" in sub.id.lower():
+                return True
+            if isinstance(sub, ast.Attribute) and "prefetch" in sub.attr.lower():
+                return True
+        return False
+
+    @classmethod
+    def _feed_call(cls, node: ast.AST) -> ast.Call | None:
+        """The first next()/np.asarray materialization inside ``node``,
+        skipping prefetch-wrapped sources."""
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name in _FEED_CALLS and not cls._mentions_prefetch(node):
+                return node
+        for child in ast.iter_child_nodes(node):
+            hit = cls._feed_call(child)
+            if hit is not None:
+                return hit
+        return None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        module_jit: set[str] = set()
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and BucketShapeRule._is_jit_value(node.value)):
+                module_jit.add(node.targets[0].id)
+        jit_attrs = BlockingReadLoopRule._jit_attr_names(ctx.tree)
+
+        scopes: list[ast.AST] = [ctx.tree] + [
+            d for d in ast.walk(ctx.tree)
+            if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            if isinstance(scope, ast.Module):
+                nodes: list[ast.AST] = []
+                stack = list(scope.body)
+                while stack:
+                    node = stack.pop()
+                    nodes.append(node)
+                    if not isinstance(node, _SCOPE_NODES):
+                        stack.extend(ast.iter_child_nodes(node))
+            else:
+                nodes = list(iter_own_body(scope))
+            jit_names = set(module_jit)
+            for node in nodes:
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and BucketShapeRule._is_jit_value(node.value)):
+                    jit_names.add(node.targets[0].id)
+            for node in nodes:
+                if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                    yield from self._check_loop(ctx, node, jit_names, jit_attrs)
+
+    def _check_loop(self, ctx, loop, jit_names, jit_attrs) -> Iterator[Finding]:
+        body = sorted(
+            iter_loop_body_nodes(loop),
+            key=lambda n: (getattr(n, "lineno", 0), getattr(n, "col_offset", 0)),
+        )
+        jit_calls = [
+            n for n in body
+            if BlockingReadLoopRule._is_jit_call(n, jit_names, jit_attrs)
+        ]
+        if not jit_calls:
+            return
+        # Names bound in this loop body from a materializing feed call,
+        # with the feed node kept as the finding's anchor.
+        feed_names: dict[str, ast.Call] = {}
+        for node in body:
+            if not isinstance(node, ast.Assign):
+                continue
+            feed = self._feed_call(node.value)
+            if feed is None:
+                continue
+            for target in node.targets:
+                for sub in ast.walk(target):
+                    if isinstance(sub, ast.Name):
+                        feed_names[sub.id] = feed
+        reported: set[int] = set()
+        for call in jit_calls:
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                feed = self._feed_call(arg)
+                if feed is None:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name) and sub.id in feed_names:
+                            feed = feed_names[sub.id]
+                            break
+                if feed is None:
+                    continue
+                anchor = getattr(feed, "lineno", 0)
+                if anchor in reported:
+                    continue
+                reported.add(anchor)
+                yield self.finding(
+                    ctx, feed,
+                    f"{dotted_name(feed.func)}(...) materializes the next "
+                    "batch on the critical path between jitted step calls: "
+                    "the device idles through the whole assemble+transfer "
+                    "every iteration; wrap the iterator in a prefetcher "
+                    "(data/prefetch.DevicePrefetcher) so batch k+1 stages "
+                    "while step k runs",
+                )
+                break
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -1287,6 +1436,7 @@ ALL_RULES: tuple[Rule, ...] = (
     TelemetryUnderTraceRule(),
     BlockingReadLoopRule(),
     SerialWarmupRule(),
+    HostBlockingFeedRule(),
 )
 
 

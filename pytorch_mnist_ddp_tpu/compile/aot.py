@@ -98,10 +98,23 @@ class ExecutableStore:
 
     MAX_ENTRIES = 8  # newest kept; key churn (source edits) orphans the rest
 
-    def __init__(self, directory: str, registry=None, sink=None):
+    def __init__(
+        self,
+        directory: str,
+        registry=None,
+        sink=None,
+        max_entries: int | None = None,
+    ):
         self.directory = directory
         self._registry = registry
         self._sink = sink
+        if max_entries is not None:
+            # Per-store override: a serving engine persists one entry per
+            # (dtype, bucket) rung and must hold the WHOLE grid — pruning
+            # mid-warmup entries would silently re-miss on warm start.
+            if max_entries < 1:
+                raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+            self.MAX_ENTRIES = max_entries
         # 0700 on creation: entries are pickles (see the module trust
         # model); a directory this process creates must not be writable
         # — or readable — by other users.  Pre-existing directories keep

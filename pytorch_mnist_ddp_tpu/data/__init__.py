@@ -1,3 +1,4 @@
 from .mnist import MNIST, load_mnist_arrays
 from .transforms import normalize, MNIST_MEAN, MNIST_STD
 from .loader import DataLoader
+from .prefetch import DevicePrefetcher

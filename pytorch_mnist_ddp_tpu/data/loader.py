@@ -24,8 +24,6 @@ subprocesses feeding pinned staging buffers (reference mnist_ddp.py:146-151,
 
 from __future__ import annotations
 
-import queue
-import threading
 from typing import Iterator
 
 import jax
@@ -34,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.sampler import epoch_indices, per_rank_count
 from . import native
+from .prefetch import DevicePrefetcher
 from .transforms import MNIST_MEAN, MNIST_STD, normalize
 from ..parallel.mesh import DATA_AXIS
 
@@ -63,6 +62,9 @@ class DataLoader:
         prefetch_depth: int = 2,
         device_place: bool = True,
         mask_padding: bool = False,
+        registry=None,
+        sink=None,
+        pipeline: str = "train",
     ) -> None:
         if global_batch % process_count:
             raise ValueError(
@@ -85,6 +87,12 @@ class DataLoader:
         # keeps duplicates live like torch's DistributedSampler).
         self.mask_padding = mask_padding
         self.prefetch_depth = prefetch_depth
+        # Steady-state observability (data/prefetch.py): optional obs
+        # registry + JSONL sink for the data_wait_seconds /
+        # prefetch_buffer_occupancy family and per-epoch summary events.
+        self.registry = registry
+        self.sink = sink
+        self.pipeline = pipeline
         self.device_place = device_place and mesh is not None
         if self.device_place:
             n_shards = mesh.shape[DATA_AXIS]
@@ -157,51 +165,19 @@ class DataLoader:
 
     def epoch(self, epoch: int) -> Iterator[Batch]:
         """Yield device-placed batches for one epoch, assembling and
-        transferring ahead of consumption on a background thread."""
-        if self.prefetch_depth <= 0:
-            for hb in self._host_batches(epoch):
-                yield self._place(hb)
-            return
-
-        q: queue.Queue = queue.Queue(maxsize=self.prefetch_depth)
-        stop = threading.Event()
-        _END, _ERR = object(), object()
-
-        def _put(item) -> bool:
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def producer() -> None:
-            try:
-                for hb in self._host_batches(epoch):
-                    if not _put(self._place(hb)):  # device_put = early transfer
-                        return  # consumer abandoned the epoch (e.g. --dry-run)
-                _put(_END)
-            except BaseException as e:  # surfaced on the consumer side
-                _put((_ERR, e))
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is _END:
-                    break
-                if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
-                    raise item[1]
-                yield item
-        finally:
-            # Unblock and reap the producer even if the consumer bailed
-            # mid-epoch (dry-run break, exception in the train loop).
-            stop.set()
-            while not q.empty():
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
-            t.join()
+        transferring ahead of consumption through a
+        :class:`~.prefetch.DevicePrefetcher` (``prefetch_depth <= 0`` is
+        the synchronous serial baseline; batches are bit-identical
+        either way, only the overlap changes)."""
+        # Abandonment (dry-run break, train-loop exception) closes this
+        # generator; GeneratorExit reaches the prefetcher's own finally
+        # through the delegation, which reaps the producer thread.
+        yield from DevicePrefetcher(
+            self._host_batches(epoch),
+            place=self._place,
+            depth=self.prefetch_depth,
+            registry=self.registry,
+            sink=self.sink,
+            pipeline=self.pipeline,
+            epoch=epoch,
+        )

@@ -1,0 +1,246 @@
+"""Device-resident double-buffered prefetch: the steady-state input leg.
+
+PR 5 proved overlap wins at *startup* (compile ∥ H2D ∥ restore); this
+module extends the discipline into steady state.  BENCH_r05's flagship
+row shows ``device_run_share=0.684`` — roughly a third of the wall clock
+is host-side feeding and bookkeeping.  The prefetcher attacks exactly
+that slice: while step k executes on the device, batch k+1 is already
+assembled on the host AND its H2D transfer dispatched (``jax.device_put``
+/ ``make_array_from_process_local_data`` are async), so by the time the
+consumer asks for it the transfer tail — not the whole assemble+transfer
+chain — is all that remains.  The consumer's per-batch cost collapses to
+a queue pop: a buffer swap.
+
+:class:`DevicePrefetcher` is deliberately generic (and jax-free — the
+placement callable is the caller's, same dependency contract as
+``compile/service.py``): it wraps ANY host-batch iterator plus a
+``place`` callable and keeps up to ``depth`` placed batches in a bounded
+queue fed by a background thread.  ``data/loader.DataLoader`` builds its
+epochs on it (sharded placement via the ``parallel/mesh`` data axis);
+the serving engine stages padded batches on device the same way
+(``serving/engine.InferenceEngine`` device staging).  The structural
+throughput test drives it with a fake device (`tests/test_steadystate
+.py`), mirroring the PR 4/5 fake-compiler pattern.
+
+Observability (docs/OBSERVABILITY.md "steady state" family):
+
+- ``data_wait_seconds{pipeline=}`` — histogram of the time the consumer
+  blocked waiting for the next batch.  THE steady-state health number:
+  near-zero means the device never waits on the host; large means the
+  input pipeline is the bottleneck (deepen ``depth`` or speed up
+  assembly).
+- ``prefetch_buffer_occupancy{pipeline=}`` — histogram of how many
+  placed batches were buffered at each consume.  Pinned at ``depth``
+  when the producer keeps ahead; hugging 0 when the consumer is starved.
+- a ``prefetch_epoch`` JSONL event per exhausted epoch (batches, total
+  wait, consume wall, mean occupancy) — `tools/perf_report.py
+  --telemetry` renders these as the "steady state" section with a
+  ``device_run_share``-style wait/step split.
+
+``depth <= 0`` is the synchronous baseline: assemble+place inline on the
+consumer thread (the pre-prefetch serial pipeline, kept for A/Bs and the
+bit-identity pin — batches are identical either way, only the overlap
+changes).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterable, Iterator
+
+_END = object()
+_ERR = object()
+
+
+def _identity(batch):
+    return batch
+
+
+class DevicePrefetcher:
+    """Keep up to ``depth`` device-placed batches in flight ahead of the
+    consumer.
+
+    Parameters
+    ----------
+    source:
+        Iterable of host batches (consumed on the producer thread when
+        ``depth > 0``, inline otherwise).
+    place:
+        ``host batch -> device batch``; called as early as possible so
+        an async H2D dispatch overlaps the consumer's current step.
+        Defaults to identity (host-only pipelines still get the
+        assembly overlap).
+    depth:
+        Bounded buffer size; ``>= 2`` double-buffers (batch k+1 places
+        while batch k is consumed), ``<= 0`` is the synchronous serial
+        baseline.
+    registry / sink:
+        Optional obs surfaces; see the module docstring for the metric
+        family.  ``pipeline`` labels the family (``train``, ``eval``,
+        ``serving``); ``epoch`` rides the ``prefetch_epoch`` event.
+    """
+
+    def __init__(
+        self,
+        source: Iterable,
+        place: Callable | None = None,
+        depth: int = 2,
+        registry=None,
+        sink=None,
+        pipeline: str = "data",
+        epoch: int | None = None,
+    ):
+        self._source = iter(source)
+        self._place = place if place is not None else _identity
+        self.depth = int(depth)
+        self.pipeline = pipeline
+        self._epoch = epoch
+        self._sink = sink
+        self._wait_hist = (
+            registry.histogram(
+                "data_wait_seconds",
+                help="consumer wait for the next device-resident batch "
+                "(near-zero = the device never waits on the host)",
+                pipeline=pipeline,
+            )
+            if registry is not None
+            else None
+        )
+        self._occ_hist = (
+            registry.histogram(
+                "prefetch_buffer_occupancy",
+                help="placed batches buffered at each consume "
+                "(pinned at depth = producer ahead; 0 = consumer starved)",
+                pipeline=pipeline,
+            )
+            if registry is not None
+            else None
+        )
+        self.batches = 0
+        self.wait_s_total = 0.0
+        self._occ_total = 0.0
+        self._t_first: float | None = None
+        self._t_last: float | None = None
+        self._emitted = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._queue: queue.Queue | None = None
+        if self.depth > 0:
+            self._queue = queue.Queue(maxsize=self.depth)
+            self._thread = threading.Thread(
+                target=self._producer, name=f"prefetch-{pipeline}", daemon=True
+            )
+            self._thread.start()
+
+    # -- producer (depth > 0) -------------------------------------------------
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _producer(self) -> None:
+        try:
+            for hb in self._source:
+                # place() here IS the early H2D: dispatch is async, so
+                # the transfer rides under the consumer's current step.
+                if not self._put(self._place(hb)):
+                    return  # consumer abandoned the epoch (dry-run break)
+            self._put(_END)
+        except BaseException as e:  # surfaced on the consumer side
+            self._put((_ERR, e))
+
+    # -- consumer -------------------------------------------------------------
+
+    def _record(self, wait: float, occupancy: int) -> None:
+        self.batches += 1
+        self.wait_s_total += wait
+        self._occ_total += occupancy
+        if self._wait_hist is not None:
+            self._wait_hist.observe(wait)
+        if self._occ_hist is not None:
+            self._occ_hist.observe(occupancy)
+
+    def __iter__(self) -> Iterator:
+        try:
+            if self._queue is None:
+                # Synchronous baseline: the whole assemble+place cost is
+                # consumer wait, recorded so the A/B shows exactly what
+                # depth > 0 hides.
+                while True:
+                    t0 = time.perf_counter()
+                    if self._t_first is None:
+                        self._t_first = t0
+                    try:
+                        item = self._place(next(self._source))
+                    except StopIteration:
+                        break
+                    self._record(time.perf_counter() - t0, 0)
+                    yield item
+                    self._t_last = time.perf_counter()
+                return
+            while True:
+                t0 = time.perf_counter()
+                if self._t_first is None:
+                    self._t_first = t0
+                item = self._queue.get()
+                if item is _END:
+                    break
+                if (
+                    isinstance(item, tuple)
+                    and len(item) == 2
+                    and item[0] is _ERR
+                ):
+                    raise item[1]
+                self._record(time.perf_counter() - t0, self._queue.qsize())
+                yield item
+                self._t_last = time.perf_counter()
+        finally:
+            self.close()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @property
+    def occupancy_mean(self) -> float:
+        return self._occ_total / self.batches if self.batches else 0.0
+
+    @property
+    def consume_wall_s(self) -> float:
+        """First ask -> last yield consumed: the steady-state window the
+        wait share is measured against."""
+        if self._t_first is None or self._t_last is None:
+            return 0.0
+        return self._t_last - self._t_first
+
+    def close(self) -> None:
+        """Stop and reap the producer (idempotent; the epoch iterator
+        calls it on exhaustion AND abandonment), then emit the epoch
+        summary event once."""
+        self._stop.set()
+        if self._queue is not None:
+            while True:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    break
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._sink and not self._emitted and self.batches:
+            self._emitted = True
+            self._sink.emit(
+                "prefetch_epoch",
+                pipeline=self.pipeline,
+                epoch=self._epoch,
+                depth=self.depth,
+                batches=self.batches,
+                wait_s_total=round(self.wait_s_total, 6),
+                consume_wall_s=round(self.consume_wall_s, 6),
+                occupancy_mean=round(self.occupancy_mean, 4),
+            )

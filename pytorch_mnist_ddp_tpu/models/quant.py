@@ -1,0 +1,147 @@
+"""int8 inference variant: quantized weights, int8 GEMMs, f32 tail.
+
+The serving raw-speed attack (ROADMAP item 3b): shrink the bytes the
+device moves and feed the MXU integer-width operands.  Scheme — the
+standard post-training symmetric recipe, kept deliberately simple so the
+parity gates (serving/engine.py) are the correctness story rather than a
+calibration pipeline:
+
+- **Weights**: per-output-channel symmetric int8.  ``scale[o] =
+  max|W[..., o]| / 127``; ``W_q = round(W / scale)`` clipped to
+  ``[-127, 127]``.  Per-channel (not per-tensor) because conv/dense
+  output channels have very different ranges at these widths — per-
+  tensor costs ~4x the logit error for zero speed.  Quantization runs
+  in host numpy at engine build time (deterministic, no device work),
+  biases stay f32.
+- **Dense layers (fc1/fc2)**: true int8 x int8 -> int32 GEMM
+  (``lax.dot_general(..., preferred_element_type=int32)``) with
+  **per-row dynamic activation quantization**: each sample's row is
+  scaled by its own max-abs (computed in the traced forward — one
+  reduction, negligible next to the 9216-wide GEMM).  Per-row keeps the
+  activation error per-sample-exact, and the rescale
+  ``int32 * (a_scale[n] * w_scale[o])`` is a rank-1 outer product fused
+  into the GEMM epilogue.  These two GEMMs are ~99% of the forward's
+  FLOPs, so this is where int8 actually pays.
+- **Convs (conv1/conv2)**: weight-only — int8 kernels dequantized to
+  f32 at use.  conv1's C_in=1 contraction cannot tile integer MXU
+  lanes any better than float ones (docs/PERF.md), so activation-
+  quantizing the convs adds error without winning compute; the weight
+  bytes still shrink 4x.
+- **Tail**: relu/maxpool between layers and the log_softmax stay f32,
+  mirroring the ``--bf16`` discipline (models/net.py).
+
+The forward mirrors :func:`~.net.raw_conv_stack`'s raw-lax style — the
+quantized tree is not a Flax param dict, and keeping it raw means the
+dequant math is exactly what you read.  Numerical parity with the f32
+``Net`` is *gated, never assumed*: the serving engine refuses to serve
+an int8 variant that has not passed its logit-tolerance +
+argmax-identical check against f32 on the fixed eval slice
+(docs/SERVING.md "reduced-precision variants").
+
+BatchNorm checkpoints are rejected (the running-stat fold-in is a
+calibration decision this simple scheme deliberately does not make);
+serve those at bf16 instead.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Symmetric int8 range: +-127, never -128 (the asymmetric extreme makes
+# |q * scale| overshoot max|W| on exactly one code point).
+_QMAX = 127.0
+
+# Layers quantized per-channel (the trailing dim is output channels for
+# both HWIO conv kernels and (in, out) dense kernels — models/net.py).
+QUANT_LAYERS = ("conv1", "conv2", "fc1", "fc2")
+
+
+def quantize_tensor(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Per-output-channel symmetric int8: ``(W_q int8, scale f32[out])``.
+
+    Host numpy, deterministic.  An all-zero channel gets scale 1.0 (its
+    quantized weights are zero either way; 0/0 must not poison the
+    dequant).
+    """
+    w = np.asarray(w, np.float32)
+    absmax = np.abs(w).reshape(-1, w.shape[-1]).max(axis=0)
+    scale = np.where(absmax > 0, absmax / _QMAX, 1.0).astype(np.float32)
+    q = np.clip(np.round(w / scale), -_QMAX, _QMAX).astype(np.int8)
+    return q, scale
+
+
+def quantize_params(params) -> dict:
+    """f32 param tree -> quantized serving tree.
+
+    ``{layer: {"kernel_q": int8, "scale": f32[out], "bias": f32}}`` for
+    every :data:`QUANT_LAYERS` entry.  Raises on BN-bearing trees (see
+    module docstring).
+    """
+    if "bn1" in params:
+        raise ValueError(
+            "int8 variant does not support BatchNorm checkpoints (the "
+            "running-stat fold-in is a calibration decision this scheme "
+            "does not make); serve BN checkpoints at f32 or bf16"
+        )
+    out = {}
+    for layer in QUANT_LAYERS:
+        if layer not in params:
+            raise ValueError(f"param tree has no layer {layer!r}")
+        kernel_q, scale = quantize_tensor(np.asarray(params[layer]["kernel"]))
+        out[layer] = {
+            "kernel_q": kernel_q,
+            "scale": scale,
+            "bias": np.asarray(params[layer]["bias"], np.float32),
+        }
+    return out
+
+
+def _dequant_conv(x: jax.Array, layer: dict) -> jax.Array:
+    """Weight-only int8 conv: dequantize the kernel, run the f32 conv."""
+    kernel = layer["kernel_q"].astype(jnp.float32) * layer["scale"]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, kernel.shape, ("NHWC", "HWIO", "NHWC")
+    )
+    return (
+        jax.lax.conv_general_dilated(
+            x, kernel, (1, 1), "VALID", dimension_numbers=dn
+        )
+        + layer["bias"]
+    )
+
+
+def _int8_dense(x: jax.Array, layer: dict) -> jax.Array:
+    """Per-row dynamically quantized int8 GEMM: ``[n, in] -> [n, out]``.
+
+    ``x`` f32; activations quantize per row (own max-abs), the matmul
+    runs int8 x int8 -> int32, and the rank-1 rescale + bias restores
+    f32.  A zero row quantizes to zeros under scale 1.0 — exact.
+    """
+    a_max = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    a_scale = jnp.where(a_max > 0, a_max / _QMAX, 1.0)
+    x_q = jnp.clip(jnp.round(x / a_scale), -_QMAX, _QMAX).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        x_q,
+        layer["kernel_q"],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc.astype(jnp.float32) * (a_scale * layer["scale"]) + layer["bias"]
+
+
+def int8_forward(qparams: dict, x: jax.Array) -> jax.Array:
+    """Eval-mode quantized forward: ``[n, 28, 28, 1]`` f32 -> ``[n, 10]``
+    f32 log-probs.  Same topology as ``Net`` (models/net.py) with
+    dropout inert (eval) and the log_softmax tail f32."""
+    x = x.astype(jnp.float32)
+    x = jax.nn.relu(_dequant_conv(x, qparams["conv1"]))
+    x = jax.nn.relu(_dequant_conv(x, qparams["conv2"]))
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+    x = x.reshape(x.shape[0], -1)  # [n, 9216], H*W*C like Net's flatten
+    x = jax.nn.relu(_int8_dense(x, qparams["fc1"]))
+    x = _int8_dense(x, qparams["fc2"])
+    return jax.nn.log_softmax(x, axis=-1)

@@ -247,6 +247,28 @@ def make_predict_step(
     return jax.jit(sharded)
 
 
+def make_int8_predict_step(mesh: Mesh):
+    """Build the jitted int8 forward for the serving path.
+
+    The quantized twin of :func:`make_predict_step`: ``predict_fn
+    (qparams, x) -> log_probs`` over the same data-axis sharding, where
+    ``qparams`` is a :func:`~..models.quant.quantize_params` tree
+    (replicated).  Same one-trace-per-bucket contract, enforced by the
+    engine's per-variant RecompileSentinel; parity with the f32 forward
+    is gated at warmup (serving/engine.py verify_parity), never assumed.
+    """
+    from ..models.quant import int8_forward
+
+    sharded = shard_map(
+        int8_forward,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
 def make_eval_step(
     mesh: Mesh, compute_dtype: jnp.dtype = jnp.float32, use_bn: bool = False,
     conv_impl: str = "conv",

@@ -88,8 +88,30 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--bf16", action="store_true",
-        help="serve the forward in bfloat16 (params stay fp32; the "
-        "log_softmax tail is fp32 either way — models/net.py)",
+        help="serve the DEFAULT forward in bfloat16 (params stay fp32; "
+        "the log_softmax tail is fp32 either way — models/net.py); for "
+        "a gated bf16 variant BESIDE the f32 path use --dtypes",
+    )
+    parser.add_argument(
+        "--dtypes", default="f32",
+        help="comma-separated serving variants to warm beside the f32 "
+        "default (f32,bf16,int8); each reduced-precision variant must "
+        "pass its parity gate (logit tolerance + argmax-identical vs "
+        "f32 on a fixed eval slice) before the server starts, and "
+        "requests select one with the /predict \"dtype\" field "
+        "(docs/SERVING.md)",
+    )
+    parser.add_argument(
+        "--aot-cache", default=None, metavar="DIR",
+        help="persist per-(dtype, bucket) serialized AOT executables in "
+        "DIR (compile/aot.ExecutableStore): a warm start deserializes "
+        "every rung instead of tracing (docs/COMPILE.md)",
+    )
+    parser.add_argument(
+        "--no-device-stage", action="store_true",
+        help="disable committing padded batches to the data-axis "
+        "sharding (async device_put) before dispatch; staging is on by "
+        "default on single-process meshes (docs/DATA.md)",
     )
     parser.add_argument(
         "--conv-impl", default="conv",
@@ -142,6 +164,17 @@ def main(argv: list[str] | None = None) -> int:
     from .server import make_server
 
     metrics = ServingMetrics()
+    dtypes = [d.strip() for d in args.dtypes.split(",") if d.strip()]
+    if args.bf16 and any(d != "f32" for d in dtypes):
+        # The gates need an f32 reference; a bf16 DEFAULT forward would
+        # anchor them on bf16 error (engine rejects this too — fail at
+        # the flag surface with the flag-level fix).
+        print(
+            "error: --bf16 (bf16 DEFAULT forward) cannot combine with "
+            "--dtypes variants — the parity gates would lose their f32 "
+            "reference; drop --bf16 and add bf16 to --dtypes instead"
+        )
+        return 2
     engine_kwargs = dict(
         buckets=(
             [int(b) for b in args.buckets.split(",")] if args.buckets else None
@@ -150,6 +183,9 @@ def main(argv: list[str] | None = None) -> int:
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         conv_impl=args.conv_impl,
         metrics=metrics,
+        dtypes=[d for d in dtypes if d != "f32"],
+        aot_cache=args.aot_cache,
+        device_stage=False if args.no_device_stage else None,
     )
     if args.checkpoint:
         print(f"loading checkpoint {args.checkpoint}")
@@ -169,27 +205,63 @@ def main(argv: list[str] | None = None) -> int:
         print(f"serving telemetry: {sink.path}")
 
     print(
-        f"warming buckets {list(engine.buckets)} "
+        f"warming buckets {list(engine.buckets)} x dtypes "
+        f"{list(engine.dtypes)} "
         f"{'serially' if args.serial_warmup else 'concurrently'} on a "
         f"{engine.mesh.devices.size}-device mesh"
         + (" (BatchNorm checkpoint)" if engine.use_bn else "")
+        + (f" (AOT cache {args.aot_cache})" if args.aot_cache else "")
     )
     # The warmup span + the compile service's per-bucket compile spans
     # land in the JSONL telemetry (and span_duration_seconds on the
     # registry /metrics serves), so cold-start cost is observable.
     with span("warmup", sink=sink, registry=metrics.registry):
         engine.warmup(
-            on_bucket=lambda bucket, traces: print(
-                f"  bucket {bucket:4d}: compiled (trace {traces})", flush=True
+            on_rung=lambda dtype, bucket, compiles: print(
+                f"  {dtype:>4s} bucket {bucket:4d}: ready "
+                f"({compiles} traces total)", flush=True
             ),
             parallel=not args.serial_warmup,
             sink=sink,
         )
-    print(
-        f"warmup verified: {engine.compile_count()} traces for "
-        f"{len(engine.buckets)} buckets, second pass hit the cache "
-        "(sentinel-enforced)"
-    )
+    if args.aot_cache:
+        # AOT mode: executables deserialize (or compile+persist) outside
+        # the jit cache — there is no second-pass sweep to claim, and
+        # zero traces is the success condition.
+        print(
+            f"warmup verified: {len(engine.buckets) * len(engine.dtypes)} "
+            f"AOT executables ready ({len(engine.buckets)} buckets x "
+            f"{len(engine.dtypes)} dtypes), {engine.compile_count()} traces"
+        )
+    else:
+        print(
+            f"warmup verified: {engine.compile_count()} traces for "
+            f"{len(engine.buckets)} buckets x {len(engine.dtypes)} dtypes, "
+            "second pass hit the cache (sentinel-enforced)"
+        )
+    # Parity gates (docs/SERVING.md): every reduced-precision variant
+    # must be argmax-identical to f32 within its logit tolerance on the
+    # fixed eval slice, or the server REFUSES to start — serving an
+    # unverified variant is the failure mode the gate exists to prevent.
+    gates = engine.verify_parity(sink=sink)
+    for name, result in gates.items():
+        print(
+            f"parity gate [{name}]: "
+            + ("PASS" if result["passed"] else "FAIL")
+            + f" (max|dlogit| {result['max_abs_logit_diff']:.2e} <= "
+            f"{result['tolerance']:g}, argmax_identical="
+            f"{result['argmax_identical']}, {result['rows']} rows)"
+        )
+    failed = [name for name, r in gates.items() if not r["passed"]]
+    if failed:
+        print(
+            f"refusing to serve: variants {failed} failed their parity "
+            "gate (near-untrained weights put real ties inside the "
+            "quantization error; serve a trained checkpoint, or drop "
+            "the variant from --dtypes)"
+        )
+        sink.close()
+        return 1
     if args.warmup_only:
         sink.close()
         return 0

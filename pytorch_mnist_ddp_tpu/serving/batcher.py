@@ -68,12 +68,18 @@ class RequestTimeout(RuntimeError):
 
 
 class PendingRequest:
-    """One admitted request: input rows + deadline + a result slot."""
+    """One admitted request: input rows + dtype + deadline + a result
+    slot.  ``dtype`` selects the engine variant the batch dispatches on
+    (docs/SERVING.md reduced-precision variants); requests only coalesce
+    with same-dtype neighbors."""
 
-    __slots__ = ("x", "deadline", "t_submit", "_event", "_value", "_error")
+    __slots__ = (
+        "x", "dtype", "deadline", "t_submit", "_event", "_value", "_error",
+    )
 
-    def __init__(self, x: np.ndarray, deadline: float):
+    def __init__(self, x: np.ndarray, deadline: float, dtype: str = "f32"):
         self.x = x
+        self.dtype = dtype
         self.deadline = deadline
         self.t_submit = time.perf_counter()
         self._event = threading.Event()
@@ -190,15 +196,16 @@ class AdaptiveLinger:
 class _InFlight:
     """One launched batch riding the dispatch→completion queue."""
 
-    __slots__ = ("batch", "logits", "staged", "bucket", "n", "stall_s")
+    __slots__ = ("batch", "logits", "staged", "bucket", "n", "stall_s", "dtype")
 
-    def __init__(self, batch, logits, staged, bucket, n, stall_s):
+    def __init__(self, batch, logits, staged, bucket, n, stall_s, dtype):
         self.batch = batch
         self.logits = logits
         self.staged = staged
         self.bucket = bucket
         self.n = n
         self.stall_s = stall_s
+        self.dtype = dtype
 
 
 class MicroBatcher:
@@ -233,6 +240,10 @@ class MicroBatcher:
         self.linger_s = linger_ms / 1e3
         self.timeout_s = timeout_ms / 1e3
         self.max_inflight = max_inflight
+        # Variant routing: engines expose their served dtype names (the
+        # reduced-precision variants, serving/engine.py); fakes without
+        # the surface serve the default only.
+        self._default_dtype = getattr(engine, "default_dtype", "f32")
         self._registry = self.metrics.registry if self.metrics is not None else None
         self._sink = sink
         self._linger = AdaptiveLinger(
@@ -323,19 +334,43 @@ class MicroBatcher:
 
     # -- admission (any thread) ----------------------------------------------
 
-    def submit(self, x: np.ndarray, timeout_ms: float | None = None) -> PendingRequest:
+    def submit(
+        self,
+        x: np.ndarray,
+        timeout_ms: float | None = None,
+        dtype: str | None = None,
+    ) -> PendingRequest:
         """Admit one request of ``[n, 28, 28, 1]`` rows or reject now.
 
         Raises :class:`RejectedError` when draining, when the request is
-        bigger than one maximal batch (it would never fit a dispatch), or
+        bigger than one maximal batch (it would never fit a dispatch),
         when the bounded queue is full — the reject-don't-queue
-        backpressure contract.
+        backpressure contract — or when ``dtype`` names a variant the
+        engine does not serve / has not parity-verified (the refusal
+        contract, docs/SERVING.md).
         """
         x = np.asarray(x, np.float32)
         if self._closed.is_set():
             if self.metrics is not None:
                 self.metrics.record_rejected()
             raise RejectedError("server draining; not accepting requests")
+        dtype = dtype or self._default_dtype
+        if dtype != self._default_dtype:
+            served = getattr(self.engine, "dtypes", (self._default_dtype,))
+            if dtype not in served:
+                if self.metrics is not None:
+                    self.metrics.record_rejected()
+                raise RejectedError(
+                    f"dtype {dtype!r} is not served (have {list(served)})"
+                )
+            verified = getattr(self.engine, "variant_verified", None)
+            if verified is not None and not verified(dtype):
+                if self.metrics is not None:
+                    self.metrics.record_rejected()
+                raise RejectedError(
+                    f"dtype {dtype!r} has not passed its parity gate; "
+                    "refusing to serve it"
+                )
         if not 1 <= len(x) <= self.max_batch:
             if self.metrics is not None:
                 self.metrics.record_rejected()
@@ -343,7 +378,9 @@ class MicroBatcher:
                 f"request of {len(x)} samples outside [1, {self.max_batch}]"
             )
         timeout_s = self.timeout_s if timeout_ms is None else timeout_ms / 1e3
-        req = PendingRequest(x, deadline=time.perf_counter() + timeout_s)
+        req = PendingRequest(
+            x, deadline=time.perf_counter() + timeout_s, dtype=dtype
+        )
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -410,6 +447,12 @@ class MicroBatcher:
                 if total + nxt.n > self.max_batch:
                     carry = nxt  # doesn't fit; leads the next batch
                     break
+                if nxt.dtype != first.dtype:
+                    # Variants dispatch on different executables; a
+                    # mixed batch cannot coalesce.  The stranger leads
+                    # the next batch instead.
+                    carry = nxt
+                    break
                 batch.append(nxt)
                 total += nxt.n
             self._dispatch(batch)
@@ -443,10 +486,16 @@ class MicroBatcher:
             stall_s = time.perf_counter() - t0
             if self.metrics is not None:
                 self.metrics.record_stall(stall_s)
+        dtype = batch[0].dtype
         try:
             with span("serving_dispatch", sink=self._sink,
                       registry=self._registry):
-                logits = self.engine.launch(staged, total)
+                # Default-dtype dispatch keeps the bare two-arg call so
+                # fake engines (tests) need not grow a dtype kwarg.
+                if dtype == self._default_dtype:
+                    logits = self.engine.launch(staged, total)
+                else:
+                    logits = self.engine.launch(staged, total, dtype=dtype)
         except BaseException as e:  # complete every waiter, keep serving
             self._staging.release(staged, bucket)
             self._window.release()
@@ -464,7 +513,7 @@ class MicroBatcher:
             if self.metrics is not None:
                 self.metrics.set_inflight(self._inflight)
         self._completions.put(
-            _InFlight(batch, logits, staged, bucket, total, stall_s)
+            _InFlight(batch, logits, staged, bucket, total, stall_s, dtype)
         )
 
     # -- completion worker ----------------------------------------------------
@@ -497,11 +546,14 @@ class MicroBatcher:
                     req.set_result(host[offset : offset + req.n])
                     offset += req.n
                     if self.metrics is not None:
-                        self.metrics.record_completed(done - req.t_submit)
+                        self.metrics.record_completed(
+                            done - req.t_submit, dtype=req.dtype
+                        )
                     if self._sink:
                         self._sink.emit(
                             "serving_request", n=req.n,
                             latency_s=done - req.t_submit,
+                            dtype=req.dtype,
                         )
             finally:
                 self._staging.release(item.staged, item.bucket)
@@ -514,4 +566,5 @@ class MicroBatcher:
                 self._sink.emit(
                     "serving_batch", real=item.n, bucket=item.bucket,
                     fill_ratio=item.n / item.bucket, stall_s=item.stall_s,
+                    dtype=item.dtype,
                 )

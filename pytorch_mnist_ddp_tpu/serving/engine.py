@@ -1,13 +1,36 @@
 """The inference engine: checkpoint -> warmed bucket executables -> logits.
 
 Lifecycle: construct (variables placed replicated on the data-parallel
-mesh), :meth:`warmup` (compile every bucket exactly once, then verify a
-second pass is pure cache hits), then :meth:`predict_logits` from the
-dispatch thread.  The jitted forward is wrapped in a RecompileSentinel
-budgeted at exactly ``len(buckets)`` traces, so ANY post-warmup shape
-leak — the silent per-request compile stall this subsystem exists to
-prevent — raises ``RecompileError`` with a pointed message instead of
-serving at 1000x latency.
+mesh), :meth:`warmup` (compile every bucket of every dtype variant
+exactly once, then verify a second pass is pure cache hits),
+:meth:`verify_parity` (gate reduced-precision variants against f32),
+then :meth:`launch`/:meth:`predict_logits` from the dispatch thread.
+Each variant's jitted forward is wrapped in a RecompileSentinel budgeted
+at exactly ``len(buckets)`` traces, so ANY post-warmup shape leak — the
+silent per-request compile stall this subsystem exists to prevent —
+raises ``RecompileError`` with a pointed message instead of serving at
+1000x latency.
+
+Reduced-precision variants (docs/SERVING.md): ``dtypes=("bf16",)`` /
+``("int8",)`` add serving paths beside the default f32 forward — bf16
+casts activations/matmuls to the MXU's native width (params stay f32,
+models/net.py), int8 serves per-channel-quantized weights with int8
+GEMMs (models/quant.py).  A variant is REFUSED until its parity gate
+passes: logit tolerance + argmax-identical vs f32 on a fixed eval
+slice, mirroring the ``--bf16`` trainer discipline.  Per-dtype
+executables can persist through the PR-5 :class:`~..compile.aot.
+ExecutableStore` (``aot_cache``): dtype and bucket join the config
+digest, so variants get distinct entries that hit on warm start.
+
+Device staging (``device_stage``, on by default on single-process
+meshes): padded batches are committed to the mesh's data-axis sharding
+with an async ``jax.device_put`` before the forward launches, so the
+H2D transfer rides under the dispatch thread's next host work instead
+of stalling inside the jit call — the steady-state overlap discipline
+of data/prefetch.py applied to serving.  Staging is consistent across
+warmup, parity, and dispatch (committed vs uncommitted inputs key
+different jit cache entries; mixing them would blow the sentinel
+budget).
 
 Threading contract: jax dispatch is not guarded here; exactly one thread
 (the micro-batcher dispatch worker, or the caller in direct use) may
@@ -28,10 +51,62 @@ import numpy as np
 
 from ..analysis.sentinel import RecompileError, RecompileSentinel
 from ..models.net import INPUT_SHAPE, NUM_CLASSES, init_params, init_variables
-from ..parallel.ddp import make_predict_step, replicate_params
+from ..parallel.ddp import (
+    make_int8_predict_step,
+    make_predict_step,
+    replicate_params,
+)
 from ..parallel.mesh import DATA_AXIS, make_mesh
 from .buckets import StagingPool, pow2_buckets, validate_buckets
 from .metrics import ServingMetrics
+
+# The default (reference-precision) variant every engine serves.
+DEFAULT_DTYPE = "f32"
+
+# Reduced-precision variants an engine can additionally serve; each must
+# pass its parity gate before a single request is dispatched to it.
+VARIANT_DTYPES = ("bf16", "int8")
+
+# Parity-gate logit tolerances (max |variant - f32| over the eval slice,
+# log-prob units).  Measured headroom on this repo's CNN: bf16 lands
+# ~1.5e-3, int8 (per-channel weights + per-row activations) ~4e-3 — the
+# gates are 50-100x above the expected error, but far below the ~1.0
+# log-prob scale where a wrong model would hide.  argmax-identity is the
+# sharp edge either way.
+PARITY_TOL = {"bf16": 0.25, "int8": 1.0}
+
+# Rows in the fixed parity slice (padded up/down to a warmed bucket at
+# gate time).  Deterministic seed: the gate must be reproducible — a
+# variant that passes once passes every restart of the same weights.
+PARITY_ROWS = 64
+PARITY_SEED = 20260803
+
+
+class UnverifiedVariantError(RuntimeError):
+    """A reduced-precision variant was asked to serve before (or after
+    failing) its parity gate — the refusal contract, docs/SERVING.md."""
+
+
+class ParityError(AssertionError):
+    """verify_parity(raise_on_failure=True) found a failing variant."""
+
+
+class _Variant:
+    """One served dtype: its jitted forward (sentinel-wrapped), its
+    variable tree, its gate state, and (AOT mode) its per-bucket
+    executable table."""
+
+    __slots__ = ("name", "jit_fn", "predict", "variables", "verified",
+                 "parity", "table")
+
+    def __init__(self, name, jit_fn, predict, variables, verified=False):
+        self.name = name
+        self.jit_fn = jit_fn
+        self.predict = predict
+        self.variables = variables
+        self.verified = verified
+        self.parity: dict | None = None
+        self.table: dict[int, Any] | None = None
 
 
 class InferenceEngine:
@@ -51,6 +126,19 @@ class InferenceEngine:
         Batch-size ladder to warm; defaults to the power-of-two ladder
         from the data-axis size up to ``max_bucket``.  Validated against
         the mesh (every bucket must shard evenly).
+    dtypes:
+        Extra reduced-precision variants to serve beside the f32
+        default (subset of :data:`VARIANT_DTYPES`); each warms its own
+        ladder under its own sentinel and is gated by
+        :meth:`verify_parity` before it may serve.
+    aot_cache:
+        Directory for serialized per-(dtype, bucket) executables
+        (compile/aot.ExecutableStore); a warm start deserializes every
+        rung instead of tracing.  Omitted = plain jit + sentinel.
+    device_stage:
+        Commit inputs to the data-axis sharding with an async
+        ``device_put`` before dispatch.  Default (None) = auto: on when
+        every mesh device is process-local, off otherwise.
     metrics:
         Optional :class:`ServingMetrics`; per-dispatch occupancy is
         recorded when present.
@@ -65,6 +153,9 @@ class InferenceEngine:
         compute_dtype=None,
         conv_impl: str = "conv",
         metrics: ServingMetrics | None = None,
+        dtypes: Sequence[str] | None = None,
+        aot_cache: str | None = None,
+        device_stage: bool | None = None,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         n_shards = self.mesh.shape[DATA_AXIS]
@@ -90,30 +181,120 @@ class InferenceEngine:
             if self.use_bn
             else variables["params"]
         )
+        if dtypes and compute_dtype is not None and (
+            jax.numpy.dtype(compute_dtype) != jax.numpy.dtype(jax.numpy.float32)
+        ):
+            # The parity gates compare variants against THE DEFAULT
+            # variant as their f32 reference; a reduced-precision default
+            # (legacy --bf16) would silently gate bf16 against itself
+            # and int8 against a bf16-skewed anchor while still claiming
+            # "parity vs f32".
+            raise ValueError(
+                "a non-f32 default compute_dtype cannot anchor the "
+                "variants' parity gates; drop the legacy --bf16 flag and "
+                "request the reduced-precision path via dtypes=('bf16',) "
+                "instead"
+            )
+        self._conv_impl = conv_impl
         self._variables = replicate_params(served, self.mesh)
+        self.metrics = metrics
+        registry = metrics.registry if metrics is not None else None
+        if device_stage is None:
+            # Auto: committed placement needs every device addressable
+            # from this process (same gate as ddp.replicate_params).
+            device_stage = all(
+                d.process_index == jax.process_index()
+                for d in self.mesh.devices.flat
+            )
+        self.device_stage = bool(device_stage)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._input_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
         fn = make_predict_step(
             self.mesh,
             compute_dtype=compute_dtype or jax.numpy.float32,
             use_bn=self.use_bn,
             conv_impl=conv_impl,
         )
-        # One trace per bucket, ever.  A post-warmup retrace means a
-        # request shape escaped the bucket policy.  Compile events land
-        # on the shared registry (jax_compiles_total{fn="predict_step"})
-        # so /metrics exposes the count Prometheus-side too.
+        # One trace per bucket per variant, ever.  A post-warmup retrace
+        # means a request shape escaped the bucket policy.  Compile
+        # events land on the shared registry (jax_compiles_total{fn=
+        # "predict_step"} / {fn="predict_step_bf16"} ...) so /metrics
+        # exposes the counts Prometheus-side too.
         self._predict = RecompileSentinel(
             fn,
             max_traces=len(self.buckets),
             name="predict_step",
-            registry=metrics.registry if metrics is not None else None,
+            registry=registry,
         )
-        self.metrics = metrics
+        # The default (reference-precision) variant serves unverified by
+        # definition: it IS the parity reference.
+        self._variants: dict[str, _Variant] = {
+            DEFAULT_DTYPE: _Variant(
+                DEFAULT_DTYPE, fn, self._predict, self._variables,
+                verified=True,
+            )
+        }
+        for name in dtypes or ():
+            if name == DEFAULT_DTYPE or name in self._variants:
+                continue
+            self._variants[name] = self._build_variant(
+                name, variables, registry
+            )
+        self._aot_store = None
+        if aot_cache:
+            from ..compile import ExecutableStore
+
+            self._aot_store = ExecutableStore(
+                aot_cache,
+                registry=registry,
+                # Hold the whole dtype x bucket grid plus headroom for one
+                # config change; the default bound would prune mid-grid.
+                max_entries=2 * len(self._variants) * len(self.buckets) + 4,
+            )
+            for v in self._variants.values():
+                v.table = {}
         self.warmed = False
         # Direct-call staging: one preallocated pad target per bucket, so
         # the serial predict_logits path allocates nothing per dispatch
         # (one slot suffices — the result is read back before the next
         # chunk stages, so the buffer is always free again by then).
         self._staging = StagingPool(self.buckets, INPUT_SHAPE, slots=1)
+
+    def _build_variant(self, name: str, variables, registry) -> _Variant:
+        if name == "bf16":
+            fn = make_predict_step(
+                self.mesh,
+                compute_dtype=jax.numpy.bfloat16,
+                use_bn=self.use_bn,
+                conv_impl=self._conv_impl,
+            )
+            placed = self._variables
+        elif name == "int8":
+            from ..models.quant import quantize_params
+
+            if self.use_bn:
+                raise ValueError(
+                    "int8 variant does not support BatchNorm checkpoints; "
+                    "serve BN checkpoints at f32 or bf16"
+                )
+            fn = make_int8_predict_step(self.mesh)
+            placed = replicate_params(
+                quantize_params(jax.device_get(variables["params"])),
+                self.mesh,
+            )
+        else:
+            raise ValueError(
+                f"unknown serving dtype {name!r}; have "
+                f"{(DEFAULT_DTYPE, *VARIANT_DTYPES)}"
+            )
+        sentinel = RecompileSentinel(
+            fn,
+            max_traces=len(self.buckets),
+            name=f"predict_step_{name}",
+            registry=registry,
+        )
+        return _Variant(name, fn, sentinel, placed)
 
     # -- construction helpers ------------------------------------------------
 
@@ -135,12 +316,86 @@ class InferenceEngine:
         key = split_streams(root_key(seed))["init"]
         return cls({"params": init_params(key)}, **kwargs)
 
+    # -- variant surface ------------------------------------------------------
+
+    @property
+    def dtypes(self) -> tuple[str, ...]:
+        """Served dtype names, default first."""
+        return tuple(self._variants)
+
+    @property
+    def default_dtype(self) -> str:
+        return DEFAULT_DTYPE
+
+    def variant_verified(self, dtype: str | None) -> bool:
+        v = self._variants.get(dtype or DEFAULT_DTYPE)
+        return v is not None and v.verified
+
+    @property
+    def parity_report(self) -> dict[str, dict]:
+        """Per-variant gate results recorded by :meth:`verify_parity`."""
+        return {
+            v.name: v.parity
+            for v in self._variants.values()
+            if v.parity is not None
+        }
+
+    def _variant_for(self, dtype: str | None) -> _Variant:
+        name = dtype or DEFAULT_DTYPE
+        v = self._variants.get(name)
+        if v is None:
+            raise ValueError(
+                f"dtype {name!r} is not served; have {list(self._variants)}"
+            )
+        return v
+
     # -- lifecycle ------------------------------------------------------------
 
     def compile_count(self) -> int:
-        """Distinct traces of the forward so far (== warmed buckets once
-        warmup has run; the /metrics ``compiles`` field)."""
-        return self._predict.trace_count()
+        """Distinct traces of the forward across every variant (== warmed
+        buckets x variants once warmup has run in jit mode, 0 in AOT
+        mode where executables deserialize; the /metrics ``compiles``
+        field)."""
+        return sum(v.predict.trace_count() for v in self._variants.values())
+
+    def _stage(self, staged):
+        """Commit a padded host batch to the data-axis sharding (async
+        H2D) — the serving leg of the steady-state prefetch discipline.
+        Identity when device staging is off or the caller pre-staged."""
+        if not self.device_stage or not isinstance(staged, np.ndarray):
+            return staged
+        return jax.device_put(staged, self._input_sharding)
+
+    def _run_variant(self, v: _Variant, staged):
+        """Dispatch one bucket-shaped batch on a variant, bypassing the
+        verified gate (warmup and the parity gate itself come through
+        here; request traffic goes through :meth:`launch`)."""
+        staged = self._stage(staged)
+        if v.table is not None and len(staged) in v.table:
+            return v.table[len(staged)](v.variables, staged)
+        return v.predict(v.variables, staged)
+
+    def _warm_one(self, v: _Variant, b: int) -> None:
+        x = self._stage(np.zeros((b, *INPUT_SHAPE), np.float32))
+        if v.table is not None:
+            config = {
+                "program": "predict_step",
+                "dtype": v.name,
+                "bucket": int(b),
+                "mesh": {str(k): int(s) for k, s in self.mesh.shape.items()},
+                "use_bn": self.use_bn,
+                "conv_impl": self._conv_impl,
+                "device_stage": self.device_stage,
+                "prng_impl": str(jax.config.jax_default_prng_impl),
+            }
+            compiled, _outcome = self._aot_store.load_or_compile(
+                f"predict_step[{v.name}][{b}]",
+                config,
+                lambda: v.jit_fn.lower(v.variables, x).compile(),
+            )
+            v.table[b] = compiled
+        else:
+            v.predict(v.variables, x)
 
     def warmup(
         self,
@@ -148,88 +403,197 @@ class InferenceEngine:
         parallel: bool = True,
         max_workers: int | None = None,
         sink=None,
+        on_rung=None,
     ) -> list[tuple[int, int]]:
-        """Compile every bucket exactly once; verify the second pass hits.
+        """Compile every (variant, bucket) exactly once; verify the
+        second pass hits.
 
-        ``parallel=True`` (the default) fans the ladder out over a
-        :class:`~..compile.CompileService` thread pool: XLA compilation
-        releases the GIL and jit's caches are thread-safe, so N buckets
-        compile in the wall time of the slowest one instead of the sum —
-        the startup win the fake-compiler structural test pins
-        (tests/test_compile.py).  The RecompileSentinel budget is
-        untouched: concurrent or not, warmup produces exactly
-        ``len(buckets)`` traces, and the serial verification sweep below
-        proves every rung is a cache hit afterwards.
+        ``parallel=True`` (the default) fans the full dtype x bucket
+        grid out over a :class:`~..compile.CompileService` thread pool:
+        XLA compilation releases the GIL and jit's caches are
+        thread-safe, so N programs compile in the wall time of the
+        slowest one instead of the sum — the startup win the
+        fake-compiler structural test pins (tests/test_compile.py).
+        Each variant's RecompileSentinel budget is untouched: concurrent
+        or not, warmup produces exactly ``len(buckets)`` traces per
+        variant, and the serial verification sweep below proves every
+        rung is a cache hit afterwards.  With an ``aot_cache``, each
+        rung instead loads-or-compiles a serialized executable keyed by
+        (dtype, bucket, config) — a warm start is pure deserialize,
+        zero traces.
 
-        Returns ``[(bucket, cumulative_trace_count), ...]`` in ladder
-        order.  Serially the counts step up one per rung; under parallel
-        warmup each entry records the trace count observed when THAT
-        bucket finished (concurrent completions may see later counts) —
-        monotonicity per rung is no longer meaningful, the invariant is
-        the final count.  ``on_bucket(bucket, traces)`` fires as each
-        bucket finishes compiling — from worker threads in parallel mode
-        — so callers can report progress DURING the slow phase (a TPU
-        ladder is tens of seconds per rung; silence until the end reads
-        as a hang).  A second sweep over the ladder must add zero
-        traces; the sentinel raises otherwise, and a final count check
-        catches the inverse failure (two buckets aliasing to one
-        executable would silently under-warm).
-
-        ``sink`` (obs event sink) receives the per-bucket ``compile``
-        spans from the service, so JSONL telemetry shows which rung took
-        how long (`tools/perf_report.py --telemetry` "startup compiles").
+        Returns ``[(bucket, cumulative_trace_count), ...]`` for the
+        DEFAULT variant in ladder order (the PR-2 report surface).
+        ``on_bucket(bucket, traces)`` fires as each default-variant rung
+        finishes; ``on_rung(dtype, bucket, total_compiles)`` fires for
+        EVERY rung of every variant — from worker threads in parallel
+        mode — so callers can report progress DURING the slow phase.
+        ``sink`` (obs event sink) receives the per-rung ``compile``
+        spans from the service.
         """
         registry = self.metrics.registry if self.metrics is not None else None
         done: dict[int, int] = {}
 
-        def warm_one(b: int) -> None:
-            self._predict(self._variables, np.zeros((b, *INPUT_SHAPE), np.float32))
-            traces = self._predict.trace_count()
-            done[b] = traces
-            if on_bucket is not None:
-                on_bucket(b, traces)
+        def warm_one(vname: str, b: int) -> None:
+            v = self._variants[vname]
+            self._warm_one(v, b)
+            if vname == DEFAULT_DTYPE:
+                traces = self._predict.trace_count()
+                done[b] = traces
+                if on_bucket is not None:
+                    on_bucket(b, traces)
+            if on_rung is not None:
+                on_rung(vname, b, self.compile_count())
 
-        if parallel and len(self.buckets) > 1:
+        jobs = [
+            (vname, b) for vname in self._variants for b in self.buckets
+        ]
+        if parallel and len(jobs) > 1:
             from ..compile import CompileService
 
             with CompileService(
-                max_workers=min(len(self.buckets), max_workers or 8),
+                max_workers=min(len(jobs), max_workers or 8),
                 registry=registry,
                 sink=sink,
             ) as svc:
-                for b in self.buckets:
-                    svc.submit(f"predict_step[{b}]", warm_one, b)
+                for vname, b in jobs:
+                    label = (
+                        f"predict_step[{b}]"
+                        if vname == DEFAULT_DTYPE
+                        else f"predict_step[{vname}][{b}]"
+                    )
+                    svc.submit(label, warm_one, vname, b)
                 svc.wait_all()
         else:
             # The opt-in serial fallback (parallel=False): deterministic
             # rung-by-rung compile order for debugging ladder issues.
-            for b in self.buckets:
-                warm_one(b)
+            for vname, b in jobs:
+                warm_one(vname, b)
         report = [(b, done[b]) for b in self.buckets]
-        for b in self.buckets:
-            self._predict(self._variables, np.zeros((b, *INPUT_SHAPE), np.float32))  # jaxlint: disable=JL010 -- verification sweep, not warmup: every call here MUST be a cache hit (the sentinel raises otherwise), so there is nothing to parallelize
-        if self._predict.trace_count() != len(self.buckets):
-            raise RecompileError(
-                f"warmup traced {self._predict.trace_count()} executables "
-                f"for {len(self.buckets)} buckets {self.buckets}; the "
-                "bucket ladder does not map 1:1 onto compiled programs"
-            )
+        for v in self._variants.values():
+            if v.table is not None:
+                missing = [b for b in self.buckets if b not in v.table]
+                if missing:
+                    raise RecompileError(
+                        f"AOT warmup left {v.name} buckets {missing} "
+                        "without executables"
+                    )
+                continue
+            for b in self.buckets:
+                self._run_variant(v, np.zeros((b, *INPUT_SHAPE), np.float32))  # jaxlint: disable=JL010 -- verification sweep, not warmup: every call here MUST be a cache hit (the sentinel raises otherwise), so there is nothing to parallelize
+            if v.predict.trace_count() != len(self.buckets):
+                raise RecompileError(
+                    f"warmup traced {v.predict.trace_count()} executables "
+                    f"for {len(self.buckets)} buckets {self.buckets} of "
+                    f"variant {v.name!r}; the bucket ladder does not map "
+                    "1:1 onto compiled programs"
+                )
         self.warmed = True
         return report
 
+    # -- parity gates ----------------------------------------------------------
+
+    def verify_parity(
+        self,
+        tol: dict[str, float] | None = None,
+        raise_on_failure: bool = False,
+        sink=None,
+    ) -> dict[str, dict]:
+        """Gate every reduced-precision variant against the f32 forward.
+
+        A fixed, seeded eval slice (raw pixels through the training
+        normalize — the distribution the model serves) is dispatched at
+        an already-warmed bucket shape on the reference variant and on
+        each unverified one; a variant passes iff
+
+        - ``max |logit_variant - logit_f32| <= tol[dtype]``
+          (:data:`PARITY_TOL` defaults), AND
+        - argmax is identical on EVERY row.
+
+        Passing marks the variant servable; failing leaves it refused
+        (``launch``/``submit`` raise).  Zero new traces: the gate rides
+        warmed bucket shapes only.  Returns (and records on
+        :attr:`parity_report`) one result dict per gated variant; with
+        ``raise_on_failure`` a failing gate raises :class:`ParityError`
+        naming the numbers.  Note near-untrained weights can
+        legitimately fail int8's argmax check — nearly-uniform logits
+        put real ties inside the quantization error, and the gate
+        refusing to serve that is the gate working.
+        """
+        from ..data.transforms import normalize
+
+        pending = [
+            v for v in self._variants.values()
+            if v.name != DEFAULT_DTYPE and not v.verified
+        ]
+        results: dict[str, dict] = {}
+        if not pending:
+            return results
+        fits = [b for b in self.buckets if b <= PARITY_ROWS]
+        bucket = fits[-1] if fits else self.buckets[0]
+        raw = np.random.RandomState(PARITY_SEED).randint(
+            0, 256, (bucket, 28, 28)
+        ).astype(np.uint8)
+        x = normalize(raw)
+        ref = np.asarray(self._run_variant(self._variants[DEFAULT_DTYPE], x))
+        registry = self.metrics.registry if self.metrics is not None else None
+        for v in pending:
+            out = np.asarray(self._run_variant(v, x))
+            max_diff = float(np.abs(out - ref).max())
+            argmax_ok = bool((out.argmax(axis=1) == ref.argmax(axis=1)).all())
+            tolerance = float(
+                (tol or {}).get(v.name, PARITY_TOL.get(v.name, 0.25))
+            )
+            passed = argmax_ok and max_diff <= tolerance
+            v.verified = passed
+            v.parity = {
+                "dtype": v.name,
+                "rows": int(bucket),
+                "max_abs_logit_diff": max_diff,
+                "tolerance": tolerance,
+                "argmax_identical": argmax_ok,
+                "passed": passed,
+            }
+            results[v.name] = v.parity
+            if registry is not None:
+                registry.gauge(
+                    "serving_variant_verified",
+                    help="1 = the dtype variant passed its parity gate "
+                    "and may serve; 0 = refused",
+                    dtype=v.name,
+                ).set(1.0 if passed else 0.0)
+            if sink:
+                sink.emit("parity_gate", **v.parity)
+        if raise_on_failure:
+            failed = [r for r in results.values() if not r["passed"]]
+            if failed:
+                raise ParityError(
+                    "parity gate failed: "
+                    + "; ".join(
+                        f"{r['dtype']} max|dlogit|={r['max_abs_logit_diff']:.4g}"
+                        f" (tol {r['tolerance']:g}), argmax_identical="
+                        f"{r['argmax_identical']}"
+                        for r in failed
+                    )
+                )
+        return results
+
     # -- serving --------------------------------------------------------------
 
-    def launch(self, staged: np.ndarray, n: int):
+    def launch(self, staged: np.ndarray, n: int, dtype: str | None = None):
         """Dispatch one already-bucket-shaped batch WITHOUT reading back.
 
         ``staged`` must be exactly a warmed bucket shape (the batcher and
         :meth:`predict_logits` stage through a :class:`StagingPool`, so
         jit only ever sees bucket shapes) and carry ``n`` live rows at
-        the front.  Returns the on-device ``[bucket, 10]`` log-probs —
-        jax's async dispatch means this does NOT wait for the compute, so
-        the caller can overlap host work (padding the next batch) with
-        device execution and read the result later with ``np.asarray``.
+        the front.  ``dtype`` selects a served variant (default f32);
+        an unverified variant refuses (:class:`UnverifiedVariantError`).
+        Returns the on-device ``[bucket, 10]`` log-probs — jax's async
+        dispatch means this does NOT wait for the compute, so the caller
+        can overlap host work (padding the next batch) with device
+        execution and read the result later with ``np.asarray``.
         """
+        v = self._variant_for(dtype)
         bucket = len(staged)
         if bucket not in self.buckets:
             raise ValueError(
@@ -238,12 +602,19 @@ class InferenceEngine:
             )
         if not 1 <= n <= bucket:
             raise ValueError(f"live rows {n} outside [1, {bucket}]")
-        logits = self._predict(self._variables, staged)
+        if not v.verified:
+            raise UnverifiedVariantError(
+                f"variant {v.name!r} has not passed its parity gate "
+                "(engine.verify_parity); refusing to serve it"
+            )
+        logits = self._run_variant(v, staged)
         if self.metrics is not None:
             self.metrics.record_batch(n, bucket)
         return logits
 
-    def predict_logits(self, x: np.ndarray) -> np.ndarray:
+    def predict_logits(
+        self, x: np.ndarray, dtype: str | None = None
+    ) -> np.ndarray:
         """``[n, 28, 28, 1]`` normalized float32 -> ``[n, 10]`` log-probs.
 
         Pads into the engine's preallocated staging buffers (zero-alloc
@@ -268,7 +639,7 @@ class InferenceEngine:
             chunk = x[start : start + top]
             staged, bucket = self._staging.stage([chunk])
             try:
-                logits = self.launch(staged, len(chunk))
+                logits = self.launch(staged, len(chunk), dtype=dtype)
                 outs.append(np.asarray(logits)[: len(chunk)])  # jaxlint: disable=JL009 -- serial direct-call path: each chunk is read inline by contract; the overlapped read lives in the batcher's completion worker
             finally:
                 self._staging.release(staged, bucket)

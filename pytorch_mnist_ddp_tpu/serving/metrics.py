@@ -87,6 +87,13 @@ class ServingMetrics:
             "serving_inflight_batches",
             help="batches launched on the device, result not yet read back",
         )
+        # Per-dtype request surface (ISSUE 6): reduced-precision serving
+        # variants get their own count + latency families so the
+        # quantization win is visible per dtype on /metrics and in the
+        # Prometheus exposition (docs/OBSERVABILITY.md).
+        self._reservoir = reservoir
+        self._dtype_count: dict[str, object] = {}
+        self._dtype_latency: dict[str, object] = {}
 
     # -- counter views (back-compat attribute surface) ------------------------
 
@@ -152,10 +159,35 @@ class ServingMetrics:
         """Current launched-not-yet-completed batch count (gauge)."""
         self._inflight.set(depth)
 
-    def record_completed(self, latency_s: float) -> None:
-        """One request finished; ``latency_s`` spans submit -> result set."""
+    def record_completed(self, latency_s: float, dtype: str | None = None) -> None:
+        """One request finished; ``latency_s`` spans submit -> result set.
+        ``dtype`` additionally lands the request on the per-variant
+        count/latency families."""
         self._requests["completed"].inc()
         self._latency.observe(latency_s)
+        if dtype is None:
+            return
+        counter = self._dtype_count.get(dtype)
+        if counter is None:
+            # Both dict entries land under the registry lock (reentrant):
+            # snapshot() iterates these dicts while holding it, and a
+            # scrape racing the first completion of a dtype must never
+            # see the counter without its latency twin.
+            with self.registry.locked():
+                counter = self._dtype_count[dtype] = self.registry.counter(
+                    "serving_dtype_requests_total",
+                    help="completed requests per serving dtype variant",
+                    dtype=dtype,
+                )
+                self._dtype_latency[dtype] = self.registry.histogram(
+                    "serving_dtype_latency_seconds",
+                    help="request latency per serving dtype variant "
+                    "(reservoir window)",
+                    reservoir=self._reservoir,
+                    dtype=dtype,
+                )
+        counter.inc()
+        self._dtype_latency[dtype].observe(latency_s)
 
     # -- reading -------------------------------------------------------------
 
@@ -181,6 +213,13 @@ class ServingMetrics:
         """
         with self.registry.locked():
             lat = sorted(self._latency.values())
+            by_dtype = {
+                name: (
+                    self._dtype_count[name].value,
+                    sorted(self._dtype_latency[name].values()),
+                )
+                for name in self._dtype_count
+            }
             fills = self._fill.values()
             stalls = sorted(self._stall.values())
             stall_count, stall_sum = self._stall.count, self._stall.sum
@@ -227,6 +266,16 @@ class ServingMetrics:
                 "stall_ms_p95": 1e3 * percentile(stalls, 95),
             },
         }
+        if by_dtype:
+            snap["dtypes"] = {
+                name: {
+                    "requests": count,
+                    "p50_ms": 1e3 * percentile(window, 50),
+                    "p95_ms": 1e3 * percentile(window, 95),
+                    "p99_ms": 1e3 * percentile(window, 99),
+                }
+                for name, (count, window) in sorted(by_dtype.items())
+            }
         gauges = [
             ("serving_uptime_seconds", "process uptime", uptime),
             ("serving_batch_occupancy_pct", "real samples / dispatched slots",

@@ -8,8 +8,11 @@ Endpoints:
   applies the training pipeline's exact ToTensor∘Normalize affine
   (data/transforms.normalize — serving must see the distribution the
   model trained on); send ``"normalized": true`` to submit pre-normalized
-  float inputs verbatim.  Response: ``{"predictions": [digit, ...]}``,
-  plus per-class ``"log_probs"`` when ``"return_log_probs": true``.
+  float inputs verbatim.  ``"dtype": "bf16"|"int8"`` selects a
+  reduced-precision serving variant (400 when not served, 503 until its
+  parity gate passes — docs/SERVING.md).  Response:
+  ``{"predictions": [digit, ...]}``, plus per-class ``"log_probs"`` when
+  ``"return_log_probs": true``.
 - ``GET /metrics`` — the full ServingMetrics snapshot (queue depth,
   occupancy, p50/p95/p99 latency, compile count) as JSON; with
   ``Accept: text/plain`` or ``?format=prom``, the same registry renders
@@ -101,12 +104,22 @@ class ServingHandler(BaseHTTPRequestHandler):
         srv: ServingHTTPServer = self.server  # type: ignore[assignment]
         url = urlsplit(self.path)
         if url.path == "/healthz":
+            engine = srv.engine
             self._send_json(
                 200,
                 {
                     "status": "ok",
-                    "warmed": srv.engine.warmed,
-                    "buckets": list(srv.engine.buckets),
+                    "warmed": engine.warmed,
+                    "buckets": list(engine.buckets),
+                    # Which dtype variants may serve right now (a False
+                    # entry is warmed but refused: parity gate not
+                    # passed — docs/SERVING.md).
+                    "dtypes": {
+                        name: getattr(
+                            engine, "variant_verified", lambda _d: True
+                        )(name)
+                        for name in getattr(engine, "dtypes", ("f32",))
+                    },
                 },
             )
         elif url.path == "/metrics":
@@ -141,11 +154,24 @@ class ServingHandler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
             x = decode_instances(body)
+            # Variant selection (docs/SERVING.md): "dtype" picks a
+            # reduced-precision serving path.  Unknown names are a
+            # client error (400); a known-but-unverified variant is
+            # rejected by the batcher below (503 — the parity-gate
+            # refusal contract).
+            dtype = body.get("dtype")
+            if dtype is not None:
+                served = getattr(srv.engine, "dtypes", ("f32",))
+                if not isinstance(dtype, str) or dtype not in served:
+                    raise ValueError(
+                        f"unknown dtype {dtype!r}; served dtypes: "
+                        f"{list(served)}"
+                    )
         except ValueError as e:
             self._send_json(400, {"error": str(e)})
             return
         try:
-            request = srv.batcher.submit(x)
+            request = srv.batcher.submit(x, dtype=dtype)
             logits = request.result()
         except RejectedError as e:
             self._send_json(503, {"error": str(e)})

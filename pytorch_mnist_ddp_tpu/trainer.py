@@ -769,6 +769,15 @@ def _fit_body(
                 )._replace(step=jnp.int32(resume_step)),
                 mesh,
             )
+        # Steady-state input pipeline (data/prefetch.py): keep
+        # --prefetch-depth placed batches in flight ahead of the step
+        # loop (0 = synchronous serial baseline; batches bit-identical
+        # either way — the A/B pin of docs/DATA.md).  With telemetry on,
+        # the loaders record data_wait_seconds/prefetch_buffer_occupancy
+        # and emit per-epoch prefetch_epoch events.
+        prefetch_depth = int(getattr(args, "prefetch_depth", 2) or 0)
+        obs_registry = telemetry.registry if telemetry is not None else None
+        obs_sink = telemetry.events if telemetry is not None else None
         train_loader = DataLoader(
             train_set.images,
             train_set.labels,
@@ -778,6 +787,10 @@ def _fit_body(
             seed=args.seed,
             process_rank=dist.process_rank,
             process_count=dist.process_count,
+            prefetch_depth=prefetch_depth,
+            registry=obs_registry,
+            sink=obs_sink,
+            pipeline="train",
         )
         test_loader = DataLoader(
             test_set.images,
@@ -790,6 +803,10 @@ def _fit_body(
             # Count every test sample exactly once in the psum'd totals,
             # even when the sampler pads ranks to equal length (multi-host).
             mask_padding=True,
+            prefetch_depth=prefetch_depth,
+            registry=obs_registry,
+            sink=obs_sink,
+            pipeline="eval",
         )
         from .utils.profiling import StepStats
 

@@ -1025,6 +1025,141 @@ def test_jl010_waiver():
 
 
 # ---------------------------------------------------------------------------
+# JL011 — host-blocking data feeds between jitted step calls
+
+
+JL011_BAD_NEXT = """\
+import numpy as np
+import jax
+
+step = jax.jit(lambda s, x: (s, x))
+
+def train(state, it, n):
+    for _ in range(n):
+        batch = np.asarray(next(it))
+        state, loss = step(state, batch)
+    return state
+"""
+
+JL011_BAD_DIRECT_ARG = """\
+import jax
+
+step = jax.jit(lambda s, x: (s, x))
+
+def train(state, it):
+    while True:
+        state, loss = step(state, next(it))
+"""
+
+JL011_BAD_SENTINEL_ATTR = """\
+import numpy as np
+import jax
+from pytorch_mnist_ddp_tpu.analysis import RecompileSentinel
+
+class Trainer:
+    def __init__(self, fn):
+        self._step = RecompileSentinel(jax.jit(fn), max_traces=1)
+
+    def run(self, state, host_batches):
+        for _ in range(3):
+            x = np.asarray(next(host_batches))
+            state = self._step(state, x)
+        return state
+"""
+
+JL011_GOOD_PREFETCHER = """\
+import jax
+
+step = jax.jit(lambda s, x: (s, x))
+
+def train(state, prefetcher):
+    for x in prefetcher:
+        state, loss = step(state, x)
+    return state
+"""
+
+JL011_GOOD_NEXT_ON_PREFETCHER = """\
+import jax
+
+step = jax.jit(lambda s, x: (s, x))
+
+def train(state, prefetcher, n):
+    for _ in range(n):
+        x = next(prefetcher)
+        state, loss = step(state, x)
+    return state
+"""
+
+JL011_GOOD_UNRELATED_NEXT = """\
+import numpy as np
+import jax
+
+step = jax.jit(lambda s, x: (s, x))
+
+def train(state, it, xs):
+    for x in xs:
+        meta = np.asarray(next(it))  # bookkeeping, never fed to the step
+        state, loss = step(state, x)
+        record(meta)
+    return state
+"""
+
+
+def test_jl011_fires_on_materialized_next_feed():
+    assert_fires(JL011_BAD_NEXT, "JL011", line=8)
+
+
+def test_jl011_fires_on_direct_next_argument():
+    assert_fires(JL011_BAD_DIRECT_ARG, "JL011", line=7)
+
+
+def test_jl011_tracks_sentinel_wrapped_attributes():
+    # The trainer shape: a sentinel-wrapped jitted step fed from
+    # next() inside the loop.
+    assert_fires(JL011_BAD_SENTINEL_ATTR, "JL011", line=11)
+
+
+def test_jl011_silent_on_prefetch_iteration():
+    # The fix shape: the loop iterates a prefetch wrapper, so the
+    # materialization happens on the producer thread.
+    assert_silent(JL011_GOOD_PREFETCHER, "JL011")
+
+
+def test_jl011_silent_on_next_of_prefetcher():
+    # next() on a prefetcher is a buffer swap, not a materialization.
+    assert_silent(JL011_GOOD_NEXT_ON_PREFETCHER, "JL011")
+
+
+def test_jl011_silent_when_feed_never_reaches_the_step():
+    # Host work that does not flow into the jitted call is not a feed.
+    assert_silent(JL011_GOOD_UNRELATED_NEXT, "JL011")
+
+
+def test_jl011_silent_without_a_jitted_call_in_the_loop():
+    # A plain host loop over next() is ordinary Python, not a feed gap.
+    assert_silent(
+        """\
+import numpy as np
+
+def collect(it, n):
+    out = []
+    for _ in range(n):
+        out.append(np.asarray(next(it)))
+    return out
+""",
+        "JL011",
+    )
+
+
+def test_jl011_waiver():
+    waived = JL011_BAD_NEXT.replace(
+        "batch = np.asarray(next(it))",
+        "batch = np.asarray(next(it))  # jaxlint: disable=JL011 -- serial bench: the end-to-end chain is the measurement",
+    )
+    assert_silent(waived, "JL011")
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

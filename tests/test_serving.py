@@ -574,8 +574,13 @@ def test_engine_staging_is_zero_alloc_and_matches_pad_to_bucket(devices):
         x = np.random.RandomState(n).rand(n, 28, 28, 1).astype(np.float32)
         got = engine.predict_logits(x)
         bucket = bucket_for(n, engine.buckets)
+        # _stage mirrors launch's device staging: the reference dispatch
+        # must hit the same committed-input executable, not trace a new
+        # uncommitted-input one past the sentinel budget.
         want = np.asarray(
-            engine._predict(engine._variables, pad_to_bucket(x, bucket))
+            engine._predict(
+                engine._variables, engine._stage(pad_to_bucket(x, bucket))
+            )
         )[:n]
         np.testing.assert_array_equal(got, want)
         # Same preallocated buffer keeps being recycled: nothing new was

@@ -326,6 +326,32 @@ def summarize_telemetry(directory: str) -> str | None:
                 f"{counts.get(k, 0)} {k}" for k in ("hit", "miss", "fallback")
             )
         )
+    # Steady-state input pipeline (data/prefetch.py prefetch_epoch
+    # events): the device_run_share-style split of consume wall into
+    # data wait vs step time, per pipeline — the number ISSUE 6's
+    # double-buffered prefetch exists to drive toward zero.
+    prefetches = [e for e in events if e.get("event") == "prefetch_epoch"]
+    if prefetches:
+        by_pipe: dict[str, list[dict]] = {}
+        for e in prefetches:
+            by_pipe.setdefault(e.get("pipeline", "?"), []).append(e)
+        for pipe, evs in sorted(by_pipe.items()):
+            batches = sum(e.get("batches", 0) for e in evs)
+            wait = sum(e.get("wait_s_total", 0.0) for e in evs)
+            wall = sum(e.get("consume_wall_s", 0.0) for e in evs)
+            occ = (
+                sum(e.get("occupancy_mean", 0.0) * e.get("batches", 0)
+                    for e in evs) / batches
+                if batches else 0.0
+            )
+            share = wait / wall if wall > 0 else 0.0
+            lines.append(
+                f"  steady state [{pipe}]: {batches} batches over "
+                f"{len(evs)} epoch(s), data wait {wait:.3f} s of "
+                f"{wall:.2f} s consume wall (wait share {share:.1%}, "
+                f"step share {1 - share:.1%}), mean buffer occupancy "
+                f"{occ:.2f} (depth {evs[-1].get('depth', '?')})"
+            )
     # Serving pipeline telemetry (serving/batcher.py under --telemetry-dir):
     # per-request latency plus per-batch fill/stall — the operator's view
     # of how well the in-flight window is overlapping.
@@ -338,6 +364,28 @@ def summarize_telemetry(directory: str) -> str | None:
                 f"p50 {1e3 * percentile(lats, 50):.2f} ms, "
                 f"p95 {1e3 * percentile(lats, 95):.2f} ms, "
                 f"p99 {1e3 * percentile(lats, 99):.2f} ms"
+            )
+        by_dtype: dict[str, list[float]] = {}
+        for e in sreqs:
+            if "latency_s" in e and e.get("dtype"):
+                by_dtype.setdefault(e["dtype"], []).append(e["latency_s"])
+        if len(by_dtype) > 1:  # per-variant split only when mixed traffic
+            for name, ds in sorted(by_dtype.items()):
+                ds.sort()
+                lines.append(
+                    f"    dtype {name}: {len(ds)} requests, "
+                    f"p50 {1e3 * percentile(ds, 50):.2f} ms, "
+                    f"p99 {1e3 * percentile(ds, 99):.2f} ms"
+                )
+    gates = [e for e in events if e.get("event") == "parity_gate"]
+    if gates:
+        for e in gates:
+            lines.append(
+                f"  parity gate [{e.get('dtype', '?')}]: "
+                + ("PASS" if e.get("passed") else "FAIL")
+                + f" (max|dlogit| {e.get('max_abs_logit_diff', 0.0):.2e}"
+                f" <= {e.get('tolerance', 0.0):g}, argmax_identical="
+                f"{e.get('argmax_identical')})"
             )
     sbatches = [e for e in events if e.get("event") == "serving_batch"]
     if sbatches:

@@ -81,12 +81,18 @@ def fetch_text(url: str, timeout: float = 30.0) -> str:
         return resp.read().decode()
 
 
-def _request_payload(rng: random.Random, n: int) -> dict:
-    return {
+def _request_payload(rng: random.Random, n: int, dtype: str = "f32") -> dict:
+    payload = {
         "instances": [
             [rng.randint(0, 255) for _ in range(784)] for _ in range(n)
         ]
     }
+    if dtype != "f32":
+        # The reduced-precision A/B knob (docs/SERVING.md): route every
+        # request to one named variant; the default payload stays
+        # byte-compatible with pre-dtype servers.
+        payload["dtype"] = dtype
+    return payload
 
 
 def run_open_loop(
@@ -97,6 +103,7 @@ def run_open_loop(
     seed: int,
     timeout_s: float,
     max_workers: int,
+    dtype: str = "f32",
 ) -> dict:
     """Poisson arrivals at ``rate`` req/s, fired independently of
     completions, bounded by ``max_workers`` outstanding requests.
@@ -122,7 +129,7 @@ def run_open_loop(
     def one(i: int, scheduled: float) -> tuple[int, float]:
         wrng = random.Random(seed * 1000 + i)
         status, _body = fetch_json(
-            f"{url}/predict", _request_payload(wrng, sizes[i]),
+            f"{url}/predict", _request_payload(wrng, sizes[i], dtype),
             timeout=timeout_s,
         )
         return status, time.perf_counter() - scheduled
@@ -148,6 +155,7 @@ def run_open_loop(
         "wall_s": wall,
         "sizes": sizes,
         "mode": "open-loop",
+        "dtype": dtype,
         "offered_rate_rps": rate,
         "achieved_arrival_rate_rps": requests / fired_span if fired_span > 0 else 0.0,
     }
@@ -160,6 +168,7 @@ def run_load(
     max_request: int,
     seed: int,
     timeout_s: float,
+    dtype: str = "f32",
 ) -> dict:
     """Drive the endpoint; returns raw per-request (status, latency_s)."""
     rng = random.Random(seed)
@@ -179,7 +188,7 @@ def run_load(
                 cursor[0] += 1
             t0 = time.perf_counter()
             status, _body = fetch_json(
-                f"{url}/predict", _request_payload(wrng, sizes[i]),
+                f"{url}/predict", _request_payload(wrng, sizes[i], dtype),
                 timeout=timeout_s,
             )
             elapsed = time.perf_counter() - t0
@@ -197,7 +206,7 @@ def run_load(
     wall = time.perf_counter() - t_start
     return {
         "results": results, "wall_s": wall, "sizes": sizes,
-        "mode": "closed-loop",
+        "mode": "closed-loop", "dtype": dtype,
     }
 
 
@@ -218,12 +227,21 @@ def summarize(raw: dict, before: dict, after: dict) -> dict:
     )
     return {
         "mode": raw.get("mode", "closed-loop"),
+        "dtype": raw.get("dtype", "f32"),
         "offered_rate_rps": raw.get("offered_rate_rps"),
         "achieved_arrival_rate_rps": raw.get("achieved_arrival_rate_rps"),
         "requests": len(results),
         "request_size_range": [min(raw["sizes"]), max(raw["sizes"])],
         "wall_s": raw["wall_s"],
+        # throughput_rps keeps its historical meaning (useful 200s per
+        # wall second — cross-revision BENCH comparability); goodput_rps
+        # is its canonical name going forward, and answered_rps is the
+        # shed-inclusive rate — under shedding load the answered/goodput
+        # gap is the capacity signal a dtype A/B compares.
         "throughput_rps": len(ok) / raw["wall_s"] if raw["wall_s"] else 0.0,
+        "goodput_rps": len(ok) / raw["wall_s"] if raw["wall_s"] else 0.0,
+        "answered_rps": len(results) / raw["wall_s"] if raw["wall_s"] else 0.0,
+        "server_dtype_latency": after.get("dtypes"),
         "status_counts": by_status,
         "rejected": by_status.get("503", 0),
         "timed_out": by_status.get("504", 0),
@@ -277,6 +295,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--max-request", type=int, default=16,
         help="request sizes are drawn uniformly from [1, this]",
+    )
+    parser.add_argument(
+        "--dtype", default="f32", choices=("f32", "bf16", "int8"),
+        help="route every request to this serving variant (the /predict "
+        "\"dtype\" field) — the reduced-precision A/B knob; in "
+        "--self-serve mode the variant is warmed and parity-gated "
+        "before the run (docs/SERVING.md)",
     )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--timeout-s", type=float, default=30.0)
@@ -335,10 +360,25 @@ def main(argv: list[str] | None = None) -> int:
 
         metrics = ServingMetrics()
         engine = InferenceEngine.from_seed(
-            buckets=[int(b) for b in args.buckets.split(",")], metrics=metrics
+            buckets=[int(b) for b in args.buckets.split(",")],
+            metrics=metrics,
+            dtypes=[args.dtype] if args.dtype != "f32" else None,
         )
-        print(f"self-serve: warming buckets {list(engine.buckets)}")
+        print(
+            f"self-serve: warming buckets {list(engine.buckets)} x dtypes "
+            f"{list(engine.dtypes)}"
+        )
         engine.warmup()
+        if args.dtype != "f32":
+            # The variant must clear its parity gate before a single
+            # request routes to it (the refusal contract): fail the
+            # A/B loudly rather than measure an unverified path.
+            gate = engine.verify_parity(raise_on_failure=True)[args.dtype]
+            print(
+                f"parity gate [{args.dtype}]: PASS "
+                f"(max|dlogit| {gate['max_abs_logit_diff']:.2e} <= "
+                f"{gate['tolerance']:g}, argmax identical)"
+            )
         sink = open_sink(args.telemetry_dir)
         server = make_server(
             engine, metrics, port=0,
@@ -366,6 +406,7 @@ def main(argv: list[str] | None = None) -> int:
                 url, args.requests, args.rate, args.max_request,
                 args.seed, args.timeout_s,
                 max_workers=args.concurrency,
+                dtype=args.dtype,
             )
         else:
             print(
@@ -374,7 +415,7 @@ def main(argv: list[str] | None = None) -> int:
             )
             raw = run_load(
                 url, args.requests, args.concurrency, args.max_request,
-                args.seed, args.timeout_s,
+                args.seed, args.timeout_s, dtype=args.dtype,
             )
         _status, after = fetch_json(f"{url}/metrics")
         if args.prom_dump:
@@ -396,6 +437,7 @@ def main(argv: list[str] | None = None) -> int:
     lat = report["latency_ms"]
     print(
         f"done in {report['wall_s']:.2f}s ({report['mode']}"
+        + (f", dtype {report['dtype']}" if report["dtype"] != "f32" else "")
         + (f", offered {report['offered_rate_rps']:.0f} req/s"
            if report["offered_rate_rps"] else "")
         + "): "
