@@ -1,9 +1,10 @@
-"""The jaxlint rule set: JL001–JL011, the JAX hazards this repo has
+"""The jaxlint rule set: JL001–JL012, the JAX hazards this repo has
 actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, the
 serving layer's per-request-shape retrace class, the telemetry layer's
 record-at-trace-time class, the serving pipeline's
 blocking-read-in-dispatch-loop class, the startup phase's serial-warmup
-class, and the steady-state input pipeline's host-blocking-feed class).
+class, the steady-state input pipeline's host-blocking-feed class, and
+the replica pool's per-replica-re-trace class).
 
 Every rule is a heuristic over one module's AST — no type inference, no
 cross-file call graph.  "Traced context" below means: a function that is
@@ -1425,6 +1426,95 @@ class HostBlockingFeedRule(Rule):
                 break
 
 
+# ---------------------------------------------------------------------------
+# JL012 — per-replica engine construction without shared warm state
+
+
+# Call names that build a serving engine (and with it a full bucket
+# ladder of compiled executables): the constructor and its classmethod
+# surfaces.  Matched on the trailing segments so both
+# `InferenceEngine(...)` and `serving.InferenceEngine.from_seed(...)`
+# resolve.
+_ENGINE_CTOR_TAIL = "InferenceEngine"
+_ENGINE_FACTORY_METHODS = {"from_seed", "from_checkpoint"}
+
+# Keyword arguments that make a per-iteration engine construction the
+# sanctioned pool idiom instead of a re-trace generator: a shared AOT
+# store and/or an explicit device/mesh pin (serving/pool.py passes both).
+_ENGINE_SHARING_KWARGS = {"aot_cache", "mesh", "device", "devices"}
+
+
+class EngineLoopRule(Rule):
+    """JL012: an InferenceEngine built inside a loop without a shared
+    AOT cache or an explicit device/mesh pin.
+
+    The replica-pool hazard class (docs/SERVING.md scale-out): a loop
+    that constructs one engine per device/replica builds one FULL bucket
+    ladder of executables per iteration.  Without ``aot_cache=`` (the
+    shared ExecutableStore) every replica re-traces and re-compiles the
+    whole dtype x bucket grid from scratch — N x the startup cost the
+    compile subsystem exists to remove — and without ``mesh=`` /
+    ``device=`` every "replica" lands on whatever jax defaults to,
+    usually the SAME device, so the loop multiplies compile cost without
+    multiplying capacity.  The fix is the pool idiom
+    (serving/pool.py: EnginePool): pin each engine to its device via an
+    explicit mesh and share one ExecutableStore so replica warmups are
+    deserializations, not traces.  (Bare ``jax.jit`` construction inside
+    a loop is the same smell one level down — that is JL004's existing
+    territory; this rule covers the engine-shaped version JL004 cannot
+    see through the constructor call.)
+
+    Heuristic: any loop-body call whose dotted name ends in
+    ``InferenceEngine`` (or ``InferenceEngine.from_seed`` /
+    ``.from_checkpoint``) with NONE of the sharing kwargs
+    (``aot_cache``/``mesh``/``device``/``devices``) present.  A
+    deliberately cache-less loop (a compile benchmark) is waived inline
+    with a reason.
+    """
+
+    rule_id = "JL012"
+    severity = Severity.WARNING
+    summary = "per-loop InferenceEngine without shared AOT cache or device pin"
+
+    @staticmethod
+    def _engine_call(node: ast.AST) -> str | None:
+        if not isinstance(node, ast.Call):
+            return None
+        name = dotted_name(node.func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[-1] == _ENGINE_CTOR_TAIL:
+            return name
+        if (len(parts) >= 2
+                and parts[-1] in _ENGINE_FACTORY_METHODS
+                and parts[-2] == _ENGINE_CTOR_TAIL):
+            return name
+        return None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for sub in iter_loop_body_nodes(loop):
+                name = self._engine_call(sub)
+                if name is None:
+                    continue
+                kwargs = {kw.arg for kw in sub.keywords if kw.arg}
+                if kwargs & _ENGINE_SHARING_KWARGS:
+                    continue
+                yield self.finding(
+                    ctx, sub,
+                    f"{name}(...) constructed inside a loop with neither "
+                    "a shared AOT cache nor an explicit device pin: each "
+                    "iteration re-traces and re-compiles a full bucket "
+                    "ladder (and every replica lands on the default "
+                    "device); pass aot_cache= (one shared "
+                    "ExecutableStore) and mesh=/device= per replica, or "
+                    "use the pool (serving/pool.py EnginePool)",
+                )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -1437,6 +1527,7 @@ ALL_RULES: tuple[Rule, ...] = (
     BlockingReadLoopRule(),
     SerialWarmupRule(),
     HostBlockingFeedRule(),
+    EngineLoopRule(),
 )
 
 

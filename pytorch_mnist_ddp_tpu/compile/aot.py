@@ -29,6 +29,16 @@ rewrites the entry — the store is an optimization, never a correctness
 surface.  Outcomes land on ``aot_executables_total{outcome=hit|miss|
 fallback}`` and as ``aot_executable`` JSONL events.
 
+Concurrency: the store is safe under concurrent readers AND writers on
+one directory (the serving replica pool warms N engines against a
+single ``--aot-cache``).  Writes go through a per-writer ``mkstemp``
+temp file and an atomic ``os.replace`` — no fixed temp name two writers
+could interleave into — so a reader only ever sees absent or complete
+entries; a same-key write race resolves last-writer-wins with an
+equally valid executable, and a double-prune race is absorbed by the
+ignore-missing removal.  Pinned by the concurrent-writers test in
+tests/test_scaleout.py.
+
 Trust model: entries are pickles (``jax.experimental.
 serialize_executable`` is pickle-based end to end), and unpickling
 attacker-controlled bytes executes code — the header gate runs AFTER
@@ -45,6 +55,8 @@ import hashlib
 import json
 import os
 import pickle
+import tempfile
+import time
 
 _FORMAT = 1
 
@@ -97,6 +109,7 @@ class ExecutableStore:
     """
 
     MAX_ENTRIES = 8  # newest kept; key churn (source edits) orphans the rest
+    TMP_GRACE_S = 600.0  # crashed-writer .tmp files older than this are reaped
 
     def __init__(
         self,
@@ -120,6 +133,16 @@ class ExecutableStore:
         # — or readable — by other users.  Pre-existing directories keep
         # their modes (the operator owns that decision).
         os.makedirs(directory, mode=0o700, exist_ok=True)
+        # Entry files honor the process umask like a plain open() would
+        # (mkstemp alone gives 0600, which silently breaks a cache dir
+        # an operator deliberately shares: the second user's loads all
+        # PermissionError into recompile fallbacks).  Probed ONCE here,
+        # where construction is single-threaded — the os.umask
+        # read-and-restore flip is process-global and would race the
+        # concurrent replica warmups writing through this store.
+        umask = os.umask(0)
+        os.umask(umask)
+        self._entry_mode = 0o666 & ~umask
 
     # -- keying ---------------------------------------------------------------
 
@@ -200,10 +223,29 @@ class ExecutableStore:
             "in_tree": in_tree,
             "out_tree": out_tree,
         }
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(entry, f)
-        os.replace(tmp, path)  # atomic: a concurrent reader never sees a torn file
+        # Concurrent-writer safety (the replica-pool case: N engines
+        # warming against ONE --aot-cache dir).  A fixed `path + ".tmp"`
+        # name would let two same-key writers interleave into one torn
+        # temp file before either renames; mkstemp gives each writer a
+        # private file, and os.replace is atomic, so a concurrent reader
+        # (or racing writer) only ever sees a complete entry — last
+        # writer wins with an equally valid executable.
+        fd, tmp = tempfile.mkstemp(
+            dir=self.directory, prefix=os.path.basename(path) + ".", suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(entry, f)
+            # mkstemp creates 0600; restore the umask-governed mode a
+            # plain open() would have produced (probed in __init__).
+            os.chmod(tmp, self._entry_mode)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
 
     def _prune(self) -> None:
         """Keep the newest :attr:`MAX_ENTRIES` entries.  Key churn —
@@ -212,10 +254,22 @@ class ExecutableStore:
         a bound, an iterating developer's cache grows one serialized
         program per edit, forever."""
         entries = []
+        now = time.time()
         for fname in os.listdir(self.directory):
+            full = os.path.join(self.directory, fname)
+            if fname.endswith(".tmp"):
+                # A writer killed between mkstemp and os.replace leaves
+                # its uniquely-named temp file behind; nothing else ever
+                # deletes it, so reap stale ones here.  The grace period
+                # spares a LIVE concurrent writer mid-dump.
+                try:
+                    if now - os.path.getmtime(full) > self.TMP_GRACE_S:
+                        os.remove(full)
+                except OSError:
+                    pass
+                continue
             if not fname.endswith(".jexec"):
                 continue
-            full = os.path.join(self.directory, fname)
             try:
                 entries.append((os.path.getmtime(full), full))
             except OSError:

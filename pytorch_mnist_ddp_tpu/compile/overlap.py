@@ -97,6 +97,13 @@ class StartupTasks:
         with self._lock:
             return self._durations.get(name)
 
+    def wait_seconds(self, name: str) -> float:
+        """Seconds ``name`` has spent blocked on other tasks' results —
+        the serialization component :meth:`duration` includes and the
+        overlap ratio excludes."""
+        with self._lock:
+            return self._waits.get(name, 0.0)
+
     def rendezvous(self, timeout: float | None = None) -> float:
         """Wait for every task; record and return the overlap ratio."""
         for job in self._jobs.values():

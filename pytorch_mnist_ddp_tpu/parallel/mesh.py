@@ -111,6 +111,47 @@ def place_tree(tree, specs, mesh: Mesh):
     return jax.tree.map(place, tree, specs)
 
 
+def local_devices() -> list[jax.Device]:
+    """Every device addressable from this process — the replica-pool
+    enumeration surface (serving/pool.py): one serving replica per entry.
+    Process-local by construction, since a replica's engine must be able
+    to ``device_put`` onto its device."""
+    return list(jax.local_devices())
+
+
+def replica_devices(
+    n: int | None = None, devices: Sequence[jax.Device] | None = None
+) -> list[jax.Device]:
+    """Device assignment for an ``n``-replica pool.
+
+    ``n=None`` means one replica per visible local device.  ``n`` beyond
+    the device count wraps round-robin — replicas then share devices,
+    which oversubscribes real hardware but keeps pool mechanics testable
+    on single-device hosts (the wrap is the caller's explicit choice of
+    ``n``, never a silent default).
+    """
+    pool = list(devices if devices is not None else local_devices())
+    if not pool:
+        raise ValueError("no devices visible to this process")
+    if n is None:
+        return pool
+    if n < 1:
+        raise ValueError(f"need >= 1 replica, got {n}")
+    return [pool[i % len(pool)] for i in range(n)]
+
+
+def single_device_mesh(device: jax.Device) -> Mesh:
+    """The 1x1 ``(data, model)`` mesh pinning one replica to ``device``.
+
+    Shape-compatible with :func:`make_mesh`, so every mesh consumer
+    (``make_predict_step`` sharding, ``replicate_params`` placement,
+    bucket validation against the data-axis size) works unchanged — the
+    pool's per-replica engines differ from a single-engine deployment
+    only in WHICH device the mesh names.
+    """
+    return make_mesh(num_data=1, num_model=1, devices=[device])
+
+
 def data_sharding(mesh: Mesh) -> NamedSharding:
     """Batch-leading sharding for input arrays: split dim 0 over 'data'."""
     return NamedSharding(mesh, P(DATA_AXIS))

@@ -32,6 +32,12 @@ ROADMAP's "heavy traffic" north star:
   (``/metrics`` also serves Prometheus text with ``Accept: text/plain``
   or ``?format=prom``); run it with
   ``python -m pytorch_mnist_ddp_tpu.serving``.
+- :mod:`.pool` / :mod:`.router` — scale-out (PR 7): one engine+batcher
+  replica per device (:class:`EnginePool`, shared weights + shared AOT
+  store, explicit device pinning) behind a queue-aware admission
+  :class:`Router` (``--replicas`` / ``--router-policy {roundrobin,
+  least-loaded,cost}``), with sharded dispatch for oversized batches
+  and graceful replica drain/re-add under live traffic.
 
 Load-test with ``tools/serve_loadgen.py``; see docs/SERVING.md.
 """
@@ -46,14 +52,20 @@ from .buckets import (
 )
 from .engine import InferenceEngine
 from .metrics import ServingMetrics
+from .pool import EnginePool
+from .router import Replica, Router, ShardedRequest
 
 __all__ = [
     "AdaptiveLinger",
+    "EnginePool",
     "InferenceEngine",
     "MicroBatcher",
     "RejectedError",
+    "Replica",
     "RequestTimeout",
+    "Router",
     "ServingMetrics",
+    "ShardedRequest",
     "StagingPool",
     "bucket_for",
     "pad_to_bucket",

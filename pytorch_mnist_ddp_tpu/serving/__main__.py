@@ -108,6 +108,20 @@ def build_parser() -> argparse.ArgumentParser:
         "every rung instead of tracing (docs/COMPILE.md)",
     )
     parser.add_argument(
+        "--replicas", type=int, default=None, metavar="N",
+        help="serve N engine replicas, one per device (0 = one per "
+        "visible device), behind the queue-aware router "
+        "(docs/SERVING.md scale-out); omitted = the single-engine path",
+    )
+    parser.add_argument(
+        "--router-policy", default="cost",
+        choices=("roundrobin", "least-loaded", "cost"),
+        help="replica placement policy with --replicas: roundrobin "
+        "(load-blind baseline), least-loaded (queue depth + in-flight), "
+        "or cost (expected time-to-answer from the per-replica latency "
+        "EWMA; falls back to least-loaded until samples exist)",
+    )
+    parser.add_argument(
         "--no-device-stage", action="store_true",
         help="disable committing padded batches to the data-axis "
         "sharding (async device_put) before dispatch; staging is on by "
@@ -187,15 +201,25 @@ def main(argv: list[str] | None = None) -> int:
         aot_cache=args.aot_cache,
         device_stage=False if args.no_device_stage else None,
     )
+    pool_mode = args.replicas is not None
+    if pool_mode:
+        # Scale-out (docs/SERVING.md): N per-device engine replicas
+        # behind the queue-aware router; 0 = one per visible device.
+        from .pool import EnginePool
+
+        factory = EnginePool
+        engine_kwargs["replicas"] = args.replicas or None
+    else:
+        factory = InferenceEngine
     if args.checkpoint:
         print(f"loading checkpoint {args.checkpoint}")
-        engine = InferenceEngine.from_checkpoint(args.checkpoint, **engine_kwargs)
+        engine = factory.from_checkpoint(args.checkpoint, **engine_kwargs)
     else:
         print(
             f"no --checkpoint; serving fresh seed-{args.seed} weights "
             "(smoke/load-test mode)"
         )
-        engine = InferenceEngine.from_seed(args.seed, **engine_kwargs)
+        engine = factory.from_seed(args.seed, **engine_kwargs)
 
     from ..obs.events import open_sink
     from ..obs.spans import span
@@ -204,40 +228,64 @@ def main(argv: list[str] | None = None) -> int:
     if sink:
         print(f"serving telemetry: {sink.path}")
 
-    print(
-        f"warming buckets {list(engine.buckets)} x dtypes "
-        f"{list(engine.dtypes)} "
-        f"{'serially' if args.serial_warmup else 'concurrently'} on a "
-        f"{engine.mesh.devices.size}-device mesh"
-        + (" (BatchNorm checkpoint)" if engine.use_bn else "")
-        + (f" (AOT cache {args.aot_cache})" if args.aot_cache else "")
-    )
+    if pool_mode:
+        print(
+            f"warming buckets {list(engine.buckets)} x dtypes "
+            f"{list(engine.dtypes)} x {engine.n_replicas} replicas "
+            f"(devices {[str(d) for d in engine.devices]})"
+            + (" (BatchNorm checkpoint)" if engine.use_bn else "")
+            + (f" (shared AOT cache {args.aot_cache})" if args.aot_cache else "")
+        )
+    else:
+        print(
+            f"warming buckets {list(engine.buckets)} x dtypes "
+            f"{list(engine.dtypes)} "
+            f"{'serially' if args.serial_warmup else 'concurrently'} on a "
+            f"{engine.mesh.devices.size}-device mesh"
+            + (" (BatchNorm checkpoint)" if engine.use_bn else "")
+            + (f" (AOT cache {args.aot_cache})" if args.aot_cache else "")
+        )
     # The warmup span + the compile service's per-bucket compile spans
     # land in the JSONL telemetry (and span_duration_seconds on the
     # registry /metrics serves), so cold-start cost is observable.
     with span("warmup", sink=sink, registry=metrics.registry):
-        engine.warmup(
-            on_rung=lambda dtype, bucket, compiles: print(
-                f"  {dtype:>4s} bucket {bucket:4d}: ready "
-                f"({compiles} traces total)", flush=True
-            ),
-            parallel=not args.serial_warmup,
-            sink=sink,
-        )
+        if pool_mode:
+            engine.warmup(
+                on_rung=lambda replica, dtype, bucket, compiles: print(
+                    f"  [{replica}] {dtype:>4s} bucket {bucket:4d}: ready "
+                    f"({compiles} traces total)", flush=True
+                ),
+                parallel=not args.serial_warmup,
+                sink=sink,
+            )
+        else:
+            engine.warmup(
+                on_rung=lambda dtype, bucket, compiles: print(
+                    f"  {dtype:>4s} bucket {bucket:4d}: ready "
+                    f"({compiles} traces total)", flush=True
+                ),
+                parallel=not args.serial_warmup,
+                sink=sink,
+            )
+    n_replicas = engine.n_replicas if pool_mode else 1
     if args.aot_cache:
         # AOT mode: executables deserialize (or compile+persist) outside
         # the jit cache — there is no second-pass sweep to claim, and
         # zero traces is the success condition.
         print(
-            f"warmup verified: {len(engine.buckets) * len(engine.dtypes)} "
+            "warmup verified: "
+            f"{n_replicas * len(engine.buckets) * len(engine.dtypes)} "
             f"AOT executables ready ({len(engine.buckets)} buckets x "
-            f"{len(engine.dtypes)} dtypes), {engine.compile_count()} traces"
+            f"{len(engine.dtypes)} dtypes"
+            + (f" x {n_replicas} replicas" if pool_mode else "")
+            + f"), {engine.compile_count()} traces"
         )
     else:
         print(
             f"warmup verified: {engine.compile_count()} traces for "
-            f"{len(engine.buckets)} buckets x {len(engine.dtypes)} dtypes, "
-            "second pass hit the cache (sentinel-enforced)"
+            f"{len(engine.buckets)} buckets x {len(engine.dtypes)} dtypes"
+            + (f" x {n_replicas} replicas" if pool_mode else "")
+            + ", second pass hit the cache (sentinel-enforced)"
         )
     # Parity gates (docs/SERVING.md): every reduced-precision variant
     # must be argmax-identical to f32 within its logit tolerance on the
@@ -265,22 +313,31 @@ def main(argv: list[str] | None = None) -> int:
     if args.warmup_only:
         sink.close()
         return 0
-    server = make_server(
-        engine,
-        metrics,
-        host=args.host,
-        port=args.port,
+    batcher_kwargs = dict(
         linger_ms=args.linger_ms,
         queue_depth=args.queue_depth,
         timeout_ms=args.timeout_ms,
         max_inflight=args.max_inflight,
         adaptive_linger=not args.no_adaptive_linger,
-        sink=sink,
     )
+    if pool_mode:
+        router = engine.start(
+            router_policy=args.router_policy, sink=sink, **batcher_kwargs
+        )
+        server = make_server(
+            engine, metrics, host=args.host, port=args.port, batcher=router
+        )
+    else:
+        server = make_server(
+            engine, metrics, host=args.host, port=args.port,
+            sink=sink, **batcher_kwargs,
+        )
     host, port = server.server_address[:2]
     print(
         f"serving on http://{host}:{port} (POST /predict, GET /metrics; "
-        f"in-flight window {args.max_inflight}, adaptive linger "
+        + (f"{engine.n_replicas} replicas, router policy "
+           f"{args.router_policy}, per-replica " if pool_mode else "")
+        + f"in-flight window {args.max_inflight}, adaptive linger "
         f"{'off' if args.no_adaptive_linger else 'on'})"
     )
 

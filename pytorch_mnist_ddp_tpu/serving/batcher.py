@@ -147,6 +147,7 @@ class AdaptiveLinger:
         ceiling_s: float,
         enabled: bool = True,
         registry=None,
+        replica: str | None = None,
         deep_depth: int = 4,
         shrink: float = 0.5,
         relax_frac: float = 0.25,
@@ -163,11 +164,15 @@ class AdaptiveLinger:
         self.relax_frac = relax_frac
         self.floor_s = floor_s
         self.current_s = self.ceiling_s
+        # Pool mode labels the gauge per replica: N controllers sharing
+        # one registry would otherwise last-writer-race a single series
+        # (the same hazard set_inflight's replica= label exists for).
         self._gauge = (
             registry.gauge(
                 "serving_linger_seconds",
                 help="current adaptive linger (shrinks under queue depth, "
                 "relaxes toward the configured ceiling when idle)",
+                **({"replica": replica} if replica else {}),
             )
             if registry is not None
             else None
@@ -230,11 +235,20 @@ class MicroBatcher:
         max_inflight: int = 2,
         adaptive_linger: bool = True,
         sink=None,
+        replica: str | None = None,
     ):
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
         top = engine.buckets[-1]
         self.engine = engine
+        # Pool mode (serving/router.py): ``replica`` names this batcher
+        # on the per-replica metric families and telemetry events, and
+        # the pool assigns ``on_complete(latency_s)`` after construction
+        # to feed the router's per-replica EWMA from the completion
+        # worker.  Both are None in single-engine use, where the
+        # unlabeled PR-4 surface is unchanged.
+        self.replica = replica
+        self.on_complete = None
         self.metrics = metrics if metrics is not None else engine.metrics
         self.max_batch = min(max_batch or top, top)
         self.linger_s = linger_ms / 1e3
@@ -247,7 +261,8 @@ class MicroBatcher:
         self._registry = self.metrics.registry if self.metrics is not None else None
         self._sink = sink
         self._linger = AdaptiveLinger(
-            self.linger_s, enabled=adaptive_linger, registry=self._registry
+            self.linger_s, enabled=adaptive_linger, registry=self._registry,
+            replica=self.replica,
         )
         self._queue: queue.Queue[PendingRequest] = queue.Queue(maxsize=queue_depth)
         # Launched-but-unread batches; the semaphore IS the window bound,
@@ -261,6 +276,7 @@ class MicroBatcher:
         self._inflight = 0
         self.peak_inflight = 0
         self._closed = threading.Event()
+        self._stop_lock = threading.Lock()  # stop() is concurrency-safe
         self._worker: threading.Thread | None = None
         self._completer: threading.Thread | None = None
 
@@ -286,8 +302,16 @@ class MicroBatcher:
         :class:`RejectedError` so no handler thread is left hanging.
         Batches already launched on the device are always read back and
         completed (abandoning them would waste finished device work).
+
+        Safe to call concurrently (a pool ``drain()`` racing the
+        shutdown path's ``Router.stop()``): calls serialize, and the
+        loser sees already-joined workers and returns.
         """
         self._closed.set()
+        with self._stop_lock:
+            self._stop_locked(drain)
+
+    def _stop_locked(self, drain: bool) -> None:
         if not drain:
             self._flush_rejected()
         if self._worker is not None:
@@ -313,7 +337,15 @@ class MicroBatcher:
             except queue.Empty:
                 return
             req.set_error(RejectedError("server shutting down"))
-            if self.metrics is not None:
+            # Pool mode: the HTTP handler resubmits a flushed request on
+            # a surviving replica (serving/server.py), so the client may
+            # never see this rejection — counting here would alert
+            # operators on phantom 503s during every drain.  The
+            # client-visible outcome is counted where it is decided: the
+            # router's last-replica submit, or the handler's final
+            # result().  Single-engine mode has no retry; the flush IS
+            # the client outcome and keeps the PR-4 accounting.
+            if self.metrics is not None and self.replica is None:
                 self.metrics.record_rejected()
 
     def depth(self) -> int:
@@ -339,6 +371,7 @@ class MicroBatcher:
         x: np.ndarray,
         timeout_ms: float | None = None,
         dtype: str | None = None,
+        count_reject: bool = True,
     ) -> PendingRequest:
         """Admit one request of ``[n, 28, 28, 1]`` rows or reject now.
 
@@ -347,32 +380,35 @@ class MicroBatcher:
         when the bounded queue is full — the reject-don't-queue
         backpressure contract — or when ``dtype`` names a variant the
         engine does not serve / has not parity-verified (the refusal
-        contract, docs/SERVING.md).
+        contract, docs/SERVING.md).  ``count_reject=False`` suppresses
+        the rejection COUNTER only (the exception still raises): the
+        router tries replicas in policy order and a skipped-and-retried
+        replica is not a client-visible 503.
         """
         x = np.asarray(x, np.float32)
         if self._closed.is_set():
-            if self.metrics is not None:
+            if count_reject and self.metrics is not None:
                 self.metrics.record_rejected()
             raise RejectedError("server draining; not accepting requests")
         dtype = dtype or self._default_dtype
         if dtype != self._default_dtype:
             served = getattr(self.engine, "dtypes", (self._default_dtype,))
             if dtype not in served:
-                if self.metrics is not None:
+                if count_reject and self.metrics is not None:
                     self.metrics.record_rejected()
                 raise RejectedError(
                     f"dtype {dtype!r} is not served (have {list(served)})"
                 )
             verified = getattr(self.engine, "variant_verified", None)
             if verified is not None and not verified(dtype):
-                if self.metrics is not None:
+                if count_reject and self.metrics is not None:
                     self.metrics.record_rejected()
                 raise RejectedError(
                     f"dtype {dtype!r} has not passed its parity gate; "
                     "refusing to serve it"
                 )
         if not 1 <= len(x) <= self.max_batch:
-            if self.metrics is not None:
+            if count_reject and self.metrics is not None:
                 self.metrics.record_rejected()
             raise RejectedError(
                 f"request of {len(x)} samples outside [1, {self.max_batch}]"
@@ -384,7 +420,7 @@ class MicroBatcher:
         try:
             self._queue.put_nowait(req)
         except queue.Full:
-            if self.metrics is not None:
+            if count_reject and self.metrics is not None:
                 self.metrics.record_rejected()
             raise RejectedError(
                 f"admission queue full ({self._queue.maxsize} deep)"
@@ -511,7 +547,7 @@ class MicroBatcher:
             # it can lose the increment/decrement race and leave a stale
             # depth on /metrics?format=prom (which never recomputes).
             if self.metrics is not None:
-                self.metrics.set_inflight(self._inflight)
+                self.metrics.set_inflight(self._inflight, replica=self.replica)
         self._completions.put(
             _InFlight(batch, logits, staged, bucket, total, stall_s, dtype)
         )
@@ -541,30 +577,46 @@ class MicroBatcher:
                     self.metrics.record_failed(len(item.batch))
             else:
                 done = time.perf_counter()
+                # Event schema note: the replica tag appears only in
+                # pool mode, so single-engine JSONL stays byte-stable.
+                tag = {"replica": self.replica} if self.replica else {}
                 offset = 0
                 for req in item.batch:
                     req.set_result(host[offset : offset + req.n])
                     offset += req.n
+                    latency_s = done - req.t_submit
                     if self.metrics is not None:
                         self.metrics.record_completed(
-                            done - req.t_submit, dtype=req.dtype
+                            latency_s, dtype=req.dtype
                         )
+                    if self.on_complete is not None:
+                        try:
+                            self.on_complete(latency_s)
+                        except Exception:
+                            # A hook failure must never kill the
+                            # completion worker: later batches would
+                            # sit in _completions forever and every
+                            # subsequent client would 504.
+                            pass
                     if self._sink:
                         self._sink.emit(
                             "serving_request", n=req.n,
-                            latency_s=done - req.t_submit,
-                            dtype=req.dtype,
+                            latency_s=latency_s,
+                            dtype=req.dtype, **tag,
                         )
             finally:
                 self._staging.release(item.staged, item.bucket)
                 with self._inflight_lock:
                     self._inflight -= 1
                     if self.metrics is not None:
-                        self.metrics.set_inflight(self._inflight)
+                        self.metrics.set_inflight(
+                            self._inflight, replica=self.replica
+                        )
                 self._window.release()
             if self._sink:
                 self._sink.emit(
                     "serving_batch", real=item.n, bucket=item.bucket,
                     fill_ratio=item.n / item.bucket, stall_s=item.stall_s,
                     dtype=item.dtype,
+                    **({"replica": self.replica} if self.replica else {}),
                 )

@@ -133,8 +133,10 @@ class InferenceEngine:
         :meth:`verify_parity` before it may serve.
     aot_cache:
         Directory for serialized per-(dtype, bucket) executables
-        (compile/aot.ExecutableStore); a warm start deserializes every
-        rung instead of tracing.  Omitted = plain jit + sentinel.
+        (compile/aot.ExecutableStore), or an already-constructed
+        ``ExecutableStore`` to share (the replica pool passes one store
+        to every engine); a warm start deserializes every rung instead
+        of tracing.  Omitted = plain jit + sentinel.
     device_stage:
         Commit inputs to the data-axis sharding with an async
         ``device_put`` before dispatch.  Default (None) = auto: on when
@@ -245,13 +247,22 @@ class InferenceEngine:
         if aot_cache:
             from ..compile import ExecutableStore
 
-            self._aot_store = ExecutableStore(
-                aot_cache,
-                registry=registry,
-                # Hold the whole dtype x bucket grid plus headroom for one
-                # config change; the default bound would prune mid-grid.
-                max_entries=2 * len(self._variants) * len(self.buckets) + 4,
-            )
+            if isinstance(aot_cache, ExecutableStore):
+                # Pool mode (serving/pool.py): N replicas share ONE
+                # store object over one directory, sized by the pool for
+                # the full replicas x dtypes x buckets grid.  The store
+                # is concurrent-writer safe (compile/aot.py), so the
+                # replicas' warmups may populate it in parallel.
+                self._aot_store = aot_cache
+            else:
+                self._aot_store = ExecutableStore(
+                    aot_cache,
+                    registry=registry,
+                    # Hold the whole dtype x bucket grid plus headroom for
+                    # one config change; the default bound would prune
+                    # mid-grid.
+                    max_entries=2 * len(self._variants) * len(self.buckets) + 4,
+                )
             for v in self._variants.values():
                 v.table = {}
         self.warmed = False
@@ -383,6 +394,14 @@ class InferenceEngine:
                 "dtype": v.name,
                 "bucket": int(b),
                 "mesh": {str(k): int(s) for k, s in self.mesh.shape.items()},
+                # Concrete device ids, not just the mesh shape: a
+                # serialized executable pins its compile-time devices
+                # (jax pickles them BY ID and the XLA device assignment
+                # rides the payload), so two replicas' same-shape meshes
+                # on different devices must never alias one entry — the
+                # deserialized program would silently run on the wrong
+                # chip or refuse the replica's committed inputs.
+                "devices": [int(d.id) for d in self.mesh.devices.flat],
                 "use_bn": self.use_bn,
                 "conv_impl": self._conv_impl,
                 "device_stage": self.device_stage,

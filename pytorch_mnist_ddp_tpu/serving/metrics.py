@@ -155,9 +155,27 @@ class ServingMetrics:
         """Dispatch thread blocked ``stall_s`` on a full in-flight window."""
         self._stall.observe(stall_s)
 
-    def set_inflight(self, depth: int) -> None:
-        """Current launched-not-yet-completed batch count (gauge)."""
-        self._inflight.set(depth)
+    def set_inflight(self, depth: int, replica: str | None = None) -> None:
+        """Current launched-not-yet-completed batch count (gauge).
+
+        With ``replica`` (pool mode, serving/router.py) the count lands
+        on the labeled ``serving_replica_inflight{replica=}`` family
+        INSTEAD of the plain gauge — N batchers sharing one metrics
+        object would otherwise race each other's unlabeled writes into
+        a meaningless last-writer value.  The labeled family (or a sum
+        over it) is therefore the pool's Prometheus surface; the
+        unlabeled gauge stays 0 there, and the router-computed
+        aggregate appears only in the JSON snapshot's
+        ``pipeline.inflight`` field."""
+        if replica is None:
+            self._inflight.set(depth)
+            return
+        self.registry.gauge(
+            "serving_replica_inflight",
+            help="per-replica batches launched on the device, result not "
+            "yet read back (pool mode)",
+            replica=replica,
+        ).set(depth)
 
     def record_completed(self, latency_s: float, dtype: str | None = None) -> None:
         """One request finished; ``latency_s`` spans submit -> result set.
@@ -199,6 +217,7 @@ class ServingMetrics:
         inflight: int | None = None,
         max_inflight: int | None = None,
         linger_ms: float | None = None,
+        replicas: dict | None = None,
     ) -> dict:
         """One consistent dict of everything (the /metrics JSON payload).
 
@@ -297,6 +316,11 @@ class ServingMetrics:
             snap["pipeline"]["max_inflight"] = max_inflight
         if linger_ms is not None:
             snap["pipeline"]["linger_ms"] = linger_ms
+        if replicas is not None:
+            # Pool mode (serving/router.py): per-replica live state, as
+            # provided by the router's replica_stats() — queue depth,
+            # in-flight, EWMA latency, drain state per replica.
+            snap["replicas"] = replicas
         if compiles is not None:
             snap["compiles"] = compiles
         if buckets is not None:

@@ -1,0 +1,360 @@
+"""EnginePool: one InferenceEngine replica per device, one warm cache.
+
+The scale-out half of the serving subsystem (the other half is the
+router, serving/router.py): everything a single-engine deployment does —
+bucket-warmed forward, per-dtype variants behind parity gates, the PR-4
+pipelined batcher — replicated once per visible device, behind one
+admission front.  Per host, aggregate goodput is then bounded by devices
+x per-replica throughput instead of by the one dispatch chain a single
+process can drive.
+
+Design points:
+
+- **Explicit device pinning.**  Each replica's engine lives on a 1x1
+  mesh over exactly one device (parallel/mesh.single_device_mesh), so
+  staging (``device_put`` against the replica's data-axis sharding) and
+  dispatch land on that device and nowhere else.  The checkpoint is
+  loaded ONCE on the host; each engine places its own device copy.
+- **One shared ExecutableStore.**  All replicas warm against a single
+  AOT cache directory (``aot_cache``), sized for the full replicas x
+  dtypes x buckets grid.  Entries are keyed per device (serialized
+  executables pin their compile-time device ids — serving/engine.py),
+  so replica k's grid is its own set of entries: a COLD pool start
+  compiles each replica's grid (concurrently, through each engine's
+  compile-service fan-out), and every later start of the same pool
+  shape deserializes the whole grid with **zero traces** — the
+  warm-pool contract tests/test_scaleout.py pins via the store's
+  hit/miss counters.  Sentinel budgets are per replica and unchanged:
+  ``len(buckets)`` traces per variant per replica, ever.
+- **Elasticity.**  ``drain(name)`` delegates to the router (mark
+  unroutable, then the PR-4 ``stop(drain=True)``); the engine stays
+  warm, so ``add(name)`` rebuilds only the batcher — re-adding capacity
+  costs no compile, no checkpoint reload, no parity re-gate.
+
+The pool deliberately exposes the single-engine surface the server and
+loadgen already consume (``buckets``/``dtypes``/``variant_verified``/
+``compile_count``/``warmed``/``use_bn``): ``make_server(pool, metrics,
+batcher=router)`` is the whole wiring difference between one replica
+and eight.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Sequence
+
+from ..parallel.mesh import replica_devices, single_device_mesh
+from .buckets import DEFAULT_MAX_BUCKET, pow2_buckets
+from .engine import InferenceEngine
+from .metrics import ServingMetrics
+from .router import Replica, Router
+
+# Replica names are positional and stable across drain/add cycles:
+# r0..rN-1, the labels on every per-replica metric family.
+def _replica_name(i: int) -> str:
+    return f"r{i}"
+
+
+class EnginePool:
+    """Per-device InferenceEngine replicas sharing weights and AOT cache.
+
+    Parameters mirror :class:`~.engine.InferenceEngine` where they mean
+    the same thing; ``replicas`` picks the pool size (default: one per
+    local device), ``devices`` overrides the assignment explicitly.
+    """
+
+    def __init__(
+        self,
+        variables: dict[str, Any],
+        replicas: int | None = None,
+        devices: Sequence | None = None,
+        buckets: Sequence[int] | None = None,
+        max_bucket: int | None = None,
+        dtypes: Sequence[str] | None = None,
+        aot_cache: str | None = None,
+        metrics: ServingMetrics | None = None,
+        conv_impl: str = "conv",
+        device_stage: bool | None = None,
+        compute_dtype=None,
+    ):
+        assigned = replica_devices(replicas, devices)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        registry = self.metrics.registry
+        dtypes = tuple(dtypes or ())
+        if buckets is None:
+            # Resolve the default ladder ONCE and hand every engine the
+            # explicit result: the store sizing below and the engines'
+            # rung grids must agree exactly (a drift under-sizes the
+            # shared store, and replica N's warmup would prune replica
+            # 1's just-written entries).  Min bucket 1 = n_shards on the
+            # single-device meshes every replica runs on.
+            buckets = pow2_buckets(1, max_bucket or DEFAULT_MAX_BUCKET)
+            max_bucket = None
+        self._store = None
+        if aot_cache:
+            from ..compile import ExecutableStore
+
+            # Sized for the WHOLE pool grid (+ headroom for one config
+            # change): per-engine sizing would let replica 8's warmup
+            # prune replica 1's just-written entries.
+            self._store = ExecutableStore(
+                aot_cache,
+                registry=registry,
+                max_entries=(
+                    2 * len(assigned) * (1 + len(dtypes)) * len(buckets) + 4
+                ),
+            )
+        self.engines: list[InferenceEngine] = []
+        for device in assigned:
+            # Per-replica engine construction carries BOTH pool
+            # disciplines jaxlint JL012 checks for: an explicit mesh pin
+            # (no replica ends up wherever jax defaults) and the shared
+            # AOT store (no replica re-compiles what another persisted).
+            self.engines.append(
+                InferenceEngine(
+                    variables,
+                    mesh=single_device_mesh(device),
+                    buckets=buckets,
+                    max_bucket=max_bucket,
+                    compute_dtype=compute_dtype,
+                    conv_impl=conv_impl,
+                    metrics=self.metrics,
+                    dtypes=dtypes,
+                    aot_cache=self._store,
+                    device_stage=device_stage,
+                )
+            )
+        self.devices = list(assigned)
+        self.router: Router | None = None
+        self._batcher_kwargs: dict = {}
+        self._sink = None
+        self._add_lock = threading.Lock()
+
+    # -- construction helpers (the engine's surface, pool-shaped) -------------
+
+    @classmethod
+    def from_checkpoint(cls, path: str, **kwargs) -> "EnginePool":
+        """Load the checkpoint ONCE, place it per replica."""
+        from ..utils.checkpoint import load_inference_variables
+
+        return cls(load_inference_variables(path), **kwargs)
+
+    @classmethod
+    def from_seed(cls, seed: int = 1, **kwargs) -> "EnginePool":
+        from ..models.net import init_params
+        from ..utils.rng import root_key, split_streams
+
+        key = split_streams(root_key(seed))["init"]
+        return cls({"params": init_params(key)}, **kwargs)
+
+    # -- single-engine-compatible surface --------------------------------------
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self.engines)
+
+    @property
+    def replica_names(self) -> list[str]:
+        return [_replica_name(i) for i in range(len(self.engines))]
+
+    @property
+    def buckets(self):
+        return self.engines[0].buckets
+
+    @property
+    def dtypes(self):
+        return self.engines[0].dtypes
+
+    @property
+    def default_dtype(self):
+        return self.engines[0].default_dtype
+
+    @property
+    def use_bn(self):
+        return self.engines[0].use_bn
+
+    @property
+    def warmed(self) -> bool:
+        return all(e.warmed for e in self.engines)
+
+    @property
+    def parity_report(self) -> dict:
+        return self.engines[0].parity_report
+
+    def variant_verified(self, dtype: str | None) -> bool:
+        return all(e.variant_verified(dtype) for e in self.engines)
+
+    def compile_count(self) -> int:
+        """Distinct traces across every replica and variant (the /metrics
+        ``compiles`` field; 0 in AOT mode, where rungs deserialize)."""
+        return sum(e.compile_count() for e in self.engines)
+
+    # -- lifecycle --------------------------------------------------------------
+
+    def warmup(
+        self, parallel: bool = True, sink=None, on_rung=None
+    ) -> None:
+        """Warm every replica's full dtype x bucket grid.
+
+        Replicas warm CONCURRENTLY (one thread each, each fanning its
+        own rungs over a compile service when ``parallel``): a cold pool
+        pays roughly the wall time of one replica's warmup, and a warm
+        pool deserializes everything.  ``on_rung(replica, dtype, bucket,
+        pool_compiles)`` reports progress across the whole grid.
+        """
+        self._sink = sink
+        if len(self.engines) == 1 or not parallel:
+            for i, engine in enumerate(self.engines):
+                self._warm_one(i, engine, parallel, sink, on_rung)
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(self.engines)) as pool:
+            futures = [
+                pool.submit(self._warm_one, i, engine, parallel, sink, on_rung)
+                for i, engine in enumerate(self.engines)
+            ]
+            for f in futures:
+                f.result()  # surface the first warmup failure, not hang
+
+    def _warm_one(self, i, engine, parallel, sink, on_rung) -> None:
+        name = _replica_name(i)
+        engine.warmup(
+            parallel=parallel,
+            sink=sink,
+            on_rung=(
+                None if on_rung is None
+                else lambda dtype, bucket, _n: on_rung(
+                    name, dtype, bucket, self.compile_count()
+                )
+            ),
+        )
+
+    def verify_parity(
+        self, tol=None, raise_on_failure: bool = False, sink=None
+    ) -> dict[str, dict]:
+        """Gate reduced-precision variants on EVERY replica.
+
+        Replicas hold identical weights, but each runs its own compiled
+        program on its own device — the gate proves each replica's
+        actual executables, not a representative's.  The returned
+        per-dtype results are replica 0's when the whole pool passed;
+        a variant that fails on ANY replica returns that replica's
+        failing result (tagged with ``"replica"``) so non-raising
+        callers — the serving CLI's refuse-to-start gate — see the
+        pool-wide verdict, not a representative's.
+        """
+        results: dict[str, dict] = {}
+        for i, engine in enumerate(self.engines):
+            name = _replica_name(i)
+            r = engine.verify_parity(
+                tol=tol, raise_on_failure=raise_on_failure,
+                sink=sink if i == 0 else None,  # one gate event set, not N
+            )
+            for dtype, gate in r.items():
+                if not gate["passed"]:
+                    gate = dict(gate, replica=name)
+                if dtype not in results or (
+                    not gate["passed"] and results[dtype]["passed"]
+                ):
+                    results[dtype] = gate
+        return results
+
+    # -- batchers + router -------------------------------------------------------
+
+    def start(
+        self, router_policy: str = "cost", sink=None, **batcher_kwargs
+    ) -> Router:
+        """Start one pipelined batcher per replica and build the router.
+
+        ``batcher_kwargs`` (linger, queue depth, timeouts, in-flight
+        window...) are remembered so :meth:`add` rebuilds identical
+        batchers later.
+        """
+        if self.router is not None:
+            raise RuntimeError("pool already started")
+        self._batcher_kwargs = dict(batcher_kwargs)
+        self._sink = sink if sink is not None else self._sink
+        replicas = []
+        for i, engine in enumerate(self.engines):
+            name = _replica_name(i)
+            batcher = self._make_batcher(name, engine)
+            replica = Replica(name, batcher, engine=engine)
+            # The completion worker feeds the router's cost policy.
+            batcher.on_complete = replica.observe_latency
+            batcher.start()
+            replicas.append(replica)
+        self.router = Router(
+            replicas,
+            policy=router_policy,
+            registry=self.metrics.registry,
+            sink=self._sink,
+            metrics=self.metrics,
+        )
+        return self.router
+
+    def _make_batcher(self, name: str, engine: InferenceEngine):
+        from .batcher import MicroBatcher
+
+        return MicroBatcher(
+            engine,
+            metrics=self.metrics,
+            sink=self._sink,
+            replica=name,
+            **self._batcher_kwargs,
+        )
+
+    # -- elasticity ---------------------------------------------------------------
+
+    def drain(self, name: str) -> float:
+        """Gracefully remove one replica under live traffic (router
+        ordering: unroutable first, then drain queue + window — nothing
+        dropped or duplicated).  The engine stays warm for :meth:`add`."""
+        if self.router is None:
+            raise RuntimeError("pool not started")
+        return self.router.drain(name)
+
+    def add(self, name: str | None = None) -> str:
+        """Re-add a drained replica (or the next drained one) under live
+        traffic.  Only the batcher is rebuilt: the engine kept its warmed
+        executables and parity state, so new capacity is routable in
+        milliseconds — the warm-elasticity contract."""
+        if self.router is None:
+            raise RuntimeError("pool not started")
+        # Serialized: two concurrent add() calls racing to the same
+        # drained replica would each build AND start a batcher, and the
+        # attach() loser's worker threads would be orphaned unstoppable.
+        with self._add_lock:
+            candidates = [
+                r for r in self.router.replicas
+                if r.state == "drained" and (name is None or r.name == name)
+            ]
+            if not candidates:
+                raise RuntimeError(
+                    f"no drained replica "
+                    f"{'named ' + name if name else 'available'}"
+                )
+            replica = candidates[0]
+            if replica.engine is None:
+                # Registered via Router.attach's new-replica path, which
+                # carries no engine to rebuild a batcher around.
+                raise RuntimeError(
+                    f"replica {replica.name!r} has no engine; re-add it "
+                    f"with router.attach(name, batcher)"
+                )
+            t0 = time.perf_counter()
+            batcher = self._make_batcher(replica.name, replica.engine)
+            batcher.on_complete = replica.observe_latency
+            batcher.start()
+            self.router.attach(replica.name, batcher)
+        if self._sink:
+            self._sink.emit(
+                "replica_add", replica=replica.name,
+                duration_s=time.perf_counter() - t0,
+            )
+        return replica.name
+
+    def stop(self, drain: bool = True) -> None:
+        if self.router is not None:
+            self.router.stop(drain=drain)

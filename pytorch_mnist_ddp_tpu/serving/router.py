@@ -1,0 +1,434 @@
+"""Queue-aware admission router over a pool of serving replicas.
+
+The single-engine stack tops out at one device: one dispatch worker, one
+in-flight window, one queue (serving/batcher.py).  Scale-out runs one
+full PR-4 pipeline — engine + micro-batcher — per device and puts this
+router in front as the shared admission surface: HTTP handlers (or any
+caller) ``submit()`` here, and the router places each request onto a
+replica whose batcher then coalesces it with same-replica neighbors.
+
+Placement policies (the ``--router-policy`` A/B switch):
+
+- **roundrobin** — rotate over active replicas; the baseline that
+  ignores load entirely.
+- **least-loaded** — smallest ``queue depth + in-flight batches``; the
+  live PR-3/4 gauges are exactly the load signal.
+- **cost** (default) — expected time-to-answer: ``(load + 1) x EWMA
+  request latency`` per replica, where the EWMA is fed by each
+  batcher's completion worker (``on_complete`` hook).  A replica that
+  has gone slow (thermals, a noisy neighbor, a bigger device queue than
+  the gauges show) decays out of rotation even at equal queue depths.
+  Until a replica has a latency sample the score falls back to
+  least-loaded — the fallback the policy name promises.
+
+Every decision lands on ``serving_router_decisions_total{policy=,
+replica=}`` and (with a sink) as ``router_decision`` events, so the A/B
+is observable per placement, not just in aggregate.
+
+**Sharded dispatch.**  A request bigger than one replica's maximal
+batch — which a lone MicroBatcher rejects outright — is split into
+top-bucket-sized chunks placed independently (data-parallel over the
+pool, the multi-replica analogue of ``ddp.make_predict_step``'s
+data-axis sharding), and the returned :class:`ShardedRequest`
+reassembles chunk results in arrival order.  The cap becomes
+``active replicas x max_batch``.
+
+**Elasticity.**  :meth:`drain` removes a replica under live traffic:
+mark it unroutable FIRST, then run its batcher's PR-4 ``stop(drain=
+True)`` — everything already admitted or launched completes, nothing is
+dropped, torn, or duplicated, and the only externally visible change is
+capacity.  A submit that raced onto the draining replica either drains
+with it or is flushed with ``RejectedError`` at ``result()`` time — the
+HTTP handler resubmits such a never-executed request once, so the retry
+lands on a surviving replica (serving/server.py).
+:meth:`attach` re-adds a replica (a fresh batcher around a still-warm
+engine — the pool's ``add``).  Drain wall time is the
+``serving_replica_drain_seconds`` histogram + ``replica_drain`` events.
+
+Pure host-side stdlib + numpy (no jax import): policies, sharding, and
+drain ordering are all testable against fake engines at interactive
+speed (tests/test_scaleout.py), exactly like the batcher.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .batcher import MicroBatcher, PendingRequest, RejectedError
+
+POLICIES = ("roundrobin", "least-loaded", "cost")
+
+# EWMA smoothing for per-replica request latency: ~5 requests of memory,
+# fast enough to notice a replica going slow, smooth enough not to
+# thrash on one outlier.
+EWMA_ALPHA = 0.2
+
+
+class Replica:
+    """One routable replica: a name, its (started) batcher, optionally
+    the engine behind it, and the router-side load state.
+
+    The object is persistent across drain/re-add cycles — the router
+    holds it forever and :meth:`reactivate` swaps in a fresh batcher —
+    so membership changes never race list mutation in the hot path.
+    """
+
+    def __init__(self, name: str, batcher: MicroBatcher, engine=None):
+        self.name = name
+        self.batcher = batcher
+        self.engine = engine
+        self.state = "active"  # active | draining | drained
+        self._ewma_s: float | None = None
+
+    # -- load signals --------------------------------------------------------
+
+    def observe_latency(self, latency_s: float) -> None:
+        """Completion-worker hook (MicroBatcher ``on_complete``): feed
+        the per-replica EWMA the cost policy scores with."""
+        prev = self._ewma_s
+        self._ewma_s = (
+            latency_s if prev is None
+            else EWMA_ALPHA * latency_s + (1.0 - EWMA_ALPHA) * prev
+        )
+
+    @property
+    def ewma_latency_s(self) -> float | None:
+        return self._ewma_s
+
+    def load(self) -> int:
+        """Queue depth + in-flight batches — the live backlog."""
+        return self.batcher.depth() + self.batcher.inflight()
+
+    # -- membership ----------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self.state == "active"
+
+    def reactivate(self, batcher: MicroBatcher) -> None:
+        if self.state != "drained":
+            raise RuntimeError(
+                f"replica {self.name!r} is {self.state}, not drained; "
+                "drain it before attaching a new batcher"
+            )
+        self.batcher = batcher
+        self._ewma_s = None  # stale latency must not bias placement
+        self.state = "active"
+
+
+class ShardedRequest:
+    """N chunk requests posing as one: data-parallel sharded dispatch.
+
+    ``result()`` concatenates chunk results in submit (= arrival) order,
+    so the caller sees exactly the rows it sent, reassembled.  Any chunk
+    error propagates as the request's error (remaining chunks still
+    complete on their replicas; device work is never torn mid-batch).
+    """
+
+    def __init__(self, parts: list[PendingRequest]):
+        self._parts = parts
+        self._value: np.ndarray | None = None
+
+    @property
+    def n(self) -> int:
+        return sum(p.n for p in self._parts)
+
+    def result(self, grace_s: float = 1.0) -> np.ndarray:
+        if self._value is None:
+            self._value = np.concatenate(
+                [p.result(grace_s) for p in self._parts]
+            )
+        return self._value
+
+
+class Router:
+    """Shared admission front: place requests over replica batchers.
+
+    ``submit()`` mirrors the MicroBatcher surface (the HTTP handlers and
+    the loadgen cannot tell a router from a batcher), plus the
+    aggregate ``depth``/``inflight`` reads the server's ``/metrics``
+    snapshot uses.  Thread-safe: any number of handler threads submit
+    concurrently; membership changes (:meth:`drain`/:meth:`attach`)
+    take the same lock as placement ordering.
+    """
+
+    def __init__(
+        self,
+        replicas: list[Replica],
+        policy: str = "cost",
+        registry=None,
+        sink=None,
+        metrics=None,
+    ):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown router policy {policy!r}; have {POLICIES}")
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        self.policy = policy
+        self.replicas = list(replicas)
+        self.metrics = metrics
+        self._registry = registry
+        self._sink = sink
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._drain_hist = (
+            registry.histogram(
+                "serving_replica_drain_seconds",
+                help="wall time of a graceful replica drain (queue + "
+                "in-flight window finished, nothing dropped)",
+            )
+            if registry is not None
+            else None
+        )
+
+    # -- membership / aggregate reads ----------------------------------------
+
+    def active(self) -> list[Replica]:
+        with self._lock:
+            return [r for r in self.replicas if r.active]
+
+    def replica(self, name: str) -> Replica:
+        for r in self.replicas:
+            if r.name == name:
+                return r
+        raise KeyError(f"no replica named {name!r}")
+
+    def depth(self) -> int:
+        """Summed admission-queue depth over ALL replicas — a draining
+        replica's queued work still occupies its device and must not
+        read as load that vanished (drained batchers report 0)."""
+        return sum(r.batcher.depth() for r in self.replicas)
+
+    def inflight(self) -> int:
+        """Summed launched-not-yet-read batches over ALL replicas (see
+        :meth:`depth` — draining work is still live work)."""
+        return sum(r.batcher.inflight() for r in self.replicas)
+
+    @property
+    def max_inflight(self) -> int:
+        return sum(r.batcher.max_inflight for r in self.active())
+
+    @property
+    def timeout_s(self) -> float:
+        """The pool's default per-request deadline (min over replicas)
+        — lets the handler's drain-race retry pass the REMAINING budget
+        instead of granting the resubmission a fresh full deadline."""
+        return min(r.batcher.timeout_s for r in self.replicas)
+
+    @property
+    def current_linger_ms(self) -> float:
+        lingers = [r.batcher.current_linger_ms for r in self.active()]
+        return sum(lingers) / len(lingers) if lingers else 0.0
+
+    def replica_stats(self) -> dict[str, dict]:
+        """Per-replica live state: the ``/metrics`` ``replicas`` block."""
+        return {
+            r.name: {
+                "state": r.state,
+                "queue_depth": r.batcher.depth(),
+                "inflight": r.batcher.inflight(),
+                "ewma_latency_ms": (
+                    1e3 * r.ewma_latency_s
+                    if r.ewma_latency_s is not None else None
+                ),
+            }
+            for r in self.replicas
+        }
+
+    # -- placement ------------------------------------------------------------
+
+    def _order(self, active: list[Replica]) -> list[Replica]:
+        """Active replicas, best placement first, under the lock."""
+        with self._lock:
+            rotation = self._rr
+            self._rr += 1
+        if self.policy == "roundrobin":
+            k = rotation % len(active)
+            return active[k:] + active[:k]
+        if self.policy == "least-loaded":
+            key = lambda r: r.load()  # noqa: E731 - local sort key
+        else:
+            # cost: expected time-to-answer = (backlog + this request) x
+            # EWMA latency.  A replica without samples yet (fresh, or
+            # just re-added) scores with the pool-mean EWMA as its prior
+            # — NOT last place, which would starve it of the very
+            # traffic that builds its estimate; with no samples anywhere
+            # the policy degrades to least-loaded (the documented
+            # fallback).
+            ewmas = [
+                r.ewma_latency_s for r in active
+                if r.ewma_latency_s is not None
+            ]
+            if not ewmas:
+                key = lambda r: r.load()  # noqa: E731 - local sort key
+            else:
+                prior = sum(ewmas) / len(ewmas)
+
+                def key(r: Replica):
+                    ewma = r.ewma_latency_s
+                    return (r.load() + 1) * (prior if ewma is None else ewma)
+        # Rotate before the stable sort so exact ties spread over
+        # replicas instead of always landing on the first name.
+        k = rotation % len(active)
+        return sorted(active[k:] + active[:k], key=key)
+
+    def _note(self, replica: Replica, rows: int) -> None:
+        if self._registry is not None:
+            self._registry.counter(
+                "serving_router_decisions_total",
+                help="request placements by policy and chosen replica",
+                policy=self.policy,
+                replica=replica.name,
+            ).inc()
+        if self._sink:
+            self._sink.emit(
+                "router_decision", policy=self.policy,
+                replica=replica.name, rows=rows,
+            )
+
+    def submit(
+        self,
+        x: np.ndarray,
+        timeout_ms: float | None = None,
+        dtype: str | None = None,
+    ) -> PendingRequest | ShardedRequest:
+        """Place one request (or its shards) onto the pool.
+
+        Tries replicas in policy order: a replica that rejects (queue
+        full, or a drain racing this submit) is transparently skipped —
+        only when EVERY active replica refuses does the caller see the
+        503.  Per-attempt rejections are not double-counted on the
+        metrics surface (only the final, client-visible one is).
+        """
+        active = self.active()
+        if not active:
+            if self.metrics is not None:
+                self.metrics.record_rejected()
+            raise RejectedError("no active replicas")
+        x = np.asarray(x, np.float32)
+        cap = min(r.batcher.max_batch for r in active)
+        if len(x) > cap:
+            return self._submit_sharded(x, active, cap, timeout_ms, dtype)
+        return self._place(x, active, timeout_ms, dtype)
+
+    def _place(self, x, active, timeout_ms, dtype) -> PendingRequest:
+        # ``active`` is the submit-time snapshot (one lock round-trip
+        # per request, shared across a sharded request's chunks).  A
+        # replica drained after the snapshot rejects at its batcher and
+        # is skipped like any other refusal.
+        order = self._order(active)
+        last = order[-1]
+        for r in order:
+            try:
+                req = r.batcher.submit(
+                    x, timeout_ms=timeout_ms, dtype=dtype,
+                    count_reject=r is last,
+                )
+            except RejectedError:
+                if r is last:
+                    raise
+                continue
+            self._note(r, len(x))
+            return req
+        raise RejectedError("no active replicas")  # unreachable: order != []
+
+    def _submit_sharded(self, x, active, cap, timeout_ms, dtype) -> ShardedRequest:
+        """Chunks are placed sequentially; a rejection mid-placement
+        (every replica full) propagates to the client as one 503, while
+        chunks already admitted drain normally on their replicas — their
+        finished device work is discarded, exactly as for a client that
+        disconnects mid-request.  The client-visible contract stays
+        atomic: one request, one answer or one error, never a partial
+        result."""
+        if len(x) > cap * len(active):
+            if self.metrics is not None:
+                self.metrics.record_rejected()
+            raise RejectedError(
+                f"request of {len(x)} samples exceeds pool capacity "
+                f"({len(active)} replicas x {cap} max batch)"
+            )
+        # Near-equal chunks preserve arrival order (chunk i = rows
+        # [offsets[i], offsets[i+1])) and spread the work instead of
+        # filling replica 1 and sending replica 2 the remainder.
+        n_chunks = -(-len(x) // cap)
+        bounds = np.linspace(0, len(x), n_chunks + 1).astype(int)
+        parts = [
+            self._place(x[lo:hi], active, timeout_ms, dtype)
+            for lo, hi in zip(bounds[:-1], bounds[1:])
+        ]
+        return ShardedRequest(parts)
+
+    # -- elasticity ------------------------------------------------------------
+
+    def drain(self, name: str) -> float:
+        """Gracefully remove one replica under live traffic.
+
+        Ordering is the correctness: the replica is marked unroutable
+        BEFORE its batcher drains, so no new placement can land on it
+        mid-drain; ``stop(drain=True)`` then finishes its queue and
+        in-flight window (the PR-4 guarantee — nothing lost, nothing
+        duplicated).  Returns (and records) the drain wall seconds.
+        """
+        replica = self.replica(name)
+        with self._lock:
+            if not replica.active:
+                raise RuntimeError(
+                    f"replica {name!r} is {replica.state}, not active"
+                )
+            if sum(1 for r in self.replicas if r.active) == 1:
+                raise RuntimeError(
+                    f"refusing to drain {name!r}: it is the last active "
+                    "replica (stop the server instead)"
+                )
+            replica.state = "draining"
+        t0 = time.perf_counter()
+        replica.batcher.stop(drain=True)
+        duration = time.perf_counter() - t0
+        replica.state = "drained"
+        if self._drain_hist is not None:
+            self._drain_hist.observe(duration)
+        if self._sink:
+            self._sink.emit(
+                "replica_drain", replica=name, duration_s=duration
+            )
+        return duration
+
+    def attach(self, name: str, batcher: MicroBatcher) -> Replica:
+        """Re-add a drained replica with a fresh (started) batcher, or
+        register a brand-new one.  Routable as soon as this returns."""
+        with self._lock:
+            for r in self.replicas:
+                if r.name == name:
+                    r.reactivate(batcher)
+                    return r
+            replica = Replica(name, batcher)
+            self.replicas.append(replica)
+            return replica
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop every active replica's batcher (draining by default).
+        Replicas already drained are left alone.  Drains run
+        concurrently — each replica's queue/window finishes on its own
+        device, so shutdown wall time is the slowest drain, not the
+        sum of all of them."""
+        stopping = [r for r in self.replicas if r.state != "drained"]
+        for r in stopping:
+            r.state = "draining"
+        if not stopping:
+            return
+
+        def _stop(r: Replica) -> None:
+            r.batcher.stop(drain=drain)
+            r.state = "drained"
+
+        with ThreadPoolExecutor(max_workers=len(stopping)) as pool:
+            list(pool.map(_stop, stopping))

@@ -33,6 +33,7 @@ here costs no device-side contention.
 from __future__ import annotations
 
 import json
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
@@ -105,23 +106,28 @@ class ServingHandler(BaseHTTPRequestHandler):
         url = urlsplit(self.path)
         if url.path == "/healthz":
             engine = srv.engine
-            self._send_json(
-                200,
-                {
-                    "status": "ok",
-                    "warmed": engine.warmed,
-                    "buckets": list(engine.buckets),
-                    # Which dtype variants may serve right now (a False
-                    # entry is warmed but refused: parity gate not
-                    # passed — docs/SERVING.md).
-                    "dtypes": {
-                        name: getattr(
-                            engine, "variant_verified", lambda _d: True
-                        )(name)
-                        for name in getattr(engine, "dtypes", ("f32",))
-                    },
+            health = {
+                "status": "ok",
+                "warmed": engine.warmed,
+                "buckets": list(engine.buckets),
+                # Which dtype variants may serve right now (a False
+                # entry is warmed but refused: parity gate not
+                # passed — docs/SERVING.md).
+                "dtypes": {
+                    name: getattr(
+                        engine, "variant_verified", lambda _d: True
+                    )(name)
+                    for name in getattr(engine, "dtypes", ("f32",))
                 },
-            )
+            }
+            # Pool mode: per-replica drain state, so an operator can see
+            # a drain as capacity (state != active) rather than guess.
+            stats = getattr(srv.batcher, "replica_stats", None)
+            if stats is not None:
+                health["replicas"] = {
+                    name: s["state"] for name, s in stats().items()
+                }
+            self._send_json(200, health)
         elif url.path == "/metrics":
             # Content negotiation: JSON stays the default (the PR-2
             # surface, nothing breaks); Prometheus text is selected by
@@ -171,8 +177,46 @@ class ServingHandler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": str(e)})
             return
         try:
-            request = srv.batcher.submit(x, dtype=dtype)
-            logits = request.result()
+            # Pool mode only: a drain racing this handler can flush an
+            # already-admitted request out of a replica's queue with
+            # RejectedError AFTER submit() returned (batcher stop()'s
+            # post-join flush).  The flushed work never ran, so one
+            # resubmission cannot duplicate it — the router places the
+            # retry on a surviving replica.  A single engine that
+            # flushes is shutting down outright: nothing to retry onto,
+            # and its flush accounting (PR 4) is already client-visible.
+            attempts = 2 if getattr(srv.batcher, "replicas", None) else 1
+            t0 = time.perf_counter()
+            for attempt in range(attempts):
+                # The retry runs on the REMAINING budget of the original
+                # admission (router.timeout_s = min over replicas), not a
+                # fresh full deadline — the drain race must not double
+                # the client's worst-case latency.
+                remaining_ms = (
+                    None if attempt == 0 else max(
+                        0.0,
+                        1e3 * (
+                            srv.batcher.timeout_s
+                            - (time.perf_counter() - t0)
+                        ),
+                    )
+                )
+                request = srv.batcher.submit(
+                    x, dtype=dtype, timeout_ms=remaining_ms
+                )
+                try:
+                    logits = request.result()
+                    break
+                except RejectedError:
+                    if attempt + 1 < attempts:
+                        continue
+                    # Pool-mode flushes don't count themselves (the
+                    # retry may succeed); a result()-raised rejection
+                    # surviving the retry IS the client outcome, and
+                    # no submit-side counter fired for it.
+                    if attempts > 1 and srv.metrics is not None:
+                        srv.metrics.record_rejected()
+                    raise
         except RejectedError as e:
             self._send_json(503, {"error": str(e)})
             return
@@ -208,6 +252,10 @@ class ServingHTTPServer(ThreadingHTTPServer):
         self.metrics = metrics
 
     def snapshot(self) -> dict:
+        # Pool mode: the router exposes the same depth/inflight surface
+        # as a single batcher (aggregated over active replicas) plus a
+        # per-replica stats block the JSON payload carries verbatim.
+        stats = getattr(self.batcher, "replica_stats", None)
         return self.metrics.snapshot(
             queue_depth=self.batcher.depth(),
             compiles=self.engine.compile_count(),
@@ -215,6 +263,7 @@ class ServingHTTPServer(ThreadingHTTPServer):
             inflight=self.batcher.inflight(),
             max_inflight=self.batcher.max_inflight,
             linger_ms=self.batcher.current_linger_ms,
+            replicas=stats() if stats is not None else None,
         )
 
     def prometheus(self) -> str:
@@ -230,10 +279,22 @@ def make_server(
     metrics: ServingMetrics,
     host: str = "127.0.0.1",
     port: int = 0,
+    batcher=None,
     **batcher_kwargs,
 ) -> ServingHTTPServer:
     """Wire engine + metrics + a started batcher into a ready-to-run
     server (port 0 = OS-assigned, for tests and the in-process loadgen;
-    the bound port is ``server.server_address[1]``)."""
-    batcher = MicroBatcher(engine, metrics=metrics, **batcher_kwargs).start()
+    the bound port is ``server.server_address[1]``).
+
+    ``batcher`` injects an already-started admission front instead —
+    the replica pool's Router (serving/router.py), whose submit/depth/
+    inflight surface is batcher-compatible; ``engine`` is then the
+    EnginePool (same buckets/dtypes/compile_count surface)."""
+    if batcher is None:
+        batcher = MicroBatcher(engine, metrics=metrics, **batcher_kwargs).start()
+    elif batcher_kwargs:
+        raise ValueError(
+            "pass batcher kwargs to the pool's start(), not make_server, "
+            "when injecting a router"
+        )
     return ServingHTTPServer((host, port), engine, batcher, metrics)

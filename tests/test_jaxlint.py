@@ -1160,6 +1160,90 @@ def test_jl011_waiver():
 
 
 # ---------------------------------------------------------------------------
+# JL012 — per-replica engine construction without shared warm state
+
+
+JL012_BAD_FACTORY = """\
+import jax
+from pytorch_mnist_ddp_tpu.serving import InferenceEngine
+
+engines = []
+for device in jax.devices():
+    engines.append(InferenceEngine.from_seed(buckets=(8,)))
+"""
+
+JL012_BAD_CTOR = """\
+from pytorch_mnist_ddp_tpu.serving import InferenceEngine
+
+def build(variables, n):
+    out = []
+    for _ in range(n):
+        out.append(InferenceEngine(variables, buckets=(8,)))
+    return out
+"""
+
+JL012_GOOD_POOL_IDIOM = """\
+import jax
+from pytorch_mnist_ddp_tpu.serving import InferenceEngine
+from pytorch_mnist_ddp_tpu.parallel.mesh import single_device_mesh
+
+def build(variables, store):
+    engines = []
+    for device in jax.devices():
+        engines.append(InferenceEngine(
+            variables,
+            mesh=single_device_mesh(device),
+            aot_cache=store,
+        ))
+    return engines
+"""
+
+JL012_GOOD_SINGLE = """\
+from pytorch_mnist_ddp_tpu.serving import InferenceEngine
+
+engine = InferenceEngine.from_seed(buckets=(8,))
+"""
+
+
+def test_jl012_fires_on_factory_in_loop():
+    assert_fires(JL012_BAD_FACTORY, "JL012", line=6)
+
+
+def test_jl012_fires_on_constructor_in_loop():
+    assert_fires(JL012_BAD_CTOR, "JL012", line=6)
+
+
+def test_jl012_silent_on_the_pool_idiom():
+    # Explicit device pin + shared AOT store: exactly what the rule
+    # teaches (serving/pool.py builds its replicas this way).
+    assert_silent(JL012_GOOD_POOL_IDIOM, "JL012")
+
+
+def test_jl012_silent_on_either_sharing_kwarg_alone():
+    only_cache = JL012_BAD_FACTORY.replace(
+        "buckets=(8,)", "buckets=(8,), aot_cache=store"
+    )
+    assert_silent(only_cache, "JL012")
+    only_mesh = JL012_BAD_FACTORY.replace(
+        "buckets=(8,)", "buckets=(8,), device=device"
+    )
+    assert_silent(only_mesh, "JL012")
+
+
+def test_jl012_silent_outside_a_loop():
+    assert_silent(JL012_GOOD_SINGLE, "JL012")
+
+
+def test_jl012_waiver():
+    waived = JL012_BAD_FACTORY.replace(
+        "engines.append(InferenceEngine.from_seed(buckets=(8,)))",
+        "engines.append(InferenceEngine.from_seed(buckets=(8,)))"
+        "  # jaxlint: disable=JL012 -- compile benchmark: the cold re-trace IS the measurement",
+    )
+    assert_silent(waived, "JL012")
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

@@ -1,0 +1,722 @@
+"""Replicated serving scale-out tests (ISSUE 7): router policies,
+sharded dispatch, drain/re-add elasticity, the pool's structural
+throughput pin, the shared AOT store's concurrent-writer safety and
+warm-pool zero-trace start, and the HTTP surface over a real pool.
+
+Run alone with ``pytest -m scaleout`` (the CI ``scale-out`` job);
+everything here also rides the default smoke tier.  Router/elasticity
+logic runs against fake engines (the device-faithful ``_LazyLogits``
+async-completion fake from the PR-4 tests) at interactive speed; the
+pool/AOT/HTTP tests drive real engines on the 8-virtual-device CPU
+mesh (conftest.py).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.models.net import NUM_CLASSES
+from pytorch_mnist_ddp_tpu.obs.registry import Registry
+from pytorch_mnist_ddp_tpu.parallel.mesh import (
+    replica_devices,
+    single_device_mesh,
+)
+from pytorch_mnist_ddp_tpu.serving import (
+    EnginePool,
+    MicroBatcher,
+    RejectedError,
+    Replica,
+    Router,
+    ServingMetrics,
+    ShardedRequest,
+)
+
+pytestmark = pytest.mark.scaleout
+
+
+# ---------------------------------------------------------------------------
+# Fakes (the test_serving.py pattern: launch returns instantly, the
+# "compute" completes delay_s after launch — real accelerator semantics)
+
+
+class _LazyLogits:
+    def __init__(self, rows: np.ndarray, delay_s: float):
+        self._rows = np.array(rows, copy=True)
+        self._t_ready = time.perf_counter() + delay_s
+
+    def __array__(self, dtype=None, copy=None):
+        wait = self._t_ready - time.perf_counter()
+        if wait > 0:
+            time.sleep(wait)
+        out = np.zeros((len(self._rows), NUM_CLASSES), np.float32)
+        out[:, 0] = self._rows.reshape(len(self._rows), -1)[:, 0]
+        return out if dtype is None else out.astype(dtype)
+
+
+class FakeEngine:
+    def __init__(self, buckets=(8,), delay_s: float = 0.0):
+        self.buckets = tuple(buckets)
+        self.metrics = None
+        self.delay_s = delay_s
+        self.dispatches: list[int] = []
+
+    def launch(self, staged, n):
+        self.dispatches.append(n)
+        return _LazyLogits(staged, self.delay_s)
+
+
+def _rows(n, tag=1.0):
+    x = np.zeros((n, 28, 28, 1), np.float32)
+    x[:, 0, 0, 0] = tag
+    return x
+
+
+def _fake_pool(
+    n_replicas,
+    delay_s=0.0,
+    policy="least-loaded",
+    registry=None,
+    sink=None,
+    metrics=None,
+    **batcher_kwargs,
+):
+    """N started fake replicas behind a router; returns (router, engines)."""
+    metrics = metrics if metrics is not None else ServingMetrics()
+    kwargs = dict(linger_ms=0.0, adaptive_linger=False)
+    kwargs.update(batcher_kwargs)
+    replicas, engines = [], []
+    for i in range(n_replicas):
+        engine = FakeEngine(buckets=(8,), delay_s=delay_s)
+        batcher = MicroBatcher(
+            engine, metrics=metrics, replica=f"r{i}", sink=sink, **kwargs
+        )
+        replica = Replica(f"r{i}", batcher, engine=engine)
+        batcher.on_complete = replica.observe_latency
+        batcher.start()
+        replicas.append(replica)
+        engines.append(engine)
+    router = Router(
+        replicas, policy=policy, registry=registry, sink=sink, metrics=metrics
+    )
+    return router, engines
+
+
+# ---------------------------------------------------------------------------
+# Router policies
+
+
+def test_roundrobin_spreads_evenly():
+    registry = Registry()
+    router, engines = _fake_pool(4, policy="roundrobin", registry=registry)
+    reqs = [router.submit(_rows(8, tag=i)) for i in range(12)]
+    for r in reqs:
+        r.result()
+    router.stop()
+    assert sorted(len(e.dispatches) for e in engines) == [3, 3, 3, 3]
+    # Every placement landed on the decisions counter under its policy.
+    total = sum(
+        registry.counter(
+            "serving_router_decisions_total", policy="roundrobin",
+            replica=f"r{i}",
+        ).value
+        for i in range(4)
+    )
+    assert total == 12
+
+
+def test_least_loaded_prefers_the_empty_replica():
+    router, engines = _fake_pool(2, delay_s=0.05, policy="least-loaded")
+    # Load up r0 directly, bypassing the router.
+    busy = [router.replica("r0").batcher.submit(_rows(8)) for _ in range(3)]
+    req = router.submit(_rows(8, tag=7.0))
+    out = req.result()
+    assert out[0, 0] == pytest.approx(7.0)
+    for b in busy:
+        b.result()
+    router.stop()
+    # The routed request went to the idle replica, not the backlogged one.
+    assert len(engines[1].dispatches) == 1
+    assert len(engines[0].dispatches) == 3
+
+
+def test_cost_policy_prefers_the_faster_replica_and_never_starves_fresh():
+    router, _ = _fake_pool(2, policy="cost")
+    slow, fast = router.replica("r0"), router.replica("r1")
+    for _ in range(8):
+        slow.observe_latency(0.100)
+        fast.observe_latency(0.010)
+    # Equal (zero) load: cost = (0+1) x EWMA -> the fast replica wins.
+    order = router._order(router.active())
+    assert order[0] is fast
+    # A replica with NO samples scores with the pool-mean prior, not
+    # last place: at zero load it must beat the known-slow replica
+    # (starvation would otherwise keep it sample-less forever).
+    fresh = Replica("r2", slow.batcher)
+    order = router._order([slow, fast, fresh])
+    assert order.index(fresh) < order.index(slow)
+    router.stop()
+
+
+def test_router_submit_skips_draining_replica_without_client_503():
+    m = ServingMetrics()
+    router, engines = _fake_pool(2, policy="roundrobin", metrics=m)
+    # Close r0's batcher directly (the drain race shape: placement
+    # picked it just as it stopped accepting).
+    router.replica("r0").batcher.stop(drain=True)
+    outs = [router.submit(_rows(8, tag=i)).result() for i in range(4)]
+    router.stop()
+    for i, out in enumerate(outs):
+        assert out[0, 0] == pytest.approx(float(i))
+    assert len(engines[1].dispatches) == 4
+    # The skipped attempts were not client-visible rejections.
+    assert m.rejected == 0
+
+
+def test_router_rejects_when_every_replica_is_unavailable():
+    m = ServingMetrics()
+    router, _ = _fake_pool(2, metrics=m)
+    for r in router.replicas:
+        r.batcher.stop(drain=True)
+    with pytest.raises(RejectedError):
+        router.submit(_rows(4))
+    assert m.rejected == 1  # exactly one 503, not one per attempted replica
+    router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Sharded dispatch (oversized batches split across replicas)
+
+
+def test_sharded_dispatch_reassembles_in_arrival_order():
+    router, engines = _fake_pool(3, policy="roundrobin")
+    x = np.zeros((20, 28, 28, 1), np.float32)
+    x[:, 0, 0, 0] = np.arange(20, dtype=np.float32)
+    req = router.submit(x)  # 20 rows > the 8-row per-replica max batch
+    assert isinstance(req, ShardedRequest)
+    out = req.result()
+    router.stop()
+    assert out.shape == (20, NUM_CLASSES)
+    # Rows come back exactly in arrival order despite landing on three
+    # different replicas.
+    np.testing.assert_array_equal(out[:, 0], np.arange(20, dtype=np.float32))
+    assert sum(len(e.dispatches) for e in engines) == 3
+    assert req.n == 20
+
+
+def test_sharded_dispatch_caps_at_pool_capacity():
+    m = ServingMetrics()
+    router, _ = _fake_pool(2, metrics=m)  # capacity 2 x 8 = 16
+    with pytest.raises(RejectedError, match="pool capacity"):
+        router.submit(np.zeros((17, 28, 28, 1), np.float32))
+    assert m.rejected == 1
+    router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Elasticity: drain / re-add under live traffic (the satellite pin)
+
+
+def test_drain_mid_stream_loses_and_duplicates_nothing():
+    registry = Registry()
+    m = ServingMetrics(registry=registry)
+    router, engines = _fake_pool(
+        3, delay_s=0.005, policy="roundrobin", metrics=m, registry=registry
+    )
+    results: dict[int, np.ndarray] = {}
+    errors: list[BaseException] = []
+    lock = threading.Lock()
+
+    def feed(start, count):
+        for i in range(start, start + count):
+            try:
+                out = router.submit(_rows(2, tag=i)).result()
+            except BaseException as e:  # a drop/reject would land here
+                with lock:
+                    errors.append(e)
+                return
+            with lock:
+                results[i] = out
+
+    feeder = threading.Thread(target=feed, args=(0, 40))
+    feeder.start()
+    time.sleep(0.02)  # mid-stream: requests in queues and in flight
+    duration = router.drain("r1")
+    feeder.join()
+    # More traffic AFTER the drain: removal is observable only as capacity.
+    feed(40, 10)
+    router.stop()
+    assert not errors
+    assert sorted(results) == list(range(50))  # nothing lost
+    for i, out in results.items():  # nothing torn or cross-wired
+        assert out.shape == (2, NUM_CLASSES)
+        assert out[0, 0] == pytest.approx(float(i))
+    assert m.completed == 50 and m.failed == 0 and m.timed_out == 0
+    # Every admitted row dispatched exactly once across the pool.
+    assert sum(sum(e.dispatches) for e in engines) == 100
+    assert router.replica("r1").state == "drained"
+    assert duration >= 0.0
+    hist = registry.histogram("serving_replica_drain_seconds")
+    assert hist.count == 1
+
+
+def test_drained_replica_reattaches_and_serves_again():
+    m = ServingMetrics()
+    router, engines = _fake_pool(2, policy="roundrobin", metrics=m)
+    router.drain("r0")
+    assert [r.name for r in router.active()] == ["r1"]
+    fresh = MicroBatcher(
+        engines[0], metrics=m, replica="r0", linger_ms=0.0,
+        adaptive_linger=False,
+    )
+    replica = router.replica("r0")
+    fresh.on_complete = replica.observe_latency
+    fresh.start()
+    router.attach("r0", fresh)
+    assert replica.state == "active"
+    assert replica.ewma_latency_s is None  # stale EWMA must not bias placement
+    outs = [router.submit(_rows(8, tag=i)).result() for i in range(4)]
+    router.stop()
+    assert all(o.shape == (8, NUM_CLASSES) for o in outs)
+    # Both replicas took traffic again after the re-add (roundrobin over
+    # two active replicas splits the four full batches evenly).
+    assert len(engines[0].dispatches) == 2
+    assert len(engines[1].dispatches) == 2
+
+
+def test_refuses_to_drain_the_last_active_replica():
+    router, _ = _fake_pool(2)
+    router.drain("r0")
+    with pytest.raises(RuntimeError, match="last active"):
+        router.drain("r1")
+    router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Structural throughput pin: 4 replicas beat 1 by > 2.5x (the fake
+# completes delay_s after launch, like an accelerator — mirroring
+# test_pipeline_throughput_beats_serial_window's device-faithful method)
+
+
+def _drive_pool_batches(n_replicas: int, n_batches: int, delay_s: float) -> float:
+    # timeout far above the single-replica serial floor (n x delay):
+    # the 1-replica leg's later batches legitimately queue for seconds.
+    router, _ = _fake_pool(
+        n_replicas, delay_s=delay_s, policy="least-loaded", max_inflight=1,
+        timeout_ms=60_000.0,
+    )
+    reqs = [router.submit(_rows(8, tag=i)) for i in range(n_batches)]
+    t0 = time.perf_counter()
+    outs = [r.result() for r in reqs]
+    wall = time.perf_counter() - t0
+    router.stop()
+    for i, out in enumerate(outs):
+        assert out[0, 0] == pytest.approx(float(i))
+    return wall
+
+
+def test_pool_throughput_beats_single_replica():
+    # delay x n sized so the structural gap (1.6 s floor vs ~0.4 s
+    # pooled) dwarfs host-side scheduling noise on a loaded 2-core box.
+    delay, n = 0.05, 32
+    single = _drive_pool_batches(1, n, delay)
+    pooled = _drive_pool_batches(4, n, delay)
+    # One replica with a serial window is structurally floored at
+    # n x delay; four replicas run four batches' compute concurrently.
+    assert single >= n * delay
+    assert pooled < single / 2.5
+
+
+# ---------------------------------------------------------------------------
+# Shared ExecutableStore: concurrent writers (satellite 1)
+
+
+def test_executable_store_survives_concurrent_writers(devices, tmp_path):
+    from pytorch_mnist_ddp_tpu.compile import ExecutableStore
+
+    registry = Registry()
+    store = ExecutableStore(str(tmp_path), registry=registry, max_entries=32)
+
+    @jax.jit
+    def prog(x):
+        return jnp.tanh(x) + 1.0
+
+    shapes = [4, 8, 16, 32]
+    xs = {n: jnp.zeros((n,), jnp.float32) for n in shapes}
+
+    def warm(n):
+        # Two threads per key race load_or_compile on one directory —
+        # the replica-pool shape (N engines, one --aot-cache).
+        compiled, _ = store.load_or_compile(
+            f"prog[{n}]", {"program": "prog", "n": n},
+            lambda: prog.lower(xs[n]).compile(),
+        )
+        return np.asarray(compiled(xs[n]))
+
+    threads, outs = [], {}
+    lock = threading.Lock()
+
+    def run(i, n):
+        out = warm(n)
+        with lock:
+            outs[i] = (n, out)
+
+    for i, n in enumerate(shapes * 2):
+        threads.append(threading.Thread(target=run, args=(i, n)))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # Every racer got a working executable with the right result.
+    assert len(outs) == len(shapes) * 2
+    for n, out in outs.values():
+        np.testing.assert_array_equal(out, np.ones((n,), np.float32))
+    # No torn files: a fresh store over the same directory hits every
+    # key (a corrupt entry would fall back and count otherwise).
+    registry2 = Registry()
+    store2 = ExecutableStore(str(tmp_path), registry=registry2, max_entries=32)
+    for n in shapes:
+        _, outcome = store2.load_or_compile(
+            f"prog[{n}]", {"program": "prog", "n": n},
+            lambda: pytest.fail("warm store must not compile"),
+        )
+        assert outcome == "hit"
+    # No stray temp files survived the race.
+    assert not [p for p in tmp_path.iterdir() if p.name.endswith(".tmp")]
+
+
+# ---------------------------------------------------------------------------
+# Real pool on the 8-virtual-device CPU mesh
+
+
+def test_replica_devices_and_single_device_mesh(devices):
+    assert replica_devices() == list(jax.local_devices())
+    picked = replica_devices(3)
+    assert [d.id for d in picked] == [0, 1, 2]
+    wrapped = replica_devices(10)  # wraps round-robin past 8 devices
+    assert [d.id for d in wrapped[:10]] == [0, 1, 2, 3, 4, 5, 6, 7, 0, 1]
+    mesh = single_device_mesh(picked[2])
+    assert mesh.devices.size == 1
+    assert [d.id for d in mesh.devices.flat] == [2]
+    with pytest.raises(ValueError):
+        replica_devices(0)
+
+
+def test_pool_replicas_are_bit_identical_and_sentinel_budgeted(devices):
+    m = ServingMetrics()
+    pool = EnginePool.from_seed(replicas=3, buckets=(8,), metrics=m)
+    assert pool.replica_names == ["r0", "r1", "r2"]
+    assert [d.id for d in pool.devices] == [0, 1, 2]
+    pool.warmup()
+    # One trace per bucket per replica — the per-replica sentinel budget.
+    assert pool.compile_count() == 3
+    assert pool.warmed
+    x = np.random.RandomState(0).rand(5, 28, 28, 1).astype(np.float32)
+    outs = [e.predict_logits(x) for e in pool.engines]
+    # Same weights, same program, different devices: identical answers.
+    np.testing.assert_array_equal(outs[0], outs[1])
+    np.testing.assert_array_equal(outs[0], outs[2])
+    assert pool.compile_count() == 3  # serving added zero traces
+
+
+def test_warm_pool_start_is_pure_aot_hits_with_zero_traces(devices, tmp_path):
+    cache = str(tmp_path / "aot")
+    m1 = ServingMetrics()
+    cold = EnginePool.from_seed(
+        replicas=2, buckets=(8,), aot_cache=cache, metrics=m1
+    )
+    cold.warmup()
+    r1 = m1.registry
+    assert r1.counter("aot_executables_total", outcome="miss").value == 2
+    assert cold.compile_count() == 0  # AOT mode: rungs never touch jit
+    # The warm-pool contract (acceptance): a restart of the same pool
+    # shape deserializes EVERY replica's grid — all hits, no miss, no
+    # fallback, zero traces anywhere.
+    m2 = ServingMetrics()
+    warm = EnginePool.from_seed(
+        replicas=2, buckets=(8,), aot_cache=cache, metrics=m2
+    )
+    warm.warmup()
+    r2 = m2.registry
+    assert r2.counter("aot_executables_total", outcome="hit").value == 2
+    assert r2.counter("aot_executables_total", outcome="miss").value == 0
+    assert r2.counter("aot_executables_total", outcome="fallback").value == 0
+    assert warm.compile_count() == 0
+    # And the deserialized executables answer bit-identically to the
+    # cold-compiled ones, per replica.
+    x = np.random.RandomState(1).rand(6, 28, 28, 1).astype(np.float32)
+    for ec, ew in zip(cold.engines, warm.engines):
+        np.testing.assert_array_equal(
+            ec.predict_logits(x), ew.predict_logits(x)
+        )
+
+
+def test_pool_http_end_to_end_with_drain_and_add(devices):
+    from pytorch_mnist_ddp_tpu.serving.server import make_server
+
+    m = ServingMetrics()
+    pool = EnginePool.from_seed(replicas=2, buckets=(8,), metrics=m)
+    pool.warmup()
+    router = pool.start(router_policy="cost", linger_ms=1.0)
+    server = make_server(pool, m, port=0, batcher=router)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def post(payload):
+        req = urllib.request.Request(
+            f"{base}/predict", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.load(resp)
+
+    try:
+        rng = np.random.RandomState(0)
+        for _ in range(4):
+            status, body = post(
+                {"instances": rng.randint(0, 255, (3, 784)).tolist()}
+            )
+            assert status == 200 and len(body["predictions"]) == 3
+        # An oversized request shards across the pool on the wire too.
+        status, body = post(
+            {"instances": rng.randint(0, 255, (12, 784)).tolist()}
+        )
+        assert status == 200 and len(body["predictions"]) == 12
+
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as resp:
+            health = json.load(resp)
+        assert health["replicas"] == {"r0": "active", "r1": "active"}
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            snap = json.load(resp)
+        assert set(snap["replicas"]) == {"r0", "r1"}
+        assert snap["compiles"] == 2  # one per bucket per replica, ever
+
+        req = urllib.request.Request(
+            f"{base}/metrics", headers={"Accept": "text/plain"}
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            prom = resp.read().decode()
+        assert 'serving_replica_inflight{replica="r0"}' in prom
+        assert 'serving_router_decisions_total{policy="cost"' in prom
+        assert "serving_replica_drain_seconds_count 0" in prom  # no drain yet
+
+        # Drain one replica under the live server: requests keep landing
+        # 200, the drained replica shows in /healthz, and a re-add
+        # restores it — no restart, no compile, no failed request.
+        pool.drain("r1")
+        status, _ = post({"instances": rng.randint(0, 255, (2, 784)).tolist()})
+        assert status == 200
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as resp:
+            health = json.load(resp)
+        assert health["replicas"] == {"r0": "active", "r1": "drained"}
+        pool.add("r1")
+        status, _ = post({"instances": rng.randint(0, 255, (2, 784)).tolist()})
+        assert status == 200
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as resp:
+            health = json.load(resp)
+        assert health["replicas"] == {"r0": "active", "r1": "active"}
+        with urllib.request.urlopen(
+            f"{base}/metrics?format=prom", timeout=10
+        ) as resp:
+            prom = resp.read().decode()
+        assert "serving_replica_drain_seconds_count 1" in prom
+    finally:
+        server.shutdown()
+        router.stop()
+        server.server_close()
+    assert pool.compile_count() == 2  # the whole exchange added zero traces
+    assert m.failed == 0 and m.timed_out == 0
+
+
+def test_http_resubmits_drain_flushed_request_once():
+    # A drain racing a handler can flush an already-admitted request
+    # with RejectedError AFTER submit() returned (the batcher stop()'s
+    # post-join flush).  The flushed work never ran, so the handler
+    # resubmits exactly once — the retry lands on a surviving replica
+    # instead of surfacing a 503 while the pool has capacity.  A second
+    # flush (a genuine pool-wide shutdown) stays a 503.
+    from pytorch_mnist_ddp_tpu.serving.server import make_server
+
+    class _Flushed:
+        def result(self):
+            raise RejectedError("server shutting down")
+
+    class _Good:
+        def __init__(self, n):
+            self.n = n
+
+        def result(self):
+            return np.zeros((self.n, NUM_CLASSES), np.float32)
+
+    class _RacingRouter:
+        replicas = ("r0", "r1")  # pool surface: enables the handler retry
+        timeout_s = 1.0  # the retry's remaining-budget base
+
+        def __init__(self, flushes):
+            self.flushes = flushes
+            self.submits = 0
+            self.retry_timeouts = []  # timeout_ms of each retry submit
+
+        def submit(self, x, dtype=None, timeout_ms=None):
+            self.submits += 1
+            if self.submits > 1:
+                self.retry_timeouts.append(timeout_ms)
+            if self.submits <= self.flushes:
+                return _Flushed()
+            return _Good(len(x))
+
+    class _FakeEngine:
+        dtypes = ("f32",)
+        buckets = (8,)
+
+    routers = []
+
+    def drive(flushes):
+        m = ServingMetrics()
+        router = _RacingRouter(flushes)
+        routers.append(router)
+        server = make_server(_FakeEngine(), m, port=0, batcher=router)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.server_address[1]}/predict",
+            data=json.dumps({"instances": [[0.0] * 784] * 2}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, router.submits, m.rejected
+        except urllib.error.HTTPError as e:
+            return e.code, router.submits, m.rejected
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    # One transparent retry: client 200, and NO phantom rejection lands
+    # on the metrics surface for the flush the retry absorbed.
+    assert drive(flushes=1) == (200, 2, 0)
+    # Both attempts flushed: exactly one client-visible 503, counted
+    # exactly once (by the handler — no submit-side counter fired).
+    assert drive(flushes=2) == (503, 2, 1)
+    # The retry runs on the REMAINING deadline budget of the original
+    # admission, not a fresh full one — a drain race must not double the
+    # client's worst-case latency.
+    for router in routers:
+        (retry_ms,) = router.retry_timeouts
+        assert retry_ms is not None and 0.0 <= retry_ms <= 1e3
+
+
+def test_pool_parity_gates_every_replica(devices):
+    pool = EnginePool.from_seed(replicas=2, buckets=(8,), dtypes=("bf16",))
+    pool.warmup()
+    assert not pool.variant_verified("bf16")
+    results = pool.verify_parity(raise_on_failure=True)
+    assert results["bf16"]["passed"]
+    # variant_verified is the POOL answer: every replica must have passed.
+    assert pool.variant_verified("bf16")
+    assert all(e.variant_verified("bf16") for e in pool.engines)
+
+
+def test_pool_parity_failure_on_any_replica_surfaces(devices, monkeypatch):
+    # The non-raising mode is the serving CLI's refuse-to-start gate: a
+    # failure on replica 1 must dominate the returned results even
+    # though replica 0 passed (a representative-only verdict would
+    # start the server with a silently refused replica).
+    pool = EnginePool.from_seed(replicas=2, buckets=(8,), dtypes=("bf16",))
+    pool.warmup()
+    real = pool.engines[1].verify_parity
+
+    def failing(tol=None, raise_on_failure=False, sink=None):
+        r = real(tol=tol, raise_on_failure=False, sink=sink)
+        return {k: dict(v, passed=False) for k, v in r.items()}
+
+    monkeypatch.setattr(pool.engines[1], "verify_parity", failing)
+    results = pool.verify_parity()
+    assert not results["bf16"]["passed"]
+    assert results["bf16"]["replica"] == "r1"
+
+
+# ---------------------------------------------------------------------------
+# Loadgen sweep + perf_report scale-out section
+
+
+def _load_tool(name):
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(root, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_loadgen_replica_sweep_report(devices, tmp_path):
+    loadgen = _load_tool("serve_loadgen")
+    report_path = str(tmp_path / "BENCH_serving_scaleout.json")
+    prom_path = str(tmp_path / "scaleout.prom")
+    tel_dir = str(tmp_path / "tel")
+    rc = loadgen.main([
+        "--replicas-sweep", "1,2", "--requests", "16", "--max-request", "4",
+        "--buckets", "8", "--concurrency", "4",
+        "--scaleout-report", report_path, "--prom-dump", prom_path,
+        "--telemetry-dir", tel_dir,
+    ])
+    assert rc == 0
+    with open(report_path) as f:
+        report = json.load(f)
+    assert [row["replicas"] for row in report["sweep"]] == [1, 2]
+    for row in report["sweep"]:
+        assert row["goodput_rps"] > 0.0
+        assert row["additional_compiles"] == 0  # the retrace firewall held
+        assert row["p99_ms"] > 0.0
+    assert report["sweep"][0]["scaling_efficiency"] == pytest.approx(1.0)
+    assert report["sweep"][1]["speedup_vs_1"] is not None
+    assert report["router_policy"] == "cost"
+    with open(prom_path) as f:
+        prom = f.read()
+    assert "serving_router_decisions_total" in prom
+    assert "serving_replica_inflight" in prom
+
+    perf_report = _load_tool("perf_report")
+    summary = perf_report.summarize_telemetry(tel_dir)
+    assert "scale-out:" in summary
+    assert "router decisions [cost]:" in summary
+
+
+def test_perf_report_scaleout_section_from_synthetic_events(tmp_path):
+    events = [
+        {"event": "serving_request", "n": 2, "latency_s": 0.010,
+         "replica": "r0"},
+        {"event": "serving_request", "n": 2, "latency_s": 0.012,
+         "replica": "r0"},
+        {"event": "serving_request", "n": 3, "latency_s": 0.030,
+         "replica": "r1"},
+        {"event": "router_decision", "policy": "cost", "replica": "r0",
+         "rows": 2},
+        {"event": "router_decision", "policy": "cost", "replica": "r1",
+         "rows": 3},
+        {"event": "replica_drain", "replica": "r1", "duration_s": 0.25},
+        {"event": "replica_add", "replica": "r1", "duration_s": 0.02},
+    ]
+    with open(tmp_path / "events-rank0.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    perf_report = _load_tool("perf_report")
+    summary = perf_report.summarize_telemetry(str(tmp_path))
+    assert "scale-out: 2 replica(s)" in summary
+    assert "r0 66.7% (2)" in summary
+    # max/mean over (2, 1) requests = 2 / 1.5
+    assert "load imbalance (max/mean) 1.33" in summary
+    assert "router decisions [cost]: r0 1, r1 1" in summary
+    assert "replica drains: r1 0.250 s" in summary
+    assert "replica re-adds: r1 0.020 s" in summary

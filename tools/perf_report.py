@@ -377,6 +377,80 @@ def summarize_telemetry(directory: str) -> str | None:
                     f"p50 {1e3 * percentile(ds, 50):.2f} ms, "
                     f"p99 {1e3 * percentile(ds, 99):.2f} ms"
                 )
+    # Scale-out telemetry (serving/pool.py + router.py): per-replica
+    # request share, router decision tallies by policy, drain/re-add
+    # durations, and the load-imbalance ratio (max/mean replica share) —
+    # the operator's view of whether the router is actually spreading.
+    # Grouped per run_id: the sweep recipe (serve_loadgen
+    # --replicas-sweep) accumulates one run per rung in the same
+    # directory, and a cross-run merge would read as imbalance (r0
+    # serves in every rung, r3 only in the last) even when each rung's
+    # router spread perfectly.
+    share_runs: dict[object, dict[str, int]] = {}
+    for e in sreqs:
+        if e.get("replica"):
+            tally = share_runs.setdefault(e.get("run_id"), {})
+            tally[e["replica"]] = tally.get(e["replica"], 0) + 1
+    # A starved replica served nothing, so it has no serving_request
+    # events — but it is exactly the replica the imbalance ratio exists
+    # to expose.  Count it as 0 if ANY event in the run names it (a
+    # replica with no events at all is undiscoverable from JSONL).
+    run_replicas: dict[object, set] = {}
+    for e in events:
+        if e.get("replica"):
+            run_replicas.setdefault(e.get("run_id"), set()).add(e["replica"])
+    for rid, by_replica in share_runs.items():
+        for name in run_replicas.get(rid, ()):
+            by_replica.setdefault(name, 0)
+        total = sum(by_replica.values())
+        mean = total / len(by_replica)
+        imbalance = max(by_replica.values()) / mean if mean else 0.0
+        shares = ", ".join(
+            f"{name} {100.0 * n / total:.1f}% ({n})"
+            for name, n in sorted(by_replica.items())
+        )
+        # run_id = wall-clock prefix + random hex; the TAIL is what
+        # tells two runs in one directory apart.
+        suffix = f" [run {str(rid)[-6:]}]" if len(share_runs) > 1 else ""
+        lines.append(
+            f"  scale-out: {len(by_replica)} replica(s), requests by "
+            f"replica: {shares}; load imbalance (max/mean) "
+            f"{imbalance:.2f}{suffix}"
+        )
+    decisions = [e for e in events if e.get("event") == "router_decision"]
+    if decisions:
+        decision_runs: dict[tuple, dict[str, int]] = {}
+        for e in decisions:
+            tally = decision_runs.setdefault(
+                (e.get("run_id"), e.get("policy", "?")), {}
+            )
+            name = e.get("replica", "?")
+            tally[name] = tally.get(name, 0) + 1
+        multi = len({rid for rid, _ in decision_runs}) > 1
+        for (rid, policy), tally in decision_runs.items():
+            rendered = ", ".join(
+                f"{name} {n}" for name, n in sorted(tally.items())
+            )
+            suffix = f" [run {str(rid)[-6:]}]" if multi else ""
+            lines.append(
+                f"  router decisions [{policy}]: {rendered}{suffix}"
+            )
+    def _elastic_lines(kind: str, label: str) -> None:
+        # Same per-run grouping as the share/decision lines above.
+        ev_runs: dict[object, list] = {}
+        for e in events:
+            if e.get("event") == kind:
+                ev_runs.setdefault(e.get("run_id"), []).append(e)
+        for rid, es in ev_runs.items():
+            rendered = ", ".join(
+                f"{e.get('replica', '?')} {e.get('duration_s', 0.0):.3f} s"
+                for e in es
+            )
+            suffix = f" [run {str(rid)[-6:]}]" if len(ev_runs) > 1 else ""
+            lines.append(f"  {label}: {rendered}{suffix}")
+
+    _elastic_lines("replica_drain", "replica drains")
+    _elastic_lines("replica_add", "replica re-adds")
     gates = [e for e in events if e.get("event") == "parity_gate"]
     if gates:
         for e in gates:

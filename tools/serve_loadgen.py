@@ -32,6 +32,12 @@ to load-test a deployment.  ``--prom-dump PATH`` saves the endpoint's
 final Prometheus exposition (the in-flight gauge, stall/fill histograms)
 for offline grepping — the CI smoke's hook.
 
+Scale-out (docs/SERVING.md): ``--replicas N`` self-serves an N-replica
+per-device engine pool behind the queue-aware router
+(``--router-policy``), and ``--replicas-sweep 1,2,4`` runs the same
+workload against each count in turn, writing goodput vs. replicas at
+fixed p99 plus scaling efficiency to ``BENCH_serving_scaleout.json``.
+
 Usage::
 
     python tools/serve_loadgen.py                       # self-contained
@@ -251,6 +257,7 @@ def summarize(raw: dict, before: dict, after: dict) -> dict:
             "p99": 1e3 * percentile(ok, 99),
             "mean": 1e3 * sum(ok) / len(ok) if ok else 0.0,
         },
+        "server_replicas": after.get("replicas"),
         "server_batch_occupancy_pct": after.get("batch_occupancy_pct"),
         "server_padding_waste_pct": after.get("padding_waste_pct"),
         "server_queue_depth_final": after.get("queue_depth"),
@@ -261,6 +268,184 @@ def summarize(raw: dict, before: dict, after: dict) -> dict:
         "server_metrics_before": before,
         "server_metrics_after": after,
     }
+
+
+def _spin_self_serve(args, replicas: int | None):
+    """Start the in-process stack (single engine, or an N-replica pool
+    behind the router when ``replicas``), warmed and parity-gated.
+    Returns ``(server, sink, url)``; the caller owns teardown."""
+    from pytorch_mnist_ddp_tpu.obs.events import open_sink
+    from pytorch_mnist_ddp_tpu.serving import InferenceEngine, ServingMetrics
+    from pytorch_mnist_ddp_tpu.serving.server import make_server
+
+    metrics = ServingMetrics()
+    buckets = [int(b) for b in args.buckets.split(",")]
+    dtypes = [args.dtype] if args.dtype != "f32" else None
+    batcher_kwargs = dict(
+        linger_ms=args.linger_ms, queue_depth=args.queue_depth,
+        timeout_ms=args.timeout_ms, max_inflight=args.max_inflight,
+        adaptive_linger=not args.no_adaptive_linger,
+    )
+    sink = open_sink(args.telemetry_dir)
+    if replicas is not None:
+        from pytorch_mnist_ddp_tpu.serving import EnginePool
+
+        # Same convention as the serving CLI: 0 = one replica per
+        # visible device (the EnginePool default).
+        pool = EnginePool.from_seed(
+            replicas=replicas or None, buckets=buckets, metrics=metrics,
+            dtypes=dtypes, aot_cache=args.aot_cache,
+        )
+        print(
+            f"self-serve pool: warming buckets {list(pool.buckets)} x "
+            f"dtypes {list(pool.dtypes)} x {pool.n_replicas} replicas"
+        )
+        pool.warmup(sink=sink)
+        if args.dtype != "f32":
+            pool.verify_parity(raise_on_failure=True)
+        router = pool.start(
+            router_policy=args.router_policy, sink=sink, **batcher_kwargs
+        )
+        server = make_server(pool, metrics, port=0, batcher=router)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        print(
+            f"self-serve pool: {url} ({pool.n_replicas} replicas, "
+            f"router policy {args.router_policy})"
+        )
+        return server, sink, url
+    engine = InferenceEngine.from_seed(
+        buckets=buckets, metrics=metrics, dtypes=dtypes,
+        aot_cache=args.aot_cache,
+    )
+    print(
+        f"self-serve: warming buckets {list(engine.buckets)} x dtypes "
+        f"{list(engine.dtypes)}"
+    )
+    engine.warmup()
+    if args.dtype != "f32":
+        # The variant must clear its parity gate before a single
+        # request routes to it (the refusal contract): fail the
+        # A/B loudly rather than measure an unverified path.
+        gate = engine.verify_parity(raise_on_failure=True)[args.dtype]
+        print(
+            f"parity gate [{args.dtype}]: PASS "
+            f"(max|dlogit| {gate['max_abs_logit_diff']:.2e} <= "
+            f"{gate['tolerance']:g}, argmax identical)"
+        )
+    server = make_server(engine, metrics, port=0, sink=sink, **batcher_kwargs)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    print(
+        f"self-serve: {url} (in-flight window {args.max_inflight}, "
+        f"adaptive linger {'off' if args.no_adaptive_linger else 'on'})"
+    )
+    return server, sink, url
+
+
+def _teardown_self_serve(server, sink) -> None:
+    if server is not None:
+        server.shutdown()
+        server.batcher.stop(drain=True)
+        server.server_close()
+    if sink is not None:
+        sink.close()
+
+
+def _drive(args, url: str) -> dict:
+    """Fire the configured workload (open or closed loop) at ``url``."""
+    if args.open_loop:
+        print(
+            f"driving {args.requests} open-loop Poisson arrivals of "
+            f"1..{args.max_request} samples at {args.rate:.0f} req/s"
+        )
+        return run_open_loop(
+            url, args.requests, args.rate, args.max_request,
+            args.seed, args.timeout_s,
+            max_workers=args.concurrency,
+            dtype=args.dtype,
+        )
+    print(
+        f"driving {args.requests} requests of 1..{args.max_request} "
+        f"samples at concurrency {args.concurrency}"
+    )
+    return run_load(
+        url, args.requests, args.concurrency, args.max_request,
+        args.seed, args.timeout_s, dtype=args.dtype,
+    )
+
+
+def run_replica_sweep(args) -> int:
+    """The scale-out A/B: the SAME workload against self-serve pools of
+    increasing replica counts, reporting goodput and p99 per rung plus
+    scaling efficiency (goodput_N / (N x goodput_1)) —
+    ``BENCH_serving_scaleout.json``."""
+    counts = [int(c) for c in args.replicas_sweep.split(",")]
+    if any(c < 1 for c in counts):
+        raise SystemExit("--replicas-sweep counts must be >= 1")
+    rows = []
+    rc = 0
+    for i, n in enumerate(counts):
+        server, sink, url = _spin_self_serve(args, replicas=n)
+        try:
+            _status, before = fetch_json(f"{url}/metrics")
+            raw = _drive(args, url)
+            _status, after = fetch_json(f"{url}/metrics")
+            if args.prom_dump and i == len(counts) - 1:
+                with open(args.prom_dump, "w") as f:
+                    f.write(fetch_text(f"{url}/metrics?format=prom"))
+                print(f"prometheus exposition ({n} replicas): {args.prom_dump}")
+        finally:
+            _teardown_self_serve(server, sink)
+        report = summarize(raw, before, after)
+        extra = report["additional_compiles"]
+        if extra and not args.no_check_compiles:
+            print(f"RETRACE at {n} replicas: {extra} additional compile(s)")
+            rc = 1
+        rows.append({
+            "replicas": n,
+            "goodput_rps": report["goodput_rps"],
+            "answered_rps": report["answered_rps"],
+            "p50_ms": report["latency_ms"]["p50"],
+            "p99_ms": report["latency_ms"]["p99"],
+            "rejected": report["rejected"],
+            "timed_out": report["timed_out"],
+            "additional_compiles": extra,
+            "router_policy": args.router_policy,
+        })
+    # Both ratios promise a 1-replica baseline; a sweep that starts at
+    # some other rung (e.g. --replicas-sweep 2,4) has no such baseline,
+    # so they stay None rather than quietly rebasing.
+    base = rows[0]["goodput_rps"] if rows[0]["replicas"] == 1 else None
+    for row in rows:
+        row["speedup_vs_1"] = (
+            row["goodput_rps"] / base if base else None
+        )
+        row["scaling_efficiency"] = (
+            row["goodput_rps"] / (row["replicas"] * base)
+            if base else None
+        )
+    sweep_report = {
+        "mode": "open-loop" if args.open_loop else "closed-loop",
+        "router_policy": args.router_policy,
+        "requests": args.requests,
+        "max_request": args.max_request,
+        "buckets": [int(b) for b in args.buckets.split(",")],
+        "offered_rate_rps": args.rate if args.open_loop else None,
+        "sweep": rows,
+    }
+    with open(args.scaleout_report, "w") as f:
+        json.dump(sweep_report, f, indent=2)
+    print(f"scale-out report: {args.scaleout_report}")
+    for row in rows:
+        eff = row["scaling_efficiency"]
+        print(
+            f"  {row['replicas']} replica(s): "
+            f"{row['goodput_rps']:.1f} goodput req/s, "
+            f"p99 {row['p99_ms']:.2f} ms, {row['rejected']} rejected"
+            + (f", efficiency {eff:.2f}" if eff is not None else "")
+        )
+    return rc
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -342,6 +527,36 @@ def main(argv: list[str] | None = None) -> int:
         help="after the run, save the endpoint's Prometheus exposition "
         "(/metrics?format=prom) to this file",
     )
+    parser.add_argument(
+        "--replicas", type=int, default=None, metavar="N",
+        help="--self-serve mode: serve an N-replica per-device engine "
+        "pool behind the queue-aware router instead of one engine "
+        "(0 = one per visible device, as in the serving CLI; "
+        "docs/SERVING.md scale-out)",
+    )
+    parser.add_argument(
+        "--router-policy", default="cost",
+        choices=("roundrobin", "least-loaded", "cost"),
+        help="replica placement policy for --replicas / --replicas-sweep",
+    )
+    parser.add_argument(
+        "--replicas-sweep", default=None, metavar="N1,N2,...",
+        help="scale-out sweep: run the SAME workload against self-serve "
+        "pools of each listed replica count and report goodput vs. "
+        "replicas at fixed p99 with scaling efficiency "
+        "(--scaleout-report; --prom-dump saves the last rung's "
+        "exposition)",
+    )
+    parser.add_argument(
+        "--scaleout-report", default="BENCH_serving_scaleout.json",
+        help="where --replicas-sweep writes its report",
+    )
+    parser.add_argument(
+        "--aot-cache", default=None, metavar="DIR",
+        help="--self-serve mode: shared serialized-executable store for "
+        "the engine(s) (compile/aot.ExecutableStore; a warm pool start "
+        "deserializes every replica's grid with zero traces)",
+    )
     parser.add_argument("--report", default="BENCH_serving.json")
     parser.add_argument(
         "--no-check-compiles", action="store_true",
@@ -349,86 +564,35 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
+    if args.url and args.replicas is not None:
+        # Silently measuring a remote single endpoint while the report
+        # claims N replicas is exactly the confusion a benchmark tool
+        # must not allow.
+        parser.error("--replicas is --self-serve only; a --url endpoint "
+                     "chooses its own replica count")
+    if args.replicas_sweep:
+        if args.url:
+            parser.error("--replicas-sweep drives self-serve pools; "
+                         "drop --url")
+        return run_replica_sweep(args)
+
     server = None
     sink = None
     if args.url and not args.self_serve:
         url = args.url.rstrip("/")
     else:
-        from pytorch_mnist_ddp_tpu.obs.events import open_sink
-        from pytorch_mnist_ddp_tpu.serving import InferenceEngine, ServingMetrics
-        from pytorch_mnist_ddp_tpu.serving.server import make_server
-
-        metrics = ServingMetrics()
-        engine = InferenceEngine.from_seed(
-            buckets=[int(b) for b in args.buckets.split(",")],
-            metrics=metrics,
-            dtypes=[args.dtype] if args.dtype != "f32" else None,
-        )
-        print(
-            f"self-serve: warming buckets {list(engine.buckets)} x dtypes "
-            f"{list(engine.dtypes)}"
-        )
-        engine.warmup()
-        if args.dtype != "f32":
-            # The variant must clear its parity gate before a single
-            # request routes to it (the refusal contract): fail the
-            # A/B loudly rather than measure an unverified path.
-            gate = engine.verify_parity(raise_on_failure=True)[args.dtype]
-            print(
-                f"parity gate [{args.dtype}]: PASS "
-                f"(max|dlogit| {gate['max_abs_logit_diff']:.2e} <= "
-                f"{gate['tolerance']:g}, argmax identical)"
-            )
-        sink = open_sink(args.telemetry_dir)
-        server = make_server(
-            engine, metrics, port=0,
-            linger_ms=args.linger_ms, queue_depth=args.queue_depth,
-            timeout_ms=args.timeout_ms,
-            max_inflight=args.max_inflight,
-            adaptive_linger=not args.no_adaptive_linger,
-            sink=sink,
-        )
-        threading.Thread(target=server.serve_forever, daemon=True).start()
-        url = f"http://127.0.0.1:{server.server_address[1]}"
-        print(
-            f"self-serve: {url} (in-flight window {args.max_inflight}, "
-            f"adaptive linger {'off' if args.no_adaptive_linger else 'on'})"
-        )
+        server, sink, url = _spin_self_serve(args, replicas=args.replicas)
 
     try:
         _status, before = fetch_json(f"{url}/metrics")
-        if args.open_loop:
-            print(
-                f"driving {args.requests} open-loop Poisson arrivals of "
-                f"1..{args.max_request} samples at {args.rate:.0f} req/s"
-            )
-            raw = run_open_loop(
-                url, args.requests, args.rate, args.max_request,
-                args.seed, args.timeout_s,
-                max_workers=args.concurrency,
-                dtype=args.dtype,
-            )
-        else:
-            print(
-                f"driving {args.requests} requests of 1..{args.max_request} "
-                f"samples at concurrency {args.concurrency}"
-            )
-            raw = run_load(
-                url, args.requests, args.concurrency, args.max_request,
-                args.seed, args.timeout_s, dtype=args.dtype,
-            )
+        raw = _drive(args, url)
         _status, after = fetch_json(f"{url}/metrics")
         if args.prom_dump:
             with open(args.prom_dump, "w") as f:
                 f.write(fetch_text(f"{url}/metrics?format=prom"))
             print(f"prometheus exposition: {args.prom_dump}")
     finally:
-        if server is not None:
-            server.shutdown()
-            server.batcher.stop(drain=True)
-            server.server_close()
-        if sink is not None:
-            sink.close()
+        _teardown_self_serve(server, sink)
 
     report = summarize(raw, before, after)
     with open(args.report, "w") as f:
