@@ -1,10 +1,11 @@
-"""The jaxlint rule set: JL001–JL012, the JAX hazards this repo has
+"""The jaxlint rule set: JL001–JL013, the JAX hazards this repo has
 actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, the
 serving layer's per-request-shape retrace class, the telemetry layer's
 record-at-trace-time class, the serving pipeline's
 blocking-read-in-dispatch-loop class, the startup phase's serial-warmup
-class, the steady-state input pipeline's host-blocking-feed class, and
-the replica pool's per-replica-re-trace class).
+class, the steady-state input pipeline's host-blocking-feed class, the
+replica pool's per-replica-re-trace class, and the fault-tolerance
+layer's swallowed-dispatch-error class).
 
 Every rule is a heuristic over one module's AST — no type inference, no
 cross-file call graph.  "Traced context" below means: a function that is
@@ -1515,6 +1516,143 @@ class EngineLoopRule(Rule):
                 )
 
 
+# ---------------------------------------------------------------------------
+# JL013 — swallowed dispatch errors in an unbounded retry loop
+
+
+# Exception names whose handlers count as catch-everything.  A handler
+# for a SPECIFIC error type (RejectedError, ValueError...) is a decision
+# about one failure mode, not the silent-poison idiom.
+_BROAD_EXCEPTS = {"Exception", "BaseException"}
+
+# A handler that calls one of these is backing off, not spinning: the
+# retry has a pacing mechanism, which is half of what the rule demands.
+_BACKOFF_HINTS = ("sleep", "backoff", "wait")
+
+
+class SwallowedDispatchErrorRule(Rule):
+    """JL013: a bare ``except:`` / ``except Exception`` swallowing errors
+    around a jitted call (or ``engine.launch``) inside an unbounded
+    dispatch/retry loop — no re-raise, no bounded retry count, no
+    backoff.
+
+    The silent-poison hazard class the serving supervisor exists to
+    replace (docs/ROBUSTNESS.md): a dispatch loop shaped ``while True:
+    try: engine.launch(...) except Exception: continue`` turns a dead
+    replica into an infinite hot loop that eats every request, counts
+    nothing, heals nothing, and keeps the replica in rotation forever.
+    The repo's sanctioned shapes all do one of three things instead:
+    surface the error to every waiter and KEEP SERVING under metrics
+    (the batcher's dispatch worker, which re-raises nothing but
+    completes waiters and feeds ``on_failure`` → the circuit breaker),
+    retry a BOUNDED number of times on the remaining deadline budget
+    (the HTTP handler's ``for attempt in range(2)``), or hand the
+    replica to the supervisor (quarantine → backoff restart → eject).
+
+    Heuristics: fires when (a) the loop is unbounded — any ``while``, or
+    a ``for`` over something other than a literal ``range(...)`` (a
+    range-bounded retry loop IS the bounded-retry idiom); (b) a ``try``
+    executed by the loop body contains a call to a known-jitted name
+    (same resolution as JL009: ``jax.jit`` values, ``RecompileSentinel``
+    wraps, ``self.attr`` bindings) or any ``*.launch(...)`` attribute
+    call; and (c) a catch-all handler (bare / ``Exception`` /
+    ``BaseException``) contains none of ``raise`` / ``break`` /
+    ``return`` and no call whose name mentions sleep/backoff/wait.
+    A deliberate swallow (a chaos driver, a best-effort prober) is
+    waived inline with a reason.
+    """
+
+    rule_id = "JL013"
+    severity = Severity.WARNING
+    summary = "catch-all swallows dispatch errors in an unbounded retry loop"
+
+    @staticmethod
+    def _is_bounded_for(loop: ast.AST) -> bool:
+        return (
+            isinstance(loop, ast.For)
+            and isinstance(loop.iter, ast.Call)
+            and dotted_name(loop.iter.func) in {"range", "builtins.range"}
+        )
+
+    @staticmethod
+    def _contains_dispatch(node: ast.AST, jit_names, jit_attrs) -> bool:
+        stack = [node]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, _SCOPE_NODES):
+                continue
+            if isinstance(sub, ast.Call):
+                if BlockingReadLoopRule._is_jit_call(sub, jit_names, jit_attrs):
+                    return True
+                if (isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "launch"):
+                    return True
+            stack.extend(ast.iter_child_nodes(sub))
+        return False
+
+    @classmethod
+    def _handler_swallows(cls, handler: ast.ExceptHandler) -> bool:
+        if handler.type is not None:
+            names = (
+                [dotted_name(t) for t in handler.type.elts]
+                if isinstance(handler.type, ast.Tuple)
+                else [dotted_name(handler.type)]
+            )
+            last = {str(n).split(".")[-1] for n in names if n}
+            if not last & _BROAD_EXCEPTS:
+                return False
+        stack: list[ast.AST] = list(handler.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _SCOPE_NODES):
+                continue
+            if isinstance(node, (ast.Raise, ast.Break, ast.Return)):
+                return False
+            if isinstance(node, ast.Call):
+                name = (dotted_name(node.func) or "").lower()
+                if any(hint in name for hint in _BACKOFF_HINTS):
+                    return False
+            stack.extend(ast.iter_child_nodes(node))
+        return True
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        module_jit: set[str] = set()
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and BucketShapeRule._is_jit_value(node.value)):
+                module_jit.add(node.targets[0].id)
+        jit_attrs = BlockingReadLoopRule._jit_attr_names(ctx.tree)
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            if self._is_bounded_for(loop):
+                continue  # the bounded-retry idiom (HTTP handler shape)
+            for node in iter_loop_body_nodes(loop):
+                if not isinstance(node, ast.Try):
+                    continue
+                if not self._contains_dispatch(
+                    ast.Module(body=node.body, type_ignores=[]),
+                    module_jit, jit_attrs,
+                ):
+                    continue
+                for handler in node.handlers:
+                    if self._handler_swallows(handler):
+                        yield self.finding(
+                            ctx, handler,
+                            "catch-all around a jitted dispatch inside an "
+                            "unbounded loop with no re-raise, bound, or "
+                            "backoff: a dead replica becomes a silent "
+                            "hot loop that poisons every request; surface "
+                            "the error to its waiters and feed a failure "
+                            "hook (serving/batcher.py), bound the retry "
+                            "(for attempt in range(n)), or let the "
+                            "supervisor quarantine the replica "
+                            "(serving/pool.py)",
+                        )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -1528,6 +1666,7 @@ ALL_RULES: tuple[Rule, ...] = (
     SerialWarmupRule(),
     HostBlockingFeedRule(),
     EngineLoopRule(),
+    SwallowedDispatchErrorRule(),
 )
 
 

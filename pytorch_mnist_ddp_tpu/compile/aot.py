@@ -55,10 +55,22 @@ import hashlib
 import json
 import os
 import pickle
+import sys
 import tempfile
 import time
 
 _FORMAT = 1
+
+
+def _fault_point(site: str, label: str | None = None) -> None:
+    """Dormant chaos hook (serving/faults.py, docs/ROBUSTNESS.md).
+
+    Resolved through ``sys.modules`` so this jax-adjacent module never
+    imports the serving package: if nobody imported the faults module,
+    nobody installed an injector, and the hook is one dict lookup."""
+    faults = sys.modules.get("pytorch_mnist_ddp_tpu.serving.faults")
+    if faults is not None:
+        faults.fault_point(site, label)
 
 _source_digest_cache: str | None = None
 
@@ -284,6 +296,11 @@ class ExecutableStore:
     def _load(self, path: str, key: str):
         from jax.experimental.serialize_executable import deserialize_and_load
 
+        # An injected aot_load failure is indistinguishable from a torn
+        # or corrupt entry — load_or_compile's fallback path (fresh
+        # compile, entry rewritten) is exactly what the chaos schedule
+        # exercises.
+        _fault_point("aot_load")
         with open(path, "rb") as f:
             entry = pickle.load(f)
         env = _environment()

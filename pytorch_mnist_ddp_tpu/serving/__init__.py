@@ -38,11 +38,26 @@ ROADMAP's "heavy traffic" north star:
   :class:`Router` (``--replicas`` / ``--router-policy {roundrobin,
   least-loaded,cost}``), with sharded dispatch for oversized batches
   and graceful replica drain/re-add under live traffic.
+- :mod:`.faults` — fault tolerance (PR 8, docs/ROBUSTNESS.md): a
+  deterministic, seedable fault-injection surface (dormant fault points
+  in dispatch/completion/warmup/AOT-load), driven by the
+  :class:`~.pool.ReplicaSupervisor` (quarantine → backoff restart →
+  ejection) and per-replica :class:`~.router.CircuitBreaker`\\ s
+  (closed/open/half-open) so a replica that throws, hangs, or dies is
+  detected, ejected from placement, and healed under live load — and
+  the loadgen's ``--chaos`` mode proves it.
 
 Load-test with ``tools/serve_loadgen.py``; see docs/SERVING.md.
 """
 
-from .batcher import AdaptiveLinger, MicroBatcher, RejectedError, RequestTimeout
+from .batcher import (
+    AdaptiveLinger,
+    MicroBatcher,
+    RejectedError,
+    ReplicaDeadError,
+    RequestTimeout,
+)
+from .faults import FaultError, FaultInjector
 from .buckets import (
     StagingPool,
     bucket_for,
@@ -52,16 +67,21 @@ from .buckets import (
 )
 from .engine import InferenceEngine
 from .metrics import ServingMetrics
-from .pool import EnginePool
-from .router import Replica, Router, ShardedRequest
+from .pool import EnginePool, ReplicaSupervisor
+from .router import CircuitBreaker, Replica, Router, ShardedRequest
 
 __all__ = [
     "AdaptiveLinger",
+    "CircuitBreaker",
     "EnginePool",
+    "FaultError",
+    "FaultInjector",
     "InferenceEngine",
     "MicroBatcher",
     "RejectedError",
     "Replica",
+    "ReplicaDeadError",
+    "ReplicaSupervisor",
     "RequestTimeout",
     "Router",
     "ServingMetrics",

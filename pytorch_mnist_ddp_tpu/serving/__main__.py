@@ -122,6 +122,23 @@ def build_parser() -> argparse.ArgumentParser:
         "EWMA; falls back to least-loaded until samples exist)",
     )
     parser.add_argument(
+        "--no-supervise", action="store_true",
+        help="with --replicas: disable the replica supervisor "
+        "(quarantine / backoff restart / ejection of replicas that "
+        "fail, hang, or trip their circuit breaker — docs/ROBUSTNESS.md)",
+    )
+    parser.add_argument(
+        "--stall-timeout-s", type=float, default=5.0,
+        help="supervisor completion-stall threshold: a replica whose "
+        "oldest in-flight batch is older than this is quarantined "
+        "(a wedged device or hung D2H read)",
+    )
+    parser.add_argument(
+        "--restart-budget", type=int, default=3,
+        help="consecutive failed supervisor restarts before a replica "
+        "is permanently ejected from the pool",
+    )
+    parser.add_argument(
         "--no-device-stage", action="store_true",
         help="disable committing padded batches to the data-axis "
         "sharding (async device_put) before dispatch; staging is on by "
@@ -322,7 +339,13 @@ def main(argv: list[str] | None = None) -> int:
     )
     if pool_mode:
         router = engine.start(
-            router_policy=args.router_policy, sink=sink, **batcher_kwargs
+            router_policy=args.router_policy, sink=sink,
+            supervise=not args.no_supervise,
+            supervisor_kwargs=dict(
+                stall_timeout_s=args.stall_timeout_s,
+                restart_budget=args.restart_budget,
+            ),
+            **batcher_kwargs,
         )
         server = make_server(
             engine, metrics, host=args.host, port=args.port, batcher=router
@@ -334,9 +357,12 @@ def main(argv: list[str] | None = None) -> int:
         )
     host, port = server.server_address[:2]
     print(
-        f"serving on http://{host}:{port} (POST /predict, GET /metrics; "
+        f"serving on http://{host}:{port} (POST /predict, GET /metrics, "
+        "GET /healthz liveness, GET /readyz readiness; "
         + (f"{engine.n_replicas} replicas, router policy "
-           f"{args.router_policy}, per-replica " if pool_mode else "")
+           f"{args.router_policy}, supervisor "
+           f"{'off' if args.no_supervise else 'on'}, per-replica "
+           if pool_mode else "")
         + f"in-flight window {args.max_inflight}, adaptive linger "
         f"{'off' if args.no_adaptive_linger else 'on'})"
     )
@@ -355,7 +381,10 @@ def main(argv: list[str] | None = None) -> int:
         # then report.  (Handler threads for in-flight requests are
         # daemons; their waiters complete during the drain.)
         print("draining admitted requests and the in-flight window...")
-        server.batcher.stop(drain=True)
+        if pool_mode:
+            engine.stop(drain=True)  # supervisor first, then the router
+        else:
+            server.batcher.stop(drain=True)
         server.server_close()
         sink.close()
         print(metrics.report_lines(

@@ -56,11 +56,22 @@ import numpy as np
 from ..obs.spans import span
 from .buckets import StagingPool
 from .engine import InferenceEngine
+from .faults import fault_point
 from .metrics import ServingMetrics
 
 
 class RejectedError(RuntimeError):
     """Admission refused (queue full or server draining) — HTTP 503."""
+
+
+class ReplicaDeadError(RejectedError):
+    """A pool replica failed or was torn down with this request aboard —
+    the work never produced a result, so resubmitting it on a surviving
+    replica cannot duplicate a response.  Subclasses
+    :class:`RejectedError` on purpose: the HTTP handler's drain-race
+    retry (serving/server.py) and the router's skip logic treat a dead
+    replica exactly like a draining one, which is the failure-aware
+    retry contract (docs/ROBUSTNESS.md)."""
 
 
 class RequestTimeout(RuntimeError):
@@ -75,6 +86,7 @@ class PendingRequest:
 
     __slots__ = (
         "x", "dtype", "deadline", "t_submit", "_event", "_value", "_error",
+        "_lock",
     )
 
     def __init__(self, x: np.ndarray, deadline: float, dtype: str = "f32"):
@@ -83,6 +95,7 @@ class PendingRequest:
         self.deadline = deadline
         self.t_submit = time.perf_counter()
         self._event = threading.Event()
+        self._lock = threading.Lock()
         self._value: np.ndarray | None = None
         self._error: BaseException | None = None
 
@@ -94,14 +107,27 @@ class PendingRequest:
         return (now if now is not None else time.perf_counter()) > self.deadline
 
     # -- completion (worker side) -------------------------------------------
+    #
+    # First writer wins, atomically: the supervisor's abort path
+    # (serving/pool.py) errors a hung batch's waiters so they can retry
+    # on a survivor, and the stuck completion read may STILL finish later
+    # and try to set a result.  Exactly one outcome must be visible — a
+    # late set after the first is a silent no-op, so a request the
+    # handler already retried can never grow a second answer.
 
     def set_result(self, value: np.ndarray) -> None:
-        self._value = value
-        self._event.set()
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._value = value
+            self._event.set()
 
     def set_error(self, error: BaseException) -> None:
-        self._error = error
-        self._event.set()
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._error = error
+            self._event.set()
 
     # -- consumption (handler side) -----------------------------------------
 
@@ -201,7 +227,10 @@ class AdaptiveLinger:
 class _InFlight:
     """One launched batch riding the dispatch→completion queue."""
 
-    __slots__ = ("batch", "logits", "staged", "bucket", "n", "stall_s", "dtype")
+    __slots__ = (
+        "batch", "logits", "staged", "bucket", "n", "stall_s", "dtype",
+        "t_launch",
+    )
 
     def __init__(self, batch, logits, staged, bucket, n, stall_s, dtype):
         self.batch = batch
@@ -211,6 +240,7 @@ class _InFlight:
         self.n = n
         self.stall_s = stall_s
         self.dtype = dtype
+        self.t_launch = time.perf_counter()
 
 
 class MicroBatcher:
@@ -249,6 +279,17 @@ class MicroBatcher:
         # unlabeled PR-4 surface is unchanged.
         self.replica = replica
         self.on_complete = None
+        # Failure hook (pool mode): called with the failed-request count
+        # from the worker that observed the failure — the router's
+        # circuit breaker feed (serving/router.py).
+        self.on_failure = None
+        # Expiry hook (pool mode): called per request that expires in
+        # the admission queue before any dispatch.  The router returns
+        # the request's half-open trial token through it — a pre-
+        # dispatch expiry is no outcome either way, and without the
+        # return a trial that times out in queue would pin the breaker
+        # half-open forever (trial_limit tokens never freed).
+        self.on_expire = None
         self.metrics = metrics if metrics is not None else engine.metrics
         self.max_batch = min(max_batch or top, top)
         self.linger_s = linger_ms / 1e3
@@ -275,6 +316,12 @@ class MicroBatcher:
         self._inflight_lock = threading.Lock()
         self._inflight = 0
         self.peak_inflight = 0
+        # Health signals the supervisor polls (serving/pool.py): launched
+        # batches not yet read back (hang detection via the oldest one's
+        # age) and the current launch-failure streak.
+        self._live: set[_InFlight] = set()
+        self.consecutive_launch_failures = 0
+        self._aborted = False
         self._closed = threading.Event()
         self._stop_lock = threading.Lock()  # stop() is concurrency-safe
         self._worker: threading.Thread | None = None
@@ -307,6 +354,12 @@ class MicroBatcher:
         shutdown path's ``Router.stop()``): calls serialize, and the
         loser sees already-joined workers and returns.
         """
+        if self._aborted:
+            # An aborted batcher's completion worker may be permanently
+            # stuck inside a dead replica's D2H read; abort() already
+            # completed every waiter, so there is nothing to drain and a
+            # join here would hang the whole shutdown on one sick thread.
+            return
         self._closed.set()
         with self._stop_lock:
             self._stop_locked(drain)
@@ -348,6 +401,69 @@ class MicroBatcher:
             if self.metrics is not None and self.replica is None:
                 self.metrics.record_rejected()
 
+    def abort(self) -> int:
+        """Tear down a DEAD replica's pipeline without waiting on it.
+
+        The drain path (``stop(drain=True)``) is for healthy replicas:
+        it joins both workers, which presumes the device still answers.
+        A replica that hangs mid-completion or fails every launch would
+        park that join forever — so the supervisor calls this instead
+        (serving/pool.py).  Every queued request and every
+        launched-but-unread batch is completed with
+        :class:`ReplicaDeadError` so its handler retries on a survivor;
+        the workers are unstuck where possible and abandoned (daemon
+        threads) where not.  Returns the number of requests flushed.
+        First-wins completion (:class:`PendingRequest`) makes this safe
+        against a stuck read that later finishes: the late result is
+        discarded, never a second client-visible outcome.
+        """
+        self._closed.set()
+        with self._inflight_lock:
+            self._aborted = True
+            live = list(self._live)
+            # Zero the in-flight bookkeeping NOW: a permanently wedged
+            # completion worker never reaches its finally block, so
+            # without this sweep the gauge, Router.inflight(), and
+            # oldest_inflight_age would report phantom stuck load for an
+            # ejected replica forever.  A worker that later unsticks
+            # clamps at zero instead of double-decrementing.
+            self._live.clear()
+            self._inflight = 0
+            if self.metrics is not None:
+                self.metrics.set_inflight(0, replica=self.replica)
+        # Unstick a dispatch worker blocked on a full in-flight window.
+        for _ in range(self.max_inflight):
+            self._window.release()
+        flushed = self._flush_dead()
+        dead = ReplicaDeadError(
+            f"replica {self.replica or '?'} aborted by the supervisor"
+        )
+        for item in live:
+            for req in item.batch:
+                req.set_error(dead)
+                flushed += 1
+        # If the completion worker is merely slow (not hung), the
+        # sentinel lets it exit once it unsticks.
+        self._completions.put(None)
+        return flushed
+
+    def _flush_dead(self) -> int:
+        """Complete every queued request with :class:`ReplicaDeadError`
+        (retriable on a survivor).  Shared by :meth:`abort` and the
+        submit-side re-check that closes abort's flush-vs-enqueue race;
+        first-wins completion makes a double flush harmless."""
+        dead = ReplicaDeadError(
+            f"replica {self.replica or '?'} aborted by the supervisor"
+        )
+        flushed = 0
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return flushed
+            req.set_error(dead)
+            flushed += 1
+
     def depth(self) -> int:
         """Current admission-queue depth (the /metrics gauge)."""
         return self._queue.qsize()
@@ -356,6 +472,18 @@ class MicroBatcher:
         """Batches launched but not yet read back (the /metrics gauge)."""
         with self._inflight_lock:
             return self._inflight
+
+    def oldest_inflight_age(self, now: float | None = None) -> float:
+        """Seconds the OLDEST launched-but-unread batch has been waiting
+        (0.0 when nothing is in flight) — the supervisor's completion-
+        stall signal: a healthy replica's reads finish in milliseconds,
+        so an age past the stall timeout means the completion worker is
+        wedged on a dead device."""
+        with self._inflight_lock:
+            if not self._live:
+                return 0.0
+            oldest = min(item.t_launch for item in self._live)
+        return (now if now is not None else time.perf_counter()) - oldest
 
     @property
     def current_linger_ms(self) -> float:
@@ -427,6 +555,17 @@ class MicroBatcher:
             ) from None
         if self.metrics is not None:
             self.metrics.record_admitted()
+        # Close the abort race: admission passed the _closed check
+        # before a concurrent abort() set it, and the enqueue may have
+        # landed AFTER abort's queue flush with both workers gone —
+        # stop() closes the same race with a post-join flush, but abort
+        # cannot join a wedged worker.  If _aborted reads False here,
+        # abort's flush (which follows its _aborted store) has yet to
+        # run and will sweep this request; if True, we sweep it
+        # ourselves.  Either way the waiter gets ReplicaDeadError and
+        # the handler retries on a survivor instead of idling into 504.
+        if self._aborted:
+            self._flush_dead()
         return req
 
     # -- dispatch worker ------------------------------------------------------
@@ -435,6 +574,11 @@ class MicroBatcher:
         req.set_error(RequestTimeout("expired in queue before dispatch"))
         if self.metrics is not None:
             self.metrics.record_timeout()
+        if self.on_expire is not None:
+            try:
+                self.on_expire(1)
+            except Exception:
+                pass  # an observability hook must not kill the worker
 
     def _run(self) -> None:
         carry: PendingRequest | None = None
@@ -526,6 +670,10 @@ class MicroBatcher:
         try:
             with span("serving_dispatch", sink=self._sink,
                       registry=self._registry):
+                # Dormant fault point (serving/faults.py): chaos schedules
+                # inject launch failures exactly where a dying device
+                # would produce them.
+                fault_point("launch", self.replica)
                 # Default-dtype dispatch keeps the bare two-arg call so
                 # fake engines (tests) need not grow a dtype kwarg.
                 if dtype == self._default_dtype:
@@ -535,22 +683,61 @@ class MicroBatcher:
         except BaseException as e:  # complete every waiter, keep serving
             self._staging.release(staged, bucket)
             self._window.release()
+            self.consecutive_launch_failures += 1
+            # Pool mode: the work never ran, so the failure is retriable
+            # on a surviving replica — surface it as ReplicaDeadError so
+            # the handler's resubmission path picks it up.  Single-engine
+            # mode has no survivors; the raw error is the client outcome.
+            err: BaseException = e
+            if self.replica is not None and not isinstance(e, RejectedError):
+                err = ReplicaDeadError(
+                    f"replica {self.replica} launch failed: "
+                    f"{type(e).__name__}: {e}"
+                )
+                err.__cause__ = e
             for req in batch:
-                req.set_error(e)
-            if self.metrics is not None:
+                req.set_error(err)
+            # Same post-abort guard as the completion worker: a launch
+            # that fails AFTER abort unstuck this worker (window
+            # released on a dead engine) is the old pipeline's corpse
+            # twitching — striking the restarted replica's breaker
+            # would re-open a healthy half-open circuit, and these
+            # requests were already flushed and retried.
+            if self.metrics is not None and not self._aborted:
                 self.metrics.record_failed(len(batch))
+            if self.on_failure is not None and not self._aborted:
+                try:
+                    self.on_failure(len(batch))
+                except Exception:
+                    pass  # a hook failure must never kill the worker
             return
+        self.consecutive_launch_failures = 0
+        item = _InFlight(batch, logits, staged, bucket, total, stall_s, dtype)
+        aborted = False
         with self._inflight_lock:
-            self._inflight += 1
-            self.peak_inflight = max(self.peak_inflight, self._inflight)
-            # Gauge set under the SAME lock as the counter: a set outside
-            # it can lose the increment/decrement race and leave a stale
-            # depth on /metrics?format=prom (which never recomputes).
-            if self.metrics is not None:
-                self.metrics.set_inflight(self._inflight, replica=self.replica)
-        self._completions.put(
-            _InFlight(batch, logits, staged, bucket, total, stall_s, dtype)
-        )
+            aborted = self._aborted
+            if not aborted:
+                self._live.add(item)
+                self._inflight += 1
+                self.peak_inflight = max(self.peak_inflight, self._inflight)
+                # Gauge set under the SAME lock as the counter: a set
+                # outside it can lose the increment/decrement race and
+                # leave a stale depth on /metrics?format=prom (which
+                # never recomputes).
+                if self.metrics is not None:
+                    self.metrics.set_inflight(
+                        self._inflight, replica=self.replica
+                    )
+        if aborted:
+            # abort() ran between the launch and this bookkeeping; its
+            # _live sweep could not see this batch, so its waiters are
+            # completed here (same retriable outcome, no thread waits).
+            for req in batch:
+                req.set_error(ReplicaDeadError(
+                    f"replica {self.replica or '?'} aborted by the supervisor"
+                ))
+            return
+        self._completions.put(item)
 
     # -- completion worker ----------------------------------------------------
 
@@ -569,27 +756,61 @@ class MicroBatcher:
             try:
                 with span("serving_complete", sink=self._sink,
                           registry=self._registry):
+                    # Dormant fault point: chaos 'hang' clauses stall this
+                    # read exactly like a wedged device would; 'fail'
+                    # clauses model a poisoned result.
+                    fault_point("complete", self.replica)
                     host = np.asarray(item.logits)  # jaxlint: disable=JL009 -- the completion worker IS the sanctioned D2H point; this read overlaps the dispatch thread's next batch
             except BaseException as e:
+                err: BaseException = e
+                if self.replica is not None and not isinstance(e, RejectedError):
+                    # Retriable in pool mode: the batch's RESPONSE never
+                    # materialized (first-wins completion keeps a late
+                    # duplicate read from ever surfacing), so survivors
+                    # may rerun the work (serving/server.py).
+                    err = ReplicaDeadError(
+                        f"replica {self.replica} completion failed: "
+                        f"{type(e).__name__}: {e}"
+                    )
+                    err.__cause__ = e
                 for req in item.batch:
-                    req.set_error(e)
-                if self.metrics is not None:
+                    req.set_error(err)
+                # Post-abort, this outcome belongs to a DEAD pipeline:
+                # the waiters were already errored and retried on
+                # survivors, and the replica's breaker now guards a
+                # RESTARTED batcher — a late failure striking it would
+                # re-open a healthy half-open circuit and march the
+                # supervisor's ladder toward a spurious ejection.
+                if self.metrics is not None and not self._aborted:
                     self.metrics.record_failed(len(item.batch))
+                if self.on_failure is not None and not self._aborted:
+                    try:
+                        self.on_failure(len(item.batch))
+                    except Exception:
+                        pass  # a hook failure must never kill the worker
             else:
                 done = time.perf_counter()
                 # Event schema note: the replica tag appears only in
                 # pool mode, so single-engine JSONL stays byte-stable.
                 tag = {"replica": self.replica} if self.replica else {}
+                # A read that unsticks AFTER an abort is not a success
+                # of THIS pipeline: the waiters were errored and retried
+                # elsewhere (counting here double-counts the outcome),
+                # and on_complete -> record_success would close the
+                # restarted replica's half-open circuit with zero real
+                # trials.  set_result stays — first-wins discards it for
+                # already-errored waiters.
+                aborted = self._aborted
                 offset = 0
                 for req in item.batch:
                     req.set_result(host[offset : offset + req.n])
                     offset += req.n
                     latency_s = done - req.t_submit
-                    if self.metrics is not None:
+                    if self.metrics is not None and not aborted:
                         self.metrics.record_completed(
                             latency_s, dtype=req.dtype
                         )
-                    if self.on_complete is not None:
+                    if self.on_complete is not None and not aborted:
                         try:
                             self.on_complete(latency_s)
                         except Exception:
@@ -598,7 +819,7 @@ class MicroBatcher:
                             # sit in _completions forever and every
                             # subsequent client would 504.
                             pass
-                    if self._sink:
+                    if self._sink and not aborted:
                         self._sink.emit(
                             "serving_request", n=req.n,
                             latency_s=latency_s,
@@ -607,7 +828,11 @@ class MicroBatcher:
             finally:
                 self._staging.release(item.staged, item.bucket)
                 with self._inflight_lock:
-                    self._inflight -= 1
+                    self._live.discard(item)
+                    # max(): abort() may have zeroed the count already
+                    # (its phantom-load sweep); an unsticking worker
+                    # must not drive it negative.
+                    self._inflight = max(0, self._inflight - 1)
                     if self.metrics is not None:
                         self.metrics.set_inflight(
                             self._inflight, replica=self.replica

@@ -1,0 +1,260 @@
+"""Deterministic fault injection for the serving stack (docs/ROBUSTNESS.md).
+
+A fleet serving real traffic must DETECT, eject, and heal replicas that
+throw, hang, or die under live load — and the only honest way to claim
+that is to inject those faults on purpose and assert the recovery, not
+narrate it.  This module is the injection surface: named **fault
+points** compiled into the serving hot path (batcher dispatch and
+completion, pool warmup, AOT deserialization) that are dormant — one
+module-global ``None`` check — until a test or the loadgen's chaos mode
+installs a :class:`FaultInjector`.
+
+Determinism is the design constraint: the chaos acceptance tests must
+produce the same fault sequence on every run, so triggers are
+**event-counted** (``after=``/``count=``) rather than timed by default,
+and the only randomness (``p=``) draws from a seeded RNG.  Wall-clock
+triggers (``at=`` seconds since :meth:`FaultInjector.start`) exist for
+the loadgen's operator-facing schedules ("kill replica 2 at t=5s") and
+are deliberately absent from the pinned tests.
+
+Spec grammar (one or more clauses joined by ``;``)::
+
+    clause  := op ':' site [ ':' replica ] [ ':' params ]
+    op      := 'fail' | 'hang'
+    site    := 'launch' | 'complete' | 'warmup' | 'aot_load'
+    replica := a replica name ('r0', ...); '*' or omitted = any replica
+               (rejected for 'aot_load': the store is pool-shared, so a
+               replica-scoped clause could never fire)
+    params  := key '=' value (',' key '=' value)*
+
+    count=N | count=inf   fire on the next N matching events (default 1)
+    after=K               skip the first K matching events (default 0)
+    at=T                  arm only once T seconds have passed since start()
+    for=S                 hang duration in seconds ('hang' op; default 0.5)
+    p=X                   fire each armed event with probability X (seeded)
+
+Examples::
+
+    fail:launch:r1:count=6        # r1's next 6 dispatches raise (a kill)
+    hang:complete:r0:for=2        # r0's next completion read stalls 2s
+    fail:aot_load:count=1         # first AOT deserialize fails -> fallback
+    fail:warmup:r2                # r2's warmup raises once
+    fail:launch:r3:at=5,count=inf # kill r3 five seconds into the run
+
+The ``fail`` op raises :class:`FaultError` at the fault point — the
+supervisor (serving/pool.py) must treat it exactly like any engine
+exception, which is the point.  The ``hang`` op blocks the calling
+thread for ``for=`` seconds (interruptibly: :func:`uninstall` releases
+stuck sleepers), which is how the completion-stall detector is proven.
+
+Off by default: ``fault_point()`` returns after a single global ``is
+None`` test when nothing is installed, so production paths pay one
+branch.  stdlib-only, no jax import — the injector is testable at
+interactive speed and importable from the jax-free compile layer.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+from contextlib import contextmanager
+
+SITES = ("launch", "complete", "warmup", "aot_load")
+OPS = ("fail", "hang")
+
+
+class FaultError(RuntimeError):
+    """An injected failure.  Deliberately a plain RuntimeError subclass:
+    the serving stack must recover from it through the SAME paths it
+    recovers from real engine failures with — any special-casing of
+    this type in non-test code would make the chaos harness a liar."""
+
+
+class FaultSpec:
+    """One parsed clause: where it fires, when, how often, what it does."""
+
+    __slots__ = (
+        "op", "site", "replica", "count", "after", "at_s", "hang_s", "p",
+        "fired", "source",
+    )
+
+    def __init__(self, op, site, replica, count, after, at_s, hang_s, p, source):
+        self.op = op
+        self.site = site
+        self.replica = replica
+        self.count = count
+        self.after = after
+        self.at_s = at_s
+        self.hang_s = hang_s
+        self.p = p
+        self.fired = 0
+        self.source = source
+
+    @classmethod
+    def parse(cls, clause: str) -> "FaultSpec":
+        parts = [p.strip() for p in clause.strip().split(":")]
+        if len(parts) < 2:
+            raise ValueError(
+                f"fault clause {clause!r} needs at least op:site "
+                f"(grammar: op:site[:replica][:k=v,...])"
+            )
+        op, site = parts[0], parts[1]
+        if op not in OPS:
+            raise ValueError(f"unknown fault op {op!r}; have {OPS}")
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r}; have {SITES}")
+        replica: str | None = None
+        params: dict[str, str] = {}
+        for part in parts[2:]:
+            if "=" in part:
+                for pair in part.split(","):
+                    key, _, value = pair.partition("=")
+                    key, value = key.strip(), value.strip()
+                    if key not in ("count", "after", "at", "for", "p"):
+                        raise ValueError(
+                            f"unknown fault param {key!r} in {clause!r}; "
+                            "have count/after/at/for/p"
+                        )
+                    params[key] = value
+            elif part and part != "*":
+                replica = part
+        count = (
+            math.inf if params.get("count") == "inf"
+            else float(params.get("count", 1))
+        )
+        if count < 1:
+            raise ValueError(f"count must be >= 1 in {clause!r}")
+        if site == "aot_load" and replica is not None:
+            # The AOT store is SHARED across replicas (one ExecutableStore
+            # per pool), so its fault point fires unlabeled; accepting a
+            # replica-scoped clause here would arm one that can never
+            # trigger — a vacuous green chaos run.
+            raise ValueError(
+                f"aot_load cannot be replica-scoped in {clause!r}: the "
+                "executable store is shared across the pool"
+            )
+        return cls(
+            op=op,
+            site=site,
+            replica=replica,
+            count=count,
+            after=int(params.get("after", 0)),
+            at_s=float(params["at"]) if "at" in params else None,
+            hang_s=float(params.get("for", 0.5)),
+            p=float(params.get("p", 1.0)),
+            source=clause.strip(),
+        )
+
+    def __repr__(self):
+        return f"FaultSpec({self.source!r}, fired={self.fired})"
+
+
+class FaultInjector:
+    """A parsed schedule of :class:`FaultSpec` clauses plus the seeded
+    RNG and the (optional) virtual-time origin the ``at=`` triggers
+    measure from.  Thread-safe: fault points fire from the dispatch
+    worker, the completion worker, and N warmup threads concurrently.
+    """
+
+    def __init__(self, spec: str = "", seed: int = 0):
+        self.specs = [
+            FaultSpec.parse(clause)
+            for clause in spec.split(";")
+            if clause.strip()
+        ]
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._t0: float | None = None
+        # Released by uninstall() so stuck hang sleepers wake instead of
+        # outliving the test that injected them.
+        self._unhang = threading.Event()
+
+    def start(self) -> "FaultInjector":
+        """Set the virtual-time origin for ``at=`` triggers (the moment
+        the workload begins, not the moment the injector was built)."""
+        self._t0 = time.monotonic()
+        return self
+
+    def fire(self, site: str, replica: str | None = None) -> None:
+        """Evaluate every armed clause against one fault-point event.
+
+        Raises :class:`FaultError` for a matching ``fail``; sleeps for a
+        matching ``hang``; silently returns otherwise.  Counters mutate
+        under the lock; the hang sleep runs outside it.
+        """
+        for spec in self.specs:
+            if spec.site != site:
+                continue
+            if spec.replica is not None and spec.replica != replica:
+                continue
+            if spec.at_s is not None and (
+                self._t0 is None or time.monotonic() - self._t0 < spec.at_s
+            ):
+                continue
+            with self._lock:
+                if spec.after > 0:
+                    spec.after -= 1
+                    continue
+                if spec.count <= 0:
+                    continue
+                if spec.p < 1.0 and self._rng.random() >= spec.p:
+                    continue
+                spec.count -= 1
+                spec.fired += 1
+                op, hang_s, source = spec.op, spec.hang_s, spec.source
+            if op == "hang":
+                self._unhang.wait(hang_s)
+            else:
+                raise FaultError(
+                    f"injected failure at {site}"
+                    + (f" on {replica}" if replica else "")
+                    + f" ({source})"
+                )
+
+    def fired_counts(self) -> dict[str, int]:
+        """``{clause source: times fired}`` — the chaos report's receipt
+        that the schedule actually bit."""
+        with self._lock:
+            return {spec.source: spec.fired for spec in self.specs}
+
+
+# The module-global installed injector.  None = every fault point is a
+# single attribute load + branch — the near-zero-overhead contract.
+_INJECTOR: FaultInjector | None = None
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    global _INJECTOR
+    _INJECTOR = injector
+    return injector
+
+
+def uninstall() -> None:
+    """Remove the installed injector and wake any thread stuck in one of
+    its ``hang`` sleeps (tests must not wait out a 3-second hang whose
+    assertion already passed)."""
+    global _INJECTOR
+    injector, _INJECTOR = _INJECTOR, None
+    if injector is not None:
+        injector._unhang.set()
+
+
+def fault_point(site: str, replica: str | None = None) -> None:
+    """The hook the serving hot path calls.  Dormant unless installed."""
+    injector = _INJECTOR
+    if injector is not None:
+        injector.fire(site, replica)
+
+
+@contextmanager
+def injected(spec: str, seed: int = 0):
+    """``with injected("fail:launch:r0:count=3"):`` — install, start,
+    and always uninstall (the test-suite ergonomic surface)."""
+    injector = install(FaultInjector(spec, seed=seed)).start()
+    try:
+        yield injector
+    finally:
+        uninstall()

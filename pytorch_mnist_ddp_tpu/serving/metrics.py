@@ -87,6 +87,16 @@ class ServingMetrics:
             "serving_inflight_batches",
             help="batches launched on the device, result not yet read back",
         )
+        # Failure-aware retry tally (docs/ROBUSTNESS.md): handler-side
+        # resubmissions of never-executed requests after a replica
+        # flush/abort.  Deliberately NOT an outcome in the requests
+        # family — a retried request still exits through exactly one of
+        # completed/rejected/timed_out/failed.
+        self._retries = self.registry.counter(
+            "serving_request_retries_total",
+            help="transparent handler resubmissions after a replica "
+            "drain race or death (pool mode); the client saw no error",
+        )
         # Per-dtype request surface (ISSUE 6): reduced-precision serving
         # variants get their own count + latency families so the
         # quantization win is visible per dtype on /metrics and in the
@@ -118,6 +128,10 @@ class ServingMetrics:
         return self._requests["failed"].value
 
     @property
+    def retried(self) -> int:
+        return self._retries.value
+
+    @property
     def batches(self) -> int:
         return self._batches.value
 
@@ -142,6 +156,9 @@ class ServingMetrics:
 
     def record_failed(self, n: int = 1) -> None:
         self._requests["failed"].inc(n)
+
+    def record_retry(self, n: int = 1) -> None:
+        self._retries.inc(n)
 
     def record_batch(self, real: int, bucket: int) -> None:
         """One engine dispatch: ``real`` live samples padded to ``bucket``."""
@@ -253,6 +270,7 @@ class ServingMetrics:
                 "timed_out": self.timed_out,
                 "failed": self.failed,
             }
+            retried = self.retried
         uptime = time.perf_counter() - self._t0
         occupancy = (
             100.0 * samples_real / samples_padded if samples_padded else 0.0
@@ -261,6 +279,9 @@ class ServingMetrics:
         snap = {
             "uptime_s": uptime,
             "requests": requests,
+            # Top-level, not inside "requests": a retry is not a request
+            # outcome (the retried request still exits through one).
+            "retries": retried,
             "batches": batches,
             "samples": {
                 "real": samples_real,

@@ -30,6 +30,14 @@ Design points:
   unroutable, then the PR-4 ``stop(drain=True)``); the engine stays
   warm, so ``add(name)`` rebuilds only the batcher — re-adding capacity
   costs no compile, no checkpoint reload, no parity re-gate.
+- **Supervision** (docs/ROBUSTNESS.md).  ``start()`` also runs a
+  :class:`ReplicaSupervisor`: a replica that fails consecutive launches,
+  trips its circuit breaker, or stalls its completion worker is
+  quarantined (batcher aborted, its requests retried on survivors) and
+  restarted with exponential backoff + seeded jitter — a *warm* restart,
+  because the engine and the shared AOT store never left memory, so
+  recovery adds ZERO traces.  A restart budget escalates to permanent
+  ejection.
 
 The pool deliberately exposes the single-engine surface the server and
 loadgen already consume (``buckets``/``dtypes``/``variant_verified``/
@@ -40,6 +48,7 @@ and eight.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Any, Sequence
@@ -47,6 +56,7 @@ from typing import Any, Sequence
 from ..parallel.mesh import replica_devices, single_device_mesh
 from .buckets import DEFAULT_MAX_BUCKET, pow2_buckets
 from .engine import InferenceEngine
+from .faults import fault_point
 from .metrics import ServingMetrics
 from .router import Replica, Router
 
@@ -54,6 +64,293 @@ from .router import Replica, Router
 # r0..rN-1, the labels on every per-replica metric family.
 def _replica_name(i: int) -> str:
     return f"r{i}"
+
+
+class _ReplicaWatch:
+    """Supervisor-side bookkeeping for one replica's restart ladder."""
+
+    __slots__ = (
+        "attempts", "restarts", "next_restart_t", "quarantined_at",
+        "backoff_s", "recovery_s",
+    )
+
+    def __init__(self):
+        self.attempts = 0          # restarts since the last healthy spell
+        self.restarts = 0          # lifetime restarts (the counter's twin)
+        self.next_restart_t: float | None = None
+        self.quarantined_at: float | None = None
+        self.backoff_s = 0.0
+        self.recovery_s: list[float] = []
+
+
+class ReplicaSupervisor:
+    """Watches replica health, quarantines the sick, restarts with
+    backoff, ejects the incurable (docs/ROBUSTNESS.md state machine).
+
+    The control-plane half of fault tolerance (the data-plane half is
+    the router's per-replica :class:`~.router.CircuitBreaker`): a
+    polling thread reads three health signals per active replica —
+
+    - **circuit open** — the breaker tripped on consecutive batch
+      failures (the fast path already stopped placement);
+    - **launch-failure streak** — ``batcher.consecutive_launch_failures``
+      at/above ``failure_threshold`` (covers a replica the breaker has
+      not tripped yet, e.g. failures interleaved with successes on
+      other dtypes);
+    - **completion stall** — the oldest launched-but-unread batch older
+      than ``stall_timeout_s`` (a wedged device or hung D2H read; the
+      chaos harness's ``hang`` op injects exactly this).
+
+    A sick replica is **quarantined**: circuit forced open, batcher
+    aborted (queued + in-flight requests complete with
+    ``ReplicaDeadError`` → handlers retry on survivors), then
+    **restarted** after an exponential backoff with seeded jitter — the
+    restart rebuilds only the batcher around the still-warm engine, so
+    a warm restart is pure deserialize/reuse, ZERO new traces (the
+    sentinel budget is unchanged; pinned in tests/test_faults.py).  The
+    circuit re-admits via half-open trial requests.  ``restart_budget``
+    consecutive failed recoveries escalate to permanent **ejection**.
+
+    Decoupled from :class:`EnginePool` on purpose: the supervisor needs
+    only a router, a ``make_batcher(replica) -> started MicroBatcher``
+    factory, and somewhere to record — so the chaos tests drive it
+    against fake engines at interactive speed.
+    """
+
+    def __init__(
+        self,
+        router: Router,
+        make_batcher,
+        registry=None,
+        sink=None,
+        interval_s: float = 0.1,
+        stall_timeout_s: float = 5.0,
+        failure_threshold: int = 3,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 10.0,
+        backoff_jitter: float = 0.25,
+        restart_budget: int = 3,
+        seed: int = 0,
+    ):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.router = router
+        self.make_batcher = make_batcher
+        self.interval_s = interval_s
+        self.stall_timeout_s = stall_timeout_s
+        self.failure_threshold = max(1, failure_threshold)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.backoff_jitter = backoff_jitter
+        self.restart_budget = max(0, restart_budget)
+        self._registry = registry
+        self._sink = sink
+        # Seeded: backoff jitter must not make two chaos runs diverge.
+        self._rng = random.Random(seed)
+        self._watch: dict[str, _ReplicaWatch] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "ReplicaSupervisor":
+        if self._thread is not None:
+            raise RuntimeError("supervisor already started")
+        self._thread = threading.Thread(
+            target=self._run, name="serve-supervisor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                # The supervisor must outlive any single bad tick (a
+                # replica torn down mid-inspection): skipping one beat
+                # is recoverable, a dead supervisor is not.
+                pass
+
+    # -- the state machine ----------------------------------------------------
+
+    def tick(self, now: float | None = None) -> None:
+        """One inspection pass (public so tests can step deterministically
+        without the polling thread)."""
+        now = now if now is not None else time.perf_counter()
+        for replica in list(self.router.replicas):
+            watch = self._watch.setdefault(replica.name, _ReplicaWatch())
+            if replica.state == "active":
+                reason = self._sick_reason(replica)
+                if reason is not None:
+                    self._quarantine(replica, watch, reason, now)
+                elif (
+                    watch.attempts
+                    and replica.breaker is not None
+                    and replica.breaker.state == "closed"
+                ):
+                    # Healed (a trial passed and traffic flows): the next
+                    # incident starts a fresh backoff ladder instead of
+                    # inheriting this one's escalation.
+                    watch.attempts = 0
+            elif (
+                replica.state == "quarantined"
+                and watch.next_restart_t is not None
+                and now >= watch.next_restart_t
+            ):
+                self._restart(replica, watch, now)
+
+    def _sick_reason(self, replica: Replica) -> str | None:
+        if replica.breaker is not None and replica.breaker.state == "open":
+            return "circuit_open"
+        batcher = replica.batcher
+        if (getattr(batcher, "consecutive_launch_failures", 0)
+                >= self.failure_threshold):
+            return "launch_failures"
+        age = getattr(batcher, "oldest_inflight_age", lambda: 0.0)()
+        if age > self.stall_timeout_s:
+            return "completion_stall"
+        return None
+
+    def _backoff(self, attempts: int) -> float:
+        """Exponential backoff with seeded jitter for the given rung of
+        the ladder (``attempts`` completed restart attempts)."""
+        backoff = min(
+            self.backoff_max_s, self.backoff_base_s * (2 ** attempts)
+        )
+        return backoff * (1.0 + self.backoff_jitter * self._rng.random())
+
+    def _quarantine(self, replica, watch, reason, now) -> None:
+        if watch.attempts >= self.restart_budget:
+            self._eject(replica, watch, reason)
+            return
+        flushed = self.router.quarantine(replica.name, reason=reason)
+        backoff = self._backoff(watch.attempts)
+        watch.quarantined_at = now
+        watch.next_restart_t = now + backoff
+        watch.backoff_s = backoff
+        # The router already emitted replica_quarantine; log the
+        # schedule here so the backoff ladder is reconstructible.
+        if self._sink:
+            self._sink.emit(
+                "replica_restart_scheduled", replica=replica.name,
+                reason=reason, attempt=watch.attempts + 1,
+                backoff_s=backoff, flushed=flushed,
+            )
+
+    def _restart(self, replica, watch, now) -> None:
+        watch.attempts += 1
+        with self.router._lock:
+            replica.state = "restarting"
+        try:
+            batcher = self.make_batcher(replica)
+        except Exception as e:
+            # Engine/batcher rebuild failed outright (not a traffic
+            # failure).  The budget applies HERE too: _quarantine's
+            # check is only reachable from state "active" (a restart
+            # that succeeded and re-sickened), so without this a
+            # make_batcher that always raises would cycle
+            # quarantined→restarting forever — never ejected, never
+            # settled (docs/ROBUSTNESS.md promises ejection after
+            # restart_budget consecutive failed recoveries).
+            if watch.attempts >= self.restart_budget:
+                if self._sink:
+                    self._sink.emit(
+                        "replica_restart", replica=replica.name,
+                        attempt=watch.attempts, outcome="restart_failed",
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                self._eject(replica, watch, "restart_failed")
+                return
+            with self.router._lock:
+                replica.state = "quarantined"
+            # attempts was already incremented for this try, so the
+            # next wait climbs one rung up the same ladder.
+            backoff = self._backoff(watch.attempts)
+            watch.next_restart_t = now + backoff
+            watch.backoff_s = backoff
+            if self._sink:
+                self._sink.emit(
+                    "replica_restart", replica=replica.name,
+                    attempt=watch.attempts, outcome="restart_failed",
+                    error=f"{type(e).__name__}: {e}", backoff_s=backoff,
+                )
+            return
+        self.router.attach(replica.name, batcher)
+        if replica.breaker is not None:
+            replica.breaker.half_open()
+        watch.restarts += 1
+        watch.next_restart_t = None
+        recovery = (
+            now - watch.quarantined_at
+            if watch.quarantined_at is not None else 0.0
+        )
+        watch.recovery_s.append(recovery)
+        if self._registry is not None:
+            self._registry.counter(
+                "serving_replica_restarts_total",
+                help="supervisor restarts per replica (fresh batcher "
+                "around the still-warm engine; zero new traces)",
+                replica=replica.name,
+            ).inc()
+        if self._sink:
+            self._sink.emit(
+                "replica_restart", replica=replica.name,
+                attempt=watch.attempts, backoff_s=watch.backoff_s,
+                recovery_s=recovery, outcome="restarted",
+            )
+
+    def _eject(self, replica, watch, reason) -> None:
+        with self.router._lock:
+            replica.state = "ejected"
+        if replica.breaker is not None:
+            replica.breaker.force_open("ejected")
+        # Same teardown quarantine gives a sick replica: queued and
+        # in-flight requests complete with ReplicaDeadError so their
+        # handlers retry on survivors instead of idling out their full
+        # deadline — ejection is permanent, so nobody else will ever
+        # flush this batcher (Router.stop skips ejected replicas, and
+        # abort makes that stop a no-op anyway).
+        flushed = replica.batcher.abort()
+        watch.next_restart_t = None
+        if self._sink:
+            self._sink.emit(
+                "replica_eject", replica=replica.name, reason=reason,
+                attempts=watch.attempts, flushed=flushed,
+            )
+
+    # -- reads ----------------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Per-replica restart/recovery accounting plus the pooled
+        recovery times — the loadgen chaos report's source."""
+        per_replica = {
+            name: {
+                "restarts": w.restarts,
+                "attempts_since_healthy": w.attempts,
+                "recovery_s": list(w.recovery_s),
+            }
+            for name, w in self._watch.items()
+        }
+        all_recoveries = [
+            s for w in self._watch.values() for s in w.recovery_s
+        ]
+        return {
+            "replicas": per_replica,
+            "restarts_total": sum(
+                w.restarts for w in self._watch.values()
+            ),
+            "mean_recovery_s": (
+                sum(all_recoveries) / len(all_recoveries)
+                if all_recoveries else None
+            ),
+        }
 
 
 class EnginePool:
@@ -127,6 +424,7 @@ class EnginePool:
             )
         self.devices = list(assigned)
         self.router: Router | None = None
+        self.supervisor: ReplicaSupervisor | None = None
         self._batcher_kwargs: dict = {}
         self._sink = None
         self._add_lock = threading.Lock()
@@ -220,6 +518,10 @@ class EnginePool:
 
     def _warm_one(self, i, engine, parallel, sink, on_rung) -> None:
         name = _replica_name(i)
+        # Dormant fault point (serving/faults.py): chaos schedules can
+        # fail one replica's warmup to prove a cold-start failure
+        # surfaces instead of silently serving an unwarmed replica.
+        fault_point("warmup", name)
         engine.warmup(
             parallel=parallel,
             sink=sink,
@@ -264,13 +566,21 @@ class EnginePool:
     # -- batchers + router -------------------------------------------------------
 
     def start(
-        self, router_policy: str = "cost", sink=None, **batcher_kwargs
+        self,
+        router_policy: str = "cost",
+        sink=None,
+        supervise: bool = True,
+        supervisor_kwargs: dict | None = None,
+        **batcher_kwargs,
     ) -> Router:
         """Start one pipelined batcher per replica and build the router.
 
         ``batcher_kwargs`` (linger, queue depth, timeouts, in-flight
         window...) are remembered so :meth:`add` rebuilds identical
-        batchers later.
+        batchers later.  ``supervise`` (default on) also starts the
+        :class:`ReplicaSupervisor` — quarantine / backoff-restart /
+        ejection of sick replicas (docs/ROBUSTNESS.md);
+        ``supervisor_kwargs`` tunes its thresholds.
         """
         if self.router is not None:
             raise RuntimeError("pool already started")
@@ -279,11 +589,9 @@ class EnginePool:
         replicas = []
         for i, engine in enumerate(self.engines):
             name = _replica_name(i)
-            batcher = self._make_batcher(name, engine)
-            replica = Replica(name, batcher, engine=engine)
-            # The completion worker feeds the router's cost policy.
-            batcher.on_complete = replica.observe_latency
-            batcher.start()
+            replica = Replica(name, self._make_batcher(name, engine),
+                              engine=engine)
+            self._hook_and_start(replica, replica.batcher)
             replicas.append(replica)
         self.router = Router(
             replicas,
@@ -292,7 +600,39 @@ class EnginePool:
             sink=self._sink,
             metrics=self.metrics,
         )
+        if supervise:
+            self.supervisor = ReplicaSupervisor(
+                self.router,
+                self._restart_batcher,
+                registry=self.metrics.registry,
+                sink=self._sink,
+                **(supervisor_kwargs or {}),
+            ).start()
         return self.router
+
+    @staticmethod
+    def _hook_and_start(replica: Replica, batcher) -> None:
+        # The completion worker feeds the router's cost policy AND the
+        # circuit breaker's success side; the failure hook feeds its
+        # trip side; the expiry hook returns half-open trial tokens
+        # held by requests that timed out in queue before dispatch.
+        batcher.on_complete = replica.observe_latency
+        batcher.on_failure = replica.observe_failure
+        batcher.on_expire = replica.observe_expiry
+        batcher.start()
+
+    def _restart_batcher(self, replica: Replica):
+        """Supervisor restart factory: a fresh batcher around the
+        replica's still-warm engine — same construction as :meth:`add`,
+        so a restart costs no compile, no checkpoint reload, no parity
+        re-gate (the zero-new-traces contract, tests/test_faults.py)."""
+        if replica.engine is None:
+            raise RuntimeError(
+                f"replica {replica.name!r} has no engine to restart around"
+            )
+        batcher = self._make_batcher(replica.name, replica.engine)
+        self._hook_and_start(replica, batcher)
+        return batcher
 
     def _make_batcher(self, name: str, engine: InferenceEngine):
         from .batcher import MicroBatcher
@@ -345,8 +685,7 @@ class EnginePool:
                 )
             t0 = time.perf_counter()
             batcher = self._make_batcher(replica.name, replica.engine)
-            batcher.on_complete = replica.observe_latency
-            batcher.start()
+            self._hook_and_start(replica, batcher)
             self.router.attach(replica.name, batcher)
         if self._sink:
             self._sink.emit(
@@ -356,5 +695,10 @@ class EnginePool:
         return replica.name
 
     def stop(self, drain: bool = True) -> None:
+        # Supervisor first: a restart racing the shutdown would attach a
+        # fresh batcher to a router that is tearing its replicas down.
+        if self.supervisor is not None:
+            self.supervisor.stop()
+            self.supervisor = None
         if self.router is not None:
             self.router.stop(drain=drain)

@@ -45,6 +45,19 @@ lands on a surviving replica (serving/server.py).
 engine — the pool's ``add``).  Drain wall time is the
 ``serving_replica_drain_seconds`` histogram + ``replica_drain`` events.
 
+**Fault tolerance** (docs/ROBUSTNESS.md).  Every replica carries a
+:class:`CircuitBreaker`: consecutive batch failures trip it open and
+placement stops selecting the replica within a handful of requests —
+no polling latency in the data plane.  :meth:`quarantine` is the
+supervisor's hard removal (abort, not drain: a dead replica cannot
+finish its window), and after a restart the breaker goes **half-open**,
+admitting trial requests until one closes it.  Requests flushed off a
+dead replica surface as ``ReplicaDeadError`` (a ``RejectedError``), so
+the HTTP handler's existing drain-race retry resubmits them on
+survivors with the REMAINING deadline budget — exactly one
+client-visible outcome per request, counted once
+(``serving_request_retries_total`` tallies the transparent retries).
+
 Pure host-side stdlib + numpy (no jax import): policies, sharding, and
 drain ordering are all testable against fake engines at interactive
 speed (tests/test_scaleout.py), exactly like the batcher.
@@ -67,6 +80,159 @@ POLICIES = ("roundrobin", "least-loaded", "cost")
 # thrash on one outlier.
 EWMA_ALPHA = 0.2
 
+# Circuit states, and the numeric encoding the serving_circuit_state
+# gauge exports (docs/OBSERVABILITY.md): 0 = closed (healthy), 1 =
+# half-open (trial traffic only), 2 = open (no placement).
+CIRCUIT_CLOSED = "closed"
+CIRCUIT_HALF_OPEN = "half-open"
+CIRCUIT_OPEN = "open"
+_CIRCUIT_GAUGE = {CIRCUIT_CLOSED: 0.0, CIRCUIT_HALF_OPEN: 1.0, CIRCUIT_OPEN: 2.0}
+
+
+class CircuitBreaker:
+    """Per-replica circuit breaker: closed → open → half-open → closed.
+
+    The data-plane half of fault tolerance (the control-plane half is
+    the supervisor, serving/pool.py): a replica whose requests FAIL —
+    launch errors, completion-read errors — must fall out of placement
+    within a handful of batches, long before any polling supervisor
+    notices, or every routed request until then is a poisoned 500.
+
+    - **closed** — normal placement.  ``failure_threshold`` consecutive
+      failures trip it open (any success resets the streak).
+    - **open** — the router never places here.  Only an explicit
+      :meth:`half_open` (the supervisor, after a restart) re-admits.
+    - **half-open** — at most ``trial_limit`` concurrently outstanding
+      *trial* requests are placed; ``trial_successes`` successes close
+      the circuit, any failure re-opens it.
+
+    Transitions land on the ``serving_circuit_state{replica=}`` gauge
+    and as ``circuit_transition`` events, so a breaker flapping is
+    observable, not folkloric.  Thread-safe: the dispatch/completion
+    workers feed outcomes while handler threads check placement.
+    """
+
+    def __init__(
+        self,
+        replica: str,
+        failure_threshold: int = 3,
+        trial_limit: int = 1,
+        trial_successes: int = 1,
+        registry=None,
+        sink=None,
+    ):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got {failure_threshold}"
+            )
+        self.replica = replica
+        self.failure_threshold = failure_threshold
+        self.trial_limit = max(1, trial_limit)
+        self.trial_successes = max(1, trial_successes)
+        self.state = CIRCUIT_CLOSED
+        self.last_reason: str | None = None
+        self._consecutive_failures = 0
+        self._trial_inflight = 0
+        self._trial_passed = 0
+        self._lock = threading.Lock()
+        self._sink = sink
+        self._gauge = (
+            registry.gauge(
+                "serving_circuit_state",
+                help="per-replica circuit breaker: 0 closed, 1 half-open "
+                "(trial traffic only), 2 open (no placement)",
+                replica=replica,
+            )
+            if registry is not None
+            else None
+        )
+        if self._gauge is not None:
+            self._gauge.set(0.0)
+
+    def _transition(self, to: str, reason: str | None) -> None:
+        """State change + gauge + event, under the lock."""
+        src = self.state
+        if src == to:
+            return
+        self.state = to
+        self.last_reason = reason
+        self._trial_inflight = 0
+        self._trial_passed = 0
+        if to == CIRCUIT_CLOSED:
+            self._consecutive_failures = 0
+        if self._gauge is not None:
+            self._gauge.set(_CIRCUIT_GAUGE[to])
+        if self._sink:
+            self._sink.emit(
+                "circuit_transition", replica=self.replica,
+                src=src, dst=to, **({"reason": reason} if reason else {}),
+            )
+
+    # -- placement side -------------------------------------------------------
+
+    def allows(self) -> bool:
+        """Pure check (no token consumed): could this replica be placed
+        on right now?"""
+        with self._lock:
+            return self.state == CIRCUIT_CLOSED or (
+                self.state == CIRCUIT_HALF_OPEN
+                and self._trial_inflight < self.trial_limit
+            )
+
+    def try_acquire(self) -> bool:
+        """Claim the right to place one request.  Free when closed;
+        consumes a trial token when half-open; refused when open."""
+        with self._lock:
+            if self.state == CIRCUIT_CLOSED:
+                return True
+            if (self.state == CIRCUIT_HALF_OPEN
+                    and self._trial_inflight < self.trial_limit):
+                self._trial_inflight += 1
+                return True
+            return False
+
+    def release(self) -> None:
+        """Return an unused trial token (the submit itself was rejected
+        before any work dispatched — not an outcome either way)."""
+        with self._lock:
+            if self._trial_inflight > 0:
+                self._trial_inflight -= 1
+
+    # -- outcome side ---------------------------------------------------------
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if self.state == CIRCUIT_HALF_OPEN:
+                if self._trial_inflight > 0:
+                    self._trial_inflight -= 1
+                self._trial_passed += 1
+                if self._trial_passed >= self.trial_successes:
+                    self._transition(CIRCUIT_CLOSED, "trial_passed")
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self.state == CIRCUIT_HALF_OPEN:
+                self._transition(CIRCUIT_OPEN, "trial_failed")
+                return
+            self._consecutive_failures += 1
+            if (self.state == CIRCUIT_CLOSED
+                    and self._consecutive_failures >= self.failure_threshold):
+                self._transition(CIRCUIT_OPEN, "failure_threshold")
+
+    # -- supervisor side ------------------------------------------------------
+
+    def force_open(self, reason: str = "quarantined") -> None:
+        with self._lock:
+            self._transition(CIRCUIT_OPEN, reason)
+
+    def half_open(self) -> None:
+        """Admit trial traffic after a restart (supervisor only — an
+        open circuit never self-heals by clock, because the thing that
+        tripped it has not been fixed by time passing)."""
+        with self._lock:
+            self._transition(CIRCUIT_HALF_OPEN, "restart_trial")
+
 
 class Replica:
     """One routable replica: a name, its (started) batcher, optionally
@@ -81,19 +247,44 @@ class Replica:
         self.name = name
         self.batcher = batcher
         self.engine = engine
-        self.state = "active"  # active | draining | drained
+        # active | draining | drained | quarantined | restarting | ejected
+        # (the last three are supervisor-owned, serving/pool.py).
+        self.state = "active"
+        # Assigned by the Router (it owns registry + sink); standalone
+        # Replica objects in tests stay breaker-less and unrestricted.
+        self.breaker: CircuitBreaker | None = None
         self._ewma_s: float | None = None
 
     # -- load signals --------------------------------------------------------
 
     def observe_latency(self, latency_s: float) -> None:
         """Completion-worker hook (MicroBatcher ``on_complete``): feed
-        the per-replica EWMA the cost policy scores with."""
+        the per-replica EWMA the cost policy scores with, and count the
+        success toward the circuit breaker."""
         prev = self._ewma_s
         self._ewma_s = (
             latency_s if prev is None
             else EWMA_ALPHA * latency_s + (1.0 - EWMA_ALPHA) * prev
         )
+        if self.breaker is not None:
+            self.breaker.record_success()
+
+    def observe_failure(self, count: int = 1) -> None:
+        """Worker failure hook (MicroBatcher ``on_failure``): one failed
+        BATCH is one breaker strike regardless of how many requests rode
+        it — the breaker measures replica health, not blast radius."""
+        if self.breaker is not None:
+            self.breaker.record_failure()
+
+    def observe_expiry(self, count: int = 1) -> None:
+        """Queue-expiry hook (MicroBatcher ``on_expire``): a request
+        that timed out before dispatch is no verdict on the replica, but
+        any half-open trial token it held must come back — otherwise the
+        breaker stays half-open forever with its whole trial quota
+        leaked to requests that never ran."""
+        if self.breaker is not None:
+            for _ in range(count):
+                self.breaker.release()
 
     @property
     def ewma_latency_s(self) -> float | None:
@@ -110,7 +301,9 @@ class Replica:
         return self.state == "active"
 
     def reactivate(self, batcher: MicroBatcher) -> None:
-        if self.state != "drained":
+        # "restarting" is the supervisor's restart path (serving/pool.py)
+        # — same fresh-batcher-around-a-warm-engine move as a re-add.
+        if self.state not in ("drained", "restarting"):
             raise RuntimeError(
                 f"replica {self.name!r} is {self.state}, not drained; "
                 "drain it before attaching a new batcher"
@@ -163,6 +356,9 @@ class Router:
         registry=None,
         sink=None,
         metrics=None,
+        failure_threshold: int = 3,
+        trial_limit: int = 1,
+        trial_successes: int = 1,
     ):
         if policy not in POLICIES:
             raise ValueError(f"unknown router policy {policy!r}; have {POLICIES}")
@@ -178,6 +374,15 @@ class Router:
         self._sink = sink
         self._lock = threading.Lock()
         self._rr = 0
+        self._breaker_kwargs = dict(
+            failure_threshold=failure_threshold,
+            trial_limit=trial_limit,
+            trial_successes=trial_successes,
+        )
+        for r in self.replicas:
+            r.breaker = CircuitBreaker(
+                r.name, registry=registry, sink=sink, **self._breaker_kwargs
+            )
         self._drain_hist = (
             registry.histogram(
                 "serving_replica_drain_seconds",
@@ -193,6 +398,17 @@ class Router:
     def active(self) -> list[Replica]:
         with self._lock:
             return [r for r in self.replicas if r.active]
+
+    def routable_count(self) -> int:
+        """Active replicas whose circuit currently admits placement —
+        the readiness signal (``/readyz``, docs/ROBUSTNESS.md): zero
+        means every replica is draining, quarantined, ejected, or
+        circuit-blocked, and new requests can only 503."""
+        with self._lock:
+            return sum(
+                1 for r in self.replicas
+                if r.active and (r.breaker is None or r.breaker.allows())
+            )
 
     def replica(self, name: str) -> Replica:
         for r in self.replicas:
@@ -232,6 +448,7 @@ class Router:
         return {
             r.name: {
                 "state": r.state,
+                "circuit": r.breaker.state if r.breaker is not None else None,
                 "queue_depth": r.batcher.depth(),
                 "inflight": r.batcher.inflight(),
                 "ewma_latency_ms": (
@@ -244,6 +461,28 @@ class Router:
 
     # -- placement ------------------------------------------------------------
 
+    @staticmethod
+    def _trials_first(order: list[Replica]) -> list[Replica]:
+        """Stable-partition half-open replicas with free trial tokens to
+        the front.  A half-open circuit can only close by carrying trial
+        traffic, and policy order alone may never offer it any: the cost
+        policy ranks a restarted replica by its persisted EWMA, so a
+        slow-but-recovered replica sorts last and a light request stream
+        (or the post-chaos recovery probe) lands every request on its
+        healthier peers — leaving it half-open forever.  Preferring it
+        is safe because ``try_acquire`` bounds exposure to
+        ``trial_limit`` concurrent trials; everything past the quota
+        falls through to normal policy order on the same pass."""
+        trials = [
+            r for r in order
+            if r.breaker is not None
+            and r.breaker.state == CIRCUIT_HALF_OPEN
+            and r.breaker.allows()
+        ]
+        if not trials:
+            return order
+        return trials + [r for r in order if r not in trials]
+
     def _order(self, active: list[Replica]) -> list[Replica]:
         """Active replicas, best placement first, under the lock."""
         with self._lock:
@@ -251,7 +490,7 @@ class Router:
             self._rr += 1
         if self.policy == "roundrobin":
             k = rotation % len(active)
-            return active[k:] + active[:k]
+            return self._trials_first(active[k:] + active[:k])
         if self.policy == "least-loaded":
             key = lambda r: r.load()  # noqa: E731 - local sort key
         else:
@@ -277,7 +516,7 @@ class Router:
         # Rotate before the stable sort so exact ties spread over
         # replicas instead of always landing on the first name.
         k = rotation % len(active)
-        return sorted(active[k:] + active[:k], key=key)
+        return self._trials_first(sorted(active[k:] + active[:k], key=key))
 
     def _note(self, replica: Replica, rows: int) -> None:
         if self._registry is not None:
@@ -322,22 +561,35 @@ class Router:
         # ``active`` is the submit-time snapshot (one lock round-trip
         # per request, shared across a sharded request's chunks).  A
         # replica drained after the snapshot rejects at its batcher and
-        # is skipped like any other refusal.
+        # is skipped like any other refusal.  An OPEN circuit blocks
+        # placement outright (docs/ROBUSTNESS.md); a half-open one
+        # admits at most its trial quota, so a freshly restarted replica
+        # proves itself on a trickle, not the full stream.
         order = self._order(active)
-        last = order[-1]
+        saw_error: RejectedError | None = None
         for r in order:
+            if r.breaker is not None and not r.breaker.try_acquire():
+                continue
             try:
                 req = r.batcher.submit(
-                    x, timeout_ms=timeout_ms, dtype=dtype,
-                    count_reject=r is last,
+                    x, timeout_ms=timeout_ms, dtype=dtype, count_reject=False,
                 )
-            except RejectedError:
-                if r is last:
-                    raise
+            except RejectedError as e:
+                # Admission refused before any work dispatched — return
+                # the trial token; this is backpressure, not a failure.
+                if r.breaker is not None:
+                    r.breaker.release()
+                saw_error = e
                 continue
             self._note(r, len(x))
             return req
-        raise RejectedError("no active replicas")  # unreachable: order != []
+        # Exactly one client-visible 503 however many replicas were
+        # tried (the per-attempt skips are not client outcomes).
+        if self.metrics is not None:
+            self.metrics.record_rejected()
+        raise saw_error if saw_error is not None else RejectedError(
+            "no routable replicas (every circuit open or replica draining)"
+        )
 
     def _submit_sharded(self, x, active, cap, timeout_ms, dtype) -> ShardedRequest:
         """Chunks are placed sequentially; a rejection mid-placement
@@ -401,34 +653,84 @@ class Router:
         return duration
 
     def attach(self, name: str, batcher: MicroBatcher) -> Replica:
-        """Re-add a drained replica with a fresh (started) batcher, or
-        register a brand-new one.  Routable as soon as this returns."""
+        """Re-add a drained (or supervisor-restarting) replica with a
+        fresh (started) batcher, or register a brand-new one.  Routable
+        as soon as this returns — subject to the replica's circuit
+        (a restart leaves it half-open until a trial passes)."""
         with self._lock:
             for r in self.replicas:
                 if r.name == name:
                     r.reactivate(batcher)
                     return r
             replica = Replica(name, batcher)
+            replica.breaker = CircuitBreaker(
+                name, registry=self._registry, sink=self._sink,
+                **self._breaker_kwargs,
+            )
             self.replicas.append(replica)
             return replica
+
+    # -- fault tolerance (the supervisor's surface, serving/pool.py) ---------
+
+    def quarantine(self, name: str, reason: str = "sick") -> int:
+        """Forcibly remove a SICK replica from rotation: trip its
+        circuit open, mark it quarantined, and abort its batcher —
+        queued and in-flight requests complete with
+        :class:`~.batcher.ReplicaDeadError` so their handlers retry on
+        survivors.  Unlike :meth:`drain`, this never waits on the
+        replica (a dead one would park the drain forever) and it IS
+        allowed to take the last active replica down — a sick lone
+        replica serving poison is worse than an honest 503.  Returns the
+        flushed-request count."""
+        replica = self.replica(name)
+        with self._lock:
+            if replica.state != "active":
+                raise RuntimeError(
+                    f"replica {name!r} is {replica.state}, not active"
+                )
+            replica.state = "quarantined"
+        if replica.breaker is not None:
+            replica.breaker.force_open(reason)
+        flushed = replica.batcher.abort()
+        if self._sink:
+            self._sink.emit(
+                "replica_quarantine", replica=name, reason=reason,
+                flushed=flushed,
+            )
+        return flushed
+
+    def record_retry(self) -> None:
+        """One handler-side resubmission of a never-executed request
+        (drain race or replica death) — the failure-aware retry tally
+        (``serving_request_retries_total``)."""
+        if self.metrics is not None:
+            self.metrics.record_retry()
+        if self._sink:
+            self._sink.emit("request_retry")
 
     # -- lifecycle -------------------------------------------------------------
 
     def stop(self, drain: bool = True) -> None:
         """Stop every active replica's batcher (draining by default).
-        Replicas already drained are left alone.  Drains run
-        concurrently — each replica's queue/window finishes on its own
-        device, so shutdown wall time is the slowest drain, not the
+        Replicas already drained are left alone; quarantined/ejected
+        ones were aborted by the supervisor, and their ``stop`` is a
+        no-op (the aborted completion worker may be unjoinable).  Drains
+        run concurrently — each replica's queue/window finishes on its
+        own device, so shutdown wall time is the slowest drain, not the
         sum of all of them."""
-        stopping = [r for r in self.replicas if r.state != "drained"]
+        stopping = [
+            r for r in self.replicas if r.state not in ("drained", "ejected")
+        ]
         for r in stopping:
-            r.state = "draining"
+            if r.state != "quarantined":
+                r.state = "draining"
         if not stopping:
             return
 
         def _stop(r: Replica) -> None:
             r.batcher.stop(drain=drain)
-            r.state = "drained"
+            if r.state != "quarantined":
+                r.state = "drained"
 
         with ThreadPoolExecutor(max_workers=len(stopping)) as pool:
             list(pool.map(_stop, stopping))

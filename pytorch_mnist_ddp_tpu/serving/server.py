@@ -18,7 +18,14 @@ Endpoints:
   ``Accept: text/plain`` or ``?format=prom``, the same registry renders
   as Prometheus text exposition (obs/export.py) instead — including the
   ``jax_compiles_total`` counter the engine's RecompileSentinel reports.
-- ``GET /healthz`` — liveness + readiness (warmed buckets).
+- ``GET /healthz`` — liveness (cheap, always 200 once serving) + the
+  warmed/dtype/replica summary.
+- ``GET /readyz`` — readiness, split from liveness (docs/ROBUSTNESS.md):
+  503 when zero replicas are routable (all quarantined/draining/ejected
+  or circuit-open), with per-replica state
+  (``healthy|draining|drained|quarantined|restarting|ejected``) and
+  circuit states in the body — the load-balancer pull signal while the
+  supervisor heals replicas.
 
 Status mapping (the backpressure contract, docs/SERVING.md): 400 malformed
 input, 503 admission rejected (queue full or draining — retry later),
@@ -128,6 +135,39 @@ class ServingHandler(BaseHTTPRequestHandler):
                     name: s["state"] for name, s in stats().items()
                 }
             self._send_json(200, health)
+        elif url.path == "/readyz":
+            # Readiness, split from liveness (docs/ROBUSTNESS.md):
+            # /healthz answers "is the process alive" (always 200 once
+            # serving); /readyz answers "can a request succeed RIGHT
+            # NOW" — 503 when zero replicas are routable (all
+            # quarantined/draining/ejected or circuit-blocked), so a
+            # load balancer pulls the instance without killing it while
+            # the supervisor heals replicas.
+            routable = getattr(srv.batcher, "routable_count", None)
+            payload: dict = {}
+            if routable is not None:
+                n = routable()
+                ready = n > 0
+                payload["routable_replicas"] = n
+                stats = srv.batcher.replica_stats()
+                payload["replicas"] = {
+                    # "active" is router vocabulary; the readiness body
+                    # speaks health: healthy|draining|drained|
+                    # quarantined|restarting|ejected.
+                    name: ("healthy" if s["state"] == "active"
+                           else s["state"])
+                    for name, s in stats.items()
+                }
+                payload["circuits"] = {
+                    name: s["circuit"] for name, s in stats.items()
+                }
+            else:
+                # Single engine: ready once warmed (admission handles
+                # the rest via 503 backpressure).
+                ready = bool(srv.engine.warmed)
+                payload["warmed"] = srv.engine.warmed
+            payload["status"] = "ready" if ready else "unready"
+            self._send_json(200 if ready else 503, payload)
         elif url.path == "/metrics":
             # Content negotiation: JSON stays the default (the PR-2
             # surface, nothing breaks); Prometheus text is selected by
@@ -177,15 +217,21 @@ class ServingHandler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": str(e)})
             return
         try:
-            # Pool mode only: a drain racing this handler can flush an
-            # already-admitted request out of a replica's queue with
-            # RejectedError AFTER submit() returned (batcher stop()'s
-            # post-join flush).  The flushed work never ran, so one
-            # resubmission cannot duplicate it — the router places the
-            # retry on a surviving replica.  A single engine that
-            # flushes is shutting down outright: nothing to retry onto,
-            # and its flush accounting (PR 4) is already client-visible.
-            attempts = 2 if getattr(srv.batcher, "replicas", None) else 1
+            # Pool mode only: a drain race OR a replica death can flush
+            # an already-admitted request back out with RejectedError /
+            # ReplicaDeadError AFTER submit() returned (batcher stop()'s
+            # post-join flush; the supervisor's abort).  The flushed
+            # work never produced a response, so resubmitting cannot
+            # duplicate one — the router places each retry on a
+            # surviving replica, and every retry runs on the REMAINING
+            # deadline budget.  Budget: one attempt per replica (a
+            # failure can cascade across the pool exactly once), so a
+            # request never outlives a pool-wide outage by spinning.  A
+            # single engine that flushes is shutting down outright:
+            # nothing to retry onto, and its flush accounting (PR 4) is
+            # already client-visible.
+            pool_replicas = getattr(srv.batcher, "replicas", None)
+            attempts = 1 + len(pool_replicas) if pool_replicas else 1
             t0 = time.perf_counter()
             for attempt in range(attempts):
                 # The retry runs on the REMAINING budget of the original
@@ -204,6 +250,17 @@ class ServingHandler(BaseHTTPRequestHandler):
                 request = srv.batcher.submit(
                     x, dtype=dtype, timeout_ms=remaining_ms
                 )
+                if attempt:
+                    # The retry tally (serving_request_retries_total +
+                    # request_retry events): transparent resubmissions
+                    # are an operator signal even when no client error
+                    # surfaces (docs/ROBUSTNESS.md).  Counted AFTER the
+                    # submit so a resubmission rejected at admission
+                    # (nothing ever placed, the client sees the 503)
+                    # doesn't inflate the tally.
+                    note_retry = getattr(srv.batcher, "record_retry", None)
+                    if note_retry is not None:
+                        note_retry()
                 try:
                     logits = request.result()
                     break

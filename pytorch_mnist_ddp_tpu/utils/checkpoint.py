@@ -23,9 +23,18 @@ from __future__ import annotations
 
 import io
 import os
+import tempfile
+import zipfile
 from typing import Any, Mapping
 
 import jax
+
+# Read once at import (single-threaded) rather than per write: the
+# os.umask(0)/os.umask(restore) probe is a process-GLOBAL mutation, and a
+# concurrent thread opening a file inside that window would create it
+# world-writable.
+_UMASK = os.umask(0)
+os.umask(_UMASK)
 import numpy as np
 
 # Flax param-name → torch state-dict-name translation for the Net module:
@@ -102,23 +111,79 @@ def save_state_dict(
                 f"torch not importable; saving {path} as a numpy .npz "
                 "archive (readable by load_state_dict, not by torch.load)"
             )
-    tmp = path + ".tmp"
     if format == "torch":
-        save_torch_checkpoint(state, tmp)
-        os.replace(tmp, path)
+        # torch.save needs a real path, so the temp file is created
+        # closed, handed to it, then durably flushed before the replace.
+        def write_torch(tmp: str) -> None:
+            save_torch_checkpoint(state, tmp)
+            with open(tmp, "rb+") as f:
+                os.fsync(f.fileno())
+
+        _atomic_write(path, write_torch)
     elif format == "npz":
         _atomic_npz_write(state, path)
     else:
         raise ValueError(f"unknown checkpoint format {format!r}")
 
 
+def _atomic_write(path: str, write_fn) -> None:
+    """The crash-safety discipline, in ONE place for every checkpoint
+    surface (compile/aot.py's store applies the same sequence): private
+    mkstemp temp (no fixed ``.tmp`` name two writers could interleave
+    into), ``write_fn(tmp)`` fills AND fsyncs it, then the atomic
+    ``os.replace``.  A writer killed at ANY point leaves the previous
+    file intact; a reader only ever sees absent or complete files,
+    never a torn one — the property the mid-write-kill test pins
+    (tests/test_checkpoint.py, docs/ROBUSTNESS.md)."""
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)),
+        prefix=os.path.basename(path) + ".", suffix=".tmp",
+    )
+    os.close(fd)
+    try:
+        write_fn(tmp)
+        # mkstemp creates 0600 and os.replace preserves it; a plain
+        # open() would have honored the umask.  Checkpoints are shared
+        # artifacts (a serving process under another uid loads them), so
+        # restore the conventional mode before publishing.
+        os.chmod(tmp, 0o666 & ~_UMASK)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def _atomic_npz_write(flat: Mapping[str, np.ndarray], path: str) -> None:
     buf = io.BytesIO()
     np.savez(buf, **flat)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(buf.getvalue())
-    os.replace(tmp, path)
+
+    def write_npz(tmp: str) -> None:
+        with open(tmp, "wb") as f:
+            f.write(buf.getvalue())
+            f.flush()
+            # fsync BEFORE replace: the rename must never become durable
+            # ahead of the data it points at (a crash between the two
+            # would otherwise resurrect as a truncated "complete" file).
+            os.fsync(f.fileno())
+
+    _atomic_write(path, write_npz)
+
+
+def _corrupt_checkpoint_error(path: str, cause: BaseException) -> ValueError:
+    """One clear diagnostic for a checkpoint that fails to parse as a
+    zip archive — the truncated/torn-file class a killed writer (or a
+    pre-atomic-write producer) leaves behind.  Without this, the reader
+    surfaces a raw ``zipfile.BadZipFile``/pickle traceback with no hint
+    that the FILE, not the code, is the problem."""
+    return ValueError(
+        f"{path!r} is corrupt or truncated ({cause}); a checkpoint this "
+        "package wrote cannot be torn (mkstemp + fsync + atomic replace), "
+        "so this file was likely produced by a killed non-atomic writer "
+        "or damaged in transit — re-save it from the run that produced it"
+    )
 
 
 def save_train_state(state, path: str, epoch: int = 0) -> None:
@@ -194,6 +259,8 @@ def load_params_tree(path: str) -> dict[str, Any]:
     try:
         with np.load(path) as archive:
             flat = {k: archive[k] for k in archive.files}
+    except zipfile.BadZipFile as e:
+        raise _corrupt_checkpoint_error(path, e) from e
     except (OSError, ValueError) as e:
         raise ValueError(f"{path!r} is not an npz params archive: {e}") from e
     fmt = int(flat.pop("__format__", 1))
@@ -231,6 +298,8 @@ def load_train_state(path: str):
     try:
         with np.load(path) as archive:
             flat = {k: archive[k] for k in archive.files}
+    except zipfile.BadZipFile as e:
+        raise _corrupt_checkpoint_error(path, e) from e
     except (OSError, ValueError) as e:
         raise ValueError(
             f"{path!r} is not a --save-state archive (npz): {e}"
@@ -265,8 +334,6 @@ def load_train_state(path: str):
 def _is_torch_zip(path: str) -> bool:
     """Both formats are zip archives; torch's contains a ``data.pkl``
     member (the pickled state-dict skeleton), npz does not."""
-    import zipfile
-
     try:
         with zipfile.ZipFile(path) as z:
             return any(n.split("/")[-1] == "data.pkl" for n in z.namelist())
@@ -289,6 +356,12 @@ def load_state_dict(path: str) -> dict[str, np.ndarray]:
     try:
         with np.load(path) as archive:
             return {k: archive[k] for k in archive.files}
+    except zipfile.BadZipFile as e:
+        # Looks like a zip (both real formats are) but will not parse as
+        # one: a truncated/torn file, not a format-sniffing miss —
+        # neither unpickler could do better, so say what happened
+        # instead of letting torch's produce a pickle traceback.
+        raise _corrupt_checkpoint_error(path, e) from e
     except ValueError as not_npz:
         # np.load raises ValueError for data that is not an npz archive
         # (e.g. a legacy pre-zip torch.save pickle, which torch.load still
@@ -345,6 +418,8 @@ def load_inference_variables(path: str) -> dict[str, Any]:
             )
             if is_state_archive:
                 flat = {k: archive[k] for k in files}
+    except zipfile.BadZipFile as e:
+        raise _corrupt_checkpoint_error(path, e) from e
     except (OSError, ValueError):
         pass  # not npz at all; load_variables sniffs the torch formats
     if not is_state_archive:

@@ -72,6 +72,72 @@ def test_corrupt_file_surfaces_real_error(tmp_path):
         load_state_dict(path)
 
 
+def test_midwrite_kill_leaves_previous_checkpoint_intact(tmp_path, monkeypatch):
+    """Crash-safe write discipline (ISSUE 8 satellite, docs/ROBUSTNESS.md):
+    a writer killed at the atomic-replace boundary must leave the
+    PREVIOUS checkpoint byte-intact and no temp debris — the reader only
+    ever sees absent or complete files.  The kill is simulated by making
+    os.replace (the last step after mkstemp + write + fsync) die."""
+    import os as os_mod
+
+    import pytest
+
+    from pytorch_mnist_ddp_tpu.utils import checkpoint as ckpt
+
+    params = init_params(jax.random.PRNGKey(4))
+    sd = model_state_dict(params)
+    path = str(tmp_path / "model.npz")
+    save_state_dict(sd, path, format="npz")
+    before = open(path, "rb").read()
+
+    newer = {k: np.asarray(v) + 1.0 for k, v in sd.items()}
+    real_replace = os_mod.replace
+
+    def killed_mid_write(src, dst):
+        raise KeyboardInterrupt("simulated kill between fsync and replace")
+
+    monkeypatch.setattr(ckpt.os, "replace", killed_mid_write)
+    with pytest.raises(KeyboardInterrupt):
+        save_state_dict(newer, path, format="npz")
+    monkeypatch.setattr(ckpt.os, "replace", real_replace)
+
+    assert open(path, "rb").read() == before  # old checkpoint untouched
+    assert not [p for p in tmp_path.iterdir() if p.name.endswith(".tmp")]
+    loaded = load_state_dict(path)  # and it still loads, bit-identical
+    for k in sd:
+        np.testing.assert_array_equal(loaded[k], np.asarray(sd[k]))
+
+
+def test_truncated_checkpoint_raises_clear_diagnostic(tmp_path):
+    """A truncated npz (the torn file a killed NON-atomic writer leaves)
+    must raise one clear 'corrupt or truncated' ValueError from every
+    load surface — not a raw zipfile.BadZipFile or pickle traceback."""
+    import pytest
+    import zipfile
+
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import (
+        load_inference_variables,
+        load_params_tree,
+        load_train_state,
+    )
+
+    params = init_params(jax.random.PRNGKey(5))
+    path = str(tmp_path / "model.npz")
+    save_state_dict(model_state_dict(params), path, format="npz")
+    data = open(path, "rb").read()
+    torn = str(tmp_path / "torn.npz")
+    with open(torn, "wb") as f:
+        f.write(data[: len(data) // 2])  # mid-write kill, non-atomic writer
+
+    for loader in (
+        load_state_dict, load_train_state, load_params_tree,
+        load_inference_variables,
+    ):
+        with pytest.raises(ValueError, match="corrupt or truncated") as exc:
+            loader(torn)
+        assert not isinstance(exc.value, zipfile.BadZipFile)
+
+
 def test_params_from_state_dict_inverts(tmp_path):
     params = init_params(jax.random.PRNGKey(2))
     for prefix in (False, True):

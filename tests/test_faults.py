@@ -1,0 +1,970 @@
+"""Fault-tolerant serving tests (ISSUE 8): the deterministic fault
+injector, first-wins request completion, batcher abort, circuit
+breakers, the replica supervisor's quarantine → backoff restart →
+ejection ladder, the /readyz readiness split, and the chaos acceptance
+pins — kill + hang against a live pool with exactly one terminal
+outcome per request and ZERO new traces through recovery.
+
+Run alone with ``pytest -m faults`` (the CI ``chaos`` job); everything
+here also rides the default smoke tier.  Supervisor/breaker logic runs
+against fake engines (the device-faithful ``_LazyLogits`` fake from the
+PR-4/7 tests) at interactive speed; the zero-new-traces restart pin and
+the AOT fallback injection drive real engines on the virtual-device CPU
+mesh (conftest.py).  Fault injection is fully deterministic: triggers
+are event-counted (never wall clock) and all jitter is seeded.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.models.net import NUM_CLASSES
+from pytorch_mnist_ddp_tpu.obs.registry import Registry
+from pytorch_mnist_ddp_tpu.serving import (
+    CircuitBreaker,
+    EnginePool,
+    FaultError,
+    FaultInjector,
+    MicroBatcher,
+    RejectedError,
+    Replica,
+    ReplicaDeadError,
+    RequestTimeout,
+    ReplicaSupervisor,
+    Router,
+    ServingMetrics,
+)
+from pytorch_mnist_ddp_tpu.serving import faults
+from pytorch_mnist_ddp_tpu.serving.batcher import PendingRequest
+
+pytestmark = pytest.mark.faults
+
+
+# ---------------------------------------------------------------------------
+# Fakes (the test_scaleout.py pattern: launch returns instantly, the
+# "compute" completes delay_s after launch — real accelerator semantics)
+
+
+class _LazyLogits:
+    def __init__(self, rows: np.ndarray, delay_s: float):
+        self._rows = np.array(rows, copy=True)
+        self._t_ready = time.perf_counter() + delay_s
+
+    def __array__(self, dtype=None, copy=None):
+        wait = self._t_ready - time.perf_counter()
+        if wait > 0:
+            time.sleep(wait)
+        out = np.zeros((len(self._rows), NUM_CLASSES), np.float32)
+        out[:, 0] = self._rows.reshape(len(self._rows), -1)[:, 0]
+        return out if dtype is None else out.astype(dtype)
+
+
+class FakeEngine:
+    def __init__(self, buckets=(8,), delay_s: float = 0.0):
+        self.buckets = tuple(buckets)
+        self.metrics = None
+        self.delay_s = delay_s
+        self.dispatches: list[int] = []
+
+    def launch(self, staged, n):
+        self.dispatches.append(n)
+        return _LazyLogits(staged, self.delay_s)
+
+
+class _ListSink:
+    """Minimal obs-sink fake: collects events for assertions."""
+
+    def __init__(self):
+        self.events: list[dict] = []
+        self._lock = threading.Lock()
+
+    def emit(self, event, **fields):
+        with self._lock:
+            self.events.append({"event": event, **fields})
+
+    def of(self, name):
+        with self._lock:
+            return [e for e in self.events if e["event"] == name]
+
+    def __bool__(self):
+        return True
+
+
+def _rows(n, tag=1.0):
+    x = np.zeros((n, 28, 28, 1), np.float32)
+    x[:, 0, 0, 0] = tag
+    return x
+
+
+def _fake_pool(
+    n_replicas,
+    delay_s=0.0,
+    policy="roundrobin",
+    registry=None,
+    sink=None,
+    metrics=None,
+    **batcher_kwargs,
+):
+    """N started fake replicas behind a router; returns (router, engines,
+    metrics).  Hooks wired exactly as EnginePool.start wires them."""
+    metrics = metrics if metrics is not None else ServingMetrics()
+    registry = registry if registry is not None else metrics.registry
+    kwargs = dict(linger_ms=0.0, adaptive_linger=False, timeout_ms=5000.0)
+    kwargs.update(batcher_kwargs)
+    replicas, engines = [], []
+    for i in range(n_replicas):
+        engine = FakeEngine(buckets=(8,), delay_s=delay_s)
+        batcher = MicroBatcher(
+            engine, metrics=metrics, replica=f"r{i}", sink=sink, **kwargs
+        )
+        replica = Replica(f"r{i}", batcher, engine=engine)
+        batcher.on_complete = replica.observe_latency
+        batcher.on_failure = replica.observe_failure
+        batcher.on_expire = replica.observe_expiry
+        batcher.start()
+        replicas.append(replica)
+        engines.append(engine)
+    router = Router(
+        replicas, policy=policy, registry=registry, sink=sink, metrics=metrics
+    )
+    return router, engines, metrics
+
+
+def _supervise(router, metrics, sink=None, **kwargs):
+    """A fast-cadence supervisor over fake replicas, wired like
+    EnginePool._restart_batcher (fresh batcher around the same engine)."""
+    defaults = dict(
+        interval_s=0.01, stall_timeout_s=0.25, backoff_base_s=0.03,
+        backoff_max_s=0.2, backoff_jitter=0.0, restart_budget=5, seed=0,
+    )
+    defaults.update(kwargs)
+
+    def make_batcher(replica):
+        batcher = MicroBatcher(
+            replica.engine, metrics=metrics, replica=replica.name,
+            linger_ms=0.0, adaptive_linger=False, timeout_ms=5000.0,
+        )
+        batcher.on_complete = replica.observe_latency
+        batcher.on_failure = replica.observe_failure
+        batcher.on_expire = replica.observe_expiry
+        batcher.start()
+        return batcher
+
+    return ReplicaSupervisor(
+        router, make_batcher, registry=metrics.registry, sink=sink, **defaults
+    )
+
+
+def _submit_with_retry(router, x, attempts=None):
+    """The HTTP handler's failure-aware retry, distilled: resubmit a
+    flushed/dead request on survivors, one attempt per replica."""
+    attempts = attempts if attempts is not None else 1 + len(router.replicas)
+    last = None
+    for _ in range(attempts):
+        try:
+            return router.submit(x).result()
+        except RejectedError as e:
+            last = e
+    raise last
+
+
+def _wait_until(predicate, timeout_s=5.0, interval_s=0.005):
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# The injector itself: grammar, trigger semantics, determinism
+
+
+def test_fault_spec_grammar():
+    spec = faults.FaultSpec.parse("fail:launch:r1:count=6,after=2")
+    assert (spec.op, spec.site, spec.replica) == ("fail", "launch", "r1")
+    assert spec.count == 6 and spec.after == 2
+    hang = faults.FaultSpec.parse("hang:complete:r0:for=1.5")
+    assert hang.op == "hang" and hang.hang_s == 1.5
+    anyrep = faults.FaultSpec.parse("fail:aot_load")
+    assert anyrep.replica is None and anyrep.count == 1
+    inf = faults.FaultSpec.parse("fail:launch:*:count=inf")
+    assert inf.replica is None and inf.count == float("inf")
+    for bad in ("explode:launch", "fail:nowhere", "fail", "fail:launch:r0:zap=1",
+                # aot_load is pool-shared (its fault point fires
+                # unlabeled) — a replica-scoped clause could never
+                # trigger, so the grammar refuses to arm one.
+                "fail:aot_load:r1"):
+        with pytest.raises(ValueError):
+            faults.FaultSpec.parse(bad)
+
+
+def test_fault_point_is_dormant_without_an_injector():
+    faults.uninstall()  # belt and suspenders: no leftover injector
+    faults.fault_point("launch", "r0")  # no injector -> no-op, no error
+
+
+def test_injector_count_after_and_replica_matching():
+    with faults.injected("fail:launch:r0:count=2,after=1") as inj:
+        faults.fault_point("launch", "r1")  # other replica: never matches
+        faults.fault_point("launch", "r0")  # after=1 skips the first match
+        with pytest.raises(FaultError):
+            faults.fault_point("launch", "r0")
+        with pytest.raises(FaultError):
+            faults.fault_point("launch", "r0")
+        faults.fault_point("launch", "r0")  # count exhausted: healed
+        assert inj.fired_counts() == {"fail:launch:r0:count=2,after=1": 2}
+    faults.fault_point("launch", "r0")  # uninstalled: dormant again
+
+
+def test_injector_hang_blocks_then_releases():
+    with faults.injected("hang:complete:r0:for=0.15"):
+        t0 = time.perf_counter()
+        faults.fault_point("complete", "r0")
+        assert time.perf_counter() - t0 >= 0.14
+        t0 = time.perf_counter()
+        faults.fault_point("complete", "r0")  # count=1 default: healed
+        assert time.perf_counter() - t0 < 0.1
+
+
+def test_injector_probabilistic_fires_are_seeded():
+    def draw(seed):
+        injector = FaultInjector("fail:launch:r0:count=inf,p=0.5", seed=seed)
+        hits = []
+        for i in range(32):
+            try:
+                injector.fire("launch", "r0")
+                hits.append(0)
+            except FaultError:
+                hits.append(1)
+        return hits
+
+    assert draw(7) == draw(7)  # same seed, same fault sequence
+    assert draw(7) != draw(8)  # and the seed actually matters
+    assert 0 < sum(draw(7)) < 32
+
+
+# ---------------------------------------------------------------------------
+# Exactly-one-outcome plumbing: first-wins completion + batcher abort
+
+
+def test_pending_request_completion_is_first_wins():
+    req = PendingRequest(_rows(2), deadline=time.perf_counter() + 5.0)
+    req.set_error(ReplicaDeadError("aborted"))
+    # The stuck read finishing later must NOT produce a second outcome.
+    req.set_result(np.ones((2, NUM_CLASSES), np.float32))
+    with pytest.raises(ReplicaDeadError):
+        req.result()
+    req2 = PendingRequest(_rows(2), deadline=time.perf_counter() + 5.0)
+    req2.set_result(np.ones((2, NUM_CLASSES), np.float32))
+    req2.set_error(RuntimeError("late failure"))
+    assert req2.result().shape == (2, NUM_CLASSES)
+
+
+def test_abort_flushes_queued_and_inflight_with_retriable_error():
+    engine = FakeEngine(buckets=(8,), delay_s=0.4)
+    m = ServingMetrics()
+    batcher = MicroBatcher(
+        engine, metrics=m, replica="r0", linger_ms=0.0,
+        adaptive_linger=False, max_inflight=1, timeout_ms=5000.0,
+    ).start()
+    reqs = [batcher.submit(_rows(8, tag=i)) for i in range(4)]
+    # One batch in flight (delay 0.4s), the rest queued or stalled.
+    assert _wait_until(lambda: batcher.inflight() == 1)
+    flushed = batcher.abort()
+    assert flushed >= 1
+    for req in reqs:  # every request: exactly one terminal outcome, now
+        with pytest.raises(ReplicaDeadError):
+            req.result(grace_s=0.1)
+    # Post-abort submits reject immediately (the router skips them).
+    with pytest.raises(RejectedError):
+        batcher.submit(_rows(2))
+    # stop() after abort is a no-op, not a hang on the dead completer.
+    batcher.stop(drain=True)
+
+
+def test_launch_failure_is_retriable_in_pool_mode_only():
+    class Dying(FakeEngine):
+        def launch(self, staged, n):
+            raise RuntimeError("device fell over")
+
+    pooled = MicroBatcher(
+        Dying(), metrics=ServingMetrics(), replica="r0",
+        linger_ms=0.0, adaptive_linger=False,
+    ).start()
+    req = pooled.submit(_rows(2))
+    with pytest.raises(ReplicaDeadError):  # retriable on survivors
+        req.result()
+    assert pooled.consecutive_launch_failures == 1
+    pooled.stop()
+    solo = MicroBatcher(
+        Dying(), metrics=ServingMetrics(),
+        linger_ms=0.0, adaptive_linger=False,
+    ).start()
+    req = solo.submit(_rows(2))
+    with pytest.raises(RuntimeError, match="device fell over"):
+        req.result()  # single engine: the raw error IS the outcome
+    solo.stop()
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker: states, gauge, transitions
+
+
+def test_circuit_breaker_trips_half_opens_and_closes():
+    registry = Registry()
+    sink = _ListSink()
+    br = CircuitBreaker(
+        "r0", failure_threshold=3, registry=registry, sink=sink
+    )
+    gauge = registry.gauge("serving_circuit_state", replica="r0")
+    assert br.state == "closed" and gauge.value == 0.0
+    br.record_failure()
+    br.record_failure()
+    br.record_success()  # a success resets the streak
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"
+    br.record_failure()  # third CONSECUTIVE failure trips it
+    assert br.state == "open" and gauge.value == 2.0
+    assert not br.allows() and not br.try_acquire()
+    br.half_open()
+    assert br.state == "half-open" and gauge.value == 1.0
+    assert br.try_acquire()          # the single trial token
+    assert not br.try_acquire()      # concurrent trials are bounded
+    br.record_success()              # trial passed
+    assert br.state == "closed" and gauge.value == 0.0
+    transitions = [(e["src"], e["dst"]) for e in sink.of("circuit_transition")]
+    assert transitions == [
+        ("closed", "open"), ("open", "half-open"), ("half-open", "closed"),
+    ]
+
+
+def test_circuit_breaker_failed_trial_reopens():
+    br = CircuitBreaker("r0", failure_threshold=3)
+    br.force_open("quarantined")
+    br.half_open()
+    assert br.try_acquire()
+    br.record_failure()
+    assert br.state == "open"
+    # An unused trial token returned on a pre-dispatch rejection does
+    # not count as an outcome either way.
+    br.half_open()
+    assert br.try_acquire()
+    br.release()
+    assert br.state == "half-open" and br.try_acquire()
+
+
+def test_open_circuit_blocks_placement_and_half_open_readmits():
+    registry = Registry()
+    router, engines, m = _fake_pool(2, policy="roundrobin", registry=registry)
+    r0 = router.replica("r0")
+    r0.breaker.force_open("test")
+    assert router.routable_count() == 1
+    for i in range(6):
+        assert router.submit(_rows(8, tag=i)).result()[0, 0] == pytest.approx(i)
+    # PROVABLY blocked: zero dispatches and zero router decisions landed
+    # on the open replica while every request still answered.
+    assert len(engines[0].dispatches) == 0
+    assert len(engines[1].dispatches) == 6
+    assert registry.counter(
+        "serving_router_decisions_total", policy="roundrobin", replica="r0"
+    ).value == 0
+    assert m.rejected == 0
+    # Half-open: the next placement that reaches r0 is a trial; its
+    # success closes the circuit and full placement resumes.
+    r0.breaker.half_open()
+    assert router.routable_count() == 2
+    outs = [router.submit(_rows(8, tag=10 + i)).result() for i in range(2)]
+    assert all(o.shape == (8, NUM_CLASSES) for o in outs)
+    assert _wait_until(lambda: r0.breaker.state == "closed")
+    assert len(engines[0].dispatches) == 1  # exactly the trial readmitted it
+    router.stop()
+
+
+def test_half_open_replica_gets_trial_even_when_cost_ranks_it_last():
+    # The chaos-recovery failure mode: under the cost policy a restarted
+    # replica keeps its pre-quarantine EWMA, so a slow-but-recovered
+    # replica sorts behind every healthy peer and a serial request
+    # stream (the post-chaos recovery probe) never offers it the trial
+    # its half-open circuit needs to close.  Placement must prefer
+    # half-open replicas up to their trial quota regardless of cost
+    # order.
+    router, engines, m = _fake_pool(2, policy="cost")
+    r0, r1 = router.replica("r0"), router.replica("r1")
+    r0.observe_latency(0.5)    # r0 = the expensive replica, sorts last
+    r1.observe_latency(0.001)
+    r0.breaker.force_open("test")
+    r0.breaker.half_open()
+    assert router.submit(_rows(8, tag=3.0)).result()[0, 0] == pytest.approx(3.0)
+    assert len(engines[0].dispatches) == 1  # the trial landed on r0
+    assert _wait_until(lambda: r0.breaker.state == "closed")
+    router.stop()
+
+
+def test_expired_trial_request_returns_its_token():
+    # A trial request that times out in the admission queue fires
+    # neither the success nor the failure hook; without the expiry hook
+    # returning its token the breaker would sit half-open forever with
+    # its whole trial quota leaked (trial_limit=1 by default).
+    router, engines, _ = _fake_pool(1)
+    r0 = router.replica("r0")
+    r0.breaker.force_open("test")
+    r0.breaker.half_open()
+    req = router.submit(_rows(4), timeout_ms=0.0)  # holds the only token
+    with pytest.raises(RequestTimeout):
+        req.result()
+    assert _wait_until(lambda: r0.breaker.allows())
+    assert r0.breaker.state == "half-open"  # expiry is no verdict either way
+    router.stop()
+
+
+def test_all_circuits_open_is_exactly_one_503():
+    router, _, m = _fake_pool(2)
+    for r in router.replicas:
+        r.breaker.force_open("test")
+    with pytest.raises(RejectedError):
+        router.submit(_rows(4))
+    assert m.rejected == 1
+    assert router.routable_count() == 0
+    router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: quarantine -> backoff restart -> half-open trial -> heal
+
+
+def test_supervisor_restarts_a_killed_replica():
+    sink = _ListSink()
+    router, engines, m = _fake_pool(2, sink=sink)
+    sup = _supervise(router, m, sink=sink).start()
+    try:
+        with faults.injected("fail:launch:r0:count=3"):
+            outs = [
+                _submit_with_retry(router, _rows(8, tag=i)) for i in range(12)
+            ]
+        for i, out in enumerate(outs):  # no losses, no duplicates, no tears
+            assert out[0, 0] == pytest.approx(float(i))
+        r0 = router.replica("r0")
+        # A half-open circuit only closes on trial TRAFFIC (it never
+        # self-heals by clock) — keep probing while the supervisor's
+        # backoff elapses and the trial lands.
+        deadline = time.perf_counter() + 5.0
+        while time.perf_counter() < deadline and not (
+            r0.state == "active" and r0.breaker.state == "closed"
+        ):
+            _submit_with_retry(router, _rows(8, tag=50.0))
+            time.sleep(0.01)
+        assert r0.state == "active" and r0.breaker.state == "closed", (
+            f"r0 never healed: state={r0.state} circuit={r0.breaker.state}"
+        )
+        # Traffic flows over BOTH replicas again after the restart.
+        before = len(engines[0].dispatches)
+        for i in range(4):
+            _submit_with_retry(router, _rows(8, tag=100 + i))
+        assert _wait_until(lambda: len(engines[0].dispatches) > before)
+    finally:
+        sup.stop()
+        router.stop()
+    restarts = m.registry.counter(
+        "serving_replica_restarts_total", replica="r0"
+    ).value
+    assert restarts >= 1
+    assert [e["replica"] for e in sink.of("replica_quarantine")] == ["r0"] * len(
+        sink.of("replica_quarantine")
+    )
+    restarted = [e for e in sink.of("replica_restart")
+                 if e.get("outcome") == "restarted"]
+    assert restarted and all(e["recovery_s"] >= 0.0 for e in restarted)
+    assert m.failed > 0  # the failures were recorded, just not client-visible
+
+
+def test_supervisor_quarantines_a_hung_completion_worker():
+    sink = _ListSink()
+    router, engines, m = _fake_pool(2, sink=sink)
+    sup = _supervise(router, m, sink=sink, stall_timeout_s=0.15).start()
+    try:
+        with faults.injected("hang:complete:r0:for=2.0"):
+            # The hang holds r0's completion read far past the stall
+            # timeout; the supervisor must abort it and the request must
+            # still answer — on a survivor, within its deadline.
+            t0 = time.perf_counter()
+            out = _submit_with_retry(router, _rows(8, tag=7.0))
+            elapsed = time.perf_counter() - t0
+        assert out[0, 0] == pytest.approx(7.0)
+        assert elapsed < 2.0  # did NOT wait out the hang
+        reasons = {e["reason"] for e in sink.of("replica_quarantine")}
+        assert "completion_stall" in reasons
+        r0 = router.replica("r0")
+        assert _wait_until(lambda: r0.state == "active")
+    finally:
+        sup.stop()
+        router.stop()
+    assert m.registry.counter(
+        "serving_replica_restarts_total", replica="r0"
+    ).value >= 1
+
+
+def test_supervisor_ejects_after_restart_budget():
+    sink = _ListSink()
+    router, engines, m = _fake_pool(2, sink=sink)
+    sup = _supervise(router, m, sink=sink, restart_budget=1).start()
+    try:
+        with faults.injected("fail:launch:r0:count=inf"):
+            # Keep offering traffic so every half-open trial actually
+            # fires (and fails) until the budget escalates to ejection.
+            r0 = router.replica("r0")
+
+            def drive_until_ejected():
+                for i in range(200):
+                    if r0.state == "ejected":
+                        return True
+                    _submit_with_retry(router, _rows(8, tag=i))
+                    time.sleep(0.01)
+                return r0.state == "ejected"
+
+            assert drive_until_ejected(), f"r0 state={r0.state}"
+            # An ejected replica is permanently out: no further restarts,
+            # the pool serves on the survivor, readiness reflects one
+            # routable replica.
+            ejections = sink.of("replica_eject")
+            assert [e["replica"] for e in ejections] == ["r0"]
+            assert router.routable_count() == 1
+            out = _submit_with_retry(router, _rows(8, tag=5.0))
+            assert out[0, 0] == pytest.approx(5.0)
+    finally:
+        sup.stop()
+        router.stop()
+    assert m.registry.counter(
+        "serving_replica_restarts_total", replica="r0"
+    ).value == 1  # the budgeted restart, then ejection — never a second
+
+
+def test_restart_failure_path_honors_the_budget():
+    # The budget check in _quarantine is only reachable from state
+    # "active" (a restart that SUCCEEDED and re-sickened); a
+    # make_batcher that always raises must still hit the ejection
+    # ladder instead of cycling quarantined -> restarting forever.
+    sink = _ListSink()
+    router, engines, m = _fake_pool(2, sink=sink)
+    sup = _supervise(router, m, sink=sink, restart_budget=2)
+
+    def broken_batcher(replica):
+        raise RuntimeError("engine is gone")
+
+    sup.make_batcher = broken_batcher
+    r0 = router.replica("r0")
+    r0.breaker.force_open("test")  # sick signal for the next tick
+    now = time.perf_counter()
+    sup.tick(now)                  # quarantine, restart scheduled
+    for step in range(1, 8):       # walk past every backoff deadline
+        sup.tick(now + step * 10.0)
+        if r0.state == "ejected":
+            break
+    assert r0.state == "ejected", f"r0 state={r0.state}"
+    failed = [e for e in sink.of("replica_restart")
+              if e.get("outcome") == "restart_failed"]
+    assert len(failed) == 2        # budget consumed by failed rebuilds
+    assert [e["replica"] for e in sink.of("replica_eject")] == ["r0"]
+    assert sink.of("replica_eject")[0]["reason"] == "restart_failed"
+    router.stop()
+    sup.stop()
+
+
+def test_eject_flushes_inflight_to_survivors():
+    # Ejection must give waiters the same teardown quarantine does: a
+    # request wedged on the ejected replica completes with the
+    # retriable ReplicaDeadError and answers on a survivor — it must
+    # NOT idle out its full client deadline on a replica nobody will
+    # ever restart.
+    sink = _ListSink()
+    router, engines, m = _fake_pool(2, sink=sink)
+    sup = _supervise(router, m, sink=sink, restart_budget=0,
+                     stall_timeout_s=0.15).start()
+    try:
+        with faults.injected("hang:complete:r0:for=30"):
+            t0 = time.perf_counter()
+            out = _submit_with_retry(router, _rows(8, tag=9.0))
+            elapsed = time.perf_counter() - t0
+            # Budget 0 means the stall escalates straight to ejection,
+            # no restart attempt.
+            assert _wait_until(
+                lambda: router.replica("r0").state == "ejected"
+            )
+        assert out[0, 0] == pytest.approx(9.0)
+        assert elapsed < 4.0  # answered on r1, not after the 5s deadline
+        ejections = sink.of("replica_eject")
+        assert [e["replica"] for e in ejections] == ["r0"]
+        assert sink.of("replica_restart") == []  # budget 0: never restarted
+        assert router.routable_count() == 1
+    finally:
+        sup.stop()
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# /readyz: readiness split from liveness
+
+
+class _EngineFacade:
+    dtypes = ("f32",)
+    buckets = (8,)
+    warmed = True
+    use_bn = False
+
+    def compile_count(self):
+        return 0
+
+    def variant_verified(self, dtype):
+        return True
+
+
+def _http_server(router, metrics):
+    from pytorch_mnist_ddp_tpu.serving.server import make_server
+
+    server = make_server(_EngineFacade(), metrics, port=0, batcher=router)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def test_readyz_reports_503_when_no_replica_is_routable():
+    router, _, m = _fake_pool(2)
+    server, base = _http_server(router, m)
+    try:
+        status, body = _get(f"{base}/readyz")
+        assert status == 200 and body["status"] == "ready"
+        assert body["replicas"] == {"r0": "healthy", "r1": "healthy"}
+        # Liveness stays cheap and green while readiness degrades.
+        router.quarantine("r0", reason="test")
+        router.quarantine("r1", reason="test")
+        status, body = _get(f"{base}/readyz")
+        assert status == 503 and body["status"] == "unready"
+        assert body["routable_replicas"] == 0
+        assert body["replicas"] == {
+            "r0": "quarantined", "r1": "quarantined"
+        }
+        assert body["circuits"] == {"r0": "open", "r1": "open"}
+        status, _ = _get(f"{base}/healthz")
+        assert status == 200  # liveness never follows readiness down
+        # An active replica whose circuit is still open is NOT routable;
+        # the half-open trial re-admission flips readiness back.
+        r0 = router.replica("r0")
+        with router._lock:
+            r0.state = "restarting"
+        fresh = MicroBatcher(
+            FakeEngine(), metrics=m, replica="r0",
+            linger_ms=0.0, adaptive_linger=False,
+        ).start()
+        router.attach("r0", fresh)
+        status, body = _get(f"{base}/readyz")
+        assert status == 503  # active but circuit-open: still unready
+        r0.breaker.half_open()
+        status, body = _get(f"{base}/readyz")
+        assert status == 200 and body["replicas"]["r0"] == "healthy"
+        assert body["circuits"]["r0"] == "half-open"
+    finally:
+        server.shutdown()
+        server.server_close()
+        router.stop()
+
+
+def test_readyz_single_engine_ready_when_warmed():
+    from pytorch_mnist_ddp_tpu.serving.server import make_server
+
+    m = ServingMetrics()
+    batcher = MicroBatcher(
+        FakeEngine(), metrics=m, linger_ms=0.0, adaptive_linger=False
+    ).start()
+    server = make_server(_EngineFacade(), m, port=0, batcher=batcher)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        status, body = _get(f"{base}/readyz")
+        assert status == 200 and body["status"] == "ready"
+    finally:
+        server.shutdown()
+        server.server_close()
+        batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# The chaos acceptance pin: kill + hang against a live 4-replica pool,
+# every submitted request exactly one terminal outcome, circuit provably
+# cycles, retries counted — all deterministic-trigger, seeded.
+
+
+def test_chaos_kill_plus_hang_every_request_one_outcome():
+    sink = _ListSink()
+    router, engines, m = _fake_pool(4, delay_s=0.002, sink=sink)
+    sup = _supervise(router, m, sink=sink, stall_timeout_s=0.15).start()
+    server, base = _http_server(router, m)
+    n_requests = 60
+    statuses: dict[int, list[int]] = {i: [] for i in range(n_requests)}
+    lock = threading.Lock()
+
+    def post_one(i):
+        payload = json.dumps(
+            {"instances": [[float(i)] * 784 for _ in range(2)],
+             "normalized": True}
+        ).encode()
+        req = urllib.request.Request(
+            f"{base}/predict", data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        with lock:
+            statuses[i].append(status)
+
+    try:
+        with faults.injected(
+            "fail:launch:r1:count=4;hang:complete:r0:count=1,for=2.0",
+            seed=0,
+        ):
+            threads = []
+            for i in range(n_requests):
+                t = threading.Thread(target=post_one, args=(i,))
+                t.start()
+                threads.append(t)
+                time.sleep(0.004)  # spread arrivals across the fault window
+            for t in threads:
+                t.join(timeout=30)
+        # Exactly one terminal outcome per submitted request: no losses
+        # (every thread recorded a status), no duplicates (exactly one).
+        assert all(len(v) == 1 for v in statuses.values())
+        flat = [v[0] for v in statuses.values()]
+        # The kill and the hang are absorbed by survivors + the
+        # failure-aware retry: no 5xx reaches a client, and 503s (all
+        # attempts flushed in one cascade) stay rare.
+        assert set(flat) <= {200, 503}, sorted(set(flat))
+        assert flat.count(503) <= 3
+        assert flat.count(200) >= n_requests - 3
+        # Both faulted replicas were quarantined AND restarted.
+        killed = router.replica("r1")
+        hung = router.replica("r0")
+        assert _wait_until(lambda: killed.state == "active")
+        assert _wait_until(lambda: hung.state == "active")
+        quarantined = {e["replica"] for e in sink.of("replica_quarantine")}
+        assert {"r0", "r1"} <= quarantined
+        for name in ("r0", "r1"):
+            assert m.registry.counter(
+                "serving_replica_restarts_total", replica=name
+            ).value >= 1
+        # The circuit cycle is on the record: open then half-open (and
+        # the gauge agrees with the final state).
+        r1_transitions = [
+            (e["src"], e["dst"]) for e in sink.of("circuit_transition")
+            if e["replica"] == "r1"
+        ]
+        assert ("closed", "open") in r1_transitions or (
+            "half-open", "open") in r1_transitions
+        assert ("open", "half-open") in r1_transitions
+        # Transparent retries happened and were counted.
+        assert m.retried >= 1
+        assert len(sink.of("request_retry")) == m.retried
+    finally:
+        server.shutdown()
+        server.server_close()
+        sup.stop()
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Real pool: a supervised restart is WARM — zero new traces (acceptance)
+
+
+def test_real_pool_restart_adds_zero_traces(devices):
+    m = ServingMetrics()
+    sink = _ListSink()
+    pool = EnginePool.from_seed(replicas=2, buckets=(8,), metrics=m)
+    pool.warmup()
+    assert pool.compile_count() == 2  # one trace per bucket per replica
+    router = pool.start(
+        router_policy="roundrobin", sink=sink,
+        supervisor_kwargs=dict(
+            interval_s=0.02, stall_timeout_s=2.0, backoff_base_s=0.05,
+            backoff_max_s=0.5, backoff_jitter=0.0, restart_budget=3, seed=0,
+        ),
+        linger_ms=0.0, adaptive_linger=False, timeout_ms=10_000.0,
+    )
+    try:
+        with faults.injected("fail:launch:r0:count=3"):
+            outs = [
+                _submit_with_retry(router, _rows(4, tag=1.0))
+                for _ in range(10)
+            ]
+        assert all(o.shape == (4, NUM_CLASSES) for o in outs)
+        r0 = router.replica("r0")
+        # Probe while the backoff elapses: the half-open circuit needs
+        # trial traffic to close (it never self-heals by clock).
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline and not (
+            r0.state == "active" and r0.breaker.state == "closed"
+        ):
+            _submit_with_retry(router, _rows(4, tag=2.0))
+            time.sleep(0.02)
+        assert r0.state == "active" and r0.breaker.state == "closed", (
+            f"r0 never healed: {r0.state}/{r0.breaker.state}"
+        )
+        # Post-restart traffic lands on r0 again...
+        for i in range(6):
+            _submit_with_retry(router, _rows(4, tag=2.0))
+    finally:
+        pool.stop()
+    # ...and the WHOLE kill -> quarantine -> restart -> trial -> heal
+    # cycle compiled NOTHING: the engine never left memory, so the
+    # sentinel budget is exactly where warmup left it.
+    assert pool.compile_count() == 2
+    assert m.registry.counter(
+        "serving_replica_restarts_total", replica="r0"
+    ).value >= 1
+    assert m.failed > 0 and m.timed_out == 0
+
+
+# ---------------------------------------------------------------------------
+# Fault points beyond the batcher: warmup and AOT load
+
+
+def test_warmup_fault_surfaces_instead_of_serving_unwarmed(devices):
+    pool = EnginePool.from_seed(replicas=2, buckets=(8,))
+    with faults.injected("fail:warmup:r1"):
+        with pytest.raises(FaultError):
+            pool.warmup()
+
+
+def test_aot_load_fault_falls_back_to_fresh_compile(devices, tmp_path):
+    from pytorch_mnist_ddp_tpu.compile import ExecutableStore
+
+    @jax.jit
+    def prog(x):
+        return jnp.tanh(x) + 1.0
+
+    x = jnp.zeros((4,), jnp.float32)
+    registry = Registry()
+    store = ExecutableStore(str(tmp_path), registry=registry, max_entries=8)
+    _, outcome = store.load_or_compile(
+        "prog[4]", {"program": "prog", "n": 4},
+        lambda: prog.lower(x).compile(),
+    )
+    assert outcome == "miss"
+    # An injected deserialization failure is indistinguishable from a
+    # corrupt entry: the store must fall back to a fresh compile and
+    # rewrite the entry (the self-healing contract, compile/aot.py).
+    with faults.injected("fail:aot_load:count=1"):
+        compiled, outcome = store.load_or_compile(
+            "prog[4]", {"program": "prog", "n": 4},
+            lambda: prog.lower(x).compile(),
+        )
+    assert outcome == "fallback"
+    np.testing.assert_array_equal(
+        np.asarray(compiled(x)), np.ones((4,), np.float32)
+    )
+    # Healed: the rewritten entry hits cleanly on the next load.
+    _, outcome = store.load_or_compile(
+        "prog[4]", {"program": "prog", "n": 4},
+        lambda: pytest.fail("healed store must not compile"),
+    )
+    assert outcome == "hit"
+
+
+# ---------------------------------------------------------------------------
+# perf_report --telemetry: the resilience section
+
+
+def _load_tool(name):
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(root, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_perf_report_resilience_section_from_synthetic_events(tmp_path):
+    events = [
+        {"event": "replica_quarantine", "replica": "r1",
+         "reason": "circuit_open", "flushed": 3},
+        {"event": "replica_restart", "replica": "r1", "attempt": 1,
+         "backoff_s": 0.2, "recovery_s": 0.35, "outcome": "restarted"},
+        {"event": "replica_quarantine", "replica": "r0",
+         "reason": "completion_stall", "flushed": 1},
+        {"event": "replica_restart", "replica": "r0", "attempt": 1,
+         "backoff_s": 0.2, "recovery_s": 0.25, "outcome": "restarted"},
+        {"event": "circuit_transition", "replica": "r1",
+         "src": "closed", "dst": "open", "reason": "failure_threshold"},
+        {"event": "circuit_transition", "replica": "r1",
+         "src": "open", "dst": "half-open", "reason": "restart_trial"},
+        {"event": "circuit_transition", "replica": "r1",
+         "src": "half-open", "dst": "closed", "reason": "trial_passed"},
+        {"event": "replica_eject", "replica": "r2",
+         "reason": "launch_failures", "attempts": 3},
+        {"event": "request_retry"},
+        {"event": "request_retry"},
+    ]
+    with open(tmp_path / "events-rank0.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    perf_report = _load_tool("perf_report")
+    summary = perf_report.summarize_telemetry(str(tmp_path))
+    assert "resilience:" in summary
+    assert "2 quarantine(s), 2 restart(s), 1 ejection(s), 2 retry(ies)" in summary
+    assert "restarts by replica: r0 x1, r1 x1" in summary
+    assert "mean recovery 0.300 s" in summary
+    assert "quarantines by reason: circuit_open x1, completion_stall x1" in summary
+    assert "circuit transitions [r1]: ->open x1, ->half-open x1, ->closed x1" \
+        in summary
+    assert "ejected: r2 (launch_failures, after 3 restart(s))" in summary
+
+
+# ---------------------------------------------------------------------------
+# Loadgen chaos mode (--chaos): the operator-facing harness
+
+
+def test_loadgen_chaos_smoke(devices, tmp_path):
+    loadgen = _load_tool("serve_loadgen")
+    report_path = str(tmp_path / "BENCH_serving_chaos.json")
+    prom_path = str(tmp_path / "chaos.prom")
+    rc = loadgen.main([
+        "--replicas", "2", "--requests", "24", "--max-request", "4",
+        "--buckets", "8", "--concurrency", "4", "--timeout-ms", "10000",
+        "--chaos", "fail:launch:r1:count=3", "--chaos-seed", "0",
+        "--report", report_path, "--prom-dump", prom_path,
+    ])
+    assert rc == 0
+    with open(report_path) as f:
+        report = json.load(f)
+    chaos = report["chaos"]
+    assert chaos["spec"] == "fail:launch:r1:count=3"
+    assert chaos["lost"] == 0
+    assert chaos["restarts"]["r1"] >= 1
+    assert chaos["fired"]["fail:launch:r1:count=3"] == 3
+    assert chaos["replica_states"]["r1"] == "active"  # healed by run end
+    assert report["additional_compiles"] == 0  # recovery compiled nothing
+    with open(prom_path) as f:
+        prom = f.read()
+    assert 'serving_replica_restarts_total{replica="r1"}' in prom
+    assert 'serving_circuit_state{replica="r1"} 0' in prom  # closed again

@@ -1244,6 +1244,110 @@ def test_jl012_waiver():
 
 
 # ---------------------------------------------------------------------------
+# JL013 — swallowed dispatch errors in an unbounded retry loop
+
+
+JL013_BAD_LAUNCH = """\
+def serve_forever(engine, batches):
+    while True:
+        batch = batches.get()
+        try:
+            engine.launch(batch, len(batch))
+        except Exception:
+            continue
+"""
+
+JL013_BAD_BARE_EXCEPT_JIT = """\
+import jax
+
+step = jax.jit(lambda x: x * 2)
+
+def drive(stream):
+    for item in stream:
+        try:
+            step(item)
+        except:
+            pass
+"""
+
+JL013_GOOD_BOUNDED_RETRY = """\
+def drive_once(engine, batch):
+    for attempt in range(3):
+        try:
+            return engine.launch(batch, len(batch))
+        except Exception:
+            pass
+"""
+
+JL013_GOOD_RERAISES = """\
+def serve_forever(engine, batches):
+    while True:
+        batch = batches.get()
+        try:
+            engine.launch(batch, len(batch))
+        except Exception:
+            raise
+"""
+
+JL013_GOOD_BACKOFF = """\
+import time
+
+def serve_forever(engine, batches):
+    while True:
+        batch = batches.get()
+        try:
+            engine.launch(batch, len(batch))
+        except Exception:
+            time.sleep(0.5)
+"""
+
+JL013_GOOD_SPECIFIC_TYPE = """\
+def serve_forever(engine, batches):
+    while True:
+        batch = batches.get()
+        try:
+            engine.launch(batch, len(batch))
+        except ValueError:
+            continue
+"""
+
+
+def test_jl013_fires_on_swallowed_launch_in_while_loop():
+    assert_fires(JL013_BAD_LAUNCH, "JL013", line=6)
+
+
+def test_jl013_fires_on_bare_except_around_jit_in_for_loop():
+    assert_fires(JL013_BAD_BARE_EXCEPT_JIT, "JL013", line=9)
+
+
+def test_jl013_silent_on_bounded_range_retry():
+    # The HTTP handler idiom: `for attempt in range(n)` IS the bounded
+    # retry count the rule demands.
+    assert_silent(JL013_GOOD_BOUNDED_RETRY, "JL013")
+
+
+def test_jl013_silent_on_reraise_backoff_and_specific_types():
+    assert_silent(JL013_GOOD_RERAISES, "JL013")
+    assert_silent(JL013_GOOD_BACKOFF, "JL013")
+    assert_silent(JL013_GOOD_SPECIFIC_TYPE, "JL013")
+
+
+def test_jl013_silent_without_a_dispatch_call():
+    no_dispatch = JL013_BAD_LAUNCH.replace(
+        "engine.launch(batch, len(batch))", "process(batch)"
+    )
+    assert_silent(no_dispatch, "JL013")
+
+
+def test_jl013_waiver():
+    waived = JL013_BAD_LAUNCH.replace(
+        "except Exception:",
+        "except Exception:  # jaxlint: disable=JL013 -- chaos driver: swallowing injected faults IS the job",
+    )
+    assert_silent(waived, "JL013")
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

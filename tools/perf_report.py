@@ -451,6 +451,77 @@ def summarize_telemetry(directory: str) -> str | None:
 
     _elastic_lines("replica_drain", "replica drains")
     _elastic_lines("replica_add", "replica re-adds")
+    # Resilience section (serving/faults.py + the pool supervisor,
+    # docs/ROBUSTNESS.md): quarantines by reason, restarts per replica
+    # with mean recovery time (quarantine -> routable again), circuit
+    # open/half-open transitions, ejections, and the transparent-retry
+    # tally — the operator's view of what the chaos (or production
+    # faults) actually cost.
+    quarantines = [e for e in events if e.get("event") == "replica_quarantine"]
+    restarts = [
+        e for e in events
+        if e.get("event") == "replica_restart"
+        and e.get("outcome") == "restarted"
+    ]
+    ejections = [e for e in events if e.get("event") == "replica_eject"]
+    transitions = [e for e in events if e.get("event") == "circuit_transition"]
+    retries = [e for e in events if e.get("event") == "request_retry"]
+    if quarantines or restarts or ejections or transitions or retries:
+        lines.append(
+            f"  resilience: {len(quarantines)} quarantine(s), "
+            f"{len(restarts)} restart(s), {len(ejections)} ejection(s), "
+            f"{len(retries)} retry(ies)"
+        )
+        if restarts:
+            by_replica: dict[str, int] = {}
+            for e in restarts:
+                name = e.get("replica", "?")
+                by_replica[name] = by_replica.get(name, 0) + 1
+            recoveries = [
+                e["recovery_s"] for e in restarts if "recovery_s" in e
+            ]
+            rendered = ", ".join(
+                f"{name} x{n}" for name, n in sorted(by_replica.items())
+            )
+            lines.append(
+                f"    restarts by replica: {rendered}"
+                + (f" (mean recovery "
+                   f"{sum(recoveries) / len(recoveries):.3f} s)"
+                   if recoveries else "")
+            )
+        if quarantines:
+            by_reason: dict[str, int] = {}
+            for e in quarantines:
+                reason = e.get("reason", "?")
+                by_reason[reason] = by_reason.get(reason, 0) + 1
+            lines.append(
+                "    quarantines by reason: "
+                + ", ".join(
+                    f"{reason} x{n}"
+                    for reason, n in sorted(by_reason.items())
+                )
+            )
+        if transitions:
+            per_replica: dict[str, dict[str, int]] = {}
+            for e in transitions:
+                tally = per_replica.setdefault(e.get("replica", "?"), {})
+                dst = e.get("dst", "?")
+                tally[dst] = tally.get(dst, 0) + 1
+            for name, tally in sorted(per_replica.items()):
+                rendered = ", ".join(
+                    f"->{dst} x{n}"
+                    # Stable lifecycle order, not alphabetical: the
+                    # open -> half-open -> closed story reads forward.
+                    for dst in ("open", "half-open", "closed")
+                    if (n := tally.get(dst))
+                )
+                lines.append(f"    circuit transitions [{name}]: {rendered}")
+        for e in ejections:
+            lines.append(
+                f"    ejected: {e.get('replica', '?')} "
+                f"({e.get('reason', '?')}, after {e.get('attempts', '?')} "
+                "restart(s))"
+            )
     gates = [e for e in events if e.get("event") == "parity_gate"]
     if gates:
         for e in gates:

@@ -38,6 +38,16 @@ per-device engine pool behind the queue-aware router
 workload against each count in turn, writing goodput vs. replicas at
 fixed p99 plus scaling efficiency to ``BENCH_serving_scaleout.json``.
 
+Chaos mode (docs/ROBUSTNESS.md): ``--chaos SPEC`` arms a fault schedule
+(``fail:launch:r1:count=6;hang:complete:r0:for=2``) against the
+self-serve pool while the workload runs, then FAILS the run on any lost
+or duplicated response, any transport error, a 503 rate above
+``--chaos-max-503-rate``, an unrecovered replica, or any post-restart
+compile — and writes restarts, recovery times, circuit states, and the
+fault receipt into the report's ``chaos`` section.  This is the
+operator-facing proof that the supervisor + circuit breakers actually
+absorb the failure classes they claim to.
+
 Usage::
 
     python tools/serve_loadgen.py                       # self-contained
@@ -63,7 +73,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def fetch_json(url: str, payload: dict | None = None, timeout: float = 30.0) -> tuple[int, dict]:
     """One HTTP exchange -> (status, parsed body); HTTP errors are data
-    here (503 IS the backpressure measurement), so they don't raise."""
+    here (503 IS the backpressure measurement), so they don't raise.
+    Transport-level failures (connection refused/reset, timeout) return
+    status 0 — under --chaos a lost RESPONSE is precisely the defect the
+    harness asserts against, so it must be countable, not a dead client
+    thread silently shrinking the result set."""
     req = urllib.request.Request(
         url,
         data=None if payload is None else json.dumps(payload).encode(),
@@ -78,6 +92,8 @@ def fetch_json(url: str, payload: dict | None = None, timeout: float = 30.0) -> 
         except Exception:
             body = {}
         return e.code, body
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        return 0, {"error": str(e)}
 
 
 def fetch_text(url: str, timeout: float = 30.0) -> str:
@@ -303,8 +319,23 @@ def _spin_self_serve(args, replicas: int | None):
         pool.warmup(sink=sink)
         if args.dtype != "f32":
             pool.verify_parity(raise_on_failure=True)
+        supervisor_kwargs = {}
+        if getattr(args, "chaos", None):
+            # Chaos cadence: the schedule compresses a production outage
+            # into seconds, so detection/backoff must compress with it —
+            # otherwise the smoke would time out waiting on defaults
+            # sized for real fleets.
+            supervisor_kwargs = dict(
+                interval_s=0.02,
+                stall_timeout_s=args.chaos_stall_timeout,
+                backoff_base_s=0.1,
+                backoff_max_s=1.0,
+                restart_budget=8,
+                seed=args.chaos_seed,
+            )
         router = pool.start(
-            router_policy=args.router_policy, sink=sink, **batcher_kwargs
+            router_policy=args.router_policy, sink=sink,
+            supervisor_kwargs=supervisor_kwargs, **batcher_kwargs
         )
         server = make_server(pool, metrics, port=0, batcher=router)
         threading.Thread(target=server.serve_forever, daemon=True).start()
@@ -346,7 +377,14 @@ def _spin_self_serve(args, replicas: int | None):
 def _teardown_self_serve(server, sink) -> None:
     if server is not None:
         server.shutdown()
-        server.batcher.stop(drain=True)
+        # Pool mode: stop the supervisor BEFORE the router drain (a
+        # restart racing the teardown would attach a fresh batcher to a
+        # router tearing its replicas down); EnginePool.stop owns that
+        # ordering.  Single engine: plain batcher drain.
+        if getattr(server.engine, "supervisor", None) is not None:
+            server.engine.stop(drain=True)
+        else:
+            server.batcher.stop(drain=True)
         server.server_close()
     if sink is not None:
         sink.close()
@@ -373,6 +411,91 @@ def _drive(args, url: str) -> dict:
         url, args.requests, args.concurrency, args.max_request,
         args.seed, args.timeout_s, dtype=args.dtype,
     )
+
+
+def _await_recovery(server, url: str, timeout_s: float) -> bool:
+    """Post-chaos settle: poll until every replica is healthy (state
+    active/drained/ejected and circuit not open), firing small probe
+    requests so half-open circuits get the trial traffic they need to
+    close — an idle pool would otherwise sit half-open forever, and the
+    final prom dump would report a recovery still in flight."""
+    router = server.batcher
+    deadline = time.perf_counter() + timeout_s
+    probe = {"instances": [[0] * 784], "normalized": True}
+    while time.perf_counter() < deadline:
+        stats = router.replica_stats()
+        unsettled = [
+            name for name, s in stats.items()
+            if s["state"] in ("quarantined", "restarting")
+            # Ejection is a SETTLED terminal state; its breaker is
+            # force-opened permanently, so the circuit check must not
+            # hold an exhausted-restart-budget replica "in flight"
+            # until the wait expires.
+            or (s["state"] != "ejected"
+                and s.get("circuit") in ("open", "half-open"))
+        ]
+        if not unsettled:
+            return True
+        fetch_json(f"{url}/predict", probe, timeout=5.0)
+        time.sleep(0.05)
+    return False
+
+
+def run_chaos(args, server, sink, url) -> tuple[dict, dict, dict, dict]:
+    """Drive the workload under an installed fault schedule; returns
+    (raw results, before, after, chaos report section).  The injector's
+    virtual clock starts when the workload does, so ``at=`` clauses are
+    relative to first arrival — 'kill replica 2 at t=5s' means five
+    seconds into the RUN, not into warmup."""
+    from pytorch_mnist_ddp_tpu.serving import faults
+
+    injector = faults.install(
+        faults.FaultInjector(args.chaos, seed=args.chaos_seed)
+    )
+    print(f"chaos: armed {len(injector.specs)} clause(s): {args.chaos}")
+    _status, before = fetch_json(f"{url}/metrics")
+    injector.start()
+    try:
+        raw = _drive(args, url)
+    finally:
+        faults.uninstall()
+    recovered = _await_recovery(server, url, args.chaos_recovery_wait)
+    _status, after = fetch_json(f"{url}/metrics")
+    pool = server.engine
+    router = server.batcher
+    supervisor = getattr(pool, "supervisor", None)
+    sup_stats = supervisor.stats() if supervisor is not None else {}
+    per_replica = sup_stats.get("replicas", {})
+    chaos = {
+        "spec": args.chaos,
+        "seed": args.chaos_seed,
+        "fired": injector.fired_counts(),
+        # Clauses that never fired, split by determinism: a p=-triggered
+        # clause can legitimately miss on a short run, but a count/after/
+        # at clause that fired zero times means the schedule never
+        # exercised what it claims to prove — e.g. warmup/aot_load sites,
+        # which the self-serve pool has already passed by the time the
+        # injector is armed (drive those from tests/test_faults.py).
+        "unfired": [s.source for s in injector.specs
+                    if s.fired == 0 and s.p >= 1.0],
+        "unfired_probabilistic": [s.source for s in injector.specs
+                                  if s.fired == 0 and s.p < 1.0],
+        "recovered": recovered,
+        "restarts": {
+            name: per_replica.get(name, {}).get("restarts", 0)
+            for name in pool.replica_names
+        },
+        "mean_recovery_s": sup_stats.get("mean_recovery_s"),
+        "replica_states": {
+            name: s["state"] for name, s in router.replica_stats().items()
+        },
+        "circuits": {
+            name: s.get("circuit")
+            for name, s in router.replica_stats().items()
+        },
+        "retries": after.get("retries"),
+    }
+    return raw, before, after, chaos
 
 
 def run_replica_sweep(args) -> int:
@@ -557,6 +680,38 @@ def main(argv: list[str] | None = None) -> int:
         "the engine(s) (compile/aot.ExecutableStore; a warm pool start "
         "deserializes every replica's grid with zero traces)",
     )
+    parser.add_argument(
+        "--chaos", default=None, metavar="SPEC",
+        help="drive a fault schedule against the self-serve pool while "
+        "the workload runs (requires --replicas; docs/ROBUSTNESS.md "
+        "grammar, e.g. 'fail:launch:r1:count=6;hang:complete:r0:for=2'). "
+        "The run then FAILS on any lost or duplicated response, any "
+        "transport error, a 503 rate above --chaos-max-503-rate, or any "
+        "post-restart compile, and the report gains a \"chaos\" section "
+        "with restarts, recovery times, and final replica states",
+    )
+    parser.add_argument(
+        "--chaos-seed", type=int, default=0,
+        help="seed for the fault schedule's probabilistic clauses and "
+        "the supervisor's backoff jitter (determinism receipt)",
+    )
+    parser.add_argument(
+        "--chaos-max-503-rate", type=float, default=0.25,
+        help="maximum tolerated client-visible 503 fraction under "
+        "--chaos (the bounded-shed contract)",
+    )
+    parser.add_argument(
+        "--chaos-stall-timeout", type=float, default=0.5,
+        help="supervisor completion-stall threshold under --chaos "
+        "(seconds; compressed from the serving CLI's 5s default to "
+        "match a compressed fault schedule)",
+    )
+    parser.add_argument(
+        "--chaos-recovery-wait", type=float, default=15.0,
+        help="after the workload, wait up to this long (driving probe "
+        "requests through half-open circuits) for every replica to "
+        "heal before the final metrics/prom snapshot",
+    )
     parser.add_argument("--report", default="BENCH_serving.json")
     parser.add_argument(
         "--no-check-compiles", action="store_true",
@@ -570,6 +725,13 @@ def main(argv: list[str] | None = None) -> int:
         # must not allow.
         parser.error("--replicas is --self-serve only; a --url endpoint "
                      "chooses its own replica count")
+    if args.chaos and (args.url or args.replicas_sweep):
+        parser.error("--chaos drives a single self-serve pool; drop "
+                     "--url / --replicas-sweep")
+    if args.chaos and args.replicas is None:
+        parser.error("--chaos needs --replicas N: fault tolerance is a "
+                     "pool property (a lone engine has no survivors to "
+                     "retry on)")
     if args.replicas_sweep:
         if args.url:
             parser.error("--replicas-sweep drives self-serve pools; "
@@ -583,10 +745,16 @@ def main(argv: list[str] | None = None) -> int:
     else:
         server, sink, url = _spin_self_serve(args, replicas=args.replicas)
 
+    chaos_section = None
     try:
-        _status, before = fetch_json(f"{url}/metrics")
-        raw = _drive(args, url)
-        _status, after = fetch_json(f"{url}/metrics")
+        if args.chaos:
+            raw, before, after, chaos_section = run_chaos(
+                args, server, sink, url
+            )
+        else:
+            _status, before = fetch_json(f"{url}/metrics")
+            raw = _drive(args, url)
+            _status, after = fetch_json(f"{url}/metrics")
         if args.prom_dump:
             with open(args.prom_dump, "w") as f:
                 f.write(fetch_text(f"{url}/metrics?format=prom"))
@@ -595,6 +763,63 @@ def main(argv: list[str] | None = None) -> int:
         _teardown_self_serve(server, sink)
 
     report = summarize(raw, before, after)
+    chaos_rc = 0
+    if chaos_section is not None:
+        # The chaos verdict (docs/ROBUSTNESS.md): every submitted
+        # request got exactly one terminal HTTP outcome (no losses, no
+        # transport errors = no duplicated/abandoned work visible to a
+        # client), shed stayed bounded, and the pool healed.
+        results = raw["results"]
+        lost = args.requests - len(results)
+        transport = sum(1 for status, _ in results if status == 0)
+        rate_503 = (
+            report["rejected"] / len(results) if results else 0.0
+        )
+        chaos_section["lost"] = lost
+        chaos_section["transport_errors"] = transport
+        chaos_section["rejected_rate"] = rate_503
+        report["chaos"] = chaos_section
+        if lost or transport:
+            print(
+                f"CHAOS FAIL: {lost} request(s) without a terminal "
+                f"outcome, {transport} transport error(s)"
+            )
+            chaos_rc = 1
+        if rate_503 > args.chaos_max_503_rate:
+            print(
+                f"CHAOS FAIL: 503 rate {rate_503:.1%} exceeds the "
+                f"--chaos-max-503-rate bound {args.chaos_max_503_rate:.1%}"
+            )
+            chaos_rc = 1
+        if not chaos_section["recovered"]:
+            print(
+                "CHAOS FAIL: replicas did not settle within "
+                f"--chaos-recovery-wait ({chaos_section['replica_states']})"
+            )
+            chaos_rc = 1
+        if chaos_section["unfired"]:
+            # A green run whose schedule never fired proves nothing —
+            # fail loudly instead of narrating a fault drill that did
+            # not happen.
+            print(
+                "CHAOS FAIL: clause(s) never fired: "
+                f"{chaos_section['unfired']} (warmup/aot_load sites are "
+                "already past by the time --chaos arms; drive those via "
+                "pytest -m faults)"
+            )
+            chaos_rc = 1
+        for clause in chaos_section["unfired_probabilistic"]:
+            print(f"chaos: WARNING probabilistic clause never fired: {clause}")
+        restarts = chaos_section["restarts"]
+        print(
+            "chaos: "
+            f"{sum(chaos_section['fired'].values())} fault(s) fired, "
+            f"restarts {restarts}, "
+            f"mean recovery {chaos_section['mean_recovery_s'] or 0.0:.3f} s, "
+            f"retries {chaos_section['retries']}, "
+            f"503 rate {rate_503:.1%}, lost {lost}, "
+            f"final states {chaos_section['replica_states']}"
+        )
     with open(args.report, "w") as f:
         json.dump(report, f, indent=2)
 
@@ -626,7 +851,7 @@ def main(argv: list[str] | None = None) -> int:
             return 1
     else:
         print("zero additional compiles (bucket firewall held)")
-    return 0
+    return chaos_rc
 
 
 if __name__ == "__main__":
