@@ -116,7 +116,98 @@ def build_parser() -> argparse.ArgumentParser:
                         "(exercises the full program shape in seconds; "
                         "never a headline number — bench.py refuses to "
                         "snapshot truncated runs)")
+    # Resilient training runtime (pytorch_mnist_ddp_tpu/resilience/,
+    # docs/ROBUSTNESS.md trainer section).  All default to off: the
+    # flagless run builds none of it and stdout stays byte-identical.
+    p.add_argument("--checkpoint-every-steps", type=int, default=0,
+                   metavar="N",
+                   help="write a mid-epoch full-state archive to the "
+                        "--save-state path every N optimizer steps, with a "
+                        "rotating last/last-1 publish so a kill at ANY "
+                        "point (including mid-save) leaves a loadable "
+                        "archive; --resume-state continues bit-identically "
+                        "from the exact batch cursor.  SIGTERM/SIGINT also "
+                        "land an emergency archive at the next step "
+                        "boundary and exit 128+signum (per-batch DP paths; "
+                        "requires --save-state)")
+    p.add_argument("--preempt-grace-s", type=float, default=30.0,
+                   metavar="S",
+                   help="bounded grace for the emergency save after "
+                        "SIGTERM/SIGINT: if the clean save+exit has not "
+                        "finished in S seconds the process force-exits "
+                        "with the same code (default: 30)")
+    p.add_argument("--loss-guard", action="store_true", default=False,
+                   help="guard each step's loss (NaN/Inf or a spike over "
+                        "the accepted-loss EWMA): the poisoned update is "
+                        "rolled back from a pre-step snapshot and retried "
+                        "— first at the original LR (a transient anomaly "
+                        "heals with zero numeric divergence), then with "
+                        "LR backoff — aborting with one diagnostic when "
+                        "--anomaly-budget is exhausted.  Syncs the loss to "
+                        "host every step (the --step-stats trade)")
+    p.add_argument("--spike-factor", type=float, default=10.0, metavar="F",
+                   help="--loss-guard spike threshold: loss > F x EWMA of "
+                        "accepted losses is an anomaly; 0 disables spike "
+                        "detection (NaN/Inf only; default: 10)")
+    p.add_argument("--anomaly-budget", type=int, default=3, metavar="K",
+                   help="rollback-and-retry attempts per step before the "
+                        "run aborts (default: 3)")
+    p.add_argument("--anomaly-lr-backoff", type=float, default=0.5,
+                   metavar="F",
+                   help="LR multiplier applied from the second retry of an "
+                        "anomalous step on (the first retry keeps the "
+                        "original LR so a transient heals bit-exactly; "
+                        "default: 0.5)")
+    p.add_argument("--step-timeout-s", type=float, default=0.0, metavar="S",
+                   help="hung-step watchdog: emit a train_stall event (and "
+                        "train_stalls_total) when a step exceeds S seconds "
+                        "(includes the first step's compile — budget for "
+                        "it); 0 disables.  Enabling syncs each step's "
+                        "output to host (the watchdog needs a completion "
+                        "signal to watch)")
+    p.add_argument("--stall-abort", action="store_true", default=False,
+                   help="with --step-timeout-s: exit 75 (EX_TEMPFAIL) on a "
+                        "stalled step after flushing telemetry, instead of "
+                        "only reporting it")
+    p.add_argument("--chaos", type=str, default=None, metavar="SPEC",
+                   help="deterministic fault injection for the trainer "
+                        "(serving/faults.py grammar; sites step/data_next/"
+                        "ckpt_save, ops fail/hang/kill/nan — e.g. "
+                        "'kill:step:after=7' or 'nan:step:after=5'): the "
+                        "chaos harness tools/train_chaos.py drives kill/"
+                        "resume/verify schedules through this flag")
+    p.add_argument("--chaos-seed", type=int, default=0, metavar="S",
+                   help="seed for probabilistic (p=) chaos triggers")
     return p
+
+
+def run_cli(args, dist_factory, save_path_factory) -> None:
+    """Shared CLI tail for mnist.py / mnist_ddp.py: install the chaos
+    schedule (if any), run fit(), and turn an exhausted anomaly budget
+    into ONE clear stderr diagnostic + a conventional non-zero exit
+    (EXIT_ANOMALY) instead of a traceback — the operator's signal that
+    the run ABORTED on a training anomaly, not crashed by accident."""
+    import sys
+
+    from pytorch_mnist_ddp_tpu.resilience import (
+        EXIT_ANOMALY,
+        AnomalyBudgetExhausted,
+    )
+    from pytorch_mnist_ddp_tpu.trainer import fit
+
+    if getattr(args, "chaos", None):
+        from pytorch_mnist_ddp_tpu.serving.faults import FaultInjector, install
+
+        install(
+            FaultInjector(args.chaos, seed=getattr(args, "chaos_seed", 0))
+        ).start()
+
+    dist = dist_factory()
+    try:
+        fit(args, dist, save_path=save_path_factory(dist))
+    except AnomalyBudgetExhausted as e:
+        print(f"fatal: {e}", file=sys.stderr)
+        raise SystemExit(EXIT_ANOMALY)
 
 
 def main() -> None:
@@ -128,7 +219,6 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
-    from pytorch_mnist_ddp_tpu.trainer import fit
     from pytorch_mnist_ddp_tpu.utils.compile_cache import enable_persistent_cache
 
     enable_persistent_cache(
@@ -137,8 +227,11 @@ def main() -> None:
 
     # Single-device semantics, like the reference mnist.py (one device, no
     # collectives); the reference saves to mnist_cnn.pt (mnist.py:133).
-    dist = DistState(devices=jax.devices()[:1])
-    fit(args, dist, save_path="mnist_cnn.pt")
+    run_cli(
+        args,
+        dist_factory=lambda: DistState(devices=jax.devices()[:1]),
+        save_path_factory=lambda dist: "mnist_cnn.pt",
+    )
 
 
 if __name__ == "__main__":

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import time
 
-from mnist import build_parser
+from mnist import build_parser, run_cli
 
 
 def main() -> None:
@@ -62,19 +62,22 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     from pytorch_mnist_ddp_tpu.parallel.distributed import init_distributed_mode
-    from pytorch_mnist_ddp_tpu.trainer import fit
     from pytorch_mnist_ddp_tpu.utils.compile_cache import enable_persistent_cache
 
     enable_persistent_cache(
         args.compile_cache_dir, force=args.compile_cache_dir is not None
     )
 
-    dist = init_distributed_mode(dist_url=args.dist_url)
     # Checkpoint filename quirk preserved: distributed saves mnist_cnn.pt,
     # the non-distributed fallback saves mnist_cnn_.pt (trailing
     # underscore; reference mnist_ddp.py:193-197, SURVEY.md §3.5).
-    save_path = "mnist_cnn.pt" if dist.distributed else "mnist_cnn_.pt"
-    fit(args, dist, save_path=save_path)
+    run_cli(
+        args,
+        dist_factory=lambda: init_distributed_mode(dist_url=args.dist_url),
+        save_path_factory=lambda dist: (
+            "mnist_cnn.pt" if dist.distributed else "mnist_cnn_.pt"
+        ),
+    )
 
 
 if __name__ == "__main__":

@@ -1,11 +1,12 @@
-"""The jaxlint rule set: JL001–JL013, the JAX hazards this repo has
+"""The jaxlint rule set: JL001–JL014, the JAX hazards this repo has
 actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, the
 serving layer's per-request-shape retrace class, the telemetry layer's
 record-at-trace-time class, the serving pipeline's
 blocking-read-in-dispatch-loop class, the startup phase's serial-warmup
 class, the steady-state input pipeline's host-blocking-feed class, the
-replica pool's per-replica-re-trace class, and the fault-tolerance
-layer's swallowed-dispatch-error class).
+replica pool's per-replica-re-trace class, the fault-tolerance
+layer's swallowed-dispatch-error class, and the resilient trainer's
+torn-file / uncadenced-checkpoint-write class).
 
 Every rule is a heuristic over one module's AST — no type inference, no
 cross-file call graph.  "Traced context" below means: a function that is
@@ -1653,6 +1654,185 @@ class SwallowedDispatchErrorRule(Rule):
                         )
 
 
+# ---------------------------------------------------------------------------
+# JL014 — non-atomic / uncadenced checkpoint writes
+
+
+# Tensor-checkpoint writers and, for each, the index of the argument
+# that names the DESTINATION (np.save(path, arr) vs torch.save(obj,
+# path) vs pickle.dump(obj, file)).  Matched on dotted names so a local
+# helper named `save` never trips the rule.
+_CKPT_WRITERS = {
+    "np.save": 0, "numpy.save": 0,
+    "np.savez": 0, "numpy.savez": 0,
+    "np.savez_compressed": 0, "numpy.savez_compressed": 0,
+    "jnp.save": 0, "jax.numpy.save": 0,
+    "torch.save": 1,
+    "pickle.dump": 1,
+}
+
+# The repo's sanctioned checkpoint helpers (utils/checkpoint.py): every
+# one routes through the mkstemp+fsync+atomic-replace discipline, so a
+# call to them is never a torn-file hazard — but INSIDE a step loop it
+# still needs a cadence guard (matched by trailing segment so
+# `checkpoint.save_train_state(...)` resolves too).
+_CKPT_HELPER_TAILS = {
+    "save_train_state", "save_state_dict", "save_params_tree",
+}
+
+# An If-test that counts as a cadence guard: a modulus (`step % N == 0`),
+# a call to a `due()`-style gate (resilience/checkpoint.py
+# MidEpochCheckpointer.due), or a comparison against an
+# every/interval/cadence-named value.
+_CADENCE_GATE_CALLS = {"due", "should_checkpoint", "should_save"}
+_CADENCE_NAME_HINTS = ("every", "interval", "cadence")
+
+
+class CheckpointWriteRule(Rule):
+    """JL014: a checkpoint write that is torn-file-unsafe or uncadenced.
+
+    The durability hazard class (docs/ROBUSTNESS.md): the whole
+    preemption-safety story rests on two disciplines, and both are
+    invisible to tests that never kill the writer.  (a) **Atomicity**: a
+    raw ``np.savez``/``torch.save``/``pickle.dump`` straight onto its
+    final path dies mid-write as a TORN file that the next load explodes
+    on — every state write must route through utils/checkpoint.py's
+    helpers (mkstemp + fsync + atomic replace; a reader only ever sees
+    absent or complete files).  (b) **Cadence**: a save inside the step
+    loop without a ``step % N``/``due(step)`` gate serializes a full
+    device_get + disk write into EVERY step — the accidental
+    10-100x slowdown class, usually introduced as a debugging aid and
+    shipped.
+
+    Heuristics: (a) fires on a raw-writer call whose destination
+    argument is a string constant, f-string, or ``os.path.join(...)``
+    call — writing DIRECTLY to a named final path.  A Name destination
+    stays silent: the atomic helpers themselves write to mkstemp/BytesIO
+    bindings, and the rule cannot see provenance through a variable.
+    (b) fires on any checkpoint write (raw writer or helper) executed by
+    a loop body with no enclosing cadence-shaped If (``%`` in the test,
+    a ``due()``-style call, or an every/interval/cadence-named operand).
+    A deliberate bare write (a one-shot export script) is waived inline
+    with a reason.
+    """
+
+    rule_id = "JL014"
+    severity = Severity.WARNING
+    summary = "checkpoint write bypasses the atomic helper or lacks a cadence guard"
+
+    @staticmethod
+    def _writer_call(node: ast.AST):
+        """(dotted name, destination arg node) for a raw-writer call."""
+        if not isinstance(node, ast.Call):
+            return None
+        name = dotted_name(node.func)
+        if name is None:
+            return None
+        idx = _CKPT_WRITERS.get(name)
+        if idx is None or len(node.args) <= idx:
+            return None
+        return name, node.args[idx]
+
+    @staticmethod
+    def _helper_call(node: ast.AST) -> str | None:
+        if not isinstance(node, ast.Call):
+            return None
+        name = dotted_name(node.func)
+        if name is None:
+            return None
+        if name.split(".")[-1] in _CKPT_HELPER_TAILS:
+            return name
+        return None
+
+    @staticmethod
+    def _is_direct_path(dest: ast.AST) -> bool:
+        """A destination the writer will open as its FINAL path: a
+        literal, an f-string, or an os.path.join(...) — not a Name
+        (could be a mkstemp temp or an in-memory buffer)."""
+        if isinstance(dest, ast.Constant) and isinstance(dest.value, str):
+            return True
+        if isinstance(dest, ast.JoinedStr):
+            return True
+        if isinstance(dest, ast.Call):
+            name = dotted_name(dest.func) or ""
+            return name in {"os.path.join", "path.join"}
+        return False
+
+    @classmethod
+    def _is_cadence_test(cls, test: ast.AST) -> bool:
+        for node in ast.walk(test):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+                return True
+            if isinstance(node, ast.Call):
+                name = (dotted_name(node.func) or "").split(".")[-1]
+                if name in _CADENCE_GATE_CALLS:
+                    return True
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                label = (dotted_name(node) or "").lower()
+                if any(h in label for h in _CADENCE_NAME_HINTS):
+                    return True
+        return False
+
+    @classmethod
+    def _unguarded_loop_nodes(cls, loop: ast.AST) -> Iterator[ast.AST]:
+        """Loop-body nodes NOT under a cadence-shaped If (and not in a
+        nested scope — same rationale as iter_loop_body_nodes)."""
+        stack: list[ast.AST] = list(loop.body) + list(loop.orelse)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _SCOPE_NODES):
+                continue
+            if isinstance(node, ast.If) and cls._is_cadence_test(node.test):
+                # The guarded branch is sanctioned; the else branch is
+                # still per-iteration work.
+                stack.extend(node.orelse)
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        # (a) raw writer straight onto a named final path, anywhere.
+        for node in ast.walk(ctx.tree):
+            hit = self._writer_call(node)
+            if hit is None:
+                continue
+            name, dest = hit
+            if self._is_direct_path(dest):
+                yield self.finding(
+                    ctx, node,
+                    f"{name}(...) writes a checkpoint directly to its "
+                    "final path: a writer killed mid-write leaves a TORN "
+                    "file the next load explodes on; route through "
+                    "utils/checkpoint.py (save_train_state / "
+                    "save_state_dict / _atomic_write: mkstemp + fsync + "
+                    "atomic replace)",
+                )
+        # (b) any checkpoint write in a loop with no cadence guard.
+        flagged: set[ast.AST] = set()
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for node in self._unguarded_loop_nodes(loop):
+                if node in flagged:
+                    continue
+                name = self._helper_call(node)
+                if name is None:
+                    hit = self._writer_call(node)
+                    name = hit[0] if hit else None
+                if name is None:
+                    continue
+                flagged.add(node)
+                yield self.finding(
+                    ctx, node,
+                    f"{name}(...) runs on EVERY iteration of this loop: "
+                    "an unguarded in-loop checkpoint write serializes a "
+                    "full state materialization + disk write into each "
+                    "step; gate it on a cadence (`if step % N == 0:` / "
+                    "`if checkpointer.due(step):` — "
+                    "resilience/checkpoint.py) or move it out of the loop",
+                )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -1667,6 +1847,7 @@ ALL_RULES: tuple[Rule, ...] = (
     HostBlockingFeedRule(),
     EngineLoopRule(),
     SwallowedDispatchErrorRule(),
+    CheckpointWriteRule(),
 )
 
 

@@ -24,6 +24,7 @@ subprocesses feeding pinned staging buffers (reference mnist_ddp.py:146-151,
 
 from __future__ import annotations
 
+import time
 from typing import Iterator
 
 import jax
@@ -31,6 +32,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.sampler import epoch_indices, per_rank_count
+from ..serving.faults import fault_point
 from . import native
 from .prefetch import DevicePrefetcher
 from .transforms import MNIST_MEAN, MNIST_STD, normalize
@@ -65,6 +67,8 @@ class DataLoader:
         registry=None,
         sink=None,
         pipeline: str = "train",
+        data_retries: int = 3,
+        data_backoff_s: float = 0.05,
     ) -> None:
         if global_batch % process_count:
             raise ValueError(
@@ -93,6 +97,13 @@ class DataLoader:
         self.registry = registry
         self.sink = sink
         self.pipeline = pipeline
+        # Transient-fault tolerance (PR 9, docs/ROBUSTNESS.md): each
+        # batch assembly retries up to ``data_retries`` times with
+        # exponential backoff on OSError/RuntimeError (the transient
+        # storage/injection class) before giving up with one clear
+        # error — a single flaky read must not kill a long run.
+        self.data_retries = int(data_retries)
+        self.data_backoff_s = float(data_backoff_s)
         self.device_place = device_place and mesh is not None
         if self.device_place:
             n_shards = mesh.shape[DATA_AXIS]
@@ -120,7 +131,32 @@ class DataLoader:
 
     # -- host-side assembly --------------------------------------------------
 
-    def _host_batches(self, epoch: int) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    def _assemble(self, idx: np.ndarray, valid: np.ndarray, b: int):
+        """Assemble host batch ``b`` of the epoch permutation ``idx``."""
+        hb = self.host_batch
+        take = idx[b * hb : (b + 1) * hb]
+        # Native multithreaded gather+normalize when the C++ core is
+        # available (data/native.py); identical numpy math otherwise.
+        x = native.gather_normalize(self.images, take, MNIST_MEAN, MNIST_STD)
+        if x is None:
+            x = normalize(self.images[take])
+        y = native.gather_labels(self._labels_raw, take)
+        if y is None:
+            y = self.labels[take]
+        if self.mask_padding:
+            w = valid[b * hb : (b + 1) * hb].astype(np.float32)
+        else:
+            w = np.ones(len(take), np.float32)
+        if len(take) < hb:  # pad the final partial batch, mask it out
+            pad = hb - len(take)
+            x = np.concatenate([x, np.zeros((pad, *x.shape[1:]), x.dtype)])
+            y = np.concatenate([y, np.zeros(pad, y.dtype)])
+            w = np.concatenate([w, np.zeros(pad, np.float32)])
+        return x, y, w
+
+    def _host_batches(
+        self, epoch: int, start_batch: int = 0
+    ) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
         idx, valid = epoch_indices(
             len(self.labels),
             self.process_count,
@@ -132,26 +168,44 @@ class DataLoader:
         )
         hb = self.host_batch
         n_full, rem = divmod(len(idx), hb)
-        for b in range(n_full + (0 if (self.drop_last or not rem) else 1)):
-            take = idx[b * hb : (b + 1) * hb]
-            # Native multithreaded gather+normalize when the C++ core is
-            # available (data/native.py); identical numpy math otherwise.
-            x = native.gather_normalize(self.images, take, MNIST_MEAN, MNIST_STD)
-            if x is None:
-                x = normalize(self.images[take])
-            y = native.gather_labels(self._labels_raw, take)
-            if y is None:
-                y = self.labels[take]
-            if self.mask_padding:
-                w = valid[b * hb : (b + 1) * hb].astype(np.float32)
-            else:
-                w = np.ones(len(take), np.float32)
-            if len(take) < hb:  # pad the final partial batch, mask it out
-                pad = hb - len(take)
-                x = np.concatenate([x, np.zeros((pad, *x.shape[1:]), x.dtype)])
-                y = np.concatenate([y, np.zeros(pad, y.dtype)])
-                w = np.concatenate([w, np.zeros(pad, np.float32)])
-            yield x, y, w
+        total = n_full + (0 if (self.drop_last or not rem) else 1)
+        # start_batch (mid-epoch resume, resilience/checkpoint.py): skip
+        # the first N batches of THIS epoch's permutation by index — the
+        # skipped batches are never assembled, and the yielded ones are
+        # bit-identical to batches N.. of the uninterrupted epoch.
+        for b in range(start_batch, total):
+            # Bounded retry-with-backoff on the transient-fault class
+            # (flaky storage, the injected 'data_next' site): assembly
+            # is deterministic, so a retried batch is bit-identical.
+            for attempt in range(self.data_retries + 1):
+                try:
+                    fault_point("data_next")
+                    batch = self._assemble(idx, valid, b)
+                    break
+                except (OSError, RuntimeError) as e:
+                    if attempt >= self.data_retries:
+                        raise RuntimeError(
+                            f"data pipeline [{self.pipeline}] failed "
+                            f"assembling batch {b} of epoch {epoch} after "
+                            f"{attempt + 1} attempt(s): {e}"
+                        ) from e
+                    if self.registry is not None:
+                        self.registry.counter(
+                            "data_retries_total",
+                            help="transient input-pipeline faults retried",
+                            pipeline=self.pipeline,
+                        ).inc()
+                    if self.sink is not None:
+                        self.sink.emit(
+                            "data_retry",
+                            pipeline=self.pipeline,
+                            epoch=epoch,
+                            batch=b,
+                            attempt=attempt + 1,
+                            error=f"{type(e).__name__}: {e}",
+                        )
+                    time.sleep(self.data_backoff_s * (2 ** attempt))
+            yield batch
 
     def _place(self, host_batch: tuple[np.ndarray, ...]) -> Batch:
         if not self.device_place:
@@ -163,17 +217,20 @@ class DataLoader:
 
     # -- prefetching epoch iterator ------------------------------------------
 
-    def epoch(self, epoch: int) -> Iterator[Batch]:
+    def epoch(self, epoch: int, start_batch: int = 0) -> Iterator[Batch]:
         """Yield device-placed batches for one epoch, assembling and
         transferring ahead of consumption through a
         :class:`~.prefetch.DevicePrefetcher` (``prefetch_depth <= 0`` is
         the synchronous serial baseline; batches are bit-identical
-        either way, only the overlap changes)."""
+        either way, only the overlap changes).  ``start_batch`` resumes
+        mid-epoch: batches ``0..start_batch-1`` of this epoch's
+        permutation are skipped (never assembled), so a resumed run
+        consumes the exact remaining batches."""
         # Abandonment (dry-run break, train-loop exception) closes this
         # generator; GeneratorExit reaches the prefetcher's own finally
         # through the delegation, which reaps the producer thread.
         yield from DevicePrefetcher(
-            self._host_batches(epoch),
+            self._host_batches(epoch, start_batch),
             place=self._place,
             depth=self.prefetch_depth,
             registry=self.registry,

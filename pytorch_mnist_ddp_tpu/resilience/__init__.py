@@ -1,0 +1,57 @@
+"""resilience: the training runtime's survive-anything layer (PR 9,
+docs/ROBUSTNESS.md trainer section).
+
+PR 8 taught the SERVING fleet to detect, eject, and heal dead replicas;
+this package gives the TRAINER the same discipline.  The reference
+paper's only durability story is a final ``torch.save`` after the last
+epoch — a preemption, a hung step, or one NaN loss loses the whole run.
+Here:
+
+- :mod:`.checkpoint` — :class:`MidEpochCheckpointer`: periodic
+  (``--checkpoint-every-steps``) and on-demand full-state archives that
+  capture the EXACT mid-epoch position (epoch in progress, batch
+  cursor, data-order seed, step counter, telemetry counters) with a
+  rotating ``last``/``last-1`` publish scheme, so a kill at ANY point —
+  including mid-save — leaves a loadable archive and ``--resume-state``
+  continues bit-identically to the uninterrupted run.
+- :mod:`.guard` — :class:`LossGuard`: classifies each step's
+  already-synced host loss (NaN/Inf, spike-over-EWMA); the runtime
+  restores the pre-step state from a donated-buffer-safe snapshot and
+  retries — first at the original LR (a transient fault heals with ZERO
+  numeric divergence), then with LR backoff — aborting with one clear
+  diagnostic (:class:`AnomalyBudgetExhausted`) when the budget runs out.
+- :mod:`.watchdog` — :class:`StepWatchdog`: a supervisor-shaped thread
+  (serving/pool.py lineage) that fires ``train_stall`` when a step
+  exceeds ``--step-timeout-s``, optionally aborting the process.
+- :mod:`.preempt` — :class:`PreemptionHandler`: SIGTERM/SIGINT land an
+  emergency checkpoint at the next step boundary and exit with the
+  conventional ``128+signum`` code, under a bounded grace timer.
+- :mod:`.runtime` — :class:`ResilientRuntime`: the bundle the trainer
+  drives; also hosts the ``step`` fault-injection site
+  (serving/faults.py grammar: ``kill:step:after=7``, ``nan:step:...``)
+  so ``tools/train_chaos.py`` can prove all of the above
+  deterministically.
+
+Everything is opt-in: with no resilience flag and no installed fault
+injector the trainer's step loop does not construct (or consult) any of
+this, and flagless stdout stays byte-identical to the reference.
+"""
+
+from __future__ import annotations
+
+from .checkpoint import MidEpochCheckpointer
+from .guard import EXIT_ANOMALY, AnomalyBudgetExhausted, LossGuard
+from .preempt import EXIT_STALLED, PreemptionHandler
+from .runtime import ResilientRuntime
+from .watchdog import StepWatchdog
+
+__all__ = [
+    "AnomalyBudgetExhausted",
+    "EXIT_ANOMALY",
+    "EXIT_STALLED",
+    "LossGuard",
+    "MidEpochCheckpointer",
+    "PreemptionHandler",
+    "ResilientRuntime",
+    "StepWatchdog",
+]

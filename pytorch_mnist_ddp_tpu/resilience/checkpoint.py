@@ -1,0 +1,161 @@
+"""MidEpochCheckpointer: periodic + emergency full-state archives with a
+rotating last/last-1 publish scheme (PR 9).
+
+``--save-state`` (PR 5 era) wrote ONE archive at end of run; a kill at
+step 4 of epoch 7 lost seven epochs.  This class generalizes the same
+archive (utils/checkpoint.save_train_state) to arbitrary step
+boundaries by recording the full mid-epoch position in ``meta.*``
+extras:
+
+- ``epoch_in_progress`` / ``batch_cursor`` — which epoch the run was
+  inside and how many of its batches were consumed, so the resumed run
+  replays the EXACT remaining batches (data/loader.py ``start_batch``).
+- ``seed`` / ``global_batch`` — the data-order parameters the cursor is
+  only meaningful under; resume validates them instead of silently
+  training on a different permutation.
+- ``steps_total`` / ``samples_total`` — telemetry counters, so a
+  resumed run's exposition continues where the killed run's numbers
+  actually were.
+
+The optimizer state, params, BN stats, and the RNG chain (derivable
+from seed + ``state.step``: utils/rng.py folds every per-step key from
+those alone) all travel in the base archive already.
+
+Publish discipline — the part a kill is aimed at::
+
+    write archive to <path>.new      (atomic in itself: mkstemp+fsync)
+    rotate  <path>      -> <path>.prev      (if a previous publish exists)
+    [fault point 'ckpt_save']
+    publish <path>.new  -> <path>
+
+A kill during the write leaves the previous <path> (and <path>.prev)
+untouched; a kill in the rotate->publish window leaves no <path> but a
+complete <path>.prev — and ``--resume-state`` falls back to it
+(utils/checkpoint.load_latest_train_state).  At every instant at least
+one complete archive is loadable; the chaos harness kills inside the
+window (``kill:ckpt_save``) to prove it.
+
+A FAILED periodic save (disk full, injected ``fail:ckpt_save``) is
+reported and survived — training continues and the next cadence tries
+again; only the PREEMPTION save propagates its failure, because exiting
+"cleanly" without the emergency archive would be a lie.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..serving.faults import fault_point
+from ..utils.checkpoint import PREV_SUFFIX, save_train_state
+
+
+class MidEpochCheckpointer:
+    """Write rotated mid-epoch archives for one training run.
+
+    Parameters
+    ----------
+    path:
+        The ``--save-state`` target; rotations live beside it at
+        ``path + ".prev"`` and the in-flight write at ``path + ".new"``.
+    every_steps:
+        Cadence in optimizer steps (``due()``); ``0`` disables periodic
+        saves (emergency saves still work).
+    seed / global_batch:
+        Data-order parameters recorded into (and validated against)
+        every mid-epoch archive.
+    registry / sink:
+        Optional obs surfaces: ``train_checkpoints_total{reason=}``,
+        ``checkpoint_write_seconds``, and per-save ``checkpoint``
+        events.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        every_steps: int = 0,
+        seed: int = 0,
+        global_batch: int = 0,
+        registry=None,
+        sink=None,
+    ) -> None:
+        self.path = path
+        self.prev_path = path + PREV_SUFFIX
+        self.tmp_path = path + ".new"
+        self.every_steps = int(every_steps)
+        self.seed = int(seed)
+        self.global_batch = int(global_batch)
+        self._registry = registry
+        self._sink = sink
+        self.saves = 0
+        self._write_hist = (
+            registry.histogram(
+                "checkpoint_write_seconds",
+                help="wall time of one mid-epoch archive write+publish",
+            )
+            if registry is not None
+            else None
+        )
+
+    def due(self, steps_done: int) -> bool:
+        """True when ``steps_done`` (steps completed THIS run) hits the
+        cadence.  The cadence guard jaxlint JL014 looks for lives here —
+        the step loop calls ``due()`` every step, the O(full-state
+        device_get + disk write) cost only on cadence steps."""
+        return self.every_steps > 0 and steps_done % self.every_steps == 0
+
+    def save(
+        self,
+        host_state,
+        *,
+        epoch_in_progress: int,
+        batch_cursor: int,
+        steps_total: int,
+        samples_total: int,
+        reason: str = "periodic",
+    ) -> float:
+        """Write + rotate + publish one mid-epoch archive; returns the
+        wall seconds spent.  ``host_state`` is already on host (the
+        runtime's ``prepare`` hook did the device_get and any layout
+        gather) — this method is pure file discipline."""
+        t0 = time.perf_counter()
+        save_train_state(
+            host_state,
+            self.tmp_path,
+            epoch=epoch_in_progress - 1,
+            extras={
+                "epoch_in_progress": epoch_in_progress,
+                "batch_cursor": batch_cursor,
+                "seed": self.seed,
+                "global_batch": self.global_batch,
+                "steps_total": steps_total,
+                "samples_total": samples_total,
+            },
+        )
+        if os.path.exists(self.path):
+            os.replace(self.path, self.prev_path)
+        # The chaos harness's mid-save kill point: a death here leaves
+        # no <path>, only the complete rotation at <path>.prev.
+        fault_point("ckpt_save")
+        os.replace(self.tmp_path, self.path)
+        duration = time.perf_counter() - t0
+        self.saves += 1
+        if self._registry is not None:
+            self._registry.counter(
+                "train_checkpoints_total",
+                help="mid-epoch checkpoint archives published",
+                reason=reason,
+            ).inc()
+        if self._write_hist is not None:
+            self._write_hist.observe(duration)
+        if self._sink is not None:
+            self._sink.emit(
+                "checkpoint",
+                reason=reason,
+                epoch=epoch_in_progress,
+                batch_cursor=batch_cursor,
+                steps_total=steps_total,
+                duration_s=round(duration, 6),
+                path=self.path,
+            )
+        return duration

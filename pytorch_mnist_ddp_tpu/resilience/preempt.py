@@ -1,0 +1,95 @@
+"""PreemptionHandler: SIGTERM/SIGINT -> emergency checkpoint -> clean
+exit (PR 9).
+
+Preemptible capacity (spot VMs, borrowed TPU slices, k8s evictions)
+delivers SIGTERM and a grace window; the default Python behavior —
+KeyboardInterrupt mid-step, or straight death — loses everything since
+the last periodic checkpoint.  The handler converts the signal into a
+FLAG the step loop polls at each step boundary (signal handlers must
+not touch device state or take locks; the step boundary is the one
+place a consistent snapshot exists), where the runtime writes an
+emergency mid-epoch archive and exits with the conventional
+``128 + signum`` code (143 for SIGTERM, 130 for SIGINT) so supervisors
+see the same code an unhandled signal would have produced — but with
+the work saved.
+
+Bounded grace: the first signal starts a daemon timer; if the clean
+path has not finished within ``grace_s`` (a wedged step, a slow
+filesystem), the timer force-exits with the same code — a preemption
+deadline missed because we were politely flushing is still a killed
+run, and lying about it by blocking past the platform's grace window
+just gets the process SIGKILLed with the checkpoint half-written.  A
+second signal force-exits immediately (the operator pressing Ctrl-C
+twice means NOW).
+
+Install/uninstall is explicit and restores the previous handlers, so
+in-process tests (and library embedders) keep their signal semantics.
+Only the main thread can install (CPython restriction); elsewhere the
+handler degrades to never-requested.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+# sysexits.h EX_TEMPFAIL: the step watchdog aborted a wedged run
+# (runtime.py uses it for --stall-abort; grouped here with the other
+# process-exit conventions).
+EXIT_STALLED = 75
+
+_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+class PreemptionHandler:
+    def __init__(self, grace_s: float = 30.0) -> None:
+        self.grace_s = float(grace_s)
+        self.requested = False
+        self.signum: int | None = None
+        self._prev: dict[int, object] = {}
+        self._timer: threading.Timer | None = None
+        self._installed = False
+
+    @property
+    def exit_code(self) -> int:
+        return 128 + (self.signum or signal.SIGTERM)
+
+    # -- signal side --------------------------------------------------------
+
+    def _handle(self, signum, frame) -> None:
+        if self.requested:
+            # Second signal: the grace period is over as far as the
+            # sender is concerned.  Exit NOW, same code.
+            os._exit(128 + signum)
+        self.requested = True
+        self.signum = signum
+        if self.grace_s > 0:
+            self._timer = threading.Timer(
+                self.grace_s, os._exit, args=(128 + signum,)
+            )
+            self._timer.daemon = True
+            self._timer.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def install(self) -> "PreemptionHandler":
+        if threading.current_thread() is not threading.main_thread():
+            return self  # signal.signal is main-thread-only; degrade
+        for sig in _SIGNALS:
+            self._prev[sig] = signal.signal(sig, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            for sig, prev in self._prev.items():
+                signal.signal(sig, prev)
+            self._prev.clear()
+            self._installed = False
+        if self._timer is not None:
+            # An in-process caller (tests, notebook embedding) survives
+            # the "preemption": the force-exit timer must die with the
+            # handler or it would kill the HOST process grace_s later.
+            self._timer.cancel()
+            self._timer = None

@@ -1,0 +1,298 @@
+"""ResilientRuntime: the bundle the trainer's step loop drives (PR 9).
+
+One object owns the four resilience concerns so trainer.py adds exactly
+two call sites — ``run_step`` (the guarded/fault-injectable step
+attempt) and ``after_step`` (cadence checkpoints + preemption polling)
+— instead of interleaving snapshot/retry/save/signal logic through the
+epoch loop.  Constructed only when a resilience flag is set or a fault
+injector is installed; the flagless path never touches this module.
+
+Step anatomy (``run_step``)::
+
+    [snapshot pre-step state]        guard only; donated-buffer-safe
+    fault point 'step'               kill/fail/hang/nan injection
+    state' = step_fn(state, ...)     donates state's buffers
+    [host-sync losses]               guard/watchdog only (opt-in sync)
+    classify -> healthy: return
+             -> anomaly: restore snapshot, retry (budget/backoff)
+
+The snapshot is ``jax.tree.map(jnp.copy, state)`` taken BEFORE the
+donating step call: the copies are new buffers the donation cannot
+alias, so "restore pre-step params exactly" is a pointer swap, not a
+reconstruction — bit-exact by construction.  Retried attempts re-enter
+the SAME compiled step function with the same shapes: zero new traces,
+the RecompileSentinel budget is untouched.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..serving.faults import FaultError, fault_point
+from .guard import AnomalyBudgetExhausted, LossGuard
+from .preempt import EXIT_STALLED, PreemptionHandler
+from .watchdog import StepWatchdog
+
+
+def _default_abort(code: int) -> None:  # pragma: no cover - process exit
+    os._exit(code)
+
+
+class ResilientRuntime:
+    """Drive one training run's resilience: guard, watchdog,
+    checkpointer, preemption.
+
+    Parameters
+    ----------
+    guard:
+        :class:`~.guard.LossGuard` or None.  Enabling it syncs each
+        step's loss to host (the guard cannot classify what it cannot
+        see) — the same opt-in per-step sync ``--step-stats`` makes.
+    checkpointer:
+        :class:`~.checkpoint.MidEpochCheckpointer` or None.
+    preemption:
+        :class:`~.preempt.PreemptionHandler` or None; polled at each
+        step boundary in ``after_step``.
+    step_timeout_s / stall_abort:
+        ``> 0`` starts a :class:`~.watchdog.StepWatchdog` (which also
+        forces the per-step host sync); on stall it emits
+        ``train_stall`` + ``train_stalls_total`` and, with
+        ``stall_abort``, exits ``EXIT_STALLED`` via ``abort_fn``
+        (injectable for tests; ``os._exit`` in production).
+    prepare:
+        ``device state -> host state`` hook for checkpoint writes
+        (device_get + any optimizer-layout gather; trainer closure).
+    steps_total / samples_total:
+        Telemetry-counter bases restored from a resumed archive so the
+        continued run's totals match the uninterrupted run's.
+    """
+
+    def __init__(
+        self,
+        *,
+        guard: LossGuard | None = None,
+        checkpointer=None,
+        preemption: PreemptionHandler | None = None,
+        step_timeout_s: float = 0.0,
+        stall_abort: bool = False,
+        prepare=None,
+        global_batch: int = 0,
+        steps_total: int = 0,
+        samples_total: int = 0,
+        registry=None,
+        sink=None,
+        abort_fn=_default_abort,
+    ) -> None:
+        self.guard = guard
+        self.checkpointer = checkpointer
+        self.preemption = preemption
+        self.prepare = prepare if prepare is not None else (lambda s: s)
+        self.global_batch = int(global_batch)
+        self.steps_total = int(steps_total)
+        self.samples_total = int(samples_total)
+        self.steps_local = 0
+        self._registry = registry
+        self._sink = sink
+        self._stall_abort = bool(stall_abort)
+        self._abort_fn = abort_fn
+        self.watchdog = (
+            StepWatchdog(step_timeout_s, self._on_stall)
+            if step_timeout_s and step_timeout_s > 0
+            else None
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ResilientRuntime":
+        if self.preemption is not None:
+            self.preemption.install()
+        if self.watchdog is not None:
+            self.watchdog.suspend()  # armed per-epoch by begin_train
+            self.watchdog.start()
+        return self
+
+    def stop(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.preemption is not None:
+            self.preemption.uninstall()
+
+    def begin_train(self) -> None:
+        """Entering a stepping region (train_one_epoch's loop)."""
+        if self.watchdog is not None:
+            self.watchdog.resume()
+
+    def end_train(self) -> None:
+        """Leaving the stepping region (eval/epoch boundary follows)."""
+        if self.watchdog is not None:
+            self.watchdog.suspend()
+
+    # -- the guarded step ---------------------------------------------------
+
+    def run_step(
+        self, step_fn, state, x, y, w, dropout_key, lr_arr,
+        *, epoch: int, batch_idx: int,
+    ):
+        """One resilient optimizer step; returns ``(state, losses,
+        host_losses-or-None)``.  ``host_losses`` is the per-replica
+        numpy loss array when this step already synced it (guard or
+        watchdog active) so the caller's telemetry/log reads reuse it
+        instead of paying a second sync."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        attempt = 0  # 0 = first try; >0 = retry number
+        while True:
+            snapshot = (
+                jax.tree.map(jnp.copy, state)
+                if self.guard is not None
+                else None
+            )
+            xs = x
+            try:
+                fault_point("step")
+            except FaultError as e:
+                if getattr(e, "op", "fail") != "nan":
+                    raise  # fail: a simulated crash, propagate as one
+                # nan: poison this attempt's batch — the guard (if any)
+                # must catch the fallout, not the injection.
+                xs = x * jnp.asarray(float("nan"), dtype=x.dtype)
+            lr_in = lr_arr
+            if attempt > 0:
+                scale = self.guard.lr_scale(attempt)
+                if scale != 1.0:
+                    lr_in = lr_arr * jnp.float32(scale)
+            new_state, losses = step_fn(state, xs, y, w, dropout_key, lr_in)
+            if self.guard is None:
+                if self.watchdog is not None:
+                    jax.block_until_ready(losses)
+                    self.watchdog.beat()
+                return new_state, losses, None
+            host = np.asarray(jax.device_get(losses))  # jaxlint: disable=JL006 -- the guard's documented opt-in read: it cannot classify a loss it never sees, and the flag text owns the one-sync-per-step trade
+            if self.watchdog is not None:
+                self.watchdog.beat()
+            kind = self.guard.classify(host)
+            if kind is None:
+                self.guard.record_healthy(host)
+                return new_state, losses, host
+            # Anomalous step: the update in new_state is poison.  Count,
+            # report, restore the pre-step snapshot, and retry (or give
+            # up when the budget is spent).
+            attempt += 1
+            self.guard.anomalies += 1
+            exhausted = attempt > self.guard.retry_budget
+            if self._registry is not None:
+                self._registry.counter(
+                    "train_anomalies_total",
+                    help="anomalous training steps detected by the "
+                    "LossGuard, by kind",
+                    kind=kind,
+                ).inc()
+            if self._sink is not None:
+                self._sink.emit(
+                    "train_anomaly",
+                    kind=kind,
+                    epoch=epoch,
+                    step=batch_idx,
+                    attempt=attempt,
+                    loss=float(np.asarray(host, np.float64).mean()),
+                    action="abort" if exhausted else "retry",
+                )
+            if exhausted:
+                raise AnomalyBudgetExhausted(
+                    f"step {batch_idx} of epoch {epoch} stayed anomalous "
+                    f"({kind}) through {self.guard.retry_budget} "
+                    "rollback-and-retry attempt(s) with LR backoff "
+                    f"{self.guard.lr_backoff}; the pre-step parameters "
+                    "were restored exactly — resume from the last "
+                    "checkpoint after fixing the cause (bad data shard, "
+                    "too-hot schedule, failing hardware)"
+                )
+            state = snapshot
+
+    # -- the step boundary --------------------------------------------------
+
+    def after_step(self, state, *, epoch: int, batch_idx: int) -> None:
+        """Bookkeeping + checkpoint/preemption work at one completed
+        step's boundary.  May raise SystemExit (preemption)."""
+        self.steps_local += 1
+        self.steps_total += 1
+        self.samples_total += self.global_batch
+        cursor = batch_idx + 1
+        if self.preemption is not None and self.preemption.requested:
+            if self.checkpointer is not None:
+                # No try/except: a failed EMERGENCY save must surface —
+                # exiting "cleanly" without the archive would be a lie.
+                self._save(state, epoch, cursor, reason="preempt")
+            if self._sink is not None:
+                self._sink.emit(
+                    "preempt_exit",
+                    signum=self.preemption.signum,
+                    exit_code=self.preemption.exit_code,
+                    epoch=epoch,
+                    batch_cursor=cursor,
+                )
+            raise SystemExit(self.preemption.exit_code)
+        if self.checkpointer is not None and self.checkpointer.due(
+            self.steps_local
+        ):
+            try:
+                self._save(state, epoch, cursor, reason="periodic")
+            except Exception as e:
+                # A failed PERIODIC save is survivable: report it and
+                # keep training — the next cadence retries with a fresh
+                # temp file, and the previous archives are intact by
+                # the rotation discipline.
+                if self._registry is not None:
+                    self._registry.counter(
+                        "train_checkpoint_failures_total",
+                        help="periodic checkpoint saves that failed "
+                        "(training continued)",
+                    ).inc()
+                if self._sink is not None:
+                    self._sink.emit(
+                        "checkpoint_failed",
+                        epoch=epoch,
+                        batch_cursor=cursor,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+
+    def _save(self, state, epoch: int, cursor: int, reason: str) -> None:
+        # A checkpoint write is a suspended region per the watchdog's
+        # contract (watchdog.py): no step is in flight, so a slow
+        # device_get + npz write must not read as a stalled step (with
+        # --stall-abort it would kill a healthy run mid-rotation).
+        if self.watchdog is not None:
+            self.watchdog.suspend()
+        try:
+            self.checkpointer.save(
+                self.prepare(state),
+                epoch_in_progress=epoch,
+                batch_cursor=cursor,
+                steps_total=self.steps_total,
+                samples_total=self.samples_total,
+                reason=reason,
+            )
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.resume()
+
+    # -- stall handling -----------------------------------------------------
+
+    def _on_stall(self, age_s: float) -> None:
+        if self._registry is not None:
+            self._registry.counter(
+                "train_stalls_total",
+                help="steps that exceeded --step-timeout-s",
+            ).inc()
+        if self._sink is not None:
+            self._sink.emit(
+                "train_stall",
+                age_s=round(age_s, 3),
+                steps_total=self.steps_total,
+            )
+        if self._stall_abort:
+            if self._sink is not None:
+                self._sink.close()  # flush: the abort is immediate
+            self._abort_fn(EXIT_STALLED)

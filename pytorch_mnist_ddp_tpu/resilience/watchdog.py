@@ -1,0 +1,119 @@
+"""StepWatchdog: hung-step detection for the training loop (PR 9).
+
+A wedged collective, a dead remote-accelerator tunnel, or an injected
+``hang:step`` stalls the step loop SILENTLY — the process sits at 0%
+CPU forever and no exception ever fires.  The serving fleet already
+solved this shape with the ReplicaSupervisor's completion-stall
+detector (serving/pool.py); this is the trainer-side twin: a polling
+daemon thread that measures the age of the current step window and
+fires ``on_stall(age_s)`` when it exceeds ``timeout_s``.
+
+The contract with the step loop:
+
+- ``beat()`` after every COMPLETED step (the runtime calls it right
+  after the step's host sync) re-arms the window.
+- ``suspend()``/``resume()`` bracket the regions where no step is in
+  flight (eval passes, epoch boundaries, checkpoint writes) so a long
+  eval never reads as a stalled step.
+- ``on_stall`` fires ONCE per stalled step window (not once per poll
+  tick): repeated events for one hang would read as N distinct stalls
+  in the telemetry.  The abort decision lives in the callback
+  (runtime.py: emit ``train_stall`` + counter, optionally
+  ``os._exit(EXIT_STALLED)``) — the watchdog only detects.
+
+Like the supervisor, the watchdog needs a real completion signal to
+watch: enabling it makes the runtime block on each step's output (the
+same one-sync-per-step trade ``--step-stats`` and ``--telemetry-dir``
+already make, documented on the flag).  Without a per-step sync an
+async dispatch queue never hangs on the host side and a watchdog would
+be a placebo.
+
+stdlib-only and jax-free: tests drive it with fake clocks/sleeps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class StepWatchdog:
+    def __init__(
+        self,
+        timeout_s: float,
+        on_stall,
+        poll_s: float | None = None,
+    ) -> None:
+        if timeout_s <= 0:
+            raise ValueError("timeout_s must be > 0")
+        self.timeout_s = float(timeout_s)
+        self.on_stall = on_stall
+        self.poll_s = float(poll_s) if poll_s else max(timeout_s / 4.0, 0.01)
+        self._lock = threading.Lock()
+        self._window_start: float | None = None  # None = suspended
+        self._beats = 0
+        self._reported_window = -1  # beat index already reported stalled
+        self.stalls = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- step-loop surface --------------------------------------------------
+
+    def beat(self) -> None:
+        """A step completed; re-arm the stall window."""
+        with self._lock:
+            self._beats += 1
+            self._window_start = time.monotonic()
+
+    def resume(self) -> None:
+        """Enter a stepping region (epoch start): arm the window."""
+        with self._lock:
+            self._window_start = time.monotonic()
+
+    def suspend(self) -> None:
+        """Leave the stepping region (eval, epoch end): stop watching."""
+        with self._lock:
+            self._window_start = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "StepWatchdog":
+        self._thread = threading.Thread(
+            target=self._watch, name="train-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    # -- detector -----------------------------------------------------------
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            with self._lock:
+                start = self._window_start
+                beats = self._beats
+                already = self._reported_window == beats
+            if start is None or already:
+                continue
+            age = time.monotonic() - start
+            if age <= self.timeout_s:
+                continue
+            with self._lock:
+                # Re-check under the lock: a beat may have landed while
+                # the age was computed, and that window is healthy.
+                if self._beats != beats or self._window_start is None:
+                    continue
+                self._reported_window = beats
+                self.stalls += 1
+            try:
+                self.on_stall(age)
+            except Exception:
+                # The detector must outlive a throwing callback: a
+                # broken telemetry sink must not disable stall
+                # detection for the rest of the run.
+                pass

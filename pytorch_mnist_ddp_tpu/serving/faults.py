@@ -1,4 +1,5 @@
-"""Deterministic fault injection for the serving stack (docs/ROBUSTNESS.md).
+"""Deterministic fault injection for the serving AND training stacks
+(docs/ROBUSTNESS.md).
 
 A fleet serving real traffic must DETECT, eject, and heal replicas that
 throw, hang, or die under live load — and the only honest way to claim
@@ -8,6 +9,20 @@ points** compiled into the serving hot path (batcher dispatch and
 completion, pool warmup, AOT deserialization) that are dormant — one
 module-global ``None`` check — until a test or the loadgen's chaos mode
 installs a :class:`FaultInjector`.
+
+PR 9 extends the same grammar to the training runtime
+(``pytorch_mnist_ddp_tpu/resilience``): trainer sites ``step`` (fired
+once per optimizer-step attempt), ``data_next`` (fired per host-batch
+assembly in ``data/loader.py``), and ``ckpt_save`` (fired inside the
+mid-epoch checkpointer's rotate→publish window), plus two ops the
+trainer chaos harness needs — ``kill`` (an uncatchable simulated
+SIGKILL: ``os._exit(137)`` at the fault point, which is how
+``tools/train_chaos.py`` dies at a DETERMINISTIC step instead of racing
+a timer against the step loop) and ``nan`` (raises a
+:class:`FaultError` tagged ``op="nan"`` that the trainer interprets by
+poisoning that step's input batch with NaNs — the injection the
+LossGuard's rollback is proven against; ``step``-site only, because
+nothing else knows how to poison).
 
 Determinism is the design constraint: the chaos acceptance tests must
 produce the same fault sequence on every run, so triggers are
@@ -20,11 +35,13 @@ are deliberately absent from the pinned tests.
 Spec grammar (one or more clauses joined by ``;``)::
 
     clause  := op ':' site [ ':' replica ] [ ':' params ]
-    op      := 'fail' | 'hang'
+    op      := 'fail' | 'hang' | 'kill' | 'nan'
     site    := 'launch' | 'complete' | 'warmup' | 'aot_load'
+             | 'step' | 'data_next' | 'ckpt_save'
     replica := a replica name ('r0', ...); '*' or omitted = any replica
                (rejected for 'aot_load': the store is pool-shared, so a
-               replica-scoped clause could never fire)
+               replica-scoped clause could never fire; the trainer sites
+               fire unlabeled — there is one trainer)
     params  := key '=' value (',' key '=' value)*
 
     count=N | count=inf   fire on the next N matching events (default 1)
@@ -40,12 +57,22 @@ Examples::
     fail:aot_load:count=1         # first AOT deserialize fails -> fallback
     fail:warmup:r2                # r2's warmup raises once
     fail:launch:r3:at=5,count=inf # kill r3 five seconds into the run
+    kill:step:after=7             # preempt the trainer before step 8
+    kill:ckpt_save:after=1        # die inside the 2nd checkpoint rotation
+    nan:step:after=5              # poison step 6's batch (LossGuard test)
+    fail:data_next:count=2        # two transient input-pipeline faults
 
 The ``fail`` op raises :class:`FaultError` at the fault point — the
 supervisor (serving/pool.py) must treat it exactly like any engine
 exception, which is the point.  The ``hang`` op blocks the calling
 thread for ``for=`` seconds (interruptibly: :func:`uninstall` releases
 stuck sleepers), which is how the completion-stall detector is proven.
+The ``kill`` op exits the process immediately (``os._exit(137)``,
+the SIGKILL convention) — no finally blocks, no atexit, exactly what a
+preemption looks like to the checkpoint files.  The ``nan`` op raises a
+:class:`FaultError` whose ``op`` attribute is ``"nan"``; the trainer's
+resilient runtime translates it into a NaN-poisoned input batch, every
+other site treats it as a plain failure.
 
 Off by default: ``fault_point()`` returns after a single global ``is
 None`` test when nothing is installed, so production paths pay one
@@ -61,15 +88,33 @@ import threading
 import time
 from contextlib import contextmanager
 
-SITES = ("launch", "complete", "warmup", "aot_load")
-OPS = ("fail", "hang")
+# Trainer sites (resilience/, data/loader.py): one step-attempt event
+# per optimizer step, one data_next event per host-batch assembly, one
+# ckpt_save event inside each checkpoint rotation.  They always fire
+# unlabeled — there is one trainer — so replica-scoped clauses are
+# rejected at parse time (same vacuous-green guard as aot_load).
+TRAINER_SITES = ("step", "data_next", "ckpt_save")
+
+SITES = ("launch", "complete", "warmup", "aot_load") + TRAINER_SITES
+OPS = ("fail", "hang", "kill", "nan")
 
 
 class FaultError(RuntimeError):
     """An injected failure.  Deliberately a plain RuntimeError subclass:
     the serving stack must recover from it through the SAME paths it
     recovers from real engine failures with — any special-casing of
-    this type in non-test code would make the chaos harness a liar."""
+    this type in non-test code would make the chaos harness a liar.
+
+    ``op``/``site`` carry the firing clause's coordinates.  The ONE
+    sanctioned read of them outside tests is the trainer's ``nan``
+    translation (resilience/runtime.py): a ``nan`` fault is not a
+    failure to recover from but an instruction to poison the step's
+    numerics, so the runtime must be able to tell it from ``fail``."""
+
+    def __init__(self, message: str, op: str = "fail", site: str = ""):
+        super().__init__(message)
+        self.op = op
+        self.site = site
 
 
 class FaultSpec:
@@ -126,6 +171,13 @@ class FaultSpec:
         )
         if count < 1:
             raise ValueError(f"count must be >= 1 in {clause!r}")
+        if op == "nan" and site != "step":
+            # Only the trainer's step attempt knows how to poison a
+            # batch; a nan clause anywhere else would be armed but
+            # uninterpretable — a vacuous green chaos run.
+            raise ValueError(
+                f"op 'nan' is only meaningful at site 'step' in {clause!r}"
+            )
         if site == "aot_load" and replica is not None:
             # The AOT store is SHARED across replicas (one ExecutableStore
             # per pool), so its fault point fires unlabeled; accepting a
@@ -134,6 +186,13 @@ class FaultSpec:
             raise ValueError(
                 f"aot_load cannot be replica-scoped in {clause!r}: the "
                 "executable store is shared across the pool"
+            )
+        if site in TRAINER_SITES and replica is not None:
+            # Same vacuous-green guard: the trainer sites fire with
+            # replica=None, so a labeled clause could never match.
+            raise ValueError(
+                f"{site} cannot be replica-scoped in {clause!r}: trainer "
+                "sites fire unlabeled (there is one trainer)"
             )
         return cls(
             op=op,
@@ -207,11 +266,21 @@ class FaultInjector:
                 op, hang_s, source = spec.op, spec.hang_s, spec.source
             if op == "hang":
                 self._unhang.wait(hang_s)
+            elif op == "kill":
+                # Simulated SIGKILL: no exception, no finally blocks, no
+                # atexit — the process is simply gone, which is the
+                # preemption the checkpoint rotation must survive.  137
+                # is the 128+SIGKILL convention the chaos driver asserts.
+                import os
+
+                os._exit(137)
             else:
                 raise FaultError(
-                    f"injected failure at {site}"
+                    f"injected {op} at {site}"
                     + (f" on {replica}" if replica else "")
-                    + f" ({source})"
+                    + f" ({source})",
+                    op=op,
+                    site=site,
                 )
 
     def fired_counts(self) -> dict[str, int]:
@@ -247,6 +316,27 @@ def fault_point(site: str, replica: str | None = None) -> None:
     injector = _INJECTOR
     if injector is not None:
         injector.fire(site, replica)
+
+
+def active() -> bool:
+    """True when an injector is installed.  The trainer reads this to
+    decide whether to route steps through the resilient runtime (the
+    fault sites live there) even when no resilience flag is set — so an
+    in-process ``with injected("fail:step:after=3"):`` bites without
+    extra plumbing.  The flagless no-injector path stays untouched."""
+    return _INJECTOR is not None
+
+
+def active_sites() -> frozenset:
+    """The sites named by the installed schedule (empty when none).
+    The trainer uses this to refuse configurations where an armed
+    trainer-site clause could never fire (e.g. ``--fused``, whose one
+    device call has no step/data_next/ckpt_save events) — a chaos run
+    that injects nothing must fail loudly, not report green."""
+    injector = _INJECTOR
+    if injector is None:
+        return frozenset()
+    return frozenset(spec.site for spec in injector.specs)
 
 
 @contextmanager

@@ -144,6 +144,8 @@ def train_one_epoch(
     per_rank_batch: int | None = None,
     step_stats=None,
     telemetry=None,
+    runtime=None,
+    start_batch: int = 0,
 ) -> TrainState:
     """One training epoch (reference train(), mnist_ddp.py:65-86).
 
@@ -158,6 +160,14 @@ def train_one_epoch(
     --step-stats, it blocks on each step's output to timestamp it — one
     device sync per step, the accepted trade for an opt-in diagnostic;
     the default path is untouched.
+
+    ``runtime`` (resilience.ResilientRuntime, PR 9) routes each step
+    through the guarded attempt (fault sites, LossGuard rollback,
+    watchdog beat) and each step boundary through cadence checkpoints +
+    preemption polling; ``start_batch`` resumes a mid-epoch archive at
+    its exact batch cursor (batch numbering, log lines, and sampler
+    position all continue as if never interrupted).  Both default to
+    the flagless no-op.
     """
     lr_arr = jnp.float32(lr)
     num_batches = len(loader)
@@ -179,58 +189,86 @@ def train_one_epoch(
             help="host-observed per-step latency (blocking read)",
         )
         epoch_t0 = step_t0 = time.perf_counter()
-    for batch_idx, (x, y, w) in enumerate(loader.epoch(epoch)):
-        state, losses = step_fn(state, x, y, w, dropout_key, lr_arr)
-        loss0 = None
-        if step_stats is not None:
-            step_stats.mark(losses)
-        if telemetry is not None:
-            jax.block_until_ready(losses)
-            now = time.perf_counter()
-            # The chief's own first local replica, same local-shard read
-            # (and same no-collective rationale) as the log path below.
-            loss0 = float(np.asarray(losses.addressable_shards[0].data)[0])
-            global_batch = per_rank_batch * (
-                dist.world_size if dist.distributed else 1
-            )
-            step_counter.inc()
-            sample_counter.inc(global_batch)
-            steps_recorded += 1
-            samples_recorded += global_batch
-            latency_hist.observe(now - step_t0)
-            telemetry.events.emit(
-                "step",
-                epoch=epoch,
-                step=batch_idx,
-                loss=loss0,
-                latency_s=now - step_t0,
-                samples=global_batch,
-            )
-            step_t0 = time.perf_counter()
-        if dist.is_chief and batch_idx % log_interval == 0:
-            samples = dist.world_size * batch_idx * per_rank_batch
-            if not dist.distributed:
-                samples = batch_idx * per_rank_batch
-            # The chief's OWN first local replica — read from its local
-            # shard, never via `losses[0]`: indexing a globally-sharded
-            # array compiles a gather over the whole mesh, and a
-            # chief-only collective deadlocks/corrupts multi-process runs
-            # (every process must enqueue the same programs in order).
-            # (Reused from the telemetry block when it already read it.)
-            if loss0 is None:
-                loss0 = float(np.asarray(losses.addressable_shards[0].data)[0])
-            print(
-                train_log_line(
-                    epoch,
-                    samples,
-                    loader.dataset_len,
-                    batch_idx,
-                    num_batches,
-                    loss0,
+    if runtime is not None:
+        runtime.begin_train()
+    try:
+        for batch_idx, (x, y, w) in enumerate(
+            loader.epoch(epoch, start_batch=start_batch), start=start_batch
+        ):
+            loss_host = None
+            if runtime is not None:
+                state, losses, loss_host = runtime.run_step(
+                    step_fn, state, x, y, w, dropout_key, lr_arr,
+                    epoch=epoch, batch_idx=batch_idx,
                 )
-            )
-        if dry_run:
-            break
+            else:
+                state, losses = step_fn(state, x, y, w, dropout_key, lr_arr)
+            loss0 = None if loss_host is None else float(loss_host[0])
+            if step_stats is not None:
+                # The runtime's guarded read already synced this step;
+                # a second block would double-count the sync cost.
+                step_stats.mark(losses if loss_host is None else None)
+            if telemetry is not None:
+                if loss0 is None:
+                    jax.block_until_ready(losses)
+                now = time.perf_counter()
+                if loss0 is None:
+                    # The chief's own first local replica, same local-shard
+                    # read (and same no-collective rationale) as the log
+                    # path below.
+                    loss0 = float(
+                        np.asarray(losses.addressable_shards[0].data)[0]
+                    )
+                global_batch = per_rank_batch * (
+                    dist.world_size if dist.distributed else 1
+                )
+                step_counter.inc()
+                sample_counter.inc(global_batch)
+                steps_recorded += 1
+                samples_recorded += global_batch
+                latency_hist.observe(now - step_t0)
+                telemetry.events.emit(
+                    "step",
+                    epoch=epoch,
+                    step=batch_idx,
+                    loss=loss0,
+                    latency_s=now - step_t0,
+                    samples=global_batch,
+                )
+                step_t0 = time.perf_counter()
+            if dist.is_chief and batch_idx % log_interval == 0:
+                samples = dist.world_size * batch_idx * per_rank_batch
+                if not dist.distributed:
+                    samples = batch_idx * per_rank_batch
+                # The chief's OWN first local replica — read from its local
+                # shard, never via `losses[0]`: indexing a globally-sharded
+                # array compiles a gather over the whole mesh, and a
+                # chief-only collective deadlocks/corrupts multi-process runs
+                # (every process must enqueue the same programs in order).
+                # (Reused from the telemetry block when it already read it.)
+                if loss0 is None:
+                    loss0 = float(
+                        np.asarray(losses.addressable_shards[0].data)[0]
+                    )
+                print(
+                    train_log_line(
+                        epoch,
+                        samples,
+                        loader.dataset_len,
+                        batch_idx,
+                        num_batches,
+                        loss0,
+                    )
+                )
+            if runtime is not None:
+                # Step boundary: cadence checkpoint + preemption poll.
+                # May raise SystemExit (emergency save already written).
+                runtime.after_step(state, epoch=epoch, batch_idx=batch_idx)
+            if dry_run:
+                break
+    finally:
+        if runtime is not None:
+            runtime.end_train()
     if telemetry is not None:
         duration = time.perf_counter() - epoch_t0
         sps = samples_recorded / duration if duration > 0 else 0.0
@@ -412,17 +450,70 @@ def _fit_body(
         raise ValueError(
             "--save-state/--resume-state ride the DP paths; drop --tp/--pp"
         )
+    # Resilient-runtime flags (resilience/, docs/ROBUSTNESS.md): validated
+    # here so every caller fails loudly before any device work.  They ride
+    # the per-batch DP paths — the fused whole-run is ONE device call with
+    # no step boundary to checkpoint, guard, or time — and are
+    # single-controller (a rollback/emergency-save decision taken from
+    # per-host loss shards could diverge across processes).
+    ckpt_every = int(getattr(args, "checkpoint_every_steps", 0) or 0)
+    loss_guard_on = bool(getattr(args, "loss_guard", False))
+    step_timeout_s = float(getattr(args, "step_timeout_s", 0) or 0.0)
+    resilience_flags = ckpt_every > 0 or loss_guard_on or step_timeout_s > 0
+    from .serving import faults as _faults
+
+    if bool(getattr(args, "fused", False)) and (
+        _faults.active_sites() & set(_faults.TRAINER_SITES)
+    ):
+        # An armed trainer-site clause can NEVER fire on the fused path
+        # (one device call, no step/data_next/ckpt_save events); letting
+        # the run proceed would be a vacuous green chaos run — exactly
+        # what the grammar's parse-time guards exist to prevent.
+        raise ValueError(
+            "--chaos clauses at trainer sites (step/data_next/ckpt_save) "
+            "need the per-batch step loop; drop --fused"
+        )
+    if resilience_flags:
+        if bool(getattr(args, "fused", False)):
+            raise ValueError(
+                "--checkpoint-every-steps/--loss-guard/--step-timeout-s "
+                "need the per-batch step loop; drop --fused"
+            )
+        if num_model > 1:
+            raise ValueError(
+                "the resilient runtime rides the DP paths; drop --tp/--pp"
+            )
+        if dist.process_count > 1:
+            raise ValueError(
+                "the resilient runtime is single-controller for now "
+                "(rollback/save decisions cannot be taken from per-host "
+                "loss shards); drop the resilience flags on multi-host runs"
+            )
+    if ckpt_every > 0 and not save_state_path:
+        raise ValueError(
+            "--checkpoint-every-steps writes mid-epoch archives to the "
+            "--save-state path; add --save-state PATH"
+        )
     epoch0 = 0
     loaded_state = None
+    resume_extras: dict = {}
     if resume_state_path:
         from .ops.pallas_adadelta import ensure_opt_layout
-        from .utils.checkpoint import load_train_state
+        from .utils.checkpoint import load_latest_train_state
 
+        # load_latest_train_state falls back to the rotated
+        # <path>.prev ONLY when <path> is missing or torn (a trainer
+        # killed inside the checkpoint rotation window) — a final
+        # archive resumes through the identical code path as before.
+        loaded_state, epoch0, resume_extras, resume_used_path = (
+            load_latest_train_state(resume_state_path)
+        )
         # Same silent-divergence hazard as --resume (see
         # _assert_checkpoint_consistent): per-host archive copies must be
-        # identical before replicate_params trusts them.
-        _assert_checkpoint_consistent(resume_state_path)
-        loaded_state, epoch0 = load_train_state(resume_state_path)
+        # identical before replicate_params trusts them.  Checked on the
+        # RESOLVED path so a host that fell back to the rotation while
+        # another did not fails loudly here.
+        _assert_checkpoint_consistent(resume_used_path)
         # The archive's optimizer layout follows the SAVING run's backend/
         # flags; convert to what THIS run executes (a flat TPU archive
         # must not drag a CPU resume into interpret-mode kernels).
@@ -480,6 +571,44 @@ def _fit_body(
     # printed output, emitted after the run completes rather than live.
     # dry-run stays on the per-batch loop (it IS the per-batch smoke test).
     fused = bool(getattr(args, "fused", False)) and not args.dry_run
+    # Mid-epoch archive (resilience/checkpoint.py meta.* extras): the
+    # resumed run re-enters epoch epoch0+1 at the saved batch cursor and
+    # consumes the exact remaining batches.  A final archive carries no
+    # extras and keeps its historical resume semantics untouched.
+    resume_cursor = 0
+    resume_in_progress = int(resume_extras.get("epoch_in_progress", 0))
+    if resume_in_progress:
+        if fused:
+            raise ValueError(
+                f"--resume-state {resume_state_path!r} is a MID-EPOCH "
+                "archive; finishing the epoch needs the per-batch step "
+                "loop — drop --fused (the next end-of-run archive can "
+                "resume fused again)"
+            )
+        if resume_in_progress != epoch0 + 1:
+            raise ValueError(
+                f"--resume-state {resume_state_path!r} is inconsistent: "
+                f"epoch_in_progress={resume_in_progress} but "
+                f"epochs_completed={epoch0}"
+            )
+        resume_cursor = int(resume_extras.get("batch_cursor", 0))
+        saved_seed = resume_extras.get("seed")
+        if saved_seed is not None and int(saved_seed) != int(args.seed):
+            raise ValueError(
+                f"--resume-state {resume_state_path!r} was saved mid-epoch "
+                f"under --seed {int(saved_seed)}; resuming with --seed "
+                f"{int(args.seed)} would replay a DIFFERENT permutation "
+                "from the saved batch cursor — pass the original seed"
+            )
+        saved_gb = resume_extras.get("global_batch")
+        if saved_gb is not None and int(saved_gb) != int(global_batch):
+            raise ValueError(
+                f"--resume-state {resume_state_path!r} was saved mid-epoch "
+                f"at global batch {int(saved_gb)}; this run's "
+                f"{int(global_batch)} re-chunks the epoch and the saved "
+                "batch cursor no longer addresses the same samples — "
+                "match --batch-size and the device count"
+            )
     use_pallas = bool(getattr(args, "pallas_opt", False))
     # --bf16: activations/matmuls at the MXU's native width; params, the
     # Adadelta state, and the log_softmax/NLL tail stay fp32 (models/net.py).
@@ -844,54 +973,153 @@ def _fit_body(
                 conv_impl=conv_impl,
             )
         want_stats = bool(getattr(args, "step_stats", False))
-        for epoch in range(epoch0 + 1, epoch0 + args.epochs + 1):
-            stats = StepStats() if want_stats else None
-            epoch_span = (
-                telemetry.span("epoch", epoch=epoch)
-                if telemetry is not None
-                else contextlib.nullcontext()
+        # Resilient runtime (resilience/, docs/ROBUSTNESS.md): constructed
+        # when a resilience flag is set OR a fault injector is installed
+        # (the 'step' chaos site lives in runtime.run_step); the flagless
+        # no-injector path never builds it and the step loop is untouched.
+        runtime = None
+        if resilience_flags or _faults.active():
+            from .resilience import (
+                LossGuard,
+                MidEpochCheckpointer,
+                PreemptionHandler,
+                ResilientRuntime,
             )
-            with epoch_span:
-                state = train_one_epoch(
-                    step_fn,
-                    state,
-                    train_loader,
-                    epoch,
-                    keys["dropout"],
-                    lr_fn(epoch),
-                    dist,
-                    log_interval=args.log_interval,
-                    dry_run=args.dry_run,
-                    per_rank_batch=args.batch_size,
-                    step_stats=stats,
-                    telemetry=telemetry,
+
+            guard = (
+                LossGuard(
+                    spike_factor=float(getattr(args, "spike_factor", 10.0)),
+                    retry_budget=int(getattr(args, "anomaly_budget", 3)),
+                    lr_backoff=float(getattr(args, "anomaly_lr_backoff", 0.5)),
                 )
-                if stats is not None and dist.is_chief:
-                    print(stats.summary_line(epoch))
-                avg_loss, correct = evaluate(
-                    eval_fn,
-                    eval_variables(state.params, state.batch_stats, syncbn),
-                    test_loader,
-                    dist,
-                    telemetry=telemetry,
+                if loss_guard_on
+                else None
+            )
+            checkpointer = (
+                MidEpochCheckpointer(
+                    save_state_path,
+                    ckpt_every,
+                    seed=int(args.seed),
+                    global_batch=int(global_batch),
+                    registry=obs_registry,
+                    sink=obs_sink,
                 )
-            if telemetry is not None:
-                acc = correct / len(test_set)
-                telemetry.registry.gauge(
-                    "test_accuracy", help="accuracy of the latest eval pass"
-                ).set(acc)
-                telemetry.events.emit(
-                    "eval",
-                    epoch=epoch,
-                    avg_loss=avg_loss,
-                    correct=correct,
-                    accuracy=acc,
+                if ckpt_every > 0
+                else None
+            )
+            preemption = (
+                PreemptionHandler(
+                    grace_s=float(getattr(args, "preempt_grace_s", 30.0))
                 )
-            if timings is not None:
-                acc = correct / len(test_set)
-                timings.setdefault("epoch1_test_accuracy", acc)
-                timings["final_test_accuracy"] = acc
-            # scheduler.step() is implicit: lr_fn(epoch+1) next iteration.
+                if checkpointer is not None
+                else None
+            )
+
+            def _host_state(s):
+                # Archives are always per-leaf (same portability contract
+                # as the end-of-run --save-state write below).
+                if zero:
+                    from .parallel.zero import zero_opt_to_per_leaf
+
+                    s = s._replace(
+                        opt=zero_opt_to_per_leaf(s.opt, s.params, mesh)
+                    )
+                return jax.device_get(s)
+
+            runtime = ResilientRuntime(
+                guard=guard,
+                checkpointer=checkpointer,
+                preemption=preemption,
+                step_timeout_s=step_timeout_s,
+                stall_abort=bool(getattr(args, "stall_abort", False)),
+                prepare=_host_state,
+                global_batch=int(global_batch),
+                steps_total=int(resume_extras.get("steps_total", 0)),
+                samples_total=int(resume_extras.get("samples_total", 0)),
+                registry=obs_registry,
+                sink=obs_sink,
+            ).start()
+        if telemetry is not None and resume_in_progress:
+            # Seed the counters with the archive's totals so the resumed
+            # run's exposition continues the killed run's numbers (the
+            # replayed steps after the checkpoint count again on resume,
+            # exactly as the uninterrupted run would have counted them).
+            base_steps = int(resume_extras.get("steps_total", 0))
+            base_samples = int(resume_extras.get("samples_total", 0))
+            if base_steps:
+                telemetry.registry.counter(
+                    "train_steps_total", help="optimizer steps executed"
+                ).inc(base_steps)
+            if base_samples:
+                telemetry.registry.counter(
+                    "train_samples_total",
+                    help="global training samples consumed",
+                ).inc(base_samples)
+            telemetry.events.emit(
+                "train_resume",
+                epoch=resume_in_progress,
+                batch_cursor=resume_cursor,
+                steps_total=base_steps,
+                archive=resume_used_path,
+            )
+        try:
+            for epoch in range(epoch0 + 1, epoch0 + args.epochs + 1):
+                stats = StepStats() if want_stats else None
+                epoch_span = (
+                    telemetry.span("epoch", epoch=epoch)
+                    if telemetry is not None
+                    else contextlib.nullcontext()
+                )
+                with epoch_span:
+                    state = train_one_epoch(
+                        step_fn,
+                        state,
+                        train_loader,
+                        epoch,
+                        keys["dropout"],
+                        lr_fn(epoch),
+                        dist,
+                        log_interval=args.log_interval,
+                        dry_run=args.dry_run,
+                        per_rank_batch=args.batch_size,
+                        step_stats=stats,
+                        telemetry=telemetry,
+                        runtime=runtime,
+                        # A mid-epoch archive re-enters ITS epoch at the
+                        # saved cursor; every later epoch starts at 0.
+                        start_batch=(
+                            resume_cursor if epoch == epoch0 + 1 else 0
+                        ),
+                    )
+                    if stats is not None and dist.is_chief:
+                        print(stats.summary_line(epoch))
+                    avg_loss, correct = evaluate(
+                        eval_fn,
+                        eval_variables(state.params, state.batch_stats, syncbn),
+                        test_loader,
+                        dist,
+                        telemetry=telemetry,
+                    )
+                if telemetry is not None:
+                    acc = correct / len(test_set)
+                    telemetry.registry.gauge(
+                        "test_accuracy", help="accuracy of the latest eval pass"
+                    ).set(acc)
+                    telemetry.events.emit(
+                        "eval",
+                        epoch=epoch,
+                        avg_loss=avg_loss,
+                        correct=correct,
+                        accuracy=acc,
+                    )
+                if timings is not None:
+                    acc = correct / len(test_set)
+                    timings.setdefault("epoch1_test_accuracy", acc)
+                    timings["final_test_accuracy"] = acc
+                # scheduler.step() is implicit: lr_fn(epoch+1) next iteration.
+        finally:
+            if runtime is not None:
+                runtime.stop()
 
     if getattr(args, "save_model", False) and save_path:
         params_for_save = state.params

@@ -172,13 +172,24 @@ def _atomic_npz_write(flat: Mapping[str, np.ndarray], path: str) -> None:
     _atomic_write(path, write_npz)
 
 
+class CorruptCheckpointError(ValueError):
+    """A checkpoint file that exists but will not parse (truncated/torn).
+
+    Distinct from plain ValueError so the rotated-archive fallback
+    (:func:`load_latest_train_state`) can tell "this FILE is damaged —
+    try the previous rotation" apart from "this is the wrong KIND of
+    file" (a model-only checkpoint fed to ``--resume-state``), which
+    must keep surfacing to the operator, never be silently papered over
+    by an older archive."""
+
+
 def _corrupt_checkpoint_error(path: str, cause: BaseException) -> ValueError:
     """One clear diagnostic for a checkpoint that fails to parse as a
     zip archive — the truncated/torn-file class a killed writer (or a
     pre-atomic-write producer) leaves behind.  Without this, the reader
     surfaces a raw ``zipfile.BadZipFile``/pickle traceback with no hint
     that the FILE, not the code, is the problem."""
-    return ValueError(
+    return CorruptCheckpointError(
         f"{path!r} is corrupt or truncated ({cause}); a checkpoint this "
         "package wrote cannot be torn (mkstemp + fsync + atomic replace), "
         "so this file was likely produced by a killed non-atomic writer "
@@ -186,7 +197,18 @@ def _corrupt_checkpoint_error(path: str, cause: BaseException) -> ValueError:
     )
 
 
-def save_train_state(state, path: str, epoch: int = 0) -> None:
+# Suffix of the previous rotation in the mid-epoch checkpoint scheme
+# (resilience/checkpoint.py): the publish sequence is write-new-to-temp →
+# rotate current to <path> + PREV_SUFFIX → replace temp onto <path>, so a
+# kill at ANY point leaves at least one loadable archive and
+# :func:`load_latest_train_state` knows where to look.
+PREV_SUFFIX = ".prev"
+
+
+def save_train_state(
+    state, path: str, epoch: int = 0,
+    extras: Mapping[str, int] | None = None,
+) -> None:
     """Save the FULL training state — params, Adadelta accumulators
     (either layout: per-leaf pytree or the Pallas kernel's padded-flat
     buffers), step counter, the epochs-completed count, BN running
@@ -200,7 +222,15 @@ def save_train_state(state, path: str, epoch: int = 0) -> None:
     schedule or the epoch-seeded shuffle stream (``epoch`` travels), not
     the per-step dropout streams (``state.step`` travels).  The
     torch-compatible model-only surface remains ``model_state_dict`` +
-    ``save_state_dict``."""
+    ``save_state_dict``.
+
+    ``extras`` (mid-epoch archives only; resilience/checkpoint.py) adds
+    integer bookkeeping under ``meta.*`` keys — epoch-in-progress, batch
+    cursor, data-order seed, telemetry counters — that generalizes the
+    continuation guarantee from epoch boundaries to ARBITRARY steps.  A
+    final (end-of-run) archive passes no extras, so its on-disk format
+    is byte-for-byte the pre-PR-9 one and ``--resume-state`` of a final
+    archive keeps its exact historical semantics."""
     from ..ops.pallas_adadelta import is_flat_state
 
     flat: dict[str, np.ndarray] = {}
@@ -218,6 +248,8 @@ def save_train_state(state, path: str, epoch: int = 0) -> None:
     flat["epoch"] = np.asarray(int(epoch))
     if state.batch_stats:
         flat.update(_flatten_raw(state.batch_stats, "batch_stats."))
+    for key, value in (extras or {}).items():
+        flat[f"meta.{key}"] = np.asarray(int(value), np.int64)
     _atomic_npz_write(flat, path)
 
 
@@ -291,6 +323,15 @@ def load_train_state(path: str):
     epochs_completed)`` — params + optimizer accumulators in their saved
     layout + step + BN stats, plus the epoch counter the continued run's
     schedule/shuffle/logging picks up from."""
+    state, epoch, _ = load_train_state_full(path)
+    return state, epoch
+
+
+def load_train_state_full(path: str):
+    """:func:`load_train_state` plus the archive's ``meta.*`` extras as a
+    plain ``{key: int}`` dict (empty for final/pre-PR-9 archives) — the
+    mid-epoch position (``epoch_in_progress``, ``batch_cursor``, data
+    ``seed``, telemetry counters) the resilient trainer resumes from."""
     from ..ops.adadelta import AdadeltaState
     from ..ops.pallas_adadelta import FlatAdadeltaState
     from ..parallel.ddp import TrainState
@@ -298,6 +339,8 @@ def load_train_state(path: str):
     try:
         with np.load(path) as archive:
             flat = {k: archive[k] for k in archive.files}
+    except FileNotFoundError:
+        raise
     except zipfile.BadZipFile as e:
         raise _corrupt_checkpoint_error(path, e) from e
     except (OSError, ValueError) as e:
@@ -322,13 +365,44 @@ def load_train_state(path: str):
             acc_delta=_unflatten(flat, "opt.acc_delta."),
         )
     batch_stats = _unflatten(flat, "batch_stats.") or ()
+    extras = {
+        k[len("meta."):]: int(np.asarray(v).ravel()[0])
+        for k, v in flat.items()
+        if k.startswith("meta.")
+    }
     import jax.numpy as jnp
 
     state = TrainState(
         params=params, opt=opt, step=jnp.int32(int(flat["step"])),
         batch_stats=batch_stats,
     )
-    return state, int(flat.get("epoch", 0))
+    return state, int(flat.get("epoch", 0)), extras
+
+
+def load_latest_train_state(path: str):
+    """Load ``path`` or, when it is missing/torn, its previous rotation
+    ``path + PREV_SUFFIX`` — the read side of the mid-epoch rotation
+    scheme (resilience/checkpoint.py): a trainer killed BETWEEN the
+    rotate and the publish leaves no ``path``, only the rotated archive,
+    and resume must land there instead of failing.
+
+    Returns ``(TrainState, epochs_completed, extras, used_path)``.
+    Falls back ONLY on ``FileNotFoundError`` / torn-file corruption
+    (:class:`CorruptCheckpointError`); a structurally-wrong file (e.g. a
+    model-only checkpoint) surfaces its own error — an older rotation
+    must never silently mask an operator mistake."""
+    try:
+        state, epoch, extras = load_train_state_full(path)
+        return state, epoch, extras, path
+    except (FileNotFoundError, CorruptCheckpointError) as main_err:
+        prev = path + PREV_SUFFIX
+        if not os.path.exists(prev):
+            raise
+        try:
+            state, epoch, extras = load_train_state_full(prev)
+        except Exception:
+            raise main_err
+        return state, epoch, extras, prev
 
 
 def _is_torch_zip(path: str) -> bool:

@@ -1348,6 +1348,104 @@ def test_jl013_waiver():
 
 
 # ---------------------------------------------------------------------------
+# JL014 — non-atomic / uncadenced checkpoint writes
+
+
+JL014_BAD_RAW_PATH = """\
+import numpy as np
+
+def export(state):
+    np.savez("ckpt.npz", **state)
+"""
+
+JL014_BAD_JOINED_PATH = """\
+import os
+import torch
+
+def export(sd, outdir):
+    torch.save(sd, os.path.join(outdir, "model.pt"))
+"""
+
+JL014_BAD_UNCADENCED_LOOP = """\
+from pytorch_mnist_ddp_tpu.utils.checkpoint import save_train_state
+
+def train(steps, state, path):
+    for step in range(steps):
+        state = update(state)
+        save_train_state(state, path)
+"""
+
+JL014_GOOD_MODULO_CADENCE = """\
+from pytorch_mnist_ddp_tpu.utils.checkpoint import save_train_state
+
+def train(steps, state, path, every):
+    for step in range(steps):
+        state = update(state)
+        if step % every == 0:
+            save_train_state(state, path)
+"""
+
+JL014_GOOD_DUE_GATE = """\
+def train(steps, state, checkpointer):
+    for step in range(steps):
+        state = update(state)
+        if checkpointer.due(step):
+            checkpointer.save(state)
+"""
+
+JL014_GOOD_HELPER_OUTSIDE_LOOP = """\
+from pytorch_mnist_ddp_tpu.utils.checkpoint import save_train_state
+
+def export(state, path):
+    save_train_state(state, path)
+"""
+
+JL014_GOOD_BYTESIO_BUFFER = """\
+import io
+import numpy as np
+
+def pack(flat):
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    return buf.getvalue()
+"""
+
+
+def test_jl014_fires_on_raw_write_to_literal_path():
+    assert_fires(JL014_BAD_RAW_PATH, "JL014", line=4)
+
+
+def test_jl014_fires_on_raw_write_to_joined_path():
+    assert_fires(JL014_BAD_JOINED_PATH, "JL014", line=5)
+
+
+def test_jl014_fires_on_uncadenced_in_loop_helper_write():
+    assert_fires(JL014_BAD_UNCADENCED_LOOP, "JL014", line=6)
+
+
+def test_jl014_silent_on_cadence_guards():
+    # The two sanctioned gates: `step % N` and the checkpointer's
+    # `due()` (resilience/checkpoint.py MidEpochCheckpointer).
+    assert_silent(JL014_GOOD_MODULO_CADENCE, "JL014")
+    assert_silent(JL014_GOOD_DUE_GATE, "JL014")
+
+
+def test_jl014_silent_on_atomic_helper_and_buffer_writes():
+    # The helper outside a loop IS the discipline; a BytesIO destination
+    # is an in-memory stage of the atomic writer, not a final path.
+    assert_silent(JL014_GOOD_HELPER_OUTSIDE_LOOP, "JL014")
+    assert_silent(JL014_GOOD_BYTESIO_BUFFER, "JL014")
+
+
+def test_jl014_waiver():
+    waived = JL014_BAD_RAW_PATH.replace(
+        'np.savez("ckpt.npz", **state)',
+        'np.savez("ckpt.npz", **state)  # jaxlint: disable=JL014 -- one-shot export script, no concurrent reader',
+    )
+    assert_silent(waived, "JL014")
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

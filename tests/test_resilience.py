@@ -1,0 +1,692 @@
+"""Resilient training runtime tests (PR 9, docs/ROBUSTNESS.md trainer
+section): mid-epoch checkpoint/resume bit-exactness at arbitrary kill
+points (including mid-save, landing on the rotated archive), LossGuard
+NaN/spike rollback + budget exhaustion, the hung-step watchdog, data
+retry exhaustion, preemption emergency saves, and the chaos driver.
+
+The acceptance bar everywhere is BIT-exactness, not closeness: a
+resumed (or healed) run's params/opt/step must equal the uninterrupted
+run's array for array, byte for byte."""
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.resilience
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.obs import Registry
+from pytorch_mnist_ddp_tpu.obs.events import read_events
+from pytorch_mnist_ddp_tpu.ops.adadelta import AdadeltaState
+from pytorch_mnist_ddp_tpu.parallel.ddp import TrainState
+from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
+from pytorch_mnist_ddp_tpu.resilience import (
+    AnomalyBudgetExhausted,
+    LossGuard,
+    MidEpochCheckpointer,
+    PreemptionHandler,
+    ResilientRuntime,
+    StepWatchdog,
+)
+from pytorch_mnist_ddp_tpu.serving.faults import (
+    FaultError,
+    FaultSpec,
+    injected,
+)
+from pytorch_mnist_ddp_tpu.trainer import fit
+from pytorch_mnist_ddp_tpu.utils.checkpoint import (
+    CorruptCheckpointError,
+    load_latest_train_state,
+    load_train_state_full,
+    save_train_state,
+)
+
+from test_e2e import _args, _write_idx
+
+
+def _dist(devices):
+    return DistState(
+        distributed=True, process_rank=0, process_count=1,
+        world_size=8, devices=list(devices),
+    )
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def _tiny_state(value=0.0):
+    """A host-side TrainState small enough for file-discipline tests."""
+    params = {"dense": {"kernel": np.full((2, 3), value, np.float32)}}
+    opt = AdadeltaState(
+        square_avg={"dense": {"kernel": np.zeros((2, 3), np.float32)}},
+        acc_delta={"dense": {"kernel": np.zeros((2, 3), np.float32)}},
+    )
+    return TrainState(
+        params=params, opt=opt, step=np.int32(int(value)), batch_stats=()
+    )
+
+
+# ---------------------------------------------------------------------------
+# LossGuard (unit)
+
+
+def test_loss_guard_classifies_nan_inf_spike_and_healthy():
+    guard = LossGuard(spike_factor=10.0)
+    assert guard.classify(np.array([0.5, 0.6])) is None
+    assert guard.classify(np.array([0.5, np.nan])) == "nan"
+    assert guard.classify(np.array([np.inf, 0.1])) == "nan"
+    # No EWMA yet: a huge first loss is NOT a spike (no baseline).
+    assert guard.classify(np.array([1e9])) is None
+    guard.record_healthy(np.array([1.0]))
+    assert guard.classify(np.array([11.0])) == "spike"
+    assert guard.classify(np.array([9.0])) is None
+    # spike_factor=0 disables spike detection entirely.
+    lax = LossGuard(spike_factor=0.0)
+    lax.record_healthy(np.array([1.0]))
+    assert lax.classify(np.array([1e12])) is None
+
+
+def test_loss_guard_ewma_only_fed_by_accepted_steps():
+    guard = LossGuard(spike_factor=2.0, ewma_alpha=1.0)
+    guard.record_healthy(np.array([1.0]))
+    assert guard.classify(np.array([3.0])) == "spike"
+    # The spike was NOT recorded: baseline unchanged, 1.9 still passes.
+    assert guard.classify(np.array([1.9])) is None
+
+
+def test_loss_guard_lr_scale_first_retry_transparent():
+    guard = LossGuard(lr_backoff=0.5)
+    assert guard.lr_scale(1) == 1.0  # transient heals bit-exactly
+    assert guard.lr_scale(2) == 0.5
+    assert guard.lr_scale(3) == 0.25
+
+
+def test_loss_guard_validates_parameters():
+    with pytest.raises(ValueError):
+        LossGuard(retry_budget=0)
+    with pytest.raises(ValueError):
+        LossGuard(lr_backoff=0.0)
+
+
+# ---------------------------------------------------------------------------
+# StepWatchdog (unit)
+
+
+def test_watchdog_fires_once_per_stalled_window():
+    import time
+
+    stalls = []
+    dog = StepWatchdog(0.05, stalls.append, poll_s=0.01).start()
+    try:
+        dog.resume()
+        time.sleep(0.2)  # one stalled window, several polls
+        assert len(stalls) == 1
+        dog.beat()  # new window
+        time.sleep(0.2)
+        assert len(stalls) == 2
+    finally:
+        dog.stop()
+
+
+def test_watchdog_suspended_regions_never_stall():
+    import time
+
+    stalls = []
+    dog = StepWatchdog(0.05, stalls.append, poll_s=0.01).start()
+    try:
+        dog.suspend()  # eval region: no step in flight
+        time.sleep(0.15)
+        assert stalls == []
+        dog.resume()
+        dog.beat()
+        dog.suspend()
+        time.sleep(0.15)
+        assert stalls == []
+    finally:
+        dog.stop()
+
+
+# ---------------------------------------------------------------------------
+# PreemptionHandler (unit)
+
+
+def test_preemption_handler_flags_sigterm_and_restores():
+    prev = signal.getsignal(signal.SIGTERM)
+    handler = PreemptionHandler(grace_s=60.0).install()
+    try:
+        assert not handler.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert handler.requested
+        assert handler.exit_code == 128 + signal.SIGTERM
+    finally:
+        handler.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is prev
+    assert handler._timer is None  # force-exit timer cancelled with it
+
+
+def test_preemption_triggers_emergency_save_and_systemexit(tmp_path):
+    """Runtime-level determinism (no real signals): a requested
+    preemption lands an emergency archive at the next step boundary and
+    raises SystemExit with the 128+signum code."""
+    state_path = str(tmp_path / "state.npz")
+    ckpt = MidEpochCheckpointer(state_path, every_steps=0, seed=1,
+                                global_batch=64)
+    handler = PreemptionHandler(grace_s=60.0)  # not installed: no signals
+    handler.requested = True
+    handler.signum = signal.SIGTERM
+    runtime = ResilientRuntime(checkpointer=ckpt, preemption=handler)
+    with pytest.raises(SystemExit) as exc:
+        runtime.after_step(_tiny_state(3.0), epoch=2, batch_idx=4)
+    assert exc.value.code == 143
+    state, epoch, extras, used = load_latest_train_state(state_path)
+    assert used == state_path
+    assert epoch == 1  # epoch 2 in progress -> 1 completed
+    assert extras["epoch_in_progress"] == 2
+    assert extras["batch_cursor"] == 5
+    assert extras["steps_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# MidEpochCheckpointer + archive format (unit)
+
+
+def test_checkpointer_rotation_keeps_previous_archive(tmp_path):
+    state_path = str(tmp_path / "state.npz")
+    registry = Registry()
+    ckpt = MidEpochCheckpointer(state_path, every_steps=2, seed=1,
+                                global_batch=64, registry=registry)
+    assert not ckpt.due(1) and ckpt.due(2) and not ckpt.due(3) and ckpt.due(4)
+    ckpt.save(_tiny_state(1.0), epoch_in_progress=1, batch_cursor=2,
+              steps_total=2, samples_total=128)
+    ckpt.save(_tiny_state(2.0), epoch_in_progress=1, batch_cursor=4,
+              steps_total=4, samples_total=256)
+    # Latest on <path>, previous rotation on <path>.prev.
+    _, _, extras, used = load_latest_train_state(state_path)
+    assert used == state_path and extras["batch_cursor"] == 4
+    _, _, prev_extras = load_train_state_full(state_path + ".prev")
+    assert prev_extras["batch_cursor"] == 2
+    assert registry.counter(
+        "train_checkpoints_total", reason="periodic"
+    ).value == 2
+
+
+def test_load_latest_falls_back_on_missing_and_corrupt(tmp_path):
+    state_path = str(tmp_path / "state.npz")
+    ckpt = MidEpochCheckpointer(state_path, every_steps=1, seed=1,
+                                global_batch=64)
+    ckpt.save(_tiny_state(1.0), epoch_in_progress=1, batch_cursor=1,
+              steps_total=1, samples_total=64)
+    ckpt.save(_tiny_state(2.0), epoch_in_progress=1, batch_cursor=2,
+              steps_total=2, samples_total=128)
+    # Torn main archive -> the rotation answers.
+    with open(state_path, "wb") as f:
+        f.write(b"PK\x03\x04 torn by a kill")
+    _, _, extras, used = load_latest_train_state(state_path)
+    assert used == state_path + ".prev" and extras["batch_cursor"] == 1
+    # Missing main archive -> the rotation answers.
+    os.remove(state_path)
+    _, _, extras, used = load_latest_train_state(state_path)
+    assert used == state_path + ".prev"
+    # Both gone -> the original error surfaces.
+    os.remove(state_path + ".prev")
+    with pytest.raises(FileNotFoundError):
+        load_latest_train_state(state_path)
+
+
+def test_load_latest_does_not_mask_wrong_archive_kind(tmp_path):
+    """A structurally-wrong file (model-only checkpoint) must surface its
+    own error even when a rotation exists — fallback is for TORN files
+    only, never for operator mistakes."""
+    state_path = str(tmp_path / "state.npz")
+    np.savez(state_path, **{"conv1.weight": np.zeros(3, np.float32)})
+    save_train_state(_tiny_state(1.0), state_path + ".prev", epoch=1)
+    with pytest.raises(ValueError, match="save-state archive") as exc:
+        load_latest_train_state(state_path)
+    assert not isinstance(exc.value, CorruptCheckpointError)
+
+
+def test_midsave_failure_lands_on_rotated_archive(tmp_path):
+    """An injected ckpt_save fault fires INSIDE the rotate->publish
+    window: the failed save leaves no <path> but the previous rotation
+    is complete — exactly what a mid-save kill leaves on disk."""
+    state_path = str(tmp_path / "state.npz")
+    ckpt = MidEpochCheckpointer(state_path, every_steps=1, seed=1,
+                                global_batch=64)
+    ckpt.save(_tiny_state(1.0), epoch_in_progress=1, batch_cursor=1,
+              steps_total=1, samples_total=64)
+    with injected("fail:ckpt_save"):
+        with pytest.raises(FaultError):
+            ckpt.save(_tiny_state(2.0), epoch_in_progress=1, batch_cursor=2,
+                      steps_total=2, samples_total=128)
+    assert not os.path.exists(state_path)
+    _, _, extras, used = load_latest_train_state(state_path)
+    assert used == state_path + ".prev" and extras["batch_cursor"] == 1
+
+
+def test_final_archive_format_unchanged_and_extras_roundtrip(tmp_path):
+    """A final (extras-less) archive carries NO meta.* keys — its format
+    is byte-compatible with pre-PR-9 readers — and an extras archive
+    round-trips every field as ints."""
+    final = str(tmp_path / "final.npz")
+    save_train_state(_tiny_state(1.0), final, epoch=3)
+    with np.load(final) as z:
+        assert not any(k.startswith("meta.") for k in z.files)
+    state, epoch, extras = load_train_state_full(final)
+    assert epoch == 3 and extras == {}
+
+    mid = str(tmp_path / "mid.npz")
+    save_train_state(
+        _tiny_state(1.0), mid, epoch=0,
+        extras={"epoch_in_progress": 1, "batch_cursor": 7, "seed": 5,
+                "global_batch": 64, "steps_total": 7, "samples_total": 448},
+    )
+    _, _, extras = load_train_state_full(mid)
+    assert extras == {"epoch_in_progress": 1, "batch_cursor": 7, "seed": 5,
+                      "global_batch": 64, "steps_total": 7,
+                      "samples_total": 448}
+
+
+# ---------------------------------------------------------------------------
+# Fault grammar: trainer sites + new ops
+
+
+def test_fault_grammar_trainer_sites_and_ops():
+    assert FaultSpec.parse("kill:step:after=7").op == "kill"
+    assert FaultSpec.parse("nan:step:after=5").op == "nan"
+    assert FaultSpec.parse("fail:data_next:count=2").site == "data_next"
+    assert FaultSpec.parse("kill:ckpt_save:after=1").site == "ckpt_save"
+    with pytest.raises(ValueError, match="only meaningful at site 'step'"):
+        FaultSpec.parse("nan:launch")
+    with pytest.raises(ValueError, match="unknown fault op"):
+        FaultSpec.parse("explode:step")
+
+
+def test_fault_grammar_rejects_replica_scoped_trainer_sites():
+    """Trainer sites fire unlabeled, so a replica-scoped clause could
+    never match — reject it at parse time (the aot_load precedent)
+    instead of arming a vacuous green schedule."""
+    for clause in ("kill:step:r0", "fail:data_next:r1", "fail:ckpt_save:r2"):
+        with pytest.raises(ValueError, match="fire unlabeled"):
+            FaultSpec.parse(clause)
+
+
+def test_fault_error_carries_op_and_site():
+    with injected("nan:step"):
+        from pytorch_mnist_ddp_tpu.serving.faults import fault_point
+
+        with pytest.raises(FaultError) as exc:
+            fault_point("step")
+        assert exc.value.op == "nan" and exc.value.site == "step"
+
+
+# ---------------------------------------------------------------------------
+# Data-pipeline retry
+
+
+def _loader(registry=None, sink=None, **kw):
+    from pytorch_mnist_ddp_tpu.data.loader import DataLoader
+
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, (64, 28, 28), dtype=np.uint8)
+    labels = rng.randint(0, 10, 64).astype(np.uint8)
+    return DataLoader(
+        images, labels, 16, mesh=None, shuffle=True, seed=3,
+        prefetch_depth=0, device_place=False,
+        registry=registry, sink=sink, **kw,
+    )
+
+
+def test_data_retry_transient_faults_batches_bit_identical():
+    clean = [tuple(np.asarray(a) for a in b) for b in _loader().epoch(1)]
+    registry = Registry()
+    loader = _loader(registry=registry, data_backoff_s=0.001)
+    with injected("fail:data_next:count=2"):
+        retried = [tuple(np.asarray(a) for a in b) for b in loader.epoch(1)]
+    assert len(retried) == len(clean) == 4
+    for (xa, ya, wa), (xb, yb, wb) in zip(clean, retried):
+        assert np.array_equal(xa, xb)
+        assert np.array_equal(ya, yb)
+        assert np.array_equal(wa, wb)
+    assert registry.counter(
+        "data_retries_total", pipeline="train"
+    ).value == 2
+
+
+def test_data_retry_exhaustion_raises_clear_error():
+    loader = _loader(data_backoff_s=0.001)
+    with injected("fail:data_next:count=inf"):
+        with pytest.raises(RuntimeError, match="after 4 attempt"):
+            list(loader.epoch(1))
+
+
+def test_data_retry_exhaustion_propagates_through_prefetcher():
+    """With the background producer (depth > 0) the exhausted retry must
+    surface on the CONSUMER side, not die silently on the thread."""
+    loader = _loader(data_backoff_s=0.001)
+    loader.prefetch_depth = 2
+    with injected("fail:data_next:count=inf"):
+        with pytest.raises(RuntimeError, match="data pipeline"):
+            list(loader.epoch(1))
+
+
+# ---------------------------------------------------------------------------
+# Guarded step: zero new traces across rollback/retry
+
+
+def test_guard_retry_adds_zero_traces(devices):
+    """An injected-NaN rollback + retry re-enters the SAME compiled step:
+    the sentinel budget of 1 trace survives the whole guarded stream."""
+    from pytorch_mnist_ddp_tpu.analysis import RecompileSentinel
+    from pytorch_mnist_ddp_tpu.parallel.ddp import (
+        make_train_state,
+        make_train_step,
+        replicate_params,
+    )
+    from pytorch_mnist_ddp_tpu.models.net import init_params
+    from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    state = replicate_params(
+        make_train_state(init_params(jax.random.PRNGKey(0))), mesh
+    )
+    step = RecompileSentinel(make_train_step(mesh), max_traces=1)
+    runtime = ResilientRuntime(guard=LossGuard(retry_budget=3))
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(16, 28, 28, 1).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 10, 16).astype(np.int32))
+    w = jnp.ones((16,), jnp.float32)
+    key, lr = jax.random.PRNGKey(1), jnp.float32(1.0)
+    with injected("nan:step:after=1,count=1"):
+        for i in range(3):
+            state, losses, host = runtime.run_step(
+                step, state, x, y, w, key, lr, epoch=1, batch_idx=i,
+            )
+            assert host is not None and np.isfinite(host).all()
+    assert int(state.step) == 3
+    assert step.trace_count() == 1
+    assert runtime.guard.anomalies == 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: kill -> resume bit-exactness
+
+
+def test_midepoch_kill_resume_bit_identical(tmp_path, capsys, devices):
+    """THE tentpole guarantee at one in-process kill point: die mid-epoch
+    (injected step failure), resume from the periodic archive's exact
+    batch cursor, finish — final params/opt/step bit-equal to the
+    uninterrupted run."""
+    root = _write_idx(tmp_path, n_train=256, n_test=128)
+    full = fit(_args(root, batch_size=8, log_interval=10_000_000),
+               _dist(devices))
+
+    state_path = str(tmp_path / "state.npz")
+    args = _args(root, batch_size=8, log_interval=10_000_000)
+    args.save_state = state_path
+    args.checkpoint_every_steps = 2
+    with injected("fail:step:after=3"):
+        with pytest.raises(FaultError):
+            fit(args, _dist(devices))
+    _, epoch0, extras, _ = load_latest_train_state(state_path)
+    assert epoch0 == 0 and extras["epoch_in_progress"] == 1
+    assert extras["batch_cursor"] == 2  # cadence-2 archive before step 3
+
+    args2 = _args(root, batch_size=8, log_interval=10_000_000)
+    args2.resume_state = state_path
+    resumed = fit(args2, _dist(devices))
+    capsys.readouterr()
+    assert _leaves_equal(jax.device_get(resumed.params),
+                         jax.device_get(full.params))
+    assert _leaves_equal(jax.device_get(resumed.opt),
+                         jax.device_get(full.opt))
+    assert int(resumed.step) == int(full.step)
+
+
+@pytest.mark.slow  # 1 baseline + 3 x (kill + resume) full fits
+def test_midepoch_kill_matrix_bit_identical(tmp_path, capsys, devices):
+    """The kill-point matrix over a 2-epoch run: early epoch 1, the
+    epoch boundary's neighborhood, and mid-epoch 2 — every resume lands
+    bit-identical (the chaos driver proves the same with real process
+    kills; this is the in-process fast path)."""
+    root = _write_idx(tmp_path, n_train=256, n_test=128)
+    full = fit(_args(root, batch_size=8, epochs=2, log_interval=10_000_000),
+               _dist(devices))
+    # 4 steps/epoch at global batch 64: kill events 1 (epoch 1 early),
+    # 4 (first step of epoch 2), 6 (mid-epoch 2).
+    for kill_at in (1, 4, 6):
+        state_path = str(tmp_path / f"state_{kill_at}.npz")
+        args = _args(root, batch_size=8, epochs=2, log_interval=10_000_000)
+        args.save_state = state_path
+        args.checkpoint_every_steps = 1
+        with injected(f"fail:step:after={kill_at}"):
+            with pytest.raises(FaultError):
+                fit(args, _dist(devices))
+        _, epoch0, extras, _ = load_latest_train_state(state_path)
+        args2 = _args(root, batch_size=8, epochs=2 - epoch0,
+                      log_interval=10_000_000)
+        args2.resume_state = state_path
+        resumed = fit(args2, _dist(devices))
+        assert _leaves_equal(jax.device_get(resumed.params),
+                             jax.device_get(full.params)), f"kill@{kill_at}"
+        assert _leaves_equal(jax.device_get(resumed.opt),
+                             jax.device_get(full.opt)), f"kill@{kill_at}"
+        assert int(resumed.step) == int(full.step)
+    capsys.readouterr()
+
+
+def test_nan_injection_guarded_run_heals_bit_exact(tmp_path, capsys, devices):
+    """Acceptance: an injected NaN step is rolled back and retried at the
+    original LR — the guarded run's final state is BIT-equal to the
+    clean run's (accuracy +-0 follows a fortiori), with exactly one
+    train_anomalies_total{kind="nan"}."""
+    root = _write_idx(tmp_path, n_train=256, n_test=128)
+    clean = fit(_args(root, batch_size=8, log_interval=10_000_000),
+                _dist(devices))
+    tel = str(tmp_path / "tel")
+    args = _args(root, batch_size=8, log_interval=10_000_000)
+    args.loss_guard = True
+    args.telemetry_dir = tel
+    with injected("nan:step:after=2"):
+        guarded = fit(args, _dist(devices))
+    capsys.readouterr()
+    assert _leaves_equal(jax.device_get(guarded.params),
+                         jax.device_get(clean.params))
+    assert _leaves_equal(jax.device_get(guarded.opt),
+                         jax.device_get(clean.opt))
+    events = read_events(os.path.join(tel, "events-rank0.jsonl"))
+    anomalies = [e for e in events if e["event"] == "train_anomaly"]
+    assert len(anomalies) == 1
+    assert anomalies[0]["kind"] == "nan"
+    assert anomalies[0]["action"] == "retry"
+    prom = open(os.path.join(tel, "metrics.prom")).read()
+    assert 'train_anomalies_total{kind="nan"} 1' in prom
+
+
+def test_anomaly_budget_exhausted_aborts_with_diagnostic(
+    tmp_path, capsys, devices
+):
+    root = _write_idx(tmp_path, n_train=256, n_test=128)
+    args = _args(root, batch_size=8, log_interval=10_000_000)
+    args.loss_guard = True
+    args.anomaly_budget = 2
+    with injected("nan:step:count=inf"):
+        with pytest.raises(AnomalyBudgetExhausted,
+                           match="through 2 rollback-and-retry"):
+            fit(args, _dist(devices))
+    capsys.readouterr()
+
+
+def test_watchdog_reports_injected_hang(tmp_path, capsys, devices):
+    """A hung step (injected pre-dispatch hang) fires train_stall + the
+    counter; without --stall-abort the run still completes."""
+    root = _write_idx(tmp_path, n_train=256, n_test=128)
+    tel = str(tmp_path / "tel")
+    args = _args(root, batch_size=8, log_interval=10_000_000)
+    args.step_timeout_s = 0.25
+    args.telemetry_dir = tel
+    with injected("hang:step:after=2,for=1.5"):
+        fit(args, _dist(devices))
+    capsys.readouterr()
+    events = read_events(os.path.join(tel, "events-rank0.jsonl"))
+    stalls = [e for e in events if e["event"] == "train_stall"]
+    assert stalls, "injected hang fired no train_stall event"
+    prom = open(os.path.join(tel, "metrics.prom")).read()
+    assert "train_stalls_total" in prom
+
+
+def test_stall_abort_flushes_and_exits_via_abort_fn():
+    """The abort path, decoupled from os._exit: the runtime's stall
+    handler flushes the sink then calls the injected abort_fn with
+    EXIT_STALLED."""
+    from pytorch_mnist_ddp_tpu.resilience import EXIT_STALLED
+
+    codes = []
+    runtime = ResilientRuntime(
+        step_timeout_s=10.0, stall_abort=True, abort_fn=codes.append
+    )
+    runtime._on_stall(1.23)
+    assert codes == [EXIT_STALLED]
+
+
+# ---------------------------------------------------------------------------
+# Flag/archive validation + stdout identity
+
+
+def test_resilience_flag_validation(tmp_path, devices):
+    root = _write_idx(tmp_path, n_train=256, n_test=128)
+    args = _args(root, batch_size=8)
+    args.checkpoint_every_steps = 2
+    with pytest.raises(ValueError, match="add --save-state"):
+        fit(args, _dist(devices))
+    args2 = _args(root, batch_size=8, fused=True)
+    args2.loss_guard = True
+    with pytest.raises(ValueError, match="drop --fused"):
+        fit(args2, _dist(devices))
+
+
+def test_fused_rejects_armed_trainer_site_chaos(tmp_path, devices):
+    """A trainer-site chaos clause can never fire on the fused path (one
+    device call, no step events): the run must refuse loudly instead of
+    completing as a vacuous green chaos run."""
+    root = _write_idx(tmp_path, n_train=256, n_test=128)
+    args = _args(root, batch_size=8, fused=True)
+    with injected("kill:step:after=7"):
+        with pytest.raises(ValueError, match="drop --fused"):
+            fit(args, _dist(devices))
+
+
+def test_watchdog_suspended_during_checkpoint_save(tmp_path):
+    """A slow checkpoint write is a suspended region: the watchdog must
+    not report (or --stall-abort a) checkpoint time as a stalled step."""
+    import time
+
+    state_path = str(tmp_path / "state.npz")
+    ckpt = MidEpochCheckpointer(state_path, every_steps=1, seed=1,
+                                global_batch=64)
+    orig_save = ckpt.save
+
+    def slow_save(*a, **k):
+        time.sleep(0.3)  # longer than the step timeout below
+        return orig_save(*a, **k)
+
+    ckpt.save = slow_save
+    runtime = ResilientRuntime(checkpointer=ckpt, step_timeout_s=0.1).start()
+    try:
+        runtime.begin_train()
+        runtime.watchdog.beat()
+        runtime.after_step(_tiny_state(1.0), epoch=1, batch_idx=0)
+        time.sleep(0.05)  # a few poll ticks after the save returned
+        assert runtime.watchdog.stalls == 0
+    finally:
+        runtime.stop()
+    assert os.path.exists(state_path)
+
+
+def test_midepoch_resume_validates_seed_batch_and_fused(tmp_path, devices):
+    """A mid-epoch archive's batch cursor only addresses the permutation
+    it was saved under: seed/global-batch mismatches and --fused are
+    rejected before any device work."""
+    state_path = str(tmp_path / "state.npz")
+    root = _write_idx(tmp_path, n_train=256, n_test=128)
+    save_train_state(
+        _tiny_state(1.0), state_path, epoch=0,
+        extras={"epoch_in_progress": 1, "batch_cursor": 2, "seed": 1,
+                "global_batch": 64, "steps_total": 2, "samples_total": 128},
+    )
+    args = _args(root, batch_size=8, seed=7)
+    args.resume_state = state_path
+    with pytest.raises(ValueError, match="pass the original seed"):
+        fit(args, _dist(devices))
+    args2 = _args(root, batch_size=4)  # global batch 32 != 64
+    args2.resume_state = state_path
+    with pytest.raises(ValueError, match="match --batch-size"):
+        fit(args2, _dist(devices))
+    args3 = _args(root, batch_size=8, fused=True)
+    args3.resume_state = state_path
+    with pytest.raises(ValueError, match="MID-EPOCH"):
+        fit(args3, _dist(devices))
+
+
+def test_flagless_stdout_identical_with_resilience_defaults(
+    tmp_path, capsys, devices
+):
+    """Satellite bugfix pin: (a) a Namespace WITHOUT any of the new
+    attributes and (b) one with every new flag at its default print
+    byte-identical stdout, and (c) an ACTIVE checkpointing run adds no
+    stdout either (archives + telemetry only)."""
+    root = _write_idx(tmp_path, n_train=256, n_test=128)
+    fit(_args(root, batch_size=8), _dist(devices))
+    baseline_out = capsys.readouterr().out
+
+    args = _args(root, batch_size=8)
+    args.checkpoint_every_steps = 0
+    args.preempt_grace_s = 30.0
+    args.loss_guard = False
+    args.spike_factor = 10.0
+    args.anomaly_budget = 3
+    args.anomaly_lr_backoff = 0.5
+    args.step_timeout_s = 0.0
+    args.stall_abort = False
+    args.chaos = None
+    args.chaos_seed = 0
+    fit(args, _dist(devices))
+    assert capsys.readouterr().out == baseline_out
+
+    args_on = _args(root, batch_size=8)
+    args_on.save_state = str(tmp_path / "state.npz")
+    args_on.checkpoint_every_steps = 2
+    fit(args_on, _dist(devices))
+    assert capsys.readouterr().out == baseline_out
+
+
+# ---------------------------------------------------------------------------
+# The chaos driver (subprocess; the CI `chaos-train` job's local twin)
+
+
+@pytest.mark.slow  # 4 subprocess trainer runs through tools/train_chaos.py
+def test_train_chaos_driver_smoke(tmp_path):
+    from conftest import cpu_subprocess_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "train_chaos.py"),
+         "--workdir", str(tmp_path / "chaos"),
+         "--synthetic", "256", "--epochs", "1", "--batch-size", "64",
+         "--checkpoint-every-steps", "2", "--kill-steps", "2",
+         "--nan-step", "1"],
+        capture_output=True, text=True, env=cpu_subprocess_env(),
+        cwd=repo, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS kill@step2" in proc.stdout
+    assert "PASS nan@step1" in proc.stdout

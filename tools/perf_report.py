@@ -352,6 +352,91 @@ def summarize_telemetry(directory: str) -> str | None:
                 f"step share {1 - share:.1%}), mean buffer occupancy "
                 f"{occ:.2f} (depth {evs[-1].get('depth', '?')})"
             )
+    # Training resilience (resilience/, docs/ROBUSTNESS.md trainer
+    # section): anomalies by kind with the retry/abort split, checkpoint
+    # cadence + write durations by reason, stalls, preemptions, resumes,
+    # and input-pipeline retries — the operator's receipt of what the
+    # run survived.
+    anomalies = [e for e in events if e.get("event") == "train_anomaly"]
+    checkpoints = [e for e in events if e.get("event") == "checkpoint"]
+    ckpt_failures = [e for e in events if e.get("event") == "checkpoint_failed"]
+    stalls = [e for e in events if e.get("event") == "train_stall"]
+    resumes = [e for e in events if e.get("event") == "train_resume"]
+    preempts = [e for e in events if e.get("event") == "preempt_exit"]
+    data_retries = [e for e in events if e.get("event") == "data_retry"]
+    if (anomalies or checkpoints or ckpt_failures or stalls or resumes
+            or preempts or data_retries):
+        lines.append(
+            f"  training resilience: {len(anomalies)} anomaly(ies), "
+            f"{len(checkpoints)} checkpoint(s), {len(stalls)} stall(s), "
+            f"{len(resumes)} resume(s), {len(preempts)} preemption(s)"
+        )
+        if anomalies:
+            by_kind: dict[str, int] = {}
+            aborted = 0
+            for e in anomalies:
+                kind = e.get("kind", "?")
+                by_kind[kind] = by_kind.get(kind, 0) + 1
+                if e.get("action") == "abort":
+                    aborted += 1
+            lines.append(
+                "    anomalies by kind: "
+                + ", ".join(
+                    f"{kind} x{n}" for kind, n in sorted(by_kind.items())
+                )
+                + (f"; {aborted} exhausted the retry budget (run aborted)"
+                   if aborted else "; all healed by rollback+retry")
+            )
+        if checkpoints:
+            by_reason: dict[str, list] = {}
+            for e in checkpoints:
+                by_reason.setdefault(e.get("reason", "?"), []).append(e)
+            for reason, es in sorted(by_reason.items()):
+                durs = [e.get("duration_s", 0.0) for e in es]
+                steps = sorted(
+                    e["steps_total"] for e in es if "steps_total" in e
+                )
+                gaps = [b - a for a, b in zip(steps, steps[1:])]
+                cadence = (
+                    f", cadence {sum(gaps) / len(gaps):.1f} step(s)"
+                    if gaps else ""
+                )
+                lines.append(
+                    f"    checkpoints [{reason}]: {len(es)}, mean write "
+                    f"{1e3 * sum(durs) / len(durs):.1f} ms{cadence}"
+                )
+        if ckpt_failures:
+            lines.append(
+                f"    checkpoint failures (survived): {len(ckpt_failures)} "
+                f"(last: {ckpt_failures[-1].get('error', '?')})"
+            )
+        if stalls:
+            ages = [e.get("age_s", 0.0) for e in stalls]
+            lines.append(
+                f"    stalls: {len(stalls)}, max age {max(ages):.2f} s"
+            )
+        for e in resumes:
+            lines.append(
+                f"    resumed: epoch {e.get('epoch', '?')} at batch cursor "
+                f"{e.get('batch_cursor', '?')} from {e.get('archive', '?')}"
+            )
+        for e in preempts:
+            lines.append(
+                f"    preempted: signal {e.get('signum', '?')} at epoch "
+                f"{e.get('epoch', '?')} cursor {e.get('batch_cursor', '?')} "
+                f"(exit {e.get('exit_code', '?')})"
+            )
+        if data_retries:
+            by_pipe: dict[str, int] = {}
+            for e in data_retries:
+                pipe = e.get("pipeline", "?")
+                by_pipe[pipe] = by_pipe.get(pipe, 0) + 1
+            lines.append(
+                "    data retries: "
+                + ", ".join(
+                    f"{pipe} x{n}" for pipe, n in sorted(by_pipe.items())
+                )
+            )
     # Serving pipeline telemetry (serving/batcher.py under --telemetry-dir):
     # per-request latency plus per-batch fill/stall — the operator's view
     # of how well the in-flight window is overlapping.

@@ -1,0 +1,400 @@
+#!/usr/bin/env python3
+"""Trainer chaos harness: kill -> resume -> verify bit-exact (PR 9).
+
+The resilient training runtime's acceptance bar (docs/ROBUSTNESS.md) is
+not "it resumed" but "the resumed run is byte-identical to a run that
+was never killed".  This driver proves it end to end with REAL OS
+processes: it runs an uninterrupted baseline, then for each scheduled
+kill point launches the trainer with deterministic chaos
+(``--chaos kill:step:after=K`` — serving/faults.py's grammar, fired at
+an exact step-event count, so there is no timer race), asserts the
+process died with the SIGKILL-convention code 137, resumes from the
+mid-epoch archive (including the rotated ``.prev`` when the kill landed
+inside the checkpoint publish window), and verifies:
+
+- the resumed run's final ``--save-state`` archive equals the
+  baseline's ARRAY FOR ARRAY, BIT FOR BIT (params, Adadelta
+  accumulators, step counter, BN stats);
+- every (epoch, step) -> loss telemetry event of the killed AND resumed
+  runs matches the baseline's exactly (the loss-curve half of the bar).
+
+Optional rounds: a real SIGTERM preemption (``--preempt-after-s``:
+nondeterministic kill position, same exactness bar — the emergency-save
+path), and a NaN-injection round (``--nan-step``) asserting the
+LossGuard healed the poisoned step with zero numeric divergence and
+exactly one ``train_anomalies_total{kind="nan"}`` in the exposition.
+
+Usage (CI shape — also the local repro):
+
+    python tools/train_chaos.py --workdir /tmp/chaos_train \\
+        --synthetic 768 --epochs 2 --checkpoint-every-steps 3 \\
+        --kill-steps 4,9,save --nan-step 5
+
+Exit 0 when every scheduled round passed; 1 with per-round FAIL lines
+otherwise.  ``save`` in ``--kill-steps`` schedules the mid-save kill
+(``kill:ckpt_save:after=1``: die between the rotation and the publish
+of the second periodic checkpoint).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+EXIT_KILLED = 137    # os._exit at the injected kill point (128+SIGKILL)
+
+
+def _env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Keep any remote-accelerator tunnel out of the subprocesses (same
+    # hygiene as tests/conftest.cpu_subprocess_env).
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def _write_synthetic_idx(root: str, n_train: int, n_test: int) -> None:
+    from pytorch_mnist_ddp_tpu.data.mnist import synthetic_mnist
+
+    os.makedirs(root, exist_ok=True)
+    xi, yi = synthetic_mnist("train", n=n_train)
+    xt, yt = synthetic_mnist("test", n=n_test)
+    for name, arr in (
+        ("train-images-idx3-ubyte", xi), ("train-labels-idx1-ubyte", yi),
+        ("t10k-images-idx3-ubyte", xt), ("t10k-labels-idx1-ubyte", yt),
+    ):
+        with open(os.path.join(root, name), "wb") as f:
+            if arr.ndim == 3:
+                f.write(struct.pack(">iiii", 2051, *arr.shape))
+            else:
+                f.write(struct.pack(">ii", 2049, len(arr)))
+            f.write(arr.tobytes())
+
+
+def _trainer_cmd(args, *, epochs, extra):
+    return [
+        sys.executable, os.path.join(REPO, "mnist.py"), "--no-accel",
+        "--data-root", args.data_root,
+        "--epochs", str(epochs),
+        "--batch-size", str(args.batch_size),
+        "--test-batch-size", str(args.test_batch_size),
+        "--seed", str(args.seed),
+        "--log-interval", "1000000",
+        *extra,
+    ]
+
+
+def _run(cmd, *, cwd=REPO, check_code=None, label=""):
+    proc = subprocess.run(
+        cmd, cwd=cwd, env=_env(), capture_output=True, text=True
+    )
+    if check_code is not None and proc.returncode != check_code:
+        raise RuntimeError(
+            f"{label}: expected exit {check_code}, got {proc.returncode}\n"
+            f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-2000:]}"
+        )
+    return proc
+
+
+def _step_losses(tel_dir: str) -> dict[tuple[int, int], float]:
+    from pytorch_mnist_ddp_tpu.obs.events import read_events
+
+    out: dict[tuple[int, int], float] = {}
+    for path in sorted(glob.glob(os.path.join(tel_dir, "*.jsonl"))):
+        for e in read_events(path):
+            if e.get("event") == "step":
+                out[(e["epoch"], e["step"])] = e["loss"]
+    return out
+
+
+def _archive_arrays(path: str) -> dict:
+    import numpy as np
+
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files if not k.startswith("meta.")}
+
+
+def _archives_bit_equal(a: str, b: str) -> list[str]:
+    """[] when bit-identical; else human-readable mismatch lines."""
+    import numpy as np
+
+    za, zb = _archive_arrays(a), _archive_arrays(b)
+    problems = []
+    if set(za) != set(zb):
+        problems.append(
+            f"key sets differ: only-in-{a}: {sorted(set(za) - set(zb))}, "
+            f"only-in-{b}: {sorted(set(zb) - set(za))}"
+        )
+    for k in sorted(set(za) & set(zb)):
+        va, vb = za[k], zb[k]
+        if va.dtype != vb.dtype or va.shape != vb.shape:
+            problems.append(f"{k}: {va.dtype}{va.shape} vs {vb.dtype}{vb.shape}")
+        elif va.tobytes() != vb.tobytes():
+            diff = np.max(np.abs(va.astype(np.float64) - vb.astype(np.float64)))
+            problems.append(f"{k}: bytes differ (max |delta| {diff:g})")
+    return problems
+
+
+def _curve_subset_of(sub: dict, base: dict, label: str) -> list[str]:
+    problems = []
+    for key, loss in sorted(sub.items()):
+        if key not in base:
+            problems.append(f"{label}: step {key} not in baseline curve")
+        elif not (loss == base[key] or (loss != loss and base[key] != base[key])):
+            problems.append(
+                f"{label}: loss at {key} = {loss!r} != baseline {base[key]!r}"
+            )
+    return problems
+
+
+def _epochs_completed(state_path: str) -> int | None:
+    """Epochs completed per the archive (or its rotation); None when no
+    archive survived (kill before the first cadence) — resume is then a
+    fresh start, which reproduces the baseline from the same seed."""
+    import numpy as np
+
+    for candidate in (state_path, state_path + ".prev"):
+        try:
+            with np.load(candidate) as z:
+                if "epoch" in z.files:
+                    return int(z["epoch"])
+        except Exception:
+            continue
+    return None
+
+
+def _kill_round(args, name: str, chaos: str, results: list) -> None:
+    rd = os.path.join(args.workdir, name)
+    os.makedirs(rd, exist_ok=True)
+    state = os.path.join(rd, "state.npz")
+    final = os.path.join(rd, "final.npz")
+    tel_killed = os.path.join(rd, "tel_killed")
+    tel_resumed = os.path.join(rd, "tel_resumed")
+
+    _run(
+        _trainer_cmd(args, epochs=args.epochs, extra=[
+            "--chaos", chaos,
+            "--checkpoint-every-steps", str(args.checkpoint_every_steps),
+            "--save-state", state,
+            "--telemetry-dir", tel_killed,
+        ]),
+        check_code=EXIT_KILLED, label=f"{name}: killed run",
+    )
+    if "ckpt_save" in chaos:
+        # The mid-save kill must land INSIDE the publish window: no
+        # <state>, a complete rotation at <state>.prev — the archive the
+        # resume is about to prove loadable.
+        if os.path.exists(state) or not os.path.exists(state + ".prev"):
+            results.append((name, [
+                "mid-save kill did not land in the rotation window "
+                f"(state exists={os.path.exists(state)}, "
+                f"prev exists={os.path.exists(state + '.prev')})"
+            ]))
+            return
+    done = _epochs_completed(state)
+    if done is None:
+        # Killed before the first cadence: nothing to resume, rerun from
+        # scratch — same seed, same run.
+        resume_extra = []
+        epochs = args.epochs
+    else:
+        resume_extra = ["--resume-state", state]
+        epochs = args.epochs - done
+    _run(
+        _trainer_cmd(args, epochs=epochs, extra=[
+            *resume_extra,
+            "--save-state", final,
+            "--telemetry-dir", tel_resumed,
+        ]),
+        check_code=0, label=f"{name}: resumed run",
+    )
+    problems = _archives_bit_equal(final, args.baseline_final)
+    base_curve = _step_losses(args.baseline_tel)
+    problems += _curve_subset_of(
+        _step_losses(tel_killed), base_curve, "killed-run curve"
+    )
+    problems += _curve_subset_of(
+        _step_losses(tel_resumed), base_curve, "resumed-run curve"
+    )
+    results.append((name, problems))
+
+
+def _preempt_round(args, results: list) -> None:
+    name = f"preempt@{args.preempt_after_s:g}s"
+    rd = os.path.join(args.workdir, "preempt")
+    os.makedirs(rd, exist_ok=True)
+    state = os.path.join(rd, "state.npz")
+    final = os.path.join(rd, "final.npz")
+    tel_resumed = os.path.join(rd, "tel_resumed")
+    proc = subprocess.Popen(
+        _trainer_cmd(args, epochs=args.epochs, extra=[
+            "--checkpoint-every-steps", str(args.checkpoint_every_steps),
+            "--save-state", state,
+        ]),
+        cwd=REPO, env=_env(),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    time.sleep(args.preempt_after_s)
+    proc.send_signal(signal.SIGTERM)
+    code = proc.wait(timeout=120)
+    if code == 0:
+        print(f"  note: {name}: run finished before the SIGTERM landed; "
+              "verifying its own final archive instead")
+        results.append((name, _archives_bit_equal(state, args.baseline_final)))
+        return
+    if code != 128 + signal.SIGTERM:
+        results.append((name, [
+            f"expected exit {128 + signal.SIGTERM} (emergency save + clean "
+            f"exit) or 0, got {code}"
+        ]))
+        return
+    done = _epochs_completed(state)
+    if done is None:
+        results.append((name, ["SIGTERM landed but no archive was written"]))
+        return
+    _run(
+        _trainer_cmd(args, epochs=args.epochs - done, extra=[
+            "--resume-state", state,
+            "--save-state", final,
+            "--telemetry-dir", tel_resumed,
+        ]),
+        check_code=0, label=f"{name}: resumed run",
+    )
+    problems = _archives_bit_equal(final, args.baseline_final)
+    problems += _curve_subset_of(
+        _step_losses(tel_resumed), _step_losses(args.baseline_tel),
+        "resumed-run curve",
+    )
+    results.append((name, problems))
+
+
+def _nan_round(args, results: list) -> None:
+    name = f"nan@step{args.nan_step}"
+    rd = os.path.join(args.workdir, "nan")
+    os.makedirs(rd, exist_ok=True)
+    final = os.path.join(rd, "final.npz")
+    tel = os.path.join(rd, "tel")
+    _run(
+        _trainer_cmd(args, epochs=args.epochs, extra=[
+            "--chaos", f"nan:step:after={args.nan_step}",
+            "--loss-guard",
+            "--save-state", final,
+            "--telemetry-dir", tel,
+        ]),
+        check_code=0, label=f"{name}: guarded run",
+    )
+    problems = _archives_bit_equal(final, args.baseline_final)
+    prom_path = os.path.join(tel, "metrics.prom")
+    try:
+        prom = open(prom_path).read()
+    except OSError:
+        prom = ""
+    if 'train_anomalies_total{kind="nan"} 1' not in prom:
+        problems.append(
+            f"{prom_path}: expected exactly one "
+            'train_anomalies_total{kind="nan"}; got: '
+            + repr([l for l in prom.splitlines() if "anomal" in l])
+        )
+    results.append((name, problems))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(
+        description="trainer chaos harness: kill -> resume -> verify "
+        "bit-exact params + loss curve"
+    )
+    p.add_argument("--workdir", default=None,
+                   help="scratch directory (default: a fresh temp dir)")
+    p.add_argument("--data-root", default=None,
+                   help="MNIST IDX directory (default: generate --synthetic)")
+    p.add_argument("--synthetic", type=int, default=768, metavar="N",
+                   help="generate an N-sample synthetic train set "
+                        "(N//3 test) when no --data-root (default: 768)")
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--test-batch-size", type=int, default=256)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--checkpoint-every-steps", type=int, default=3)
+    p.add_argument("--kill-steps", default="4,9,save",
+                   help="comma list of deterministic kill points: step-event "
+                        "counts and/or 'save' (mid-checkpoint-publish kill); "
+                        "default: 4,9,save")
+    p.add_argument("--preempt-after-s", type=float, default=0.0, metavar="T",
+                   help="also run a real-SIGTERM preemption round T seconds "
+                        "into the run (0 = skip; timing-dependent by design)")
+    p.add_argument("--nan-step", type=int, default=5, metavar="K",
+                   help="NaN-injection round: poison step K under "
+                        "--loss-guard and require a bit-exact heal "
+                        "(-1 = skip; default: 5)")
+    args = p.parse_args()
+
+    if args.workdir is None:
+        import tempfile
+
+        args.workdir = tempfile.mkdtemp(prefix="train_chaos_")
+    os.makedirs(args.workdir, exist_ok=True)
+    if args.data_root is None:
+        args.data_root = os.path.join(args.workdir, "data")
+        _write_synthetic_idx(args.data_root, args.synthetic,
+                             max(args.synthetic // 3, args.test_batch_size))
+    print(f"train_chaos: workdir {args.workdir}, data {args.data_root}")
+
+    base_dir = os.path.join(args.workdir, "baseline")
+    os.makedirs(base_dir, exist_ok=True)
+    args.baseline_final = os.path.join(base_dir, "final.npz")
+    args.baseline_tel = os.path.join(base_dir, "tel")
+    t0 = time.perf_counter()
+    _run(
+        _trainer_cmd(args, epochs=args.epochs, extra=[
+            "--save-state", args.baseline_final,
+            "--telemetry-dir", args.baseline_tel,
+        ]),
+        check_code=0, label="baseline run",
+    )
+    n_steps = len(_step_losses(args.baseline_tel))
+    print(f"  baseline: {args.epochs} epoch(s), {n_steps} steps "
+          f"({time.perf_counter() - t0:.1f} s)")
+
+    results: list[tuple[str, list[str]]] = []
+    for spec in [s.strip() for s in args.kill_steps.split(",") if s.strip()]:
+        if spec == "save":
+            _kill_round(args, "kill@ckpt_save", "kill:ckpt_save:after=1",
+                        results)
+        else:
+            k = int(spec)
+            if not 0 <= k < n_steps:
+                print(f"  note: kill step {k} outside the run's "
+                      f"{n_steps} steps; it would never fire — skipping")
+                continue
+            _kill_round(args, f"kill@step{k}", f"kill:step:after={k}", results)
+    if args.preempt_after_s > 0:
+        _preempt_round(args, results)
+    if args.nan_step >= 0:
+        _nan_round(args, results)
+
+    failed = False
+    for name, problems in results:
+        if problems:
+            failed = True
+            print(f"FAIL {name}:")
+            for line in problems:
+                print(f"    {line}")
+        else:
+            print(f"PASS {name}: resumed run bit-identical to baseline")
+    if not results:
+        print("train_chaos: nothing ran (empty schedule?)")
+        return 1
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
