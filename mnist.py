@@ -178,6 +178,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "resume/verify schedules through this flag")
     p.add_argument("--chaos-seed", type=int, default=0, metavar="S",
                    help="seed for probabilistic (p=) chaos triggers")
+    # Elastic distributed runtime (parallel/elastic.py + launch.py,
+    # docs/ROBUSTNESS.md elastic section).
+    p.add_argument("--elastic", action="store_true", default=False,
+                   help="elastic-restart contract: when the --save-state "
+                        "archive already exists, resume from it and read "
+                        "--epochs as the TOTAL target (the supervising "
+                        "launcher's gang restarts get this automatically "
+                        "via ELASTIC_RESTART_COUNT)")
+    p.add_argument("--resume-reshard", action="store_true", default=False,
+                   help="accept a mid-epoch archive saved at a DIFFERENT "
+                        "world size: same seed + global batch consume the "
+                        "exact same global batches over the new rank "
+                        "count (sampler contract) — a sample-exact "
+                        "continuation with FP-level drift (reductions "
+                        "re-associate), not bit-equality; without this "
+                        "flag the world-fingerprint mismatch is refused")
     return p
 
 

@@ -35,6 +35,14 @@ def main() -> None:
                    help="number of processes (env WORLD_SIZE wins)")
     p.add_argument("--dist-url", type=str, default="env://",
                    help="rendezvous URL for multi-host init")
+    p.add_argument("--rdzv-timeout-s", type=float, default=None, metavar="S",
+                   help="total rendezvous budget: world formation fails "
+                        "with a pointed diagnostic instead of hanging past "
+                        "it (default: the launcher's RDZV_TIMEOUT_S env, "
+                        "else 60)")
+    p.add_argument("--rdzv-attempts", type=int, default=None, metavar="K",
+                   help="bounded rendezvous attempts within the budget "
+                        "(default: RDZV_ATTEMPTS env, else 2)")
     # Beyond-parity parallelism over the mesh's model axis (the reference
     # is DP-only; its README only *mentions* model parallelism, README.md:8).
     p.add_argument("--tp", type=int, default=1, metavar="N",
@@ -73,7 +81,11 @@ def main() -> None:
     # underscore; reference mnist_ddp.py:193-197, SURVEY.md §3.5).
     run_cli(
         args,
-        dist_factory=lambda: init_distributed_mode(dist_url=args.dist_url),
+        dist_factory=lambda: init_distributed_mode(
+            dist_url=args.dist_url,
+            rdzv_timeout_s=args.rdzv_timeout_s,
+            rdzv_attempts=args.rdzv_attempts,
+        ),
         save_path_factory=lambda dist: (
             "mnist_cnn.pt" if dist.distributed else "mnist_cnn_.pt"
         ),

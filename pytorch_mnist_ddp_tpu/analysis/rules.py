@@ -1,12 +1,13 @@
-"""The jaxlint rule set: JL001–JL014, the JAX hazards this repo has
+"""The jaxlint rule set: JL001–JL015, the JAX hazards this repo has
 actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, the
 serving layer's per-request-shape retrace class, the telemetry layer's
 record-at-trace-time class, the serving pipeline's
 blocking-read-in-dispatch-loop class, the startup phase's serial-warmup
 class, the steady-state input pipeline's host-blocking-feed class, the
 replica pool's per-replica-re-trace class, the fault-tolerance
-layer's swallowed-dispatch-error class, and the resilient trainer's
-torn-file / uncadenced-checkpoint-write class).
+layer's swallowed-dispatch-error class, the resilient trainer's
+torn-file / uncadenced-checkpoint-write class, and the elastic
+runtime's unbounded-rendezvous / unsupervised-launch class).
 
 Every rule is a heuristic over one module's AST — no type inference, no
 cross-file call graph.  "Traced context" below means: a function that is
@@ -1833,6 +1834,188 @@ class CheckpointWriteRule(Rule):
                 )
 
 
+# ---------------------------------------------------------------------------
+# JL015 — unbounded rendezvous / unsupervised training-script launches
+
+
+# Spellings of the multi-process world-formation entry point.  Matched on
+# the dotted tail so both `jax.distributed.initialize(...)` and a
+# `from jax import distributed; distributed.initialize(...)` resolve.
+_RDZV_TAILS = ("distributed.initialize",)
+
+# Launch calls the rule polices: the blocking and the supervisable
+# spawn.  `subprocess.run` is deliberately absent — the repo's bench
+# probes use it for short-lived device checks with their own timeouts,
+# which is not the launcher shape.
+_LAUNCH_CALLS = {"subprocess.call", "subprocess.Popen", "Popen"}
+
+
+class ElasticLaunchRule(Rule):
+    """JL015: a world-formation or process-launch call with no failure
+    story — the two hazards the elastic runtime exists to remove
+    (docs/ROBUSTNESS.md elastic section).
+
+    (a) **Unbounded rendezvous**: a bare ``jax.distributed.initialize(...)``
+    with no ``initialization_timeout`` argument and no surrounding
+    bounded-retry shape (a ``for ... in range(...)`` loop) inherits
+    jax's 300-second near-hang — one dead or late rank wedges the whole
+    gang with zero diagnostics.  Route through
+    ``parallel/distributed.initialize_with_retry`` (bounded attempts
+    inside ``--rdzv-timeout-s``, a who-is-missing error) or at least
+    pass the timeout.
+
+    (b) **Unsupervised launch**: a ``subprocess.call``/``Popen`` of a
+    Python script (``sys.executable`` or a ``*.py`` argument) in a
+    module with NO signal handling anywhere (no ``signal`` usage at
+    all).  A SIGTERM to such a launcher orphans the child — silently
+    defeating the trainer's ``--preempt-grace-s`` emergency save — and
+    a dead child is never detected, restarted, or even reported.
+    Launcher-shaped modules must forward signals and supervise
+    (``parallel/elastic.GangSupervisor``); one-shot probe drivers that
+    deliberately fire-and-collect are waived inline with a reason.
+
+    Heuristics: (a) fires on any call whose dotted name ends with
+    ``distributed.initialize``, lacking an ``initialization_timeout``
+    keyword, unless a lexically enclosing ``for`` iterates a literal
+    ``range(...)`` (the bounded-retry idiom).  (b) fires on a
+    ``subprocess.call``/``subprocess.Popen``/``Popen`` call whose first
+    argument is a list containing ``sys.executable`` or a string
+    constant ending ``.py``, in a module that never references the name
+    ``signal`` (import, attribute, or call) — referencing it at all is
+    taken as "this module thought about signals".
+    """
+
+    rule_id = "JL015"
+    severity = Severity.WARNING
+    summary = "unbounded rendezvous or unsupervised training-script launch"
+
+    @staticmethod
+    def _is_initialize(node: ast.Call) -> bool:
+        name = dotted_name(node.func)
+        return name is not None and any(
+            name == tail or name.endswith("." + tail) for tail in _RDZV_TAILS
+        )
+
+    @staticmethod
+    def _in_bounded_retry(node: ast.AST, parents: dict) -> bool:
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.For) and (
+                isinstance(cur.iter, ast.Call)
+                and dotted_name(cur.iter.func) in {"range", "builtins.range"}
+            ):
+                return True
+            cur = parents.get(cur)
+        return False
+
+    @staticmethod
+    def _is_script_cmd(cmd: ast.AST, script_names: set[str]) -> bool:
+        if isinstance(cmd, ast.Name) and cmd.id in script_names:
+            return True  # cmd = [sys.executable, ...] assembled earlier
+        elements: list[ast.AST] = []
+        if isinstance(cmd, (ast.List, ast.Tuple)):
+            elements = list(cmd.elts)
+            for el in cmd.elts:
+                if isinstance(el, ast.Starred):
+                    elements.append(el.value)
+        else:
+            elements = [cmd]
+        for el in elements:
+            if dotted_name(el) == "sys.executable":
+                return True
+            if (isinstance(el, ast.Constant) and isinstance(el.value, str)
+                    and el.value.endswith(".py")):
+                return True
+        return False
+
+    @classmethod
+    def _script_cmd_names(cls, tree: ast.Module) -> set[str]:
+        """Names bound (anywhere in the module) to a list/tuple literal
+        containing ``sys.executable`` or a ``*.py`` constant — the
+        ``cmd = [sys.executable, script, ...]`` idiom the original
+        unsupervised launcher used."""
+        names: set[str] = set()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, (ast.List, ast.Tuple))
+                    and cls._is_script_cmd(node.value, set())):
+                names.add(node.targets[0].id)
+        return names
+
+    @classmethod
+    def _is_script_launch(cls, node: ast.Call, script_names: set[str]) -> bool:
+        name = dotted_name(node.func)
+        if name not in _LAUNCH_CALLS:
+            return False
+        if not node.args:
+            return False
+        return cls._is_script_cmd(node.args[0], script_names)
+
+    @staticmethod
+    def _module_handles_signals(tree: ast.Module) -> bool:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name) and node.id in (
+                "signal", "GangSupervisor",
+            ):
+                # Referencing `signal` means "this module thought about
+                # signals"; referencing GangSupervisor means the spawns
+                # are routed through the supervised launcher, which
+                # forwards signals by construction.
+                return True
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                names = [a.name for a in node.names]
+                if "signal" in names or getattr(node, "module", None) == "signal":
+                    return True
+                if "GangSupervisor" in names:
+                    return True
+            if isinstance(node, ast.Attribute) and node.attr in (
+                "send_signal", "install_signals",
+            ):
+                return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        signal_aware = self._module_handles_signals(ctx.tree)
+        script_names = self._script_cmd_names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._is_initialize(node):
+                has_timeout = any(
+                    kw.arg == "initialization_timeout" for kw in node.keywords
+                )
+                if not has_timeout and not self._in_bounded_retry(
+                    node, parents
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        "jax.distributed.initialize(...) with no "
+                        "initialization_timeout and no bounded-retry shape: "
+                        "one dead or late rank hangs the gang for jax's "
+                        "default 300 s with zero diagnostics; route through "
+                        "parallel/distributed.initialize_with_retry "
+                        "(bounded attempts inside --rdzv-timeout-s, a "
+                        "who-is-missing error) or pass the timeout",
+                    )
+            elif self._is_script_launch(node, script_names) and not signal_aware:
+                yield self.finding(
+                    ctx, node,
+                    f"{dotted_name(node.func)}(...) launches a Python "
+                    "script from a module with no signal handling: SIGTERM "
+                    "to this launcher orphans the child (silently defeating "
+                    "the trainer's emergency-save path) and a dead child is "
+                    "never detected or restarted; forward signals and "
+                    "supervise (parallel/elastic.GangSupervisor, "
+                    "parallel/launch.py)",
+                )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -1848,6 +2031,7 @@ ALL_RULES: tuple[Rule, ...] = (
     EngineLoopRule(),
     SwallowedDispatchErrorRule(),
     CheckpointWriteRule(),
+    ElasticLaunchRule(),
 )
 
 

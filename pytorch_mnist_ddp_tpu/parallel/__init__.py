@@ -35,7 +35,12 @@ from .zero import (
     shard_zero_state,
     zero_opt_to_per_leaf,
 )
-from .distributed import init_distributed_mode, DistState
+from .distributed import (
+    DistState,
+    init_distributed_mode,
+    initialize_with_retry,
+)
+from .elastic import EXIT_GANG, GangSupervisor, RankHeartbeat
 from .ddp import (
     TrainState,
     eval_variables,

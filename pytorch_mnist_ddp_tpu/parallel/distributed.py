@@ -27,6 +27,7 @@ GPU, while a JAX process drives EVERY local chip (SPMD).  So:
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass, field
 
 import jax
@@ -45,6 +46,9 @@ class DistState:
     local_rank: int = 0
     devices: list = field(default_factory=list)
     dist_url: str = "env://"
+    # Rendezvous attempts it took to form this world (0 = no rendezvous
+    # ran); fit() exports it as rendezvous_attempts_total.
+    rendezvous_attempts: int = 0
 
     @property
     def is_chief(self) -> bool:
@@ -63,19 +67,216 @@ def _coordinator_address(dist_url: str) -> str | None:
     port = os.environ.get("MASTER_PORT")
     if addr and port:
         return f"{addr}:{port}"
+    if addr or port:
+        # Satellite fix (ISSUE 10): half an env:// address used to fall
+        # through to coordinator_address=None — jax then guesses or the
+        # rendezvous hangs, neither of which names the operator's actual
+        # mistake.  One pointed error, naming the MISSING variable.
+        missing = "MASTER_PORT" if addr else "MASTER_ADDR"
+        present = "MASTER_ADDR" if addr else "MASTER_PORT"
+        raise ValueError(
+            f"{present} is set but {missing} is not: the env:// rendezvous "
+            f"needs both — export {missing} (the launcher sets the pair "
+            "from --master_addr/--master_port)"
+        )
     return None
+
+
+def initialize_with_retry(
+    coordinator_address: str | None,
+    num_processes: int,
+    process_id: int,
+    timeout_s: float = 60.0,
+    attempts: int = 2,
+    backoff_s: float = 1.0,
+    initialize_fn=None,
+    sink=None,
+) -> int:
+    """``jax.distributed.initialize`` under a BOUNDED total budget:
+    ``attempts`` tries splitting ``timeout_s`` between them (so the call
+    fails within the budget regardless of the attempt count), retry
+    backoff between tries, and a pointed who-is-missing diagnostic
+    instead of jax's default 300-second near-hang.
+
+    Returns the number of attempts used.  ``initialize_fn`` and
+    ``sink`` are injectable for tests (a fake initializer / an event
+    sink receiving ``rendezvous_retry`` + final ``rendezvous`` events).
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    # Probe the coordinator socket before the real initialize on
+    # non-coordinator ranks: jax's distributed client LOG(FATAL)s the
+    # whole process when the coordinator never answers (client.h
+    # "Terminating process...") — un-catchable, un-diagnosable.  A
+    # bounded TCP probe turns "coordinator absent" into a Python
+    # exception the retry ladder and the pointed terminal error can
+    # own.  Injected initializers (tests) skip it.
+    probe = initialize_fn is None and process_id != 0
+    if initialize_fn is None:
+        initialize_fn = jax.distributed.initialize
+    # Per-attempt timeout: the TOTAL rendezvous budget is timeout_s (the
+    # --rdzv-timeout-s contract — "fails within", not "times that").
+    # Every leg — probe, initialize, backoff — is clamped against one
+    # shared deadline, so probe+initialize cannot stack to 2x the
+    # budget and backoffs cannot extend past it (worst-case slop is
+    # the ~1 s minimum window each leg is guaranteed).
+    per_attempt = max(1, int(timeout_s / attempts))
+    deadline = time.monotonic() + float(timeout_s)
+
+    def _window() -> int:
+        return max(1, min(per_attempt, int(deadline - time.monotonic())))
+
+    last_err: Exception | None = None
+    for attempt in range(1, attempts + 1):
+        if attempt > 1 and time.monotonic() >= deadline:
+            break  # budget spent; fail now with the terminal diagnostic
+        try:
+            if probe and coordinator_address:
+                _await_coordinator(coordinator_address, _window())
+            initialize_fn(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                initialization_timeout=_window(),
+            )
+            if sink is not None:
+                sink.emit(
+                    "rendezvous",
+                    attempts=attempt,
+                    ok=True,
+                    coordinator=coordinator_address,
+                    rank=process_id,
+                    world=num_processes,
+                )
+            return attempt
+        except Exception as e:  # jax raises RuntimeError/XlaRuntimeError
+            last_err = e
+            # A failed attempt can leave the client half-initialized;
+            # tear it down so the retry starts clean.
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            if attempt < attempts:
+                if sink is not None:
+                    sink.emit(
+                        "rendezvous_retry",
+                        attempt=attempt,
+                        timeout_s=per_attempt,
+                        coordinator=coordinator_address,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                time.sleep(
+                    min(
+                        backoff_s * (2 ** (attempt - 1)),
+                        max(0.0, deadline - time.monotonic()),
+                    )
+                )
+    if sink is not None:
+        sink.emit(
+            "rendezvous",
+            attempts=attempts,
+            ok=False,
+            coordinator=coordinator_address,
+            rank=process_id,
+            world=num_processes,
+        )
+    raise RuntimeError(
+        f"rendezvous at {coordinator_address!r} failed after {attempts} "
+        f"attempt(s) x {per_attempt}s (budget {timeout_s:g}s) as process "
+        f"{process_id} of {num_processes}: a peer never arrived — check "
+        f"that every rank 0..{num_processes - 1} is running and that "
+        "MASTER_ADDR/MASTER_PORT match on every host "
+        f"(last error: {type(last_err).__name__}: {last_err})"
+    ) from last_err
+
+
+def _await_coordinator(coordinator_address: str, timeout_s: float) -> None:
+    """Wait (bounded) for the coordinator's TCP socket to accept; raise
+    a catchable ConnectionError when it never does within the window."""
+    import socket
+
+    host, _, port = coordinator_address.rpartition(":")
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            with socket.create_connection((host, int(port)), timeout=1.0):
+                return
+        except OSError as e:
+            if time.monotonic() >= deadline:
+                raise ConnectionError(
+                    f"coordinator {coordinator_address} not accepting "
+                    f"connections within {timeout_s:g}s"
+                ) from e
+            time.sleep(0.2)
+
+
+def _enable_cpu_collectives() -> None:
+    """Select the gloo cross-process collectives implementation for the
+    CPU client.  The pinned jaxlib SHIPS gloo but defaults to 'none',
+    so a multi-rank CPU gang formed without this dies at its first
+    psum with "Multiprocess computations aren't implemented on the CPU
+    backend" — after a clean-looking rendezvous.  Must run before the
+    backend initializes (same ordering constraint as the rendezvous
+    itself); harmless on accelerator platforms (it only parameterizes
+    CPU client creation) and on jax builds without the option."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+
+
+def _distributed_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` where it exists (newer jax);
+    the pinned 0.4.x image predates it, so fall back to probing the
+    distributed client state directly — the old direct call raised
+    AttributeError on EVERY multi-process launch here."""
+    checker = getattr(jax.distributed, "is_initialized", None)
+    if checker is not None:
+        return bool(checker())
+    try:
+        from jax._src import distributed as _dist_src
+
+        return _dist_src.global_state.client is not None
+    except Exception:
+        return False
+
+
+def _rendezvous_sink(process_rank: int):
+    """Per-rank JSONL sink for rendezvous events when the launcher (or
+    an operator) exported ``ELASTIC_TELEMETRY_DIR`` — the retry trail
+    must land somewhere BEFORE the trainer's telemetry exists, since
+    world formation is the first thing a rank does."""
+    directory = os.environ.get("ELASTIC_TELEMETRY_DIR")
+    if not directory:
+        return None
+    from ..obs.events import EventSink
+
+    return EventSink(
+        directory,
+        rank=process_rank,
+        filename=f"events-rdzv-rank{process_rank}.jsonl",
+    )
 
 
 def init_distributed_mode(
     dist_url: str = "env://",
     devices_per_process: int | None = None,
     quiet: bool = False,
+    rdzv_timeout_s: float | None = None,
+    rdzv_attempts: int | None = None,
 ) -> DistState:
     """Resolve the world from the environment, mirroring the reference's
     decision tree (mnist_ddp.py:13-37), and return a ``DistState``.
 
     ``devices_per_process`` caps how many local devices join the mesh
     (the ``--nproc_per_node`` request); ``None`` uses all of them.
+
+    ``rdzv_timeout_s``/``rdzv_attempts`` bound the rendezvous
+    (:func:`initialize_with_retry`); ``None`` reads the launcher's
+    ``RDZV_TIMEOUT_S``/``RDZV_ATTEMPTS`` env contract, falling back to
+    60 s total over 2 attempts — never the indefinite-looking jax
+    default.
     """
     env = os.environ
     # --nproc_per_node caps local devices in every mode (the launcher sets
@@ -98,15 +299,29 @@ def init_distributed_mode(
             print(NOT_DISTRIBUTED_NOTICE)
         return DistState(devices=jax.local_devices()[:1], dist_url=dist_url)
 
-    if process_count > 1 and not jax.distributed.is_initialized():
+    rendezvous_attempts = 0
+    if process_count > 1 and not _distributed_initialized():
         # Multi-host rendezvous (replaces TCPStore + NCCL bootstrap).
         # NOTE: must run before anything touches the XLA backend — even
         # jax.process_count() would initialize it and make this raise.
-        jax.distributed.initialize(
-            coordinator_address=_coordinator_address(dist_url),
-            num_processes=process_count,
-            process_id=process_rank,
-        )
+        _enable_cpu_collectives()
+        if rdzv_timeout_s is None:
+            rdzv_timeout_s = float(env.get("RDZV_TIMEOUT_S", 60.0))
+        if rdzv_attempts is None:
+            rdzv_attempts = int(env.get("RDZV_ATTEMPTS", 2))
+        sink = _rendezvous_sink(process_rank)
+        try:
+            rendezvous_attempts = initialize_with_retry(
+                _coordinator_address(dist_url),
+                process_count,
+                process_rank,
+                timeout_s=rdzv_timeout_s,
+                attempts=rdzv_attempts,
+                sink=sink,
+            )
+        finally:
+            if sink is not None:
+                sink.close()
 
     local = jax.local_devices()
     if devices_per_process is not None:
@@ -126,6 +341,7 @@ def init_distributed_mode(
         local_rank=local_rank,
         devices=local,
         dist_url=dist_url,
+        rendezvous_attempts=rendezvous_attempts,
     )
     if not quiet:
         print(

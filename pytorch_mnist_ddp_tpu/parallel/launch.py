@@ -1,4 +1,5 @@
-"""Launcher CLI (replaces ``torch.distributed.launch``; SURVEY.md N4).
+"""Supervising launcher CLI (replaces ``torch.distributed.launch``;
+SURVEY.md N4, elastic runtime ISSUE 10).
 
 The reference is launched as ``python -m torch.distributed.launch
 --nproc_per_node=4 mnist_ddp.py --batch-size 200 --epochs 20`` (reference
@@ -15,6 +16,31 @@ ONE process per host driving all local chips (SPMD), so this launcher:
   ``--master_port``): exports the reference's env contract
   (``RANK``/``WORLD_SIZE``/``MASTER_ADDR``/``MASTER_PORT``) with
   rank = node_rank — one process per node.
+- ``--nprocs N``: a multi-RANK gang on THIS host — N processes form an
+  N-process world via the rendezvous (each driving ``--nproc_per_node``
+  local devices; 1 virtual CPU device each on ``--backend cpu``), which
+  is how one box exercises the real multi-controller path (and how the
+  distributed chaos harness kills a real rank, tools/train_chaos.py
+  ``--distributed``).
+
+Unlike the PR-9-era ``subprocess.call``, every child is SUPERVISED
+(parallel/elastic.py GangSupervisor):
+
+- SIGTERM/SIGINT to the launcher forward to every rank's process group,
+  so the trainer's ``--preempt-grace-s`` emergency save fires through
+  the launcher, and the child's conventional ``128+signum`` exit code
+  propagates back out.
+- liveness + per-rank heartbeat files detect a dead or hung rank; the
+  survivors get a bounded-grace SIGTERM (then SIGKILL) and the gang is
+  restarted from the latest coordinated ``--save-state`` archive under
+  a seeded exponential-backoff ``--restart-budget`` (escalating to one
+  diagnostic + exit 69 when exhausted).  Restarted children see
+  ``ELASTIC_RESTART_COUNT`` and resume via the trainer's elastic
+  contract; ``--chaos`` clauses are stripped from restarted commands
+  (the injected failure describes incarnation 0 only).
+- ``--rdzv-timeout-s``/``--rdzv-attempts`` export the bounded-rendezvous
+  contract to ``init_distributed_mode`` (parallel/distributed.py), so a
+  missing peer fails with a pointed diagnostic instead of hanging.
 
 Usage: ``python -m pytorch_mnist_ddp_tpu.parallel.launch
 --nproc_per_node=4 [--backend cpu] mnist_ddp.py ...script args...``
@@ -27,29 +53,87 @@ import os
 import subprocess
 import sys
 
+from .elastic import (
+    ENV_HEARTBEAT_FILE,
+    ENV_RDZV_ATTEMPTS,
+    ENV_RDZV_TIMEOUT_S,
+    ENV_RESTART_COUNT,
+    ENV_TELEMETRY_DIR,
+    GangSupervisor,
+    heartbeat_path,
+    strip_chaos_args,
+)
 
-def main(argv: list[str] | None = None) -> int:
+
+def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="TPU-native distributed launcher")
     p.add_argument("--nproc_per_node", type=int, default=1,
-                   help="devices to use on this host")
+                   help="devices each process drives on this host")
+    p.add_argument("--nprocs", type=int, default=1, metavar="N",
+                   help="rank PROCESSES to spawn on this host (an N-process "
+                        "world formed via the rendezvous; each drives "
+                        "--nproc_per_node local devices)")
     p.add_argument("--nnodes", type=int, default=1)
     p.add_argument("--node_rank", type=int, default=0)
     p.add_argument("--master_addr", type=str, default="127.0.0.1")
     p.add_argument("--master_port", type=str, default="29500")
     p.add_argument("--backend", type=str, default=None,
                    help="force a JAX platform (e.g. cpu for virtual devices)")
+    # Supervision (parallel/elastic.py; docs/ROBUSTNESS.md).
+    p.add_argument("--restart-budget", type=int, default=0, metavar="K",
+                   help="gang restarts from the latest coordinated archive "
+                        "before escalating to one diagnostic + exit 69 "
+                        "(default: 0 — no restarts; signals still forward "
+                        "and the child's exit code still propagates)")
+    p.add_argument("--grace-s", type=float, default=10.0, metavar="S",
+                   help="SIGTERM-to-SIGKILL window when stopping survivors "
+                        "of a dead rank (budget the trainer's emergency "
+                        "save inside it; default: 10)")
+    p.add_argument("--backoff-base-s", type=float, default=0.5)
+    p.add_argument("--backoff-max-s", type=float, default=30.0)
+    p.add_argument("--backoff-seed", type=int, default=0,
+                   help="seed for restart-backoff jitter (deterministic "
+                        "chaos schedules)")
+    p.add_argument("--heartbeat-timeout-s", type=float, default=0.0,
+                   metavar="S",
+                   help="treat a rank as HUNG when its step-boundary "
+                        "heartbeat file goes silent for S seconds (0 = "
+                        "liveness only; budget the first step's compile)")
+    p.add_argument("--rdzv-timeout-s", type=float, default=60.0, metavar="S",
+                   help="total rendezvous budget exported to the children: "
+                        "jax.distributed.initialize fails (with a pointed "
+                        "diagnostic) instead of hanging past it")
+    p.add_argument("--rdzv-attempts", type=int, default=2, metavar="K",
+                   help="bounded rendezvous attempts within the budget "
+                        "(retry/backoff between them)")
+    p.add_argument("--telemetry-dir", type=str, default=None, metavar="DIR",
+                   help="launcher telemetry: launch_restarts_total/"
+                        "rank_deaths_total/rank_heartbeat_age_seconds in "
+                        "DIR/launcher.prom plus rank_death/gang_restart "
+                        "JSONL events (DIR is also exported to children "
+                        "for their rendezvous events)")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
-    args = p.parse_args(argv)
+    return p
 
+
+def _child_env(args, rank: int, restart_count: int, hb_dir: str | None) -> dict:
     env = dict(os.environ)
     env["NPROC_PER_NODE"] = str(args.nproc_per_node)
-    if args.nnodes > 1:
-        env["RANK"] = str(args.node_rank)
-        env["WORLD_SIZE"] = str(args.nnodes)
+    multi_rank = args.nprocs > 1 or args.nnodes > 1
+    if multi_rank:
+        if args.nnodes > 1:
+            # One process per node: rank = node_rank (reference contract).
+            env["RANK"] = str(args.node_rank)
+            env["WORLD_SIZE"] = str(args.nnodes)
+        else:
+            env["RANK"] = str(rank)
+            env["WORLD_SIZE"] = str(args.nprocs)
         env["LOCAL_RANK"] = "0"
         env["MASTER_ADDR"] = args.master_addr
         env["MASTER_PORT"] = args.master_port
+        env[ENV_RDZV_TIMEOUT_S] = str(args.rdzv_timeout_s)
+        env[ENV_RDZV_ATTEMPTS] = str(args.rdzv_attempts)
     if args.backend:
         env["JAX_PLATFORMS"] = args.backend
         if args.backend == "cpu":
@@ -61,9 +145,91 @@ def main(argv: list[str] | None = None) -> int:
             # Keep the axon sitecustomize from re-registering the TPU in
             # the child when a CPU run was explicitly requested.
             env.pop("PALLAS_AXON_POOL_IPS", None)
+    if hb_dir is not None:
+        env[ENV_HEARTBEAT_FILE] = heartbeat_path(hb_dir, rank)
+    if args.telemetry_dir:
+        env[ENV_TELEMETRY_DIR] = args.telemetry_dir
+    env[ENV_RESTART_COUNT] = str(restart_count)
+    return env
 
-    cmd = [sys.executable, args.script, *args.script_args]
-    return subprocess.call(cmd, env=env)
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.nprocs > 1 and args.nnodes > 1:
+        # Every local child would get RANK=node_rank — duplicate process
+        # ids wedging the rendezvous until the timeout on EVERY
+        # incarnation, burning the restart budget on a flag mistake.
+        parser.error(
+            "--nprocs (multi-rank gang on one host) and --nnodes "
+            "(one process per node) cannot combine: per-node multi-rank "
+            "worlds need distinct RANK assignment the env contract "
+            "does not carry; launch one --nprocs gang per node with "
+            "hand-assigned rank ranges, or drop one of the flags"
+        )
+
+    registry = sink = None
+    if args.telemetry_dir:
+        from ..obs import EventSink, Registry
+
+        registry = Registry()
+        sink = EventSink(args.telemetry_dir, filename="events-launcher.jsonl")
+
+    hb_dir = None
+    if args.heartbeat_timeout_s > 0:
+        import tempfile
+
+        hb_dir = (
+            args.telemetry_dir
+            if args.telemetry_dir
+            else tempfile.mkdtemp(prefix="elastic_hb_")
+        )
+
+    def spawn(rank: int, restart_count: int) -> subprocess.Popen:
+        script_args = list(args.script_args)
+        if restart_count > 0:
+            # Restarts run CLEAN: the chaos schedule describes
+            # incarnation 0 — re-arming it would just re-kill the rank.
+            script_args = strip_chaos_args(script_args)
+        cmd = [sys.executable, args.script, *script_args]
+        return subprocess.Popen(
+            cmd,
+            env=_child_env(args, rank, restart_count, hb_dir),
+            # Own session per rank: the supervisor signals the whole
+            # process group (grace kill reaches grandchildren too).
+            start_new_session=True,
+        )
+
+    supervisor = GangSupervisor(
+        spawn,
+        args.nprocs,
+        restart_budget=args.restart_budget,
+        backoff_base_s=args.backoff_base_s,
+        backoff_max_s=args.backoff_max_s,
+        seed=args.backoff_seed,
+        grace_s=args.grace_s,
+        heartbeat_dir=hb_dir,
+        heartbeat_timeout_s=args.heartbeat_timeout_s,
+        # Transparent single-child mode: no budget, one rank — the
+        # child's own exit code passes through (the 128+signum pin).
+        propagate_exit=(args.nprocs == 1 and args.restart_budget == 0),
+        registry=registry,
+        sink=sink,
+    )
+    supervisor.install_signals()
+    try:
+        code = supervisor.run()
+    finally:
+        supervisor.uninstall_signals()
+        if sink is not None:
+            sink.close()
+        if registry is not None:
+            from ..obs import write_prometheus
+
+            write_prometheus(
+                registry, os.path.join(args.telemetry_dir, "launcher.prom")
+            )
+    return code
 
 
 if __name__ == "__main__":

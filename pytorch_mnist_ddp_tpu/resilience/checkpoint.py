@@ -64,6 +64,15 @@ class MidEpochCheckpointer:
     seed / global_batch:
         Data-order parameters recorded into (and validated against)
         every mid-epoch archive.
+    world_size:
+        The saving run's data-parallel degree — the last leg of the
+        world fingerprint (world_size / seed / global_batch / step)
+        stamped into mid-epoch archives (ISSUE 10).  Resume validates
+        it: a mismatch is refused with a pointed error unless the run
+        explicitly opts into re-sharding (``--resume-reshard`` —
+        bit-compatible under the sampler contract when seed and
+        global_batch match).  0 (the default) omits the stamp, keeping
+        pre-elastic unit archives byte-stable.
     registry / sink:
         Optional obs surfaces: ``train_checkpoints_total{reason=}``,
         ``checkpoint_write_seconds``, and per-save ``checkpoint``
@@ -76,6 +85,7 @@ class MidEpochCheckpointer:
         every_steps: int = 0,
         seed: int = 0,
         global_batch: int = 0,
+        world_size: int = 0,
         registry=None,
         sink=None,
     ) -> None:
@@ -85,6 +95,7 @@ class MidEpochCheckpointer:
         self.every_steps = int(every_steps)
         self.seed = int(seed)
         self.global_batch = int(global_batch)
+        self.world_size = int(world_size)
         self._registry = registry
         self._sink = sink
         self.saves = 0
@@ -119,18 +130,23 @@ class MidEpochCheckpointer:
         runtime's ``prepare`` hook did the device_get and any layout
         gather) — this method is pure file discipline."""
         t0 = time.perf_counter()
+        extras = {
+            "epoch_in_progress": epoch_in_progress,
+            "batch_cursor": batch_cursor,
+            "seed": self.seed,
+            "global_batch": self.global_batch,
+            "steps_total": steps_total,
+            "samples_total": samples_total,
+        }
+        if self.world_size > 0:
+            # The world fingerprint's last leg (ISSUE 10): which
+            # data-parallel degree this mid-epoch position was cut at.
+            extras["world_size"] = self.world_size
         save_train_state(
             host_state,
             self.tmp_path,
             epoch=epoch_in_progress - 1,
-            extras={
-                "epoch_in_progress": epoch_in_progress,
-                "batch_cursor": batch_cursor,
-                "seed": self.seed,
-                "global_batch": self.global_batch,
-                "steps_total": steps_total,
-                "samples_total": samples_total,
-            },
+            extras=extras,
         )
         if os.path.exists(self.path):
             os.replace(self.path, self.prev_path)

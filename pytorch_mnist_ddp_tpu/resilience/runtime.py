@@ -65,6 +65,25 @@ class ResilientRuntime:
     steps_total / samples_total:
         Telemetry-counter bases restored from a resumed archive so the
         continued run's totals match the uninterrupted run's.
+    is_chief:
+        Multi-rank coordination (ISSUE 10): CADENCE decisions run
+        identically on every rank (deterministic ``steps_local``
+        counting — the ranks agree on the step by construction), and
+        ``prepare`` runs everywhere (a ZeRO gather is a collective
+        every process must enqueue), but only the chief WRITES the
+        coordinated archive.  Preemption is different in kind: the
+        signal lands asynchronously, so ranks can observe the flag at
+        DIFFERENT step boundaries — the emergency save is best-effort
+        chief-side (a chief wedged in a collective against a departed
+        peer is force-exited by its grace timer instead), and the
+        coherent recovery floor is the last cadence archive, from
+        which resume is bit-exact by the PR-9 contract (the
+        distributed chaos driver pins exactly this path).
+        Single-process runs (the default True) are unchanged.
+    heartbeat:
+        Optional :class:`~..parallel.elastic.RankHeartbeat` — touched
+        at every step boundary so the supervising launcher can tell a
+        hung rank from a slow one.
     """
 
     def __init__(
@@ -82,10 +101,14 @@ class ResilientRuntime:
         registry=None,
         sink=None,
         abort_fn=_default_abort,
+        is_chief: bool = True,
+        heartbeat=None,
     ) -> None:
         self.guard = guard
         self.checkpointer = checkpointer
         self.preemption = preemption
+        self.is_chief = bool(is_chief)
+        self.heartbeat = heartbeat
         self.prepare = prepare if prepare is not None else (lambda s: s)
         self.global_batch = int(global_batch)
         self.steps_total = int(steps_total)
@@ -109,6 +132,10 @@ class ResilientRuntime:
         if self.watchdog is not None:
             self.watchdog.suspend()  # armed per-epoch by begin_train
             self.watchdog.start()
+        # NOTE: no heartbeat at start() — the first beat lands at the
+        # first completed step's boundary (after_step), so rendezvous
+        # and the first step's compile never count against the
+        # supervisor's age clock (it ignores a missing file).
         return self
 
     def stop(self) -> None:
@@ -220,6 +247,8 @@ class ResilientRuntime:
         self.steps_total += 1
         self.samples_total += self.global_batch
         cursor = batch_idx + 1
+        if self.heartbeat is not None:
+            self.heartbeat.beat()
         if self.preemption is not None and self.preemption.requested:
             if self.checkpointer is not None:
                 # No try/except: a failed EMERGENCY save must surface —
@@ -266,14 +295,21 @@ class ResilientRuntime:
         if self.watchdog is not None:
             self.watchdog.suspend()
         try:
-            self.checkpointer.save(
-                self.prepare(state),
-                epoch_in_progress=epoch,
-                batch_cursor=cursor,
-                steps_total=self.steps_total,
-                samples_total=self.samples_total,
-                reason=reason,
-            )
+            # prepare() runs on EVERY rank (a ZeRO layout gather is a
+            # collective all processes must enqueue in the same order);
+            # the file write is chief-only — that is the whole
+            # coordinated-save protocol, because the cadence decision
+            # that got us here is deterministic and identical per rank.
+            host_state = self.prepare(state)
+            if self.is_chief:
+                self.checkpointer.save(
+                    host_state,
+                    epoch_in_progress=epoch,
+                    batch_cursor=cursor,
+                    steps_total=self.steps_total,
+                    samples_total=self.samples_total,
+                    reason=reason,
+                )
         finally:
             if self.watchdog is not None:
                 self.watchdog.resume()

@@ -49,6 +49,11 @@ Spec grammar (one or more clauses joined by ``;``)::
     at=T                  arm only once T seconds have passed since start()
     for=S                 hang duration in seconds ('hang' op; default 0.5)
     p=X                   fire each armed event with probability X (seeded)
+    rank=R                trainer sites only: fire only in the process
+                          whose distributed RANK is R (the injector reads
+                          its own rank from the launcher's env; the
+                          distributed chaos harness kills ONE rank of a
+                          real gang this way)
 
 Examples::
 
@@ -61,6 +66,8 @@ Examples::
     kill:ckpt_save:after=1        # die inside the 2nd checkpoint rotation
     nan:step:after=5              # poison step 6's batch (LossGuard test)
     fail:data_next:count=2        # two transient input-pipeline faults
+    kill:step:rank=1:after=4      # kill RANK 1 of the gang before its
+                                  # 5th step (elastic supervisor test)
 
 The ``fail`` op raises :class:`FaultError` at the fault point — the
 supervisor (serving/pool.py) must treat it exactly like any engine
@@ -121,14 +128,16 @@ class FaultSpec:
     """One parsed clause: where it fires, when, how often, what it does."""
 
     __slots__ = (
-        "op", "site", "replica", "count", "after", "at_s", "hang_s", "p",
-        "fired", "source",
+        "op", "site", "replica", "rank", "count", "after", "at_s", "hang_s",
+        "p", "fired", "source",
     )
 
-    def __init__(self, op, site, replica, count, after, at_s, hang_s, p, source):
+    def __init__(self, op, site, replica, count, after, at_s, hang_s, p,
+                 source, rank=None):
         self.op = op
         self.site = site
         self.replica = replica
+        self.rank = rank
         self.count = count
         self.after = after
         self.at_s = at_s
@@ -157,10 +166,10 @@ class FaultSpec:
                 for pair in part.split(","):
                     key, _, value = pair.partition("=")
                     key, value = key.strip(), value.strip()
-                    if key not in ("count", "after", "at", "for", "p"):
+                    if key not in ("count", "after", "at", "for", "p", "rank"):
                         raise ValueError(
                             f"unknown fault param {key!r} in {clause!r}; "
-                            "have count/after/at/for/p"
+                            "have count/after/at/for/p/rank"
                         )
                     params[key] = value
             elif part and part != "*":
@@ -192,12 +201,25 @@ class FaultSpec:
             # replica=None, so a labeled clause could never match.
             raise ValueError(
                 f"{site} cannot be replica-scoped in {clause!r}: trainer "
-                "sites fire unlabeled (there is one trainer)"
+                "sites fire unlabeled (there is one trainer per rank; "
+                "scope to a gang member with rank=R instead)"
+            )
+        rank = int(params["rank"]) if "rank" in params else None
+        if rank is not None and rank < 0:
+            raise ValueError(f"rank must be >= 0 in {clause!r}")
+        if rank is not None and site not in TRAINER_SITES:
+            # Serving processes are single-rank (replica scoping is their
+            # addressing); a rank-scoped serving clause could never
+            # match — the vacuous-green guard again.
+            raise ValueError(
+                f"rank= only scopes trainer sites in {clause!r}: serving "
+                "clauses address replicas (r0, r1, ...), not gang ranks"
             )
         return cls(
             op=op,
             site=site,
             replica=replica,
+            rank=rank,
             count=count,
             after=int(params.get("after", 0)),
             at_s=float(params["at"]) if "at" in params else None,
@@ -217,13 +239,22 @@ class FaultInjector:
     worker, the completion worker, and N warmup threads concurrently.
     """
 
-    def __init__(self, spec: str = "", seed: int = 0):
+    def __init__(self, spec: str = "", seed: int = 0, rank: int | None = None):
         self.specs = [
             FaultSpec.parse(clause)
             for clause in spec.split(";")
             if clause.strip()
         ]
         self.seed = seed
+        # This process's gang rank, for rank= scoped trainer clauses: the
+        # launcher's env contract (RANK) by default, so a schedule like
+        # 'kill:step:rank=1:after=4' handed identically to every gang
+        # member fires only inside rank 1.
+        import os as _os
+
+        self.rank = (
+            int(_os.environ.get("RANK", 0) or 0) if rank is None else int(rank)
+        )
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._t0: float | None = None
@@ -248,6 +279,8 @@ class FaultInjector:
             if spec.site != site:
                 continue
             if spec.replica is not None and spec.replica != replica:
+                continue
+            if spec.rank is not None and spec.rank != self.rank:
                 continue
             if spec.at_s is not None and (
                 self._t0 is None or time.monotonic() - self._t0 < spec.at_s

@@ -10,6 +10,7 @@ exact analogue of the reference's "Not using distributed mode" degradation
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 
 import jax
@@ -364,6 +365,17 @@ def fit(
             rank=dist.process_rank,
             distributed=dist.distributed,
         )
+        attempts = int(getattr(dist, "rendezvous_attempts", 0) or 0)
+        if attempts:
+            # The world-formation receipt (parallel/distributed.py
+            # initialize_with_retry): how many bounded attempts this
+            # process's rendezvous took.  >1 means a retry healed a
+            # late peer — the rendezvous_retry events carry the trail.
+            telemetry.registry.counter(
+                "rendezvous_attempts_total",
+                help="bounded jax.distributed.initialize attempts this "
+                "process took to form the world",
+            ).inc(attempts)
     t0 = time.perf_counter()
     try:
         with trace(getattr(args, "profile", None)):
@@ -483,11 +495,18 @@ def _fit_body(
             raise ValueError(
                 "the resilient runtime rides the DP paths; drop --tp/--pp"
             )
-        if dist.process_count > 1:
+        if loss_guard_on and dist.process_count > 1:
+            # Checkpointing and the watchdog are multi-rank coherent
+            # (ISSUE 10): cadence decisions are deterministic and
+            # identical per rank, writes are chief-gated, and a
+            # watchdog abort is just a rank death the supervising
+            # launcher gang-restarts.  The LossGuard is NOT: it
+            # classifies per-host loss shards, so rank 0 could roll
+            # back a step rank 1 committed — silent divergence.
             raise ValueError(
-                "the resilient runtime is single-controller for now "
-                "(rollback/save decisions cannot be taken from per-host "
-                "loss shards); drop the resilience flags on multi-host runs"
+                "--loss-guard is single-controller (a rollback decision "
+                "taken from per-host loss shards could diverge across "
+                "ranks); drop it on multi-process runs"
             )
     if ckpt_every > 0 and not save_state_path:
         raise ValueError(
@@ -497,6 +516,25 @@ def _fit_body(
     epoch0 = 0
     loaded_state = None
     resume_extras: dict = {}
+    # Elastic restart contract (parallel/elastic.py, ISSUE 10): a child
+    # relaunched by the supervising gang launcher (ELASTIC_RESTART_COUNT
+    # exported) — or any run opting in with --elastic — resumes from its
+    # OWN --save-state archive when one exists, with --epochs read as
+    # the TOTAL epoch target rather than "more epochs".  The launcher
+    # re-executes the original command verbatim and needs zero knowledge
+    # of the trainer's flag surface; this is where the resume happens.
+    elastic_resumed = False
+    elastic_on = bool(getattr(args, "elastic", False)) or int(
+        os.environ.get("ELASTIC_RESTART_COUNT", "0") or 0
+    ) > 0
+    if elastic_on and save_state_path and not resume_state_path:
+        from .utils.checkpoint import PREV_SUFFIX
+
+        if os.path.exists(save_state_path) or os.path.exists(
+            save_state_path + PREV_SUFFIX
+        ):
+            resume_state_path = save_state_path
+            elastic_resumed = True
     if resume_state_path:
         from .ops.pallas_adadelta import ensure_opt_layout
         from .utils.checkpoint import load_latest_train_state
@@ -531,6 +569,12 @@ def _fit_body(
                 + ("add" if loaded_state.batch_stats else "drop")
                 + " --syncbn to match"
             )
+        if elastic_resumed:
+            # Epochs-as-total: a gang restart reruns the SAME command,
+            # so "train 2 epochs" must mean "finish the 2-epoch run",
+            # not "train 2 more" — the arithmetic tools/train_chaos.py
+            # does by hand for explicit --resume-state.
+            args.epochs = max(int(args.epochs) - epoch0, 0)
 
     if dist.distributed:
         # Multi-host: the mesh spans every device in the world (JAX's global
@@ -609,6 +653,29 @@ def _fit_body(
                 "batch cursor no longer addresses the same samples — "
                 "match --batch-size and the device count"
             )
+        saved_ws = resume_extras.get("world_size")
+        if saved_ws is not None and int(saved_ws) != int(n_shards):
+            # The world fingerprint's last leg (ISSUE 10).  With the
+            # same seed and global batch a different data-parallel
+            # degree consumes the SAME global batches (each epoch batch
+            # is the same slab of the global permutation whatever the
+            # rank striping — parallel/sampler.py), so a re-shard is a
+            # correct, sample-exact continuation; but the new striping
+            # re-partitions each batch across devices, reductions
+            # re-associate, and bit-exactness is gone — and silently
+            # resuming into a different world is how a fat-fingered
+            # launch flag corrupts a run.  Say it out loud.
+            if not bool(getattr(args, "resume_reshard", False)):
+                raise ValueError(
+                    f"--resume-state {resume_state_path!r} was saved "
+                    f"mid-epoch at world size {int(saved_ws)}; this run's "
+                    f"world size is {int(n_shards)}.  Matching seed and "
+                    "global batch make a re-shard consume the exact same "
+                    "global batches (sampler contract; reductions "
+                    "re-associate, so expect FP-level drift, not "
+                    "bit-equality) — pass --resume-reshard to accept it, "
+                    "or relaunch at the original world size"
+                )
     use_pallas = bool(getattr(args, "pallas_opt", False))
     # --bf16: activations/matmuls at the MXU's native width; params, the
     # Adadelta state, and the log_softmax/NLL tail stay fp32 (models/net.py).
@@ -978,7 +1045,13 @@ def _fit_body(
         # (the 'step' chaos site lives in runtime.run_step); the flagless
         # no-injector path never builds it and the step loop is untouched.
         runtime = None
-        if resilience_flags or _faults.active():
+        from .parallel.elastic import RankHeartbeat
+
+        # ELASTIC_HEARTBEAT_FILE (set by the supervising launcher) opts
+        # the step loop into liveness beats; unset — every flagless
+        # run — builds nothing.
+        heartbeat = RankHeartbeat.from_env()
+        if resilience_flags or _faults.active() or heartbeat is not None:
             from .resilience import (
                 LossGuard,
                 MidEpochCheckpointer,
@@ -1001,6 +1074,7 @@ def _fit_body(
                     ckpt_every,
                     seed=int(args.seed),
                     global_batch=int(global_batch),
+                    world_size=int(n_shards),
                     registry=obs_registry,
                     sink=obs_sink,
                 )
@@ -1038,6 +1112,13 @@ def _fit_body(
                 samples_total=int(resume_extras.get("samples_total", 0)),
                 registry=obs_registry,
                 sink=obs_sink,
+                # Multi-rank coordination (ISSUE 10): every rank runs
+                # the same deterministic cadence decisions and the
+                # prepare collectives; only the chief writes (emergency
+                # saves are best-effort chief-side — the signal lands
+                # asynchronously; see ResilientRuntime.is_chief).
+                is_chief=dist.is_chief,
+                heartbeat=heartbeat,
             ).start()
         if telemetry is not None and resume_in_progress:
             # Seed the counters with the archive's totals so the resumed

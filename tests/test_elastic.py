@@ -1,0 +1,598 @@
+"""Elastic distributed runtime tests (ISSUE 10, docs/ROBUSTNESS.md
+elastic section): the supervising launcher's gang state machine over
+fake rank processes (death detection, grace kill, seeded backoff
+determinism, budget escalation, heartbeat hang detection), signal
+forwarding + exit-code propagation through the real launcher CLI,
+bounded rendezvous retry with a fake initializer, world-fingerprint
+validation on mid-epoch resume, the rank-scoped chaos grammar, and the
+slow 2-rank kill -> gang-restart -> byte-identical e2e through
+tools/train_chaos.py --distributed."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.elastic
+
+from pytorch_mnist_ddp_tpu.obs import Registry
+from pytorch_mnist_ddp_tpu.parallel.distributed import (
+    _coordinator_address,
+    initialize_with_retry,
+)
+from pytorch_mnist_ddp_tpu.parallel.elastic import (
+    EXIT_GANG,
+    GangSupervisor,
+    RankHeartbeat,
+    heartbeat_age_s,
+    heartbeat_path,
+    strip_chaos_args,
+)
+from pytorch_mnist_ddp_tpu.resilience import MidEpochCheckpointer
+from pytorch_mnist_ddp_tpu.serving.faults import (
+    FaultError,
+    FaultInjector,
+    FaultSpec,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Sink:
+    """Event recorder standing in for an obs EventSink."""
+
+    def __init__(self):
+        self.events: list[tuple[str, dict]] = []
+
+    def emit(self, event, **fields):
+        self.events.append((event, fields))
+
+    def close(self):
+        pass
+
+    def __bool__(self):
+        return True
+
+    def named(self, name):
+        return [f for e, f in self.events if e == name]
+
+
+def _py(code: str) -> list[str]:
+    return [sys.executable, "-c", code]
+
+
+def _spawn_from_table(table):
+    """spawn(rank, restart_count) looking commands up per incarnation;
+    the last row repeats for later incarnations."""
+
+    def spawn(rank, restart_count):
+        row = table[min(restart_count, len(table) - 1)]
+        return subprocess.Popen(_py(row[rank]))
+
+    return spawn
+
+
+# ---------------------------------------------------------------------------
+# GangSupervisor over fake rank processes
+
+
+def test_supervisor_clean_gang_exits_zero():
+    sup = GangSupervisor(
+        _spawn_from_table([["pass", "pass"]]), 2, poll_s=0.02, grace_s=1.0,
+    )
+    assert sup.run() == 0
+    assert sup.restarts == 0
+
+
+def test_supervisor_detects_rank_death_and_gang_restarts():
+    """Incarnation 0: rank 1 dies (exit 9) while rank 0 would run long —
+    the supervisor must stop the survivor, restart the WORLD, and the
+    clean second incarnation finishes green."""
+    sink, registry = _Sink(), Registry()
+    sup = GangSupervisor(
+        _spawn_from_table([
+            ["import time; time.sleep(30)", "import sys; sys.exit(9)"],
+            ["pass", "pass"],
+        ]),
+        2,
+        restart_budget=2, backoff_base_s=0.01, backoff_max_s=0.05,
+        grace_s=2.0, poll_s=0.02, registry=registry, sink=sink,
+    )
+    assert sup.run() == 0
+    assert sup.restarts == 1
+    deaths = sink.named("rank_death")
+    assert deaths and deaths[0]["rank"] == 1
+    assert deaths[0]["reason"] == "exit" and deaths[0]["exit_code"] == 9
+    restarts = sink.named("gang_restart")
+    assert restarts and restarts[0]["attempt"] == 1
+    assert registry.counter("launch_restarts_total").value == 1
+    assert registry.counter("rank_deaths_total", rank=1).value == 1
+
+
+def test_supervisor_budget_escalates_with_one_diagnostic(capfd):
+    """A rank that dies every incarnation burns the budget: the run ends
+    EXIT_GANG with exactly ONE 'launch: gang failed' diagnostic."""
+    sup = GangSupervisor(
+        _spawn_from_table([["pass", "import sys; sys.exit(7)"]]),
+        2,
+        restart_budget=2, backoff_base_s=0.01, backoff_max_s=0.02,
+        grace_s=1.0, poll_s=0.02,
+    )
+    assert sup.run() == EXIT_GANG
+    assert sup.restarts == 2  # the budget was actually spent
+    err = capfd.readouterr().err
+    assert err.count("launch: gang failed") == 1
+    assert "restart budget (2) is exhausted" in err
+
+
+def test_supervisor_budget_zero_escalates_immediately(capfd):
+    sup = GangSupervisor(
+        _spawn_from_table([["import time; time.sleep(30)",
+                            "import sys; sys.exit(3)"]]),
+        2,
+        restart_budget=0, grace_s=1.0, poll_s=0.02,
+    )
+    assert sup.run() == EXIT_GANG
+    assert sup.restarts == 0
+    assert capfd.readouterr().err.count("launch: gang failed") == 1
+
+
+def test_supervisor_grace_kills_a_deaf_survivor():
+    """A survivor ignoring SIGTERM must be SIGKILLed after grace_s, not
+    waited on forever."""
+    deaf = ("import signal, time; "
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN); time.sleep(60)")
+    sup = GangSupervisor(
+        _spawn_from_table([[deaf, "import sys; sys.exit(2)"]]),
+        2,
+        restart_budget=0, grace_s=0.3, poll_s=0.02,
+    )
+    t0 = time.monotonic()
+    assert sup.run() == EXIT_GANG
+    assert time.monotonic() - t0 < 10.0  # not the deaf child's 60 s
+
+
+def test_supervisor_propagates_single_child_exit_code(capfd):
+    """Transparent mode (the launcher's default single-child shape): the
+    child's own exit code — e.g. the PR-9 128+signum convention — passes
+    through with no diagnostic."""
+    sup = GangSupervisor(
+        _spawn_from_table([["import os; os._exit(137)"]]),
+        1,
+        restart_budget=0, grace_s=1.0, poll_s=0.02, propagate_exit=True,
+    )
+    assert sup.run() == 137
+    assert "gang failed" not in capfd.readouterr().err
+
+
+def test_supervisor_heartbeat_detects_a_hung_rank(tmp_path):
+    """A rank whose process is alive but whose heartbeat went silent is
+    an incident (reason=heartbeat): alive-but-wedged is exactly what
+    liveness polling cannot see."""
+    hb_dir = str(tmp_path)
+    hung = (
+        f"import time; open(r'{heartbeat_path(hb_dir, 0)}', 'w').close(); "
+        "time.sleep(60)"
+    )
+    sink, registry = _Sink(), Registry()
+    sup = GangSupervisor(
+        _spawn_from_table([[hung]]),
+        1,
+        restart_budget=0, grace_s=0.5, poll_s=0.05,
+        heartbeat_dir=hb_dir, heartbeat_timeout_s=0.4,
+        registry=registry, sink=sink,
+    )
+    assert sup.run() == EXIT_GANG
+    deaths = sink.named("rank_death")
+    assert deaths and deaths[0]["reason"] == "heartbeat"
+    assert deaths[0]["heartbeat_age_s"] > 0.4
+    assert registry.gauge("rank_heartbeat_age_seconds", rank=0).value > 0
+
+
+def test_supervisor_ignores_missing_heartbeat_during_startup():
+    """No heartbeat file yet = the rank is still forming the world /
+    compiling — never a hang verdict.  A clean fast exit stays green."""
+    sup = GangSupervisor(
+        _spawn_from_table([["pass"]]),
+        1,
+        restart_budget=0, grace_s=0.5, poll_s=0.02,
+        heartbeat_dir=None, heartbeat_timeout_s=0.05,
+    )
+    assert sup.run() == 0
+
+
+def test_supervisor_backoff_schedule_is_seed_deterministic():
+    def ladder(seed):
+        sup = GangSupervisor(lambda r, c: None, 1, seed=seed,
+                             backoff_base_s=0.5, backoff_max_s=30.0)
+        return [sup.backoff_s(k) for k in range(5)]
+
+    assert ladder(7) == ladder(7)
+    assert ladder(7) != ladder(8)
+    base = ladder(0)
+    # Exponential shape under the jitter cap (jitter in [0, 0.25)).
+    for k, b in enumerate(base):
+        rung = min(30.0, 0.5 * 2 ** k)
+        assert rung <= b < rung * 1.25
+
+
+def test_strip_chaos_args():
+    argv = ["--epochs", "2", "--chaos", "kill:step:rank=1:after=4",
+            "--save-state", "s.npz", "--chaos-seed", "3",
+            "--chaos=nan:step", "--chaos-seed=9"]
+    assert strip_chaos_args(argv) == [
+        "--epochs", "2", "--save-state", "s.npz",
+    ]
+
+
+# ---------------------------------------------------------------------------
+# RankHeartbeat
+
+
+def test_rank_heartbeat_writes_and_throttles(tmp_path):
+    path = str(tmp_path / "rank0.hb")
+    hb = RankHeartbeat(path, interval_s=10.0)
+    assert heartbeat_age_s(path) is None  # no beat yet: startup
+    hb.beat()
+    assert heartbeat_age_s(path) is not None
+    mtime = os.stat(path).st_mtime
+    os.utime(path, (mtime - 100, mtime - 100))
+    hb.beat()  # throttled: inside interval_s, must NOT touch
+    assert os.stat(path).st_mtime == mtime - 100
+    hb.beat(force=True)
+    assert os.stat(path).st_mtime > mtime - 100
+
+
+def test_rank_heartbeat_from_env(monkeypatch, tmp_path):
+    monkeypatch.delenv("ELASTIC_HEARTBEAT_FILE", raising=False)
+    assert RankHeartbeat.from_env() is None
+    monkeypatch.setenv("ELASTIC_HEARTBEAT_FILE", str(tmp_path / "r.hb"))
+    hb = RankHeartbeat.from_env()
+    assert hb is not None and hb.path.endswith("r.hb")
+
+
+# ---------------------------------------------------------------------------
+# Launcher CLI: signal forwarding + exit-code propagation (satellite pin)
+
+
+_SIGNAL_CHILD = """\
+import signal, sys, time
+
+def handle(signum, frame):
+    with open(sys.argv[1], "w") as f:
+        f.write("emergency-saved")
+    sys.exit(128 + signum)
+
+signal.signal(signal.SIGTERM, handle)
+print("ready", flush=True)
+time.sleep(60)
+"""
+
+
+def _launch(args, **popen_kw):
+    return subprocess.Popen(
+        [sys.executable, "-m", "pytorch_mnist_ddp_tpu.parallel.launch",
+         *args],
+        cwd=REPO, text=True, **popen_kw,
+    )
+
+
+def test_launcher_forwards_sigterm_and_propagates_exit_code(tmp_path):
+    """THE satellite bugfix pin: SIGTERM to the launcher reaches the
+    child (its handler runs — the PR-9 emergency-save path), and the
+    child's 128+signum exit code propagates out of the launcher."""
+    script = tmp_path / "child.py"
+    script.write_text(_SIGNAL_CHILD)
+    marker = tmp_path / "marker"
+    proc = _launch([str(script), str(marker)], stdout=subprocess.PIPE)
+    assert proc.stdout.readline().strip() == "ready"
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=30) == 128 + signal.SIGTERM
+    assert marker.read_text() == "emergency-saved"
+
+
+def test_launcher_propagates_plain_child_exit_code(tmp_path):
+    script = tmp_path / "child.py"
+    script.write_text("import sys; sys.exit(7)\n")
+    proc = _launch([str(script)])
+    assert proc.wait(timeout=30) == 7
+
+
+# ---------------------------------------------------------------------------
+# Bounded rendezvous retry (fake initializer)
+
+
+def _failing_initializer(fail_times):
+    calls = []
+
+    def fake(coordinator_address, num_processes, process_id,
+             initialization_timeout):
+        calls.append(initialization_timeout)
+        if len(calls) <= fail_times:
+            raise RuntimeError("barrier timed out")
+
+    fake.calls = calls
+    return fake
+
+
+def test_rendezvous_retry_succeeds_after_transient_failure():
+    sink = _Sink()
+    fake = _failing_initializer(2)
+    attempts = initialize_with_retry(
+        "127.0.0.1:2900", 2, 1, timeout_s=9.0, attempts=3,
+        backoff_s=0.01, initialize_fn=fake, sink=sink,
+    )
+    assert attempts == 3
+    # The TOTAL budget splits across attempts (fails WITHIN the budget).
+    assert fake.calls == [3, 3, 3]
+    assert len(sink.named("rendezvous_retry")) == 2
+    final = sink.named("rendezvous")
+    assert final and final[-1]["ok"] and final[-1]["attempts"] == 3
+
+
+def test_rendezvous_retry_exhaustion_names_the_coordinator():
+    sink = _Sink()
+    with pytest.raises(RuntimeError) as exc:
+        initialize_with_retry(
+            "10.0.0.9:29400", 4, 2, timeout_s=4.0, attempts=2,
+            backoff_s=0.01, initialize_fn=_failing_initializer(99),
+            sink=sink,
+        )
+    msg = str(exc.value)
+    assert "10.0.0.9:29400" in msg
+    assert "process 2 of 4" in msg
+    assert "every rank 0..3" in msg
+    final = sink.named("rendezvous")
+    assert final and not final[-1]["ok"]
+
+
+def test_rendezvous_retry_validates_attempts():
+    with pytest.raises(ValueError, match="attempts"):
+        initialize_with_retry("a:1", 2, 0, attempts=0,
+                              initialize_fn=lambda **k: None)
+
+
+def test_coordinator_address_partial_env_raises(monkeypatch):
+    """Satellite fix: MASTER_ADDR xor MASTER_PORT must raise one pointed
+    error naming the MISSING variable — not fall through to a hang."""
+    for var in ("MASTER_ADDR", "MASTER_PORT"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+    with pytest.raises(ValueError, match="MASTER_PORT is not"):
+        _coordinator_address("env://")
+    monkeypatch.delenv("MASTER_ADDR")
+    monkeypatch.setenv("MASTER_PORT", "29500")
+    with pytest.raises(ValueError, match="MASTER_ADDR is not"):
+        _coordinator_address("env://")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_rendezvous_absent_peer_fails_within_budget():
+    """Acceptance pin: a REAL jax.distributed rendezvous with its peer
+    absent fails within the --rdzv-timeout-s budget — no indefinite
+    hang — with a diagnostic naming the coordinator address."""
+    from conftest import cpu_subprocess_env
+
+    port = _free_port()
+    env = cpu_subprocess_env()
+    env.update(
+        RANK="1", WORLD_SIZE="2", LOCAL_RANK="0",
+        MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port),
+        RDZV_TIMEOUT_S="8", RDZV_ATTEMPTS="2",
+        PYTHONPATH=REPO,
+    )
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from pytorch_mnist_ddp_tpu.parallel.distributed import "
+         "init_distributed_mode; init_distributed_mode()"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode != 0
+    assert elapsed < 60, f"rendezvous took {elapsed:.0f}s against an 8s budget"
+    assert f"127.0.0.1:{port}" in proc.stderr
+    assert "a peer never arrived" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# World fingerprint (mid-epoch archives)
+
+
+def test_checkpointer_stamps_world_size(tmp_path):
+    from test_resilience import _tiny_state
+
+    path = str(tmp_path / "state.npz")
+    ckpt = MidEpochCheckpointer(path, every_steps=1, seed=1,
+                                global_batch=64, world_size=8)
+    ckpt.save(_tiny_state(1.0), epoch_in_progress=1, batch_cursor=1,
+              steps_total=1, samples_total=64)
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import load_train_state_full
+
+    _, _, extras = load_train_state_full(path)
+    assert extras["world_size"] == 8
+    # Legacy shape (no world_size given) omits the stamp: pre-elastic
+    # archives and their readers are untouched.
+    legacy = str(tmp_path / "legacy.npz")
+    MidEpochCheckpointer(legacy, every_steps=1, seed=1, global_batch=64).save(
+        _tiny_state(1.0), epoch_in_progress=1, batch_cursor=1,
+        steps_total=1, samples_total=64,
+    )
+    _, _, extras = load_train_state_full(legacy)
+    assert "world_size" not in extras
+
+
+def test_resume_rejects_mismatched_world_size(tmp_path, devices):
+    """Fingerprint leg 4: a mid-epoch archive cut at a different
+    data-parallel degree is refused with a pointed error that names the
+    opt-in (--resume-reshard)."""
+    from test_e2e import _args, _write_idx
+    from test_resilience import _dist, _tiny_state
+    from pytorch_mnist_ddp_tpu.trainer import fit
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import save_train_state
+
+    root = _write_idx(tmp_path, n_train=256, n_test=128)
+    state_path = str(tmp_path / "state.npz")
+    save_train_state(
+        _tiny_state(1.0), state_path, epoch=0,
+        extras={"epoch_in_progress": 1, "batch_cursor": 2, "seed": 1,
+                "global_batch": 64, "steps_total": 2, "samples_total": 128,
+                "world_size": 4},
+    )
+    args = _args(root, batch_size=8)  # 8 shards -> world 8 != stamped 4
+    args.resume_state = state_path
+    with pytest.raises(ValueError, match="--resume-reshard"):
+        fit(args, _dist(devices))
+
+
+def test_resume_reshard_flag_accepts_and_stays_bit_identical(
+    tmp_path, capsys, devices
+):
+    """--resume-reshard accepts the mismatch; with seed and global batch
+    matching, the resumed run is still bit-identical to the baseline
+    (here the actual device world is unchanged — the stamp is edited —
+    so the flag's acceptance path is what's under test; a REAL
+    cross-topology re-shard is sample-exact with FP-level drift and is
+    pinned by the chaos driver's reshard-resume round)."""
+    from test_e2e import _args, _write_idx
+    from test_resilience import _dist, _leaves_equal
+    from pytorch_mnist_ddp_tpu.serving.faults import injected
+    from pytorch_mnist_ddp_tpu.trainer import fit
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import load_latest_train_state
+
+    import jax
+
+    root = _write_idx(tmp_path, n_train=256, n_test=128)
+    full = fit(_args(root, batch_size=8, log_interval=10_000_000),
+               _dist(devices))
+
+    state_path = str(tmp_path / "state.npz")
+    args = _args(root, batch_size=8, log_interval=10_000_000)
+    args.save_state = state_path
+    args.checkpoint_every_steps = 2
+    with injected("fail:step:after=3"):
+        with pytest.raises(FaultError):
+            fit(args, _dist(devices))
+    _, _, extras, used = load_latest_train_state(state_path)
+    assert extras["world_size"] == 8  # stamped by the real save
+    # Re-stamp a different world (as if saved at 4 ranks x batch 16).
+    with np.load(used) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["meta.world_size"] = np.asarray(4, np.int64)
+    np.savez(used, **arrays)  # jaxlint: disable=JL014 -- test fixture rewriting one meta key in place
+    if used != state_path and os.path.exists(state_path):
+        os.remove(state_path)
+
+    args2 = _args(root, batch_size=8, log_interval=10_000_000)
+    args2.resume_state = used
+    with pytest.raises(ValueError, match="--resume-reshard"):
+        fit(args2, _dist(devices))
+    args2.resume_reshard = True
+    resumed = fit(args2, _dist(devices))
+    capsys.readouterr()
+    assert _leaves_equal(jax.device_get(resumed.params),
+                         jax.device_get(full.params))
+    assert int(resumed.step) == int(full.step)
+
+
+def test_elastic_resume_epochs_as_total(tmp_path, capsys, devices):
+    """--elastic: a rerun of the SAME command resumes from its own
+    archive with --epochs read as the TOTAL target — the gang-restart
+    contract — and lands bit-identical to the uninterrupted run."""
+    from test_e2e import _args, _write_idx
+    from test_resilience import _dist, _leaves_equal
+    from pytorch_mnist_ddp_tpu.serving.faults import injected
+    from pytorch_mnist_ddp_tpu.trainer import fit
+
+    import jax
+
+    root = _write_idx(tmp_path, n_train=256, n_test=128)
+    full = fit(_args(root, batch_size=8, epochs=2, log_interval=10_000_000),
+               _dist(devices))
+
+    state_path = str(tmp_path / "state.npz")
+
+    def run(chaos=None):
+        args = _args(root, batch_size=8, epochs=2, log_interval=10_000_000)
+        args.save_state = state_path
+        args.checkpoint_every_steps = 2
+        args.elastic = True
+        if chaos is None:
+            return fit(args, _dist(devices))
+        with injected(chaos):
+            with pytest.raises(FaultError):
+                fit(args, _dist(devices))
+
+    run(chaos="fail:step:after=5")   # dies mid-run, archives exist
+    resumed = run()                   # SAME command, elastic resume
+    capsys.readouterr()
+    assert _leaves_equal(jax.device_get(resumed.params),
+                         jax.device_get(full.params))
+    assert _leaves_equal(jax.device_get(resumed.opt),
+                         jax.device_get(full.opt))
+    assert int(resumed.step) == int(full.step)
+
+
+# ---------------------------------------------------------------------------
+# Rank-scoped chaos grammar
+
+
+def test_fault_grammar_rank_param():
+    spec = FaultSpec.parse("kill:step:rank=1:after=4")
+    assert spec.rank == 1 and spec.after == 4 and spec.op == "kill"
+    assert FaultSpec.parse("fail:data_next:rank=0").rank == 0
+    with pytest.raises(ValueError, match="rank must be >= 0"):
+        FaultSpec.parse("kill:step:rank=-1")
+    with pytest.raises(ValueError, match="only scopes trainer sites"):
+        FaultSpec.parse("fail:launch:rank=1")
+
+
+def test_rank_scoped_clause_fires_only_in_its_rank():
+    inj0 = FaultInjector("fail:step:rank=1", rank=0)
+    inj0.fire("step")  # silent: wrong rank
+    inj1 = FaultInjector("fail:step:rank=1", rank=1)
+    with pytest.raises(FaultError):
+        inj1.fire("step")
+
+
+def test_injector_rank_defaults_from_env(monkeypatch):
+    monkeypatch.setenv("RANK", "3")
+    inj = FaultInjector("fail:step:rank=3")
+    assert inj.rank == 3
+    with pytest.raises(FaultError):
+        inj.fire("step")
+    monkeypatch.delenv("RANK")
+    assert FaultInjector("").rank == 0
+
+
+# ---------------------------------------------------------------------------
+# The slow 2-rank e2e (the CI chaos-dist job's local twin)
+
+
+@pytest.mark.slow  # 3 launcher worlds x 2 rank processes each
+def test_distributed_chaos_driver_kill_gang_restart(tmp_path):
+    from conftest import cpu_subprocess_env
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "train_chaos.py"),
+         "--distributed", "--nproc", "2",
+         "--workdir", str(tmp_path / "chaos"),
+         "--synthetic", "512", "--epochs", "1", "--batch-size", "64",
+         "--checkpoint-every-steps", "2",
+         "--chaos", "kill:step:rank=1:after=2"],
+        capture_output=True, text=True, env=cpu_subprocess_env(),
+        cwd=REPO, timeout=580,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS gang-kill" in proc.stdout
+    assert "PASS gang-budget0" in proc.stdout

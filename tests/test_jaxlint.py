@@ -1446,6 +1446,123 @@ def test_jl014_waiver():
 
 
 # ---------------------------------------------------------------------------
+# JL015 — unbounded rendezvous / unsupervised training-script launches
+
+
+JL015_BAD_BARE_INITIALIZE = """\
+import jax
+
+def form_world(addr, n, rank):
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=n, process_id=rank)
+"""
+
+JL015_GOOD_TIMEOUT_KWARG = """\
+import jax
+
+def form_world(addr, n, rank):
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=n, process_id=rank,
+        initialization_timeout=30)
+"""
+
+JL015_GOOD_BOUNDED_RETRY = """\
+import jax
+
+def form_world(addr, n, rank):
+    for attempt in range(3):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=addr, num_processes=n, process_id=rank)
+            return
+        except RuntimeError:
+            continue
+    raise RuntimeError(f"rendezvous at {addr} failed")
+"""
+
+JL015_BAD_UNSUPERVISED_CALL = """\
+import subprocess
+import sys
+
+def launch(script, args, env):
+    cmd = [sys.executable, script, *args]
+    return subprocess.call(cmd, env=env)
+"""
+
+JL015_BAD_UNSUPERVISED_POPEN = """\
+import subprocess
+import sys
+
+def launch(env):
+    return subprocess.Popen([sys.executable, "mnist_ddp.py"], env=env)
+"""
+
+JL015_GOOD_SIGNAL_AWARE_LAUNCH = """\
+import signal
+import subprocess
+import sys
+
+def launch(env):
+    proc = subprocess.Popen([sys.executable, "mnist_ddp.py"], env=env)
+    signal.signal(signal.SIGTERM, lambda s, f: proc.send_signal(s))
+    return proc.wait()
+"""
+
+JL015_GOOD_SUPERVISED_LAUNCH = """\
+import subprocess
+import sys
+
+from pytorch_mnist_ddp_tpu.parallel.elastic import GangSupervisor
+
+def launch(env):
+    def spawn(rank, restart_count):
+        return subprocess.Popen([sys.executable, "mnist_ddp.py"], env=env)
+    return GangSupervisor(spawn, 2).run()
+"""
+
+JL015_GOOD_NON_SCRIPT_SUBPROCESS = """\
+import subprocess
+
+def probe():
+    return subprocess.Popen(["nvidia-smi", "--list-gpus"])
+"""
+
+
+def test_jl015_fires_on_bare_initialize():
+    assert_fires(JL015_BAD_BARE_INITIALIZE, "JL015", line=4)
+
+
+def test_jl015_silent_on_timeout_and_bounded_retry():
+    assert_silent(JL015_GOOD_TIMEOUT_KWARG, "JL015")
+    assert_silent(JL015_GOOD_BOUNDED_RETRY, "JL015")
+
+
+def test_jl015_fires_on_unsupervised_script_launch():
+    # Both the assembled-command idiom (the original launch.py shape:
+    # cmd = [sys.executable, ...] then subprocess.call(cmd)) and the
+    # inline Popen of a .py script.
+    assert_fires(JL015_BAD_UNSUPERVISED_CALL, "JL015", line=6)
+    assert_fires(JL015_BAD_UNSUPERVISED_POPEN, "JL015", line=5)
+
+
+def test_jl015_silent_on_signal_aware_and_supervised_launches():
+    assert_silent(JL015_GOOD_SIGNAL_AWARE_LAUNCH, "JL015")
+    assert_silent(JL015_GOOD_SUPERVISED_LAUNCH, "JL015")
+
+
+def test_jl015_silent_on_non_script_subprocess():
+    assert_silent(JL015_GOOD_NON_SCRIPT_SUBPROCESS, "JL015")
+
+
+def test_jl015_waiver():
+    waived = JL015_BAD_UNSUPERVISED_POPEN.replace(
+        'env=env)',
+        'env=env)  # jaxlint: disable=JL015 -- fire-and-collect probe, parent never signals it',
+    )
+    assert_silent(waived, "JL015")
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

@@ -437,6 +437,68 @@ def summarize_telemetry(directory: str) -> str | None:
                     f"{pipe} x{n}" for pipe, n in sorted(by_pipe.items())
                 )
             )
+    # Distributed resilience (parallel/elastic.py launcher events +
+    # parallel/distributed.py rendezvous events, ISSUE 10): rank deaths
+    # by rank, gang restarts with time-to-recover, rendezvous attempt
+    # statistics — the elastic runtime's survival receipt.
+    rank_deaths = [e for e in events if e.get("event") == "rank_death"]
+    gang_restarts = [e for e in events if e.get("event") == "gang_restart"]
+    gang_exhausted = [e for e in events if e.get("event") == "gang_exhausted"]
+    rdzv = [e for e in events if e.get("event") == "rendezvous"]
+    rdzv_retries = [e for e in events if e.get("event") == "rendezvous_retry"]
+    if rank_deaths or gang_restarts or gang_exhausted or rdzv or rdzv_retries:
+        deaths_by_rank: dict[str, int] = {}
+        for e in rank_deaths:
+            key = str(e.get("rank", "?"))
+            deaths_by_rank[key] = deaths_by_rank.get(key, 0) + 1
+        attempts = [e.get("attempts", 1) for e in rdzv]
+        mean_attempts = (
+            sum(attempts) / len(attempts) if attempts else 0.0
+        )
+        recoveries = [
+            e.get("downtime_s", 0.0) for e in gang_restarts
+        ]
+        mean_recover = (
+            sum(recoveries) / len(recoveries) if recoveries else 0.0
+        )
+        lines.append(
+            f"  distributed resilience: {len(rank_deaths)} rank death(s), "
+            f"{len(gang_restarts)} gang restart(s), mean rendezvous "
+            f"attempts {mean_attempts:.2f}, mean time-to-recover "
+            f"{mean_recover:.2f} s"
+        )
+        if deaths_by_rank:
+            lines.append(
+                "    rank deaths: "
+                + ", ".join(
+                    f"rank {r} x{n} "
+                    + "("
+                    + "/".join(sorted({
+                        e.get("reason", "?") for e in rank_deaths
+                        if str(e.get("rank", "?")) == r
+                    }))
+                    + ")"
+                    for r, n in sorted(deaths_by_rank.items())
+                )
+            )
+        for e in gang_restarts:
+            lines.append(
+                f"    gang restart {e.get('attempt', '?')}: backoff "
+                f"{e.get('backoff_s', 0.0):.2f} s, downtime "
+                f"{e.get('downtime_s', 0.0):.2f} s (rank "
+                f"{e.get('rank', '?')} {e.get('reason', '?')})"
+            )
+        if rdzv_retries:
+            lines.append(
+                f"    rendezvous retries: {len(rdzv_retries)} "
+                f"(last: {rdzv_retries[-1].get('error', '?')})"
+            )
+        for e in gang_exhausted:
+            lines.append(
+                f"    gang EXHAUSTED after {e.get('attempts', '?')} "
+                f"attempt(s) (budget {e.get('budget', '?')}, rank "
+                f"{e.get('rank', '?')} {e.get('reason', '?')})"
+            )
     # Serving pipeline telemetry (serving/batcher.py under --telemetry-dir):
     # per-request latency plus per-batch fill/stall — the operator's view
     # of how well the in-flight window is overlapping.

@@ -34,6 +34,24 @@ Exit 0 when every scheduled round passed; 1 with per-round FAIL lines
 otherwise.  ``save`` in ``--kill-steps`` schedules the mid-save kill
 (``kill:ckpt_save:after=1``: die between the rotation and the publish
 of the second periodic checkpoint).
+
+**Distributed mode** (``--distributed --nproc 2``, ISSUE 10) drives the
+ELASTIC runtime end to end with a real multi-rank gang through the
+supervising launcher (``parallel/launch.py --nprocs``):
+
+- an uninterrupted 2-rank baseline;
+- a rank-scoped kill round (``--chaos kill:step:rank=1:after=4``): a
+  REAL rank dies mid-epoch, the launcher SIGTERMs the survivor with
+  bounded grace, gang-restarts from the coordinated mid-epoch archive
+  (the children's elastic-resume contract), and the run completes —
+  the round FAILS unless ``launch_restarts_total`` ≥ 1, the
+  ``rank_death``/``gang_restart`` events fired, and the final params +
+  loss curve are byte-identical to the baseline;
+- a ``--restart-budget 0`` round: the same kill must escalate to a
+  clean non-zero launcher exit with exactly ONE diagnostic line.
+
+    python tools/train_chaos.py --distributed --nproc 2 \\
+        --chaos kill:step:rank=1:after=4
 """
 
 from __future__ import annotations
@@ -141,6 +159,49 @@ def _archives_bit_equal(a: str, b: str) -> list[str]:
         elif va.tobytes() != vb.tobytes():
             diff = np.max(np.abs(va.astype(np.float64) - vb.astype(np.float64)))
             problems.append(f"{k}: bytes differ (max |delta| {diff:g})")
+    return problems
+
+
+def _archives_close(a: str, b: str, atol: float) -> list[str]:
+    """Same keys/dtypes/shapes and every array within ``atol`` — the
+    cross-topology bar (sample-exact continuation, FP-reassociated
+    reductions; see the reshard-resume round)."""
+    import numpy as np
+
+    za, zb = _archive_arrays(a), _archive_arrays(b)
+    problems = []
+    if set(za) != set(zb):
+        problems.append(
+            f"key sets differ: only-in-{a}: {sorted(set(za) - set(zb))}, "
+            f"only-in-{b}: {sorted(set(zb) - set(za))}"
+        )
+    for k in sorted(set(za) & set(zb)):
+        va, vb = za[k], zb[k]
+        if va.dtype != vb.dtype or va.shape != vb.shape:
+            problems.append(f"{k}: {va.dtype}{va.shape} vs {vb.dtype}{vb.shape}")
+            continue
+        diff = float(
+            np.max(np.abs(va.astype(np.float64) - vb.astype(np.float64)))
+        ) if va.size else 0.0
+        if diff > atol:
+            problems.append(f"{k}: max |delta| {diff:g} > atol {atol:g}")
+    return problems
+
+
+def _curve_close_to(sub: dict, base: dict, label: str,
+                    atol: float) -> list[str]:
+    """Every (epoch, step) of ``sub`` exists in ``base`` within ``atol``
+    — the loss-curve-compatibility bar for re-sharded continuations."""
+    problems = []
+    for key, loss in sorted(sub.items()):
+        if key not in base:
+            problems.append(f"{label}: step {key} not in baseline curve")
+        elif not (abs(loss - base[key]) <= atol
+                  or (loss != loss and base[key] != base[key])):
+            problems.append(
+                f"{label}: loss at {key} = {loss!r} vs baseline "
+                f"{base[key]!r} (|delta| > {atol:g})"
+            )
     return problems
 
 
@@ -307,6 +368,217 @@ def _nan_round(args, results: list) -> None:
     results.append((name, problems))
 
 
+# ---------------------------------------------------------------------------
+# Distributed mode (ISSUE 10): real multi-rank gang through the
+# supervising launcher.
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launcher_cmd(args, *, port, launch_extra, trainer_extra):
+    """One supervised 2-rank world: N rank processes x 1 CPU device."""
+    return [
+        sys.executable, "-m", "pytorch_mnist_ddp_tpu.parallel.launch",
+        "--nprocs", str(args.nproc), "--nproc_per_node", "1",
+        "--backend", "cpu", "--master_port", str(port),
+        "--rdzv-timeout-s", "120",
+        *launch_extra,
+        os.path.join(REPO, "mnist_ddp.py"), "--no-accel",
+        "--data-root", args.data_root,
+        "--epochs", str(args.epochs),
+        "--batch-size", str(args.batch_size),
+        "--test-batch-size", str(args.test_batch_size),
+        "--seed", str(args.seed),
+        "--log-interval", "1000000",
+        *trainer_extra,
+    ]
+
+
+def _read_events(tel_dir: str, name: str) -> list[dict]:
+    from pytorch_mnist_ddp_tpu.obs.events import read_events
+
+    out = []
+    for path in sorted(glob.glob(os.path.join(tel_dir, "*.jsonl"))):
+        out.extend(e for e in read_events(path) if e.get("event") == name)
+    return out
+
+
+def _distributed_main(args) -> int:
+    """Baseline -> rank-kill gang-restart -> budget-0 escalation."""
+    print(f"train_chaos[distributed]: {args.nproc}-rank gang, "
+          f"workdir {args.workdir}, chaos {args.chaos!r}")
+    results: list[tuple[str, list[str]]] = []
+
+    base_dir = os.path.join(args.workdir, "dist_baseline")
+    base_tel = os.path.join(base_dir, "tel")
+    baseline_final = os.path.join(base_dir, "final.npz")
+    os.makedirs(base_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    _run(
+        _launcher_cmd(args, port=_free_port(), launch_extra=[], trainer_extra=[
+            "--save-state", baseline_final,
+            "--telemetry-dir", base_tel,
+        ]),
+        check_code=0, label="distributed baseline",
+    )
+    base_curve = _step_losses(base_tel)
+    print(f"  baseline: {args.epochs} epoch(s) x {args.nproc} ranks, "
+          f"{len(base_curve)} steps ({time.perf_counter() - t0:.1f} s)")
+
+    # Round 1: rank-scoped kill -> supervisor gang-restart -> resume ->
+    # byte-identical finish.
+    name = f"gang-kill[{args.chaos}]"
+    rd = os.path.join(args.workdir, "dist_kill")
+    tel = os.path.join(rd, "tel")
+    state = os.path.join(rd, "state.npz")
+    os.makedirs(rd, exist_ok=True)
+    _run(
+        _launcher_cmd(
+            args, port=_free_port(),
+            launch_extra=[
+                "--restart-budget", "2", "--grace-s", "10",
+                "--backoff-base-s", "0.1", "--telemetry-dir", tel,
+            ],
+            trainer_extra=[
+                "--chaos", args.chaos,
+                "--preempt-grace-s", "5",
+                "--checkpoint-every-steps",
+                str(args.checkpoint_every_steps),
+                "--save-state", state,
+                "--telemetry-dir", tel,
+            ],
+        ),
+        check_code=0, label=f"{name}: supervised run",
+    )
+    problems = _archives_bit_equal(state, baseline_final)
+    gang_curve = _step_losses(tel)
+    problems += _curve_subset_of(gang_curve, base_curve, "gang curve")
+    if base_curve and max(base_curve) not in gang_curve:
+        problems.append(
+            f"gang curve never reached the baseline's final step "
+            f"{max(base_curve)} (resume did not finish the run)"
+        )
+    deaths = _read_events(tel, "rank_death")
+    restarts = _read_events(tel, "gang_restart")
+    if not deaths:
+        problems.append("no rank_death event: the kill never fired "
+                        "(vacuous green)")
+    if not restarts:
+        problems.append("no gang_restart event: the supervisor never "
+                        "restarted the world")
+    prom_path = os.path.join(tel, "launcher.prom")
+    try:
+        prom = open(prom_path).read()
+    except OSError:
+        prom = ""
+    if not any(
+        line.startswith("launch_restarts_total ")
+        and float(line.split()[-1]) >= 1
+        for line in prom.splitlines()
+    ):
+        problems.append(f"{prom_path}: launch_restarts_total >= 1 missing")
+    results.append((name, problems))
+
+    # Round 2: the same kill with --restart-budget 0 must escalate to a
+    # clean non-zero exit with exactly ONE diagnostic.
+    name0 = "gang-budget0"
+    rd0 = os.path.join(args.workdir, "dist_budget0")
+    tel0 = os.path.join(rd0, "tel")
+    os.makedirs(rd0, exist_ok=True)
+    proc0 = _run(
+        _launcher_cmd(
+            args, port=_free_port(),
+            launch_extra=[
+                "--restart-budget", "0", "--grace-s", "10",
+                "--telemetry-dir", tel0,
+            ],
+            trainer_extra=[
+                "--chaos", args.chaos,
+                "--preempt-grace-s", "5",
+                "--checkpoint-every-steps",
+                str(args.checkpoint_every_steps),
+                "--save-state", os.path.join(rd0, "state.npz"),
+            ],
+        ),
+    )
+    problems0 = []
+    if proc0.returncode == 0:
+        problems0.append("budget-0 launcher exited 0: the kill never "
+                         "escalated")
+    diags = [
+        line for line in proc0.stderr.splitlines()
+        if line.startswith("launch: gang failed")
+    ]
+    if len(diags) != 1:
+        problems0.append(
+            f"expected exactly one 'launch: gang failed' diagnostic, got "
+            f"{len(diags)}: {diags!r}"
+        )
+    results.append((name0, problems0))
+
+    # Round 3: cross-topology resume — the archive the exhausted gang
+    # left behind (coordinated at world size N by N rank processes)
+    # resumes in ONE process driving N local devices.  The sampler
+    # contract makes every remaining batch the SAME global sample set,
+    # but the process striping re-partitions it across devices, so
+    # reductions re-associate: the continuation is SAMPLE-exact and
+    # loss-curve-compatible (tolerance), not bit-exact — only a
+    # same-topology restart (round 1) can be byte-identical.
+    name1 = "reshard-resume"
+    state0 = os.path.join(rd0, "state.npz")
+    problems1: list[str] = []
+    if not (os.path.exists(state0) or os.path.exists(state0 + ".prev")):
+        problems1.append(
+            "the exhausted gang left no coordinated archive to resume"
+        )
+    else:
+        _run(
+            [
+                sys.executable, "-m",
+                "pytorch_mnist_ddp_tpu.parallel.launch",
+                "--nproc_per_node", str(args.nproc), "--backend", "cpu",
+                os.path.join(REPO, "mnist_ddp.py"), "--no-accel",
+                "--data-root", args.data_root,
+                "--epochs", str(args.epochs),
+                "--batch-size", str(args.batch_size),
+                "--test-batch-size", str(args.test_batch_size),
+                "--seed", str(args.seed),
+                "--log-interval", "1000000",
+                "--elastic",  # resume own archive, epochs-as-total
+                "--save-state", state0,
+                "--telemetry-dir", tel0,
+            ],
+            check_code=0, label=f"{name1}: single-process resume",
+        )
+        problems1 += _archives_close(state0, baseline_final, atol=0.15)
+        reshard_curve = _step_losses(tel0)
+        problems1 += _curve_close_to(
+            reshard_curve, base_curve, "reshard curve", atol=0.35
+        )
+        if base_curve and max(base_curve) not in reshard_curve:
+            problems1.append(
+                "reshard curve never reached the baseline's final step"
+            )
+    results.append((name1, problems1))
+
+    failed = False
+    for rname, rproblems in results:
+        if rproblems:
+            failed = True
+            print(f"FAIL {rname}:")
+            for line in rproblems:
+                print(f"    {line}")
+        else:
+            print(f"PASS {rname}")
+    return 1 if failed else 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(
         description="trainer chaos harness: kill -> resume -> verify "
@@ -335,6 +607,19 @@ def main() -> int:
                    help="NaN-injection round: poison step K under "
                         "--loss-guard and require a bit-exact heal "
                         "(-1 = skip; default: 5)")
+    p.add_argument("--distributed", action="store_true", default=False,
+                   help="elastic-runtime mode (ISSUE 10): drive a real "
+                        "--nproc-rank gang through the supervising "
+                        "launcher, kill one rank mid-epoch (--chaos), and "
+                        "require gang-restart + byte-identical finish")
+    p.add_argument("--nproc", type=int, default=2, metavar="N",
+                   help="rank processes in the distributed gang "
+                        "(default: 2)")
+    p.add_argument("--chaos", default="kill:step:rank=1:after=4",
+                   metavar="SPEC",
+                   help="distributed-round chaos clause (rank-scoped "
+                        "trainer grammar; default: kill rank 1 before its "
+                        "5th step)")
     args = p.parse_args()
 
     if args.workdir is None:
@@ -346,6 +631,8 @@ def main() -> int:
         args.data_root = os.path.join(args.workdir, "data")
         _write_synthetic_idx(args.data_root, args.synthetic,
                              max(args.synthetic // 3, args.test_batch_size))
+    if args.distributed:
+        return _distributed_main(args)
     print(f"train_chaos: workdir {args.workdir}, data {args.data_root}")
 
     base_dir = os.path.join(args.workdir, "baseline")
