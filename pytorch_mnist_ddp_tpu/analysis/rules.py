@@ -1,4 +1,4 @@
-"""The jaxlint rule set: JL001–JL015, the JAX hazards this repo has
+"""The jaxlint rule set: JL001–JL016, the JAX hazards this repo has
 actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, the
 serving layer's per-request-shape retrace class, the telemetry layer's
 record-at-trace-time class, the serving pipeline's
@@ -6,8 +6,9 @@ blocking-read-in-dispatch-loop class, the startup phase's serial-warmup
 class, the steady-state input pipeline's host-blocking-feed class, the
 replica pool's per-replica-re-trace class, the fault-tolerance
 layer's swallowed-dispatch-error class, the resilient trainer's
-torn-file / uncadenced-checkpoint-write class, and the elastic
-runtime's unbounded-rendezvous / unsupervised-launch class).
+torn-file / uncadenced-checkpoint-write class, the elastic
+runtime's unbounded-rendezvous / unsupervised-launch class, and the
+tail-latency layer's deadline-blind fixed-linger class).
 
 Every rule is a heuristic over one module's AST — no type inference, no
 cross-file call graph.  "Traced context" below means: a function that is
@@ -2016,6 +2017,124 @@ class ElasticLaunchRule(Rule):
                 )
 
 
+# ---------------------------------------------------------------------------
+# JL016 — deadline-blind fixed linger in a dispatch loop
+
+
+# Loop-body identifiers that count as "this loop consults request
+# deadlines": the deadline itself, a remaining-budget computation, an
+# expiry check, or a due()-style gate.  Any ONE of them anywhere in the
+# loop body is taken as deadline-awareness (the taught idiom computes a
+# close deadline from the oldest member's budget and sleeps THAT).
+_DEADLINE_HINTS = ("deadline", "remaining", "budget", "expire", "due")
+
+_SLEEP_CALLS = {"time.sleep", "sleep"}
+
+
+class FixedLingerDispatchRule(Rule):
+    """JL016: a dispatch loop that sleeps a FIXED linger, blind to
+    request deadlines — the tail-latency hazard class the deadline-aware
+    batch close exists to remove (docs/SERVING.md).
+
+    The shape ``while True: batch = drain(queue); time.sleep(LINGER);
+    engine.launch(batch)`` treats the linger as a constant of nature:
+    every request pays it, including the one whose deadline budget is
+    nearly spent — which then expires in the batch (a wasted device
+    slot) or answers at p99 instead of p50.  The taught idiom
+    (serving/batcher.py ``_close_at``) computes the batch close from
+    ``min(global linger, oldest member's deadline - service estimate)``
+    and waits THAT, so the sleep is never longer than the tightest
+    budget aboard allows.
+
+    Heuristics: fires on a ``time.sleep(X)`` where (a) the enclosing
+    loop is unbounded (any ``while``, or a ``for`` over something other
+    than a literal ``range(...)``); (b) the same loop body dispatches —
+    a known-jitted call (JL009's resolution: ``jax.jit`` values,
+    ``RecompileSentinel`` wraps, ``self.attr`` bindings) or any
+    ``*.launch(...)`` attribute call; (c) ``X`` is a numeric constant or
+    a linger-named value; and (d) NOTHING in the loop body mentions a
+    deadline-shaped name (deadline/remaining/budget/expire/due) — one
+    mention anywhere is taken as deadline-awareness.  A deliberately
+    fixed cadence (a metronome-style replay driver) is waived inline
+    with a reason.
+    """
+
+    rule_id = "JL016"
+    severity = Severity.WARNING
+    summary = "dispatch loop sleeps a fixed linger, blind to request deadlines"
+
+    @staticmethod
+    def _fixed_sleep(node: ast.AST) -> bool:
+        """``time.sleep(<const>)`` or ``time.sleep(<linger-named>)``."""
+        if not isinstance(node, ast.Call):
+            return False
+        if dotted_name(node.func) not in _SLEEP_CALLS:
+            return False
+        if not node.args:
+            return False
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(
+            arg.value, (int, float)
+        ):
+            return True
+        label = (dotted_name(arg) or "").lower()
+        return "linger" in label
+
+    @staticmethod
+    def _mentions_deadline(body_nodes: list[ast.AST]) -> bool:
+        for node in body_nodes:
+            label = ""
+            if isinstance(node, ast.Attribute):
+                label = (dotted_name(node) or node.attr).lower()
+            elif isinstance(node, ast.Name):
+                label = node.id.lower()
+            if label and any(h in label for h in _DEADLINE_HINTS):
+                return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        module_jit: set[str] = set()
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and BucketShapeRule._is_jit_value(node.value)):
+                module_jit.add(node.targets[0].id)
+        jit_attrs = BlockingReadLoopRule._jit_attr_names(ctx.tree)
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            if SwallowedDispatchErrorRule._is_bounded_for(loop):
+                continue  # a bounded replay/retry is not a dispatch loop
+            body_nodes = list(iter_loop_body_nodes(loop))
+            dispatches = any(
+                isinstance(n, ast.Call)
+                and (
+                    BlockingReadLoopRule._is_jit_call(n, module_jit, jit_attrs)
+                    or (isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "launch")
+                )
+                for n in body_nodes
+            )
+            if not dispatches:
+                continue
+            if self._mentions_deadline(body_nodes):
+                continue
+            for node in body_nodes:
+                if self._fixed_sleep(node):
+                    yield self.finding(
+                        ctx, node,
+                        "fixed linger sleep inside a dispatch loop that "
+                        "never consults request deadlines: every request "
+                        "pays the full linger, and one whose budget is "
+                        "nearly spent expires in the batch or answers at "
+                        "p99; close the batch from the oldest member's "
+                        "remaining deadline budget instead "
+                        "(serving/batcher.py _close_at — "
+                        "min(linger, deadline - service estimate))",
+                    )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -2032,6 +2151,7 @@ ALL_RULES: tuple[Rule, ...] = (
     SwallowedDispatchErrorRule(),
     CheckpointWriteRule(),
     ElasticLaunchRule(),
+    FixedLingerDispatchRule(),
 )
 
 

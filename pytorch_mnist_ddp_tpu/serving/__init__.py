@@ -46,6 +46,14 @@ ROADMAP's "heavy traffic" north star:
   (closed/open/half-open) so a replica that throws, hangs, or dies is
   detected, ejected from placement, and healed under live load — and
   the loadgen's ``--chaos`` mode proves it.
+- :mod:`.qos` — tail-latency engineering (PR 11, docs/SERVING.md):
+  per-request QoS classes (``interactive``/``batch``) on a weighted
+  admission queue that sheds the lowest class first under pressure,
+  deadline-aware batch close (the linger is clamped by the oldest
+  member's remaining budget), and hedged dispatch
+  (:class:`~.router.HedgeManager`: stragglers re-dispatch to a second
+  replica after a p99-derived delay, first-wins completion, no
+  double-counted outcomes).
 
 Load-test with ``tools/serve_loadgen.py``; see docs/SERVING.md.
 """
@@ -68,16 +76,27 @@ from .buckets import (
 from .engine import InferenceEngine
 from .metrics import ServingMetrics
 from .pool import EnginePool, ReplicaSupervisor
-from .router import CircuitBreaker, Replica, Router, ShardedRequest
+from .qos import DEFAULT_QOS, QOS_CLASSES, QoSQueue
+from .router import (
+    CircuitBreaker,
+    HedgeManager,
+    Replica,
+    Router,
+    ShardedRequest,
+)
 
 __all__ = [
     "AdaptiveLinger",
     "CircuitBreaker",
+    "DEFAULT_QOS",
     "EnginePool",
     "FaultError",
     "FaultInjector",
+    "HedgeManager",
     "InferenceEngine",
     "MicroBatcher",
+    "QOS_CLASSES",
+    "QoSQueue",
     "RejectedError",
     "Replica",
     "ReplicaDeadError",

@@ -72,6 +72,32 @@ def build_parser() -> argparse.ArgumentParser:
         "0 while the admission queue is deep",
     )
     parser.add_argument(
+        "--no-deadline-close", action="store_true",
+        help="disable deadline-aware batch close: by default a forming "
+        "batch dispatches once the OLDEST member's remaining deadline "
+        "budget no longer covers the estimated service time, instead of "
+        "honoring the global linger (docs/SERVING.md tail latency)",
+    )
+    parser.add_argument(
+        "--qos-weights", default=None, metavar="CLASS=W,...",
+        help="weighted-round-robin service shares for the QoS admission "
+        "queue (default interactive=4,batch=1); requests pick a class "
+        "with the /predict \"qos\" field, and a full queue sheds the "
+        "lowest class first (docs/SERVING.md)",
+    )
+    parser.add_argument(
+        "--hedge", action="store_true",
+        help="with --replicas: hedged dispatch — re-submit a straggler "
+        "request to a second replica once it has waited past its QoS "
+        "class's online p99 (or --hedge-delay-ms), first completion "
+        "wins with exactly one client-visible outcome",
+    )
+    parser.add_argument(
+        "--hedge-delay-ms", type=float, default=None, metavar="MS",
+        help="fixed hedge delay instead of the per-class p99 digest "
+        "(implies --hedge; pool mode only)",
+    )
+    parser.add_argument(
         "--telemetry-dir", default=None,
         help="write serving JSONL telemetry (serving_request/serving_batch "
         "events, pad/dispatch/complete spans) into this directory "
@@ -206,6 +232,47 @@ def main(argv: list[str] | None = None) -> int:
             "reference; drop --bf16 and add bf16 to --dtypes instead"
         )
         return 2
+    # Flag-surface validation BEFORE the (expensive) engine build +
+    # warmup: a config error must fail in milliseconds, not minutes.
+    qos_weights = None
+    if args.qos_weights:
+        from .qos import QOS_CLASSES
+
+        try:
+            qos_weights = {
+                name.strip(): int(w)
+                for name, w in (
+                    part.split("=") for part in args.qos_weights.split(",")
+                )
+            }
+        except ValueError:
+            print(
+                f"error: --qos-weights {args.qos_weights!r} must be "
+                "CLASS=INT[,CLASS=INT...] (e.g. interactive=4,batch=1)"
+            )
+            return 2
+        unknown = sorted(set(qos_weights) - set(QOS_CLASSES))
+        bad = sorted(n for n, w in qos_weights.items() if w < 1)
+        if unknown or bad:
+            # A typo'd class name would silently fall out of the weight
+            # map and the intended class would clamp to weight 1 — the
+            # operator gets WORSE scheduling than the default with zero
+            # diagnostic.  Fail at the flag surface instead.
+            print(
+                f"error: --qos-weights {args.qos_weights!r}: "
+                + (f"unknown class(es) {unknown} "
+                   f"(have {list(QOS_CLASSES)})" if unknown else "")
+                + ("; " if unknown and bad else "")
+                + (f"weight(s) must be >= 1 for {bad}" if bad else "")
+            )
+            return 2
+    hedge = args.hedge or args.hedge_delay_ms is not None
+    if hedge and (args.replicas is None or args.replicas == 1):
+        # --replicas 0 (one per visible device) may still resolve to a
+        # single device; the banner below reports the resolved truth.
+        print("error: --hedge/--hedge-delay-ms need --replicas >= 2 (a "
+              "lone replica has no second replica to hedge onto)")
+        return 2
     engine_kwargs = dict(
         buckets=(
             [int(b) for b in args.buckets.split(",")] if args.buckets else None
@@ -336,6 +403,8 @@ def main(argv: list[str] | None = None) -> int:
         timeout_ms=args.timeout_ms,
         max_inflight=args.max_inflight,
         adaptive_linger=not args.no_adaptive_linger,
+        deadline_aware=not args.no_deadline_close,
+        qos_weights=qos_weights,
     )
     if pool_mode:
         router = engine.start(
@@ -345,6 +414,8 @@ def main(argv: list[str] | None = None) -> int:
                 stall_timeout_s=args.stall_timeout_s,
                 restart_budget=args.restart_budget,
             ),
+            hedge=hedge,
+            hedge_delay_ms=args.hedge_delay_ms,
             **batcher_kwargs,
         )
         server = make_server(
@@ -361,10 +432,19 @@ def main(argv: list[str] | None = None) -> int:
         "GET /healthz liveness, GET /readyz readiness; "
         + (f"{engine.n_replicas} replicas, router policy "
            f"{args.router_policy}, supervisor "
-           f"{'off' if args.no_supervise else 'on'}, per-replica "
+           f"{'off' if args.no_supervise else 'on'}, hedging "
+           # Report the RESOLVED truth: the router silently disables
+           # hedging on a 1-replica pool (--replicas 0 on a 1-device
+           # host), and a banner claiming "on" would mislabel the A/B.
+           + ("off, " if not (hedge and engine.n_replicas > 1) else (
+               f"on ({args.hedge_delay_ms:g} ms), "
+               if args.hedge_delay_ms is not None else "on (p99 digest), "
+           ))
+           + "per-replica "
            if pool_mode else "")
         + f"in-flight window {args.max_inflight}, adaptive linger "
-        f"{'off' if args.no_adaptive_linger else 'on'})"
+        f"{'off' if args.no_adaptive_linger else 'on'}, deadline close "
+        f"{'off' if args.no_deadline_close else 'on'})"
     )
 
     def _shutdown(signum, frame):

@@ -33,10 +33,17 @@ toward 0; when traffic goes idle it relaxes back toward the configured
 ceiling so lone requests still get coalescing's benefit.  Disable it
 (``adaptive_linger=False``) for the fixed-linger PR 3 behavior.
 
-Admission is unchanged: a bounded queue that rejects immediately when
-full (:class:`RejectedError`, the HTTP 503) — the backpressure contract,
+Admission is a bounded **QoS-weighted** queue (serving/qos.py): requests
+carry a class (``interactive``/``batch``), dequeue is weighted
+round-robin so latency-sensitive work overtakes bulk backlog, and a full
+queue sheds the lowest class first before rejecting
+(:class:`RejectedError`, the HTTP 503) — the backpressure contract,
 docs/SERVING.md.  Requests that expire while queued are completed with
-:class:`RequestTimeout` (504) without being dispatched.
+:class:`RequestTimeout` (504) without being dispatched — eagerly, on the
+workers' cadence, not when batch formation happens to reach them.  Batch
+close is **deadline-aware**: the linger is clamped so the oldest
+member's remaining deadline budget still covers the estimated service
+time, instead of holding a nearly-expired request to a global linger.
 
 Shutdown is a graceful drain: ``stop()`` closes admission (new submits
 get 503) and, by default, lets the dispatch worker finish everything
@@ -58,6 +65,7 @@ from .buckets import StagingPool
 from .engine import InferenceEngine
 from .faults import fault_point
 from .metrics import ServingMetrics
+from .qos import DEFAULT_QOS, QOS_CLASSES, QoSQueue
 
 
 class RejectedError(RuntimeError):
@@ -79,21 +87,43 @@ class RequestTimeout(RuntimeError):
 
 
 class PendingRequest:
-    """One admitted request: input rows + dtype + deadline + a result
-    slot.  ``dtype`` selects the engine variant the batch dispatches on
-    (docs/SERVING.md reduced-precision variants); requests only coalesce
-    with same-dtype neighbors."""
+    """One admitted request: input rows + dtype + QoS class + deadline +
+    a result slot.  ``dtype`` selects the engine variant the batch
+    dispatches on (docs/SERVING.md reduced-precision variants); requests
+    only coalesce with same-dtype neighbors.  ``qos`` is the scheduling
+    class (serving/qos.py) the weighted admission queue orders by."""
 
     __slots__ = (
-        "x", "dtype", "deadline", "t_submit", "_event", "_value", "_error",
-        "_lock",
+        "x", "dtype", "qos", "deadline", "t_submit", "completed_by",
+        "_copies", "_event", "_value", "_error", "_lock",
     )
 
-    def __init__(self, x: np.ndarray, deadline: float, dtype: str = "f32"):
+    def __init__(
+        self,
+        x: np.ndarray,
+        deadline: float,
+        dtype: str = "f32",
+        qos: str = DEFAULT_QOS,
+    ):
         self.x = x
         self.dtype = dtype
+        self.qos = qos
         self.deadline = deadline
         self.t_submit = time.perf_counter()
+        # Live-copy count: 1 for the original admission, +1 per hedge
+        # twin (submit_hedge).  Eviction paths — shed, queue flush,
+        # abort's in-flight flush, launch/read failures — consume a
+        # copy (:meth:`drop_copy`) and set a client-visible error ONLY
+        # on the LAST one: while a twin is still live it owns the
+        # outcome, and an eviction error would win the first-wins race
+        # and clobber the twin's (likely successful) answer.
+        self._copies = 1
+        # Which replica's completion won (hedged dispatch,
+        # serving/router.py): set atomically with the winning outcome so
+        # the hedge accounting can tell won from lost without a second
+        # synchronization point.  None for error outcomes that carry no
+        # replica (flushes, expiry).
+        self.completed_by: str | None = None
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._value: np.ndarray | None = None
@@ -106,6 +136,28 @@ class PendingRequest:
     def expired(self, now: float | None = None) -> bool:
         return (now if now is not None else time.perf_counter()) > self.deadline
 
+    def done(self) -> bool:
+        """An outcome (result or error) is already set — a hedged twin
+        answered, or the client's expiry fired.  The dispatch worker
+        skips done requests instead of wasting a device slot on them."""
+        return self._event.is_set()
+
+    # -- live-copy accounting (hedged dispatch, serving/router.py) ----------
+
+    def add_copy(self) -> None:
+        """A hedge twin is being enqueued: one more live copy exists."""
+        with self._lock:
+            self._copies += 1
+
+    def drop_copy(self) -> int:
+        """One copy was evicted without producing an outcome (shed,
+        flush, abort, launch/read failure); returns the number of live
+        copies REMAINING.  Non-zero means another copy still owns the
+        outcome and the evicting path must stay silent."""
+        with self._lock:
+            self._copies = max(0, self._copies - 1)
+            return self._copies
+
     # -- completion (worker side) -------------------------------------------
     #
     # First writer wins, atomically: the supervisor's abort path
@@ -113,21 +165,31 @@ class PendingRequest:
     # on a survivor, and the stuck completion read may STILL finish later
     # and try to set a result.  Exactly one outcome must be visible — a
     # late set after the first is a silent no-op, so a request the
-    # handler already retried can never grow a second answer.
+    # handler already retried can never grow a second answer.  The same
+    # lock is what makes hedged dispatch safe (serving/router.py): the
+    # SAME PendingRequest rides two replicas' queues, and whichever
+    # completion worker sets first is the one client-visible outcome.
+    # Both setters return True only to the winner, so the loser's
+    # worker can skip its metrics/breaker accounting — a hedge must
+    # never double-count (docs/SERVING.md).
 
-    def set_result(self, value: np.ndarray) -> None:
+    def set_result(self, value: np.ndarray, by: str | None = None) -> bool:
         with self._lock:
             if self._event.is_set():
-                return
+                return False
             self._value = value
+            self.completed_by = by
             self._event.set()
+            return True
 
-    def set_error(self, error: BaseException) -> None:
+    def set_error(self, error: BaseException, by: str | None = None) -> bool:
         with self._lock:
             if self._event.is_set():
-                return
+                return False
             self._error = error
+            self.completed_by = by
             self._event.set()
+            return True
 
     # -- consumption (handler side) -----------------------------------------
 
@@ -266,6 +328,9 @@ class MicroBatcher:
         adaptive_linger: bool = True,
         sink=None,
         replica: str | None = None,
+        deadline_aware: bool = True,
+        qos_classes: tuple[str, ...] = QOS_CLASSES,
+        qos_weights: dict[str, int] | None = None,
     ):
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
@@ -305,7 +370,24 @@ class MicroBatcher:
             self.linger_s, enabled=adaptive_linger, registry=self._registry,
             replica=self.replica,
         )
-        self._queue: queue.Queue[PendingRequest] = queue.Queue(maxsize=queue_depth)
+        # Deadline-aware batch close (docs/SERVING.md tail latency): the
+        # linger is additionally clamped so the batch dispatches while
+        # the OLDEST member's remaining deadline budget still covers the
+        # estimated service time (EWMA of launch -> read-back, fed by
+        # the completion worker).  Off = the PR-4 global linger.
+        self.deadline_aware = deadline_aware
+        self._service_ewma_s: float | None = None
+        self.qos_classes = tuple(qos_classes)
+        self._queue: QoSQueue = QoSQueue(
+            maxsize=queue_depth, classes=self.qos_classes, weights=qos_weights
+        )
+        # Eager pre-registration: the per-class families must appear on
+        # the Prometheus exposition from the first scrape, not after the
+        # first completion of each class (CI greps the families from a
+        # short smoke — a lazy family is a flaky grep).
+        if self.metrics is not None:
+            for name in self.qos_classes:
+                self.metrics.ensure_qos(name)
         # Launched-but-unread batches; the semaphore IS the window bound,
         # the queue just carries them to the completion worker in order.
         self._window = threading.Semaphore(max_inflight)
@@ -389,6 +471,10 @@ class MicroBatcher:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 return
+            if req.drop_copy() > 0:
+                # A hedge twin is still live elsewhere and owns the
+                # outcome; erroring this copy would clobber it.
+                continue
             req.set_error(RejectedError("server shutting down"))
             # Pool mode: the HTTP handler resubmits a flushed request on
             # a surviving replica (serving/server.py), so the client may
@@ -440,6 +526,8 @@ class MicroBatcher:
         )
         for item in live:
             for req in item.batch:
+                if req.drop_copy() > 0:
+                    continue  # a live hedge twin owns the outcome
                 req.set_error(dead)
                 flushed += 1
         # If the completion worker is merely slow (not hung), the
@@ -461,12 +549,18 @@ class MicroBatcher:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 return flushed
+            if req.drop_copy() > 0:
+                continue  # a live hedge twin owns the outcome
             req.set_error(dead)
             flushed += 1
 
     def depth(self) -> int:
         """Current admission-queue depth (the /metrics gauge)."""
         return self._queue.qsize()
+
+    def qos_depths(self) -> dict[str, int]:
+        """Per-class admission-queue depths (the /metrics qos block)."""
+        return self._queue.sizes()
 
     def inflight(self) -> int:
         """Batches launched but not yet read back (the /metrics gauge)."""
@@ -499,6 +593,7 @@ class MicroBatcher:
         x: np.ndarray,
         timeout_ms: float | None = None,
         dtype: str | None = None,
+        qos: str | None = None,
         count_reject: bool = True,
     ) -> PendingRequest:
         """Admit one request of ``[n, 28, 28, 1]`` rows or reject now.
@@ -508,16 +603,28 @@ class MicroBatcher:
         when the bounded queue is full — the reject-don't-queue
         backpressure contract — or when ``dtype`` names a variant the
         engine does not serve / has not parity-verified (the refusal
-        contract, docs/SERVING.md).  ``count_reject=False`` suppresses
-        the rejection COUNTER only (the exception still raises): the
-        router tries replicas in policy order and a skipped-and-retried
-        replica is not a client-visible 503.
+        contract, docs/SERVING.md).  ``qos`` names the scheduling class
+        (serving/qos.py; default the most latency-sensitive): a full
+        queue first sweeps expired entries, then sheds the newest
+        request of a strictly LOWER class to admit this one
+        (``serving_shed_total{qos=}``) before giving up with the 503.
+        ``count_reject=False`` suppresses the rejection COUNTER only
+        (the exception still raises): the router tries replicas in
+        policy order and a skipped-and-retried replica is not a
+        client-visible 503.
         """
         x = np.asarray(x, np.float32)
         if self._closed.is_set():
             if count_reject and self.metrics is not None:
                 self.metrics.record_rejected()
             raise RejectedError("server draining; not accepting requests")
+        qos = qos or DEFAULT_QOS
+        if qos not in self.qos_classes:
+            if count_reject and self.metrics is not None:
+                self.metrics.record_rejected()
+            raise RejectedError(
+                f"unknown QoS class {qos!r}; have {list(self.qos_classes)}"
+            )
         dtype = dtype or self._default_dtype
         if dtype != self._default_dtype:
             served = getattr(self.engine, "dtypes", (self._default_dtype,))
@@ -543,16 +650,17 @@ class MicroBatcher:
             )
         timeout_s = self.timeout_s if timeout_ms is None else timeout_ms / 1e3
         req = PendingRequest(
-            x, deadline=time.perf_counter() + timeout_s, dtype=dtype
+            x, deadline=time.perf_counter() + timeout_s, dtype=dtype, qos=qos
         )
         try:
             self._queue.put_nowait(req)
         except queue.Full:
-            if count_reject and self.metrics is not None:
-                self.metrics.record_rejected()
-            raise RejectedError(
-                f"admission queue full ({self._queue.maxsize} deep)"
-            ) from None
+            if not self._admit_under_pressure(req):
+                if count_reject and self.metrics is not None:
+                    self.metrics.record_rejected()
+                raise RejectedError(
+                    f"admission queue full ({self._queue.maxsize} deep)"
+                ) from None
         if self.metrics is not None:
             self.metrics.record_admitted()
         # Close the abort race: admission passed the _closed check
@@ -568,17 +676,149 @@ class MicroBatcher:
             self._flush_dead()
         return req
 
+    def _admit_under_pressure(self, req: PendingRequest) -> bool:
+        """Full-queue admission ladder: (1) eagerly sweep requests that
+        expired (or were satisfied by a hedge twin) while queued — the
+        satellite bugfix: their slots and any held circuit trial tokens
+        free NOW, not when batch formation reaches them; (2) shed the
+        newest queued request of a strictly lower class (lowest class
+        first, serving/qos.py) so interactive goodput holds under
+        pressure while batch absorbs the 503s.  Returns True once
+        ``req`` is queued."""
+        for attempt in range(2):
+            if attempt == 0:
+                self.sweep_expired()
+            else:
+                victim = self._queue.shed_for(req.qos)
+                if victim is None:
+                    return False
+                self._shed(victim)
+            try:
+                self._queue.put_nowait(req)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _shed(self, victim: PendingRequest) -> None:
+        """Complete a load-shed victim with the 503 and count it.  In
+        pool mode the handler's failure-aware retry may still land it on
+        a less-loaded replica; the shed counter is the operator's
+        pressure signal either way (docs/OBSERVABILITY.md)."""
+        if victim.drop_copy() > 0:
+            # One copy of a hedged request: another live copy owns the
+            # outcome (or will).  Setting RejectedError here would WIN
+            # the first-wins race and discard the twin's — likely
+            # successful — answer, turning a hedge into a client 503.
+            # Dropping the copy silently just cancels this replica's
+            # side of the hedge; the slot is freed either way.  (When
+            # the LAST copy is evicted, whichever eviction path takes
+            # it sets the client-visible error as usual.)
+            return
+        won = victim.set_error(
+            RejectedError(
+                f"shed under pressure (QoS {victim.qos!r} yielded the "
+                "queue slot to a higher class)"
+            )
+        )
+        if self.metrics is not None and won:
+            self.metrics.record_shed(victim.qos)
+            # Single-engine mode: the shed IS the client outcome (no
+            # retry exists), same accounting rule as _flush_rejected.
+            if self.replica is None:
+                self.metrics.record_rejected()
+        if self._sink and won:
+            self._sink.emit(
+                "qos_shed", qos=victim.qos, n=victim.n,
+                **({"replica": self.replica} if self.replica else {}),
+            )
+        if self.on_expire is not None and won:
+            # A shed is no outcome for the replica either way — but any
+            # half-open trial token the victim held must come back, the
+            # same leak the expiry path plugs (serving/router.py).
+            try:
+                self.on_expire(1)
+            except Exception:
+                pass  # an observability hook must not kill the caller
+
+    def sweep_expired(self) -> int:
+        """Eagerly expire every queued request whose deadline already
+        passed (and silently drop hedge twins that were satisfied
+        elsewhere).  Called by the workers on their natural cadence and
+        by the full-queue admission path; public so the supervisor or
+        tests can force a sweep.  Returns the number expired."""
+        expired = self._queue.sweep_expired()
+        for req in expired:
+            self._expire(req)
+        return len(expired)
+
+    def submit_hedge(self, req: PendingRequest) -> None:
+        """Enqueue an ALREADY-ADMITTED request a second time — hedged
+        dispatch (serving/router.py): the same :class:`PendingRequest`
+        rides this replica's queue beside its still-in-flight twin, and
+        the first completion wins under the request's own lock.
+
+        Deliberately narrower than :meth:`submit`: no new deadline (the
+        hedge runs on the ORIGINAL admission's remaining budget), no
+        admitted count (one client request, one admission), no shedding
+        (a hedge is opportunistic — it must never evict real work), and
+        a full queue is a plain :class:`RejectedError` the hedger treats
+        as "this replica declined".
+        """
+        if self._closed.is_set():
+            raise RejectedError("replica draining; not accepting hedges")
+        if req.done() or req.expired():
+            raise RejectedError("hedge target already settled")
+        # Counted BEFORE the enqueue: from this instant the request has
+        # two live copies, and eviction paths consume copies silently
+        # until the last one (drop_copy).
+        req.add_copy()
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            # The twin was never enqueued: give its copy back.  If an
+            # eviction consumed the ORIGIN's copy during the window
+            # where the count read 2 (it stayed silent, expecting this
+            # twin to own the outcome), this request now has zero live
+            # copies — set the retriable eviction error here so the
+            # client's handler resubmits instead of idling into a 504.
+            if req.drop_copy() == 0 and not req.done():
+                req.set_error(RejectedError(
+                    "evicted under pressure while a hedge was declined"
+                ))
+            raise RejectedError("admission queue full; hedge declined") from None
+        if self._aborted:
+            self._flush_dead()
+
     # -- dispatch worker ------------------------------------------------------
 
     def _expire(self, req: PendingRequest) -> None:
-        req.set_error(RequestTimeout("expired in queue before dispatch"))
-        if self.metrics is not None:
+        # won=False: a hedge twin on another replica already settled the
+        # request (or a concurrent sweep beat us) — the timeout must not
+        # double-count, but any trial token THIS replica holds for the
+        # request still returns through on_expire.
+        won = req.set_error(RequestTimeout("expired in queue before dispatch"))
+        if self.metrics is not None and won:
             self.metrics.record_timeout()
         if self.on_expire is not None:
             try:
                 self.on_expire(1)
             except Exception:
                 pass  # an observability hook must not kill the worker
+
+    def _close_at(self, now: float, linger: float, oldest_deadline: float) -> float:
+        """When this forming batch must dispatch: the linger ceiling,
+        clamped — when ``deadline_aware`` — so the OLDEST member's
+        remaining deadline budget still covers the estimated service
+        time (EWMA of launch→read-back).  A global linger holds a
+        nearly-expired request hostage to traffic that may never come;
+        the member's own budget is the thing that actually expires
+        (docs/SERVING.md tail latency)."""
+        close = now + linger
+        if self.deadline_aware:
+            margin = self._service_ewma_s or 0.0
+            close = min(close, oldest_deadline - margin)
+        return close
 
     def _run(self) -> None:
         carry: PendingRequest | None = None
@@ -592,27 +832,36 @@ class MicroBatcher:
                     if self._closed.is_set():
                         return
                     # Idle tick: let the controller relax back toward the
-                    # ceiling even when no batch is forming.
+                    # ceiling even when no batch is forming, and eagerly
+                    # expire anything whose deadline passed while queued
+                    # (the satellite bugfix — its slot and any circuit
+                    # trial token free now, not at next batch formation).
                     self._linger.update(0)
+                    self.sweep_expired()
                     continue
+            if first.done():
+                continue  # settled elsewhere (hedge twin won); free slot
             if first.expired():
                 self._expire(first)
                 continue
             batch = [first]
             total = first.n
-            # Linger: coalesce until the batch is full or the deadline
-            # passes.  A draining batcher skips the linger — nothing new
-            # is being admitted, so waiting only delays shutdown.  The
-            # adaptive controller sets the deadline from the CURRENT
-            # queue depth: deep queue -> the next batch is already here,
-            # lingering is pure latency.
+            oldest_deadline = first.deadline
+            # Linger: coalesce until the batch is full or the close
+            # deadline passes.  A draining batcher skips the linger —
+            # nothing new is being admitted, so waiting only delays
+            # shutdown.  The adaptive controller sets the linger from
+            # the CURRENT queue depth: deep queue -> the next batch is
+            # already here, lingering is pure latency.  Deadline-aware
+            # close additionally dispatches early when the oldest
+            # member's budget is nearly spent (_close_at).
             linger = (
                 0.0 if self._closed.is_set()
                 else self._linger.update(self._queue.qsize())
             )
-            deadline = time.perf_counter() + linger
+            close_at = self._close_at(time.perf_counter(), linger, oldest_deadline)
             while total < self.max_batch:
-                remaining = deadline - time.perf_counter()
+                remaining = close_at - time.perf_counter()
                 try:
                     nxt = (
                         self._queue.get_nowait()
@@ -621,6 +870,8 @@ class MicroBatcher:
                     )
                 except queue.Empty:
                     break
+                if nxt.done():
+                    continue  # hedge twin already answered; drop silently
                 if nxt.expired():
                     self._expire(nxt)
                     continue
@@ -635,6 +886,17 @@ class MicroBatcher:
                     break
                 batch.append(nxt)
                 total += nxt.n
+                if nxt.deadline < oldest_deadline:
+                    # QoS-weighted dequeue can hand us a member with an
+                    # EARLIER deadline than the batch leader; the close
+                    # clamp tracks the tightest budget aboard.
+                    oldest_deadline = nxt.deadline
+                    close_at = min(
+                        close_at,
+                        self._close_at(
+                            time.perf_counter(), linger, oldest_deadline
+                        ),
+                    )
             self._dispatch(batch)
 
     def _dispatch(self, batch: list[PendingRequest]) -> None:
@@ -644,6 +906,12 @@ class MicroBatcher:
         compute — only (briefly) on a full in-flight window, which is
         recorded as pipeline stall.
         """
+        # A member can settle between its dequeue and here (a hedge twin
+        # completing on the other replica): dispatching it would burn
+        # bucket rows on an answer nobody is waiting for.
+        batch = [r for r in batch if not r.done()]
+        if not batch:
+            return
         parts = [r.x for r in batch]
         total = sum(len(p) for p in parts)
         if self._staging is None:
@@ -695,16 +963,22 @@ class MicroBatcher:
                     f"{type(e).__name__}: {e}"
                 )
                 err.__cause__ = e
-            for req in batch:
-                req.set_error(err)
+            # Only requests whose outcome THIS failure decided count on
+            # the failed tally — a hedge twin that already answered
+            # elsewhere (first-wins) or is still live elsewhere
+            # (drop_copy) is not a client-visible failure here.
+            failed = sum(
+                1 for req in batch
+                if req.drop_copy() == 0 and req.set_error(err)
+            )
             # Same post-abort guard as the completion worker: a launch
             # that fails AFTER abort unstuck this worker (window
             # released on a dead engine) is the old pipeline's corpse
             # twitching — striking the restarted replica's breaker
             # would re-open a healthy half-open circuit, and these
             # requests were already flushed and retried.
-            if self.metrics is not None and not self._aborted:
-                self.metrics.record_failed(len(batch))
+            if self.metrics is not None and not self._aborted and failed:
+                self.metrics.record_failed(failed)
             if self.on_failure is not None and not self._aborted:
                 try:
                     self.on_failure(len(batch))
@@ -731,8 +1005,11 @@ class MicroBatcher:
         if aborted:
             # abort() ran between the launch and this bookkeeping; its
             # _live sweep could not see this batch, so its waiters are
-            # completed here (same retriable outcome, no thread waits).
+            # completed here (same retriable outcome, no thread waits;
+            # a copy with a live hedge twin stays silent as everywhere).
             for req in batch:
+                if req.drop_copy() > 0:
+                    continue
                 req.set_error(ReplicaDeadError(
                     f"replica {self.replica or '?'} aborted by the supervisor"
                 ))
@@ -773,16 +1050,22 @@ class MicroBatcher:
                         f"{type(e).__name__}: {e}"
                     )
                     err.__cause__ = e
-                for req in item.batch:
-                    req.set_error(err)
+                # First-wins + live-copy gate: only requests whose
+                # outcome THIS failure decided count (a hedge twin that
+                # answered — or is still live — on another replica is
+                # not a client-visible failure here).
+                failed = sum(
+                    1 for req in item.batch
+                    if req.drop_copy() == 0 and req.set_error(err)
+                )
                 # Post-abort, this outcome belongs to a DEAD pipeline:
                 # the waiters were already errored and retried on
                 # survivors, and the replica's breaker now guards a
                 # RESTARTED batcher — a late failure striking it would
                 # re-open a healthy half-open circuit and march the
                 # supervisor's ladder toward a spurious ejection.
-                if self.metrics is not None and not self._aborted:
-                    self.metrics.record_failed(len(item.batch))
+                if self.metrics is not None and not self._aborted and failed:
+                    self.metrics.record_failed(failed)
                 if self.on_failure is not None and not self._aborted:
                     try:
                         self.on_failure(len(item.batch))
@@ -790,6 +1073,14 @@ class MicroBatcher:
                         pass  # a hook failure must never kill the worker
             else:
                 done = time.perf_counter()
+                # Service-time estimate (launch -> read-back) feeding
+                # the deadline-aware batch close: the margin a forming
+                # batch reserves out of its oldest member's budget.
+                dur = done - item.t_launch
+                self._service_ewma_s = (
+                    dur if self._service_ewma_s is None
+                    else 0.2 * dur + 0.8 * self._service_ewma_s
+                )
                 # Event schema note: the replica tag appears only in
                 # pool mode, so single-engine JSONL stays byte-stable.
                 tag = {"replica": self.replica} if self.replica else {}
@@ -803,12 +1094,22 @@ class MicroBatcher:
                 aborted = self._aborted
                 offset = 0
                 for req in item.batch:
-                    req.set_result(host[offset : offset + req.n])
+                    # First-wins gate doubles as the hedge cancellation
+                    # accounting (docs/SERVING.md): the losing replica's
+                    # read must not re-count the request on completed/
+                    # latency families nor feed on_complete -> the
+                    # breaker's success side — exactly one client
+                    # outcome, counted exactly once.
+                    won = req.set_result(
+                        host[offset : offset + req.n], by=self.replica
+                    )
                     offset += req.n
                     latency_s = done - req.t_submit
+                    if not won:
+                        continue
                     if self.metrics is not None and not aborted:
                         self.metrics.record_completed(
-                            latency_s, dtype=req.dtype
+                            latency_s, dtype=req.dtype, qos=req.qos
                         )
                     if self.on_complete is not None and not aborted:
                         try:
@@ -823,7 +1124,13 @@ class MicroBatcher:
                         self._sink.emit(
                             "serving_request", n=req.n,
                             latency_s=latency_s,
-                            dtype=req.dtype, **tag,
+                            dtype=req.dtype,
+                            # Schema note: the qos tag appears only for
+                            # non-default classes, so pre-QoS JSONL
+                            # consumers see an unchanged record.
+                            **({"qos": req.qos}
+                               if req.qos != DEFAULT_QOS else {}),
+                            **tag,
                         )
             finally:
                 self._staging.release(item.staged, item.bucket)
@@ -845,3 +1152,9 @@ class MicroBatcher:
                     dtype=item.dtype,
                     **({"replica": self.replica} if self.replica else {}),
                 )
+            # Eager expiry on the completion cadence too: when the
+            # dispatch worker is parked on a full in-flight window, this
+            # is the thread that still runs — queued requests whose
+            # deadline passed must not hold their slots (or circuit
+            # trial tokens) until the window frees.
+            self.sweep_expired()

@@ -104,6 +104,15 @@ class ServingMetrics:
         self._reservoir = reservoir
         self._dtype_count: dict[str, object] = {}
         self._dtype_latency: dict[str, object] = {}
+        # Per-QoS-class surface (ISSUE 11, docs/SERVING.md tail
+        # latency): request count + latency per scheduling class, the
+        # load-shed tally, and the hedged-dispatch outcome tally.  The
+        # batcher pre-registers its classes (ensure_qos) so the families
+        # are scrapeable from the first exposition.
+        self._qos_count: dict[str, object] = {}
+        self._qos_latency: dict[str, object] = {}
+        self._shed: dict[str, object] = {}
+        self._hedges: dict[str, object] = {}
 
     # -- counter views (back-compat attribute surface) ------------------------
 
@@ -194,12 +203,84 @@ class ServingMetrics:
             replica=replica,
         ).set(depth)
 
-    def record_completed(self, latency_s: float, dtype: str | None = None) -> None:
+    def ensure_qos(self, qos: str) -> None:
+        """Pre-register one QoS class's count/latency/shed families so
+        they render on the exposition before the first observation (CI
+        greps a short smoke's dump; lazily-born families are flaky)."""
+        if qos in self._qos_count:
+            return
+        with self.registry.locked():
+            self._qos_count[qos] = self.registry.counter(
+                "serving_qos_requests_total",
+                help="completed requests per QoS class",
+                qos=qos,
+            )
+            self._qos_latency[qos] = self.registry.histogram(
+                "serving_qos_latency_seconds",
+                help="request latency per QoS class (reservoir window)",
+                reservoir=self._reservoir,
+                qos=qos,
+            )
+            self._shed[qos] = self.registry.counter(
+                "serving_shed_total",
+                help="requests load-shed from the admission queue per "
+                "QoS class (lowest class first under pressure)",
+                qos=qos,
+            )
+
+    def ensure_hedges(self) -> None:
+        """Pre-register the hedge outcome family (the router's hedger
+        calls this once when hedging is enabled) — same scrapeable-from-
+        first-exposition rationale as :meth:`ensure_qos`."""
+        for outcome in ("won", "lost", "cancelled"):
+            self._hedges[outcome] = self.registry.counter(
+                "serving_hedges_total",
+                help="hedged dispatches by outcome: won = the hedge's "
+                "completion was the client-visible one, lost = the "
+                "primary answered first, cancelled = a due hedge was "
+                "abandoned before or without a decisive dispatch",
+                outcome=outcome,
+            )
+
+    def record_shed(self, qos: str) -> None:
+        """One request evicted from the admission queue to admit a
+        higher class under pressure (serving/qos.py)."""
+        self.ensure_qos(qos)
+        self._shed[qos].inc()
+
+    def record_hedge(self, outcome: str) -> None:
+        if outcome not in self._hedges:
+            self.ensure_hedges()  # registers the full outcome set once
+        self._hedges[outcome].inc()
+
+    def qos_p99_s(self, qos: str, min_samples: int = 20) -> float | None:
+        """Online per-class p99 (seconds) from the latency reservoir —
+        the hedger's delay digest.  None until ``min_samples``
+        observations exist: hedging on a cold estimate would fire on
+        noise."""
+        hist = self._qos_latency.get(qos)
+        if hist is None:
+            return None
+        window = hist.values()
+        if len(window) < min_samples:
+            return None
+        return percentile(sorted(window), 99)
+
+    def record_completed(
+        self,
+        latency_s: float,
+        dtype: str | None = None,
+        qos: str | None = None,
+    ) -> None:
         """One request finished; ``latency_s`` spans submit -> result set.
         ``dtype`` additionally lands the request on the per-variant
-        count/latency families."""
+        count/latency families, ``qos`` on the per-class ones."""
         self._requests["completed"].inc()
         self._latency.observe(latency_s)
+        if qos is not None:
+            self.ensure_qos(qos)
+            self._qos_count[qos].inc()
+            self._qos_latency[qos].observe(latency_s)
         if dtype is None:
             return
         counter = self._dtype_count.get(dtype)
@@ -255,6 +336,18 @@ class ServingMetrics:
                     sorted(self._dtype_latency[name].values()),
                 )
                 for name in self._dtype_count
+            }
+            by_qos = {
+                name: (
+                    self._qos_count[name].value,
+                    sorted(self._qos_latency[name].values()),
+                    self._shed[name].value,
+                )
+                for name in self._qos_count
+            }
+            hedges = {
+                outcome: counter.value
+                for outcome, counter in self._hedges.items()
             }
             fills = self._fill.values()
             stalls = sorted(self._stall.values())
@@ -316,6 +409,23 @@ class ServingMetrics:
                 }
                 for name, (count, window) in sorted(by_dtype.items())
             }
+        if by_qos:
+            # The tail-latency surface (docs/SERVING.md): per-class
+            # percentiles + shed counts, and hedge outcomes when the
+            # router's hedger is on.  Classes appear as soon as a
+            # batcher registers them (ensure_qos), count 0 until served.
+            snap["qos"] = {
+                name: {
+                    "requests": count,
+                    "shed": shed,
+                    "p50_ms": 1e3 * percentile(window, 50),
+                    "p95_ms": 1e3 * percentile(window, 95),
+                    "p99_ms": 1e3 * percentile(window, 99),
+                }
+                for name, (count, window, shed) in sorted(by_qos.items())
+            }
+        if hedges:
+            snap["hedges"] = dict(sorted(hedges.items()))
         gauges = [
             ("serving_uptime_seconds", "process uptime", uptime),
             ("serving_batch_occupancy_pct", "real samples / dispatched slots",
@@ -385,6 +495,21 @@ class ServingMetrics:
                 f"{pipe['stalls']} stalls "
                 f"({pipe['stall_s_total']:.3f} s total, "
                 f"p95 {pipe['stall_ms_p95']:.2f} ms)"
+            )
+        for name, q in s.get("qos", {}).items():
+            lines.append(
+                f"  qos [{name}]: {q['requests']} ok, {q['shed']} shed, "
+                f"p50 {q['p50_ms']:.2f} ms / p95 {q['p95_ms']:.2f} ms / "
+                f"p99 {q['p99_ms']:.2f} ms"
+            )
+        if s.get("hedges"):
+            h = s["hedges"]
+            placed = h.get("won", 0) + h.get("lost", 0)
+            lines.append(
+                f"  hedges: {h.get('won', 0)} won / {h.get('lost', 0)} lost "
+                f"/ {h.get('cancelled', 0)} cancelled"
+                + (f" (win rate {h.get('won', 0) / placed:.1%})"
+                   if placed else "")
             )
         if "compiles" in s:
             lines.append(

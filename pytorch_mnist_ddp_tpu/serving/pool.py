@@ -571,16 +571,22 @@ class EnginePool:
         sink=None,
         supervise: bool = True,
         supervisor_kwargs: dict | None = None,
+        hedge: bool = False,
+        hedge_delay_ms: float | None = None,
         **batcher_kwargs,
     ) -> Router:
         """Start one pipelined batcher per replica and build the router.
 
         ``batcher_kwargs`` (linger, queue depth, timeouts, in-flight
-        window...) are remembered so :meth:`add` rebuilds identical
-        batchers later.  ``supervise`` (default on) also starts the
-        :class:`ReplicaSupervisor` — quarantine / backoff-restart /
-        ejection of sick replicas (docs/ROBUSTNESS.md);
-        ``supervisor_kwargs`` tunes its thresholds.
+        window, QoS weights, deadline-aware close...) are remembered so
+        :meth:`add` rebuilds identical batchers later.  ``supervise``
+        (default on) also starts the :class:`ReplicaSupervisor` —
+        quarantine / backoff-restart / ejection of sick replicas
+        (docs/ROBUSTNESS.md); ``supervisor_kwargs`` tunes its
+        thresholds.  ``hedge`` enables hedged dispatch
+        (:class:`~.router.HedgeManager`): straggler requests re-dispatch
+        to a second replica after ``hedge_delay_ms`` (None = each
+        class's online p99), first completion wins.
         """
         if self.router is not None:
             raise RuntimeError("pool already started")
@@ -599,6 +605,8 @@ class EnginePool:
             registry=self.metrics.registry,
             sink=self._sink,
             metrics=self.metrics,
+            hedge=hedge,
+            hedge_delay_ms=hedge_delay_ms,
         )
         if supervise:
             self.supervisor = ReplicaSupervisor(

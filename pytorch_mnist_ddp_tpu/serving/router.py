@@ -72,6 +72,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from .batcher import MicroBatcher, PendingRequest, RejectedError
+from .qos import DEFAULT_QOS
 
 POLICIES = ("roundrobin", "least-loaded", "cost")
 
@@ -338,6 +339,229 @@ class ShardedRequest:
         return self._value
 
 
+class _HedgeEntry:
+    """One tracked request awaiting its hedge decision."""
+
+    __slots__ = ("req", "origin", "due_t", "placed", "attempted")
+
+    def __init__(self, req: PendingRequest, origin: str, due_t: float):
+        self.req = req
+        self.origin = origin
+        self.due_t = due_t
+        self.placed: str | None = None   # hedge replica once dispatched
+        self.attempted = False           # a due hedge tried to place
+
+
+class HedgeManager:
+    """Hedged dispatch: re-submit the straggler request to a SECOND
+    replica after a tail-derived delay; first completion wins.
+
+    The tail-latency move (docs/SERVING.md): once a request has waited
+    past its class's p99, the most likely explanation is that its
+    replica is having a bad time (deep batch, slow device, noisy
+    neighbor) — a copy on a healthy replica usually answers first, at
+    the cost of ~1% duplicated work.  Safety comes from primitives that
+    already exist: the hedge enqueues the SAME :class:`~.batcher
+    .PendingRequest` (``MicroBatcher.submit_hedge``), so the PR-8
+    first-wins lock guarantees exactly one client-visible outcome, and
+    the batcher's win-gated accounting keeps the loser's completion off
+    the metrics and breaker surfaces — a hedge can never double-count
+    (tests/test_tail.py pins it).
+
+    Delay: ``delay_ms`` fixed, or — when None — the request class's
+    ONLINE p99 from the per-QoS latency digest
+    (``ServingMetrics.qos_p99_s``); no hedging until the digest has
+    ``min_samples`` observations, so a cold start never hedges on noise.
+
+    Placement: least-loaded active replica other than the origin, with
+    a CLOSED breaker only — half-open circuits carry supervised trial
+    traffic, and a hedge must neither consume a trial token it cannot
+    return nor evict real work (``submit_hedge`` never sheds).
+
+    Outcomes land on ``serving_hedges_total{outcome=}`` +
+    ``hedge_dispatch``/``hedge_outcome`` events: **won** (the hedge's
+    completion was the client-visible one), **lost** (the primary
+    answered first; the duplicate was discarded by first-wins),
+    **cancelled** (a due hedge was abandoned — target queues full, no
+    eligible replica, or the request settled/expired before a decisive
+    dispatch).  Requests that complete before their delay elapses are
+    simply untracked: they were never hedges.
+    """
+
+    def __init__(
+        self,
+        router: "Router",
+        delay_ms: float | None = None,
+        poll_s: float = 0.005,
+        min_samples: int = 20,
+        digest_refresh_s: float = 0.25,
+    ):
+        self.router = router
+        self.delay_ms = delay_ms
+        self.poll_s = poll_s
+        self.min_samples = min_samples
+        self.digest_refresh_s = digest_refresh_s
+        self._entries: list[_HedgeEntry] = []
+        self._lock = threading.Lock()
+        self._p99: dict[str, tuple[float, float | None]] = {}  # qos -> (t, p99)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if router.metrics is not None:
+            # The outcome family must be scrapeable before the first
+            # hedge fires (CI greps a short smoke's exposition).
+            router.metrics.ensure_hedges()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "HedgeManager":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="serve-hedger", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    # -- tracking (router submit path) ----------------------------------------
+
+    def _delay_s(self, qos: str, now: float) -> float | None:
+        if self.delay_ms is not None:
+            return self.delay_ms / 1e3
+        cached = self._p99.get(qos)
+        if cached is None or now - cached[0] > self.digest_refresh_s:
+            metrics = self.router.metrics
+            p99 = (
+                metrics.qos_p99_s(qos, min_samples=self.min_samples)
+                if metrics is not None else None
+            )
+            self._p99[qos] = cached = (now, p99)
+        return cached[1]
+
+    def track(self, req: PendingRequest, origin: str) -> None:
+        now = time.perf_counter()
+        delay = self._delay_s(getattr(req, "qos", DEFAULT_QOS), now)
+        if delay is None:
+            return  # digest still cold: no hedging on noise
+        due = now + delay
+        if due >= req.deadline:
+            return  # the hedge could never answer inside the deadline
+        with self._lock:
+            self._entries.append(_HedgeEntry(req, origin, due))
+
+    # -- the decision loop ----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.tick()
+            except Exception:
+                # One bad tick (a replica torn down mid-inspection) must
+                # not end hedging for the life of the process.
+                pass
+        # Shutdown: resolve what we can so counters don't dangle —
+        # resolve ONLY: dispatching a new hedge here would land it on a
+        # batcher about to drain (the reason Router.stop stops the
+        # hedger first) with nobody left to resolve its outcome.
+        try:
+            self.tick(dispatch=False)
+        except Exception:
+            pass  # a replica torn down concurrently with shutdown
+
+    def tick(self, now: float | None = None, dispatch: bool = True) -> None:
+        """One inspection pass (public so tests step deterministically).
+        ``dispatch=False`` resolves settled entries without placing new
+        hedges (the shutdown pass)."""
+        now = now if now is not None else time.perf_counter()
+        with self._lock:
+            entries = list(self._entries)
+        done: set[_HedgeEntry] = set()
+        for entry in entries:
+            if entry.req.done():
+                if entry.placed is not None:
+                    # completed_by is set only by a WINNING completion
+                    # worker: the hedge replica -> won; another replica
+                    # (the primary) -> lost; None -> the outcome was an
+                    # error with no replica behind it (expiry, flush) —
+                    # nobody's dispatch was decisive, so it counts as
+                    # cancelled, not as a primary win ("lost" would
+                    # deflate the reported win rate with every 504).
+                    by = entry.req.completed_by
+                    self._resolve(
+                        entry,
+                        "won" if by == entry.placed
+                        else ("lost" if by is not None else "cancelled"),
+                    )
+                elif entry.attempted:
+                    self._resolve(entry, "cancelled")
+                done.add(entry)
+            elif entry.req.expired(now):
+                if entry.placed is not None or entry.attempted:
+                    self._resolve(entry, "cancelled")
+                done.add(entry)
+            elif (dispatch and entry.placed is None
+                    and now >= entry.due_t):
+                entry.attempted = True
+                self._dispatch_hedge(entry)
+        if done:
+            with self._lock:
+                self._entries = [
+                    e for e in self._entries if e not in done
+                ]
+
+    def _dispatch_hedge(self, entry: _HedgeEntry) -> None:
+        req = entry.req
+        candidates = [
+            r for r in self.router.active()
+            if r.name != entry.origin
+            and (r.breaker is None or r.breaker.state == CIRCUIT_CLOSED)
+        ]
+        candidates.sort(key=lambda r: r.load())
+        for r in candidates:
+            if not hasattr(r.batcher, "submit_hedge"):
+                continue  # a fake/legacy batcher without the surface
+            try:
+                r.batcher.submit_hedge(req)
+            except RejectedError:
+                # Full queue / draining: try the next candidate this
+                # tick, the rest next tick.  (Deliberately NOT catching
+                # AttributeError here — a bug inside submit_hedge must
+                # stay loud, not read as "replica declined".)
+                continue
+            entry.placed = r.name
+            if self.router._registry is not None:
+                self.router._registry.counter(
+                    "serving_hedge_dispatches_total",
+                    help="hedge re-dispatches placed, by target replica",
+                    replica=r.name,
+                ).inc()
+            if self.router._sink:
+                self.router._sink.emit(
+                    "hedge_dispatch", origin=entry.origin, replica=r.name,
+                    qos=getattr(req, "qos", DEFAULT_QOS),
+                    waited_ms=1e3 * (time.perf_counter() - req.t_submit),
+                )
+            return
+
+    def _resolve(self, entry: _HedgeEntry, outcome: str) -> None:
+        if self.router.metrics is not None:
+            self.router.metrics.record_hedge(outcome)
+        if self.router._sink:
+            self.router._sink.emit(
+                "hedge_outcome", outcome=outcome, origin=entry.origin,
+                **({"replica": entry.placed} if entry.placed else {}),
+                qos=getattr(entry.req, "qos", DEFAULT_QOS),
+            )
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
 class Router:
     """Shared admission front: place requests over replica batchers.
 
@@ -359,6 +583,9 @@ class Router:
         failure_threshold: int = 3,
         trial_limit: int = 1,
         trial_successes: int = 1,
+        hedge: bool = False,
+        hedge_delay_ms: float | None = None,
+        hedge_poll_s: float = 0.005,
     ):
         if policy not in POLICIES:
             raise ValueError(f"unknown router policy {policy!r}; have {POLICIES}")
@@ -392,6 +619,14 @@ class Router:
             if registry is not None
             else None
         )
+        # Hedged dispatch (docs/SERVING.md tail latency): off by
+        # default; ``hedge_delay_ms=None`` derives the delay from each
+        # class's online p99 digest.  One replica cannot hedge.
+        self.hedger: HedgeManager | None = None
+        if hedge and len(self.replicas) > 1:
+            self.hedger = HedgeManager(
+                self, delay_ms=hedge_delay_ms, poll_s=hedge_poll_s
+            ).start()
 
     # -- membership / aggregate reads ----------------------------------------
 
@@ -432,6 +667,13 @@ class Router:
         return sum(r.batcher.max_inflight for r in self.active())
 
     @property
+    def qos_classes(self) -> tuple[str, ...]:
+        """The pool's QoS classes (batchers are built identically, so
+        replica 0 speaks for all) — the server's 400-validation list."""
+        batcher = self.replicas[0].batcher
+        return getattr(batcher, "qos_classes", ())
+
+    @property
     def timeout_s(self) -> float:
         """The pool's default per-request deadline (min over replicas)
         — lets the handler's drain-race retry pass the REMAINING budget
@@ -450,6 +692,10 @@ class Router:
                 "state": r.state,
                 "circuit": r.breaker.state if r.breaker is not None else None,
                 "queue_depth": r.batcher.depth(),
+                "qos_depth": (
+                    r.batcher.qos_depths()
+                    if hasattr(r.batcher, "qos_depths") else None
+                ),
                 "inflight": r.batcher.inflight(),
                 "ewma_latency_ms": (
                     1e3 * r.ewma_latency_s
@@ -537,6 +783,7 @@ class Router:
         x: np.ndarray,
         timeout_ms: float | None = None,
         dtype: str | None = None,
+        qos: str | None = None,
     ) -> PendingRequest | ShardedRequest:
         """Place one request (or its shards) onto the pool.
 
@@ -545,6 +792,10 @@ class Router:
         only when EVERY active replica refuses does the caller see the
         503.  Per-attempt rejections are not double-counted on the
         metrics surface (only the final, client-visible one is).
+        ``qos`` rides through to each batcher's weighted admission
+        queue (serving/qos.py); placed requests are registered with the
+        hedger when hedging is on (sharded chunks are not hedged — a
+        chunk's twin would race its own reassembly).
         """
         active = self.active()
         if not active:
@@ -554,10 +805,25 @@ class Router:
         x = np.asarray(x, np.float32)
         cap = min(r.batcher.max_batch for r in active)
         if len(x) > cap:
-            return self._submit_sharded(x, active, cap, timeout_ms, dtype)
-        return self._place(x, active, timeout_ms, dtype)
+            return self._submit_sharded(x, active, cap, timeout_ms, dtype, qos)
+        req, placed = self._place(x, active, timeout_ms, dtype, qos)
+        if self.hedger is not None and (
+            placed.breaker is None
+            or placed.breaker.state == CIRCUIT_CLOSED
+        ):
+            # Never hedge a request placed on a non-closed origin: a
+            # half-open placement holds one of the breaker's trial
+            # tokens, and the token only returns through THAT replica's
+            # own outcome paths (record_success / record_failure /
+            # on_expire).  A hedge twin winning elsewhere would leave
+            # the origin's copy to be silently discarded — token leaked,
+            # breaker pinned half-open forever.  Semantically the trial
+            # must run on the origin anyway: hedging around the probe
+            # defeats it.
+            self.hedger.track(req, placed.name)
+        return req
 
-    def _place(self, x, active, timeout_ms, dtype) -> PendingRequest:
+    def _place(self, x, active, timeout_ms, dtype, qos=None):
         # ``active`` is the submit-time snapshot (one lock round-trip
         # per request, shared across a sharded request's chunks).  A
         # replica drained after the snapshot rejects at its batcher and
@@ -572,7 +838,8 @@ class Router:
                 continue
             try:
                 req = r.batcher.submit(
-                    x, timeout_ms=timeout_ms, dtype=dtype, count_reject=False,
+                    x, timeout_ms=timeout_ms, dtype=dtype, qos=qos,
+                    count_reject=False,
                 )
             except RejectedError as e:
                 # Admission refused before any work dispatched — return
@@ -582,7 +849,7 @@ class Router:
                 saw_error = e
                 continue
             self._note(r, len(x))
-            return req
+            return req, r
         # Exactly one client-visible 503 however many replicas were
         # tried (the per-attempt skips are not client outcomes).
         if self.metrics is not None:
@@ -591,7 +858,9 @@ class Router:
             "no routable replicas (every circuit open or replica draining)"
         )
 
-    def _submit_sharded(self, x, active, cap, timeout_ms, dtype) -> ShardedRequest:
+    def _submit_sharded(
+        self, x, active, cap, timeout_ms, dtype, qos=None
+    ) -> ShardedRequest:
         """Chunks are placed sequentially; a rejection mid-placement
         (every replica full) propagates to the client as one 503, while
         chunks already admitted drain normally on their replicas — their
@@ -612,7 +881,7 @@ class Router:
         n_chunks = -(-len(x) // cap)
         bounds = np.linspace(0, len(x), n_chunks + 1).astype(int)
         parts = [
-            self._place(x[lo:hi], active, timeout_ms, dtype)
+            self._place(x[lo:hi], active, timeout_ms, dtype, qos)[0]
             for lo, hi in zip(bounds[:-1], bounds[1:])
         ]
         return ShardedRequest(parts)
@@ -718,6 +987,10 @@ class Router:
         run concurrently — each replica's queue/window finishes on its
         own device, so shutdown wall time is the slowest drain, not the
         sum of all of them."""
+        if self.hedger is not None:
+            # Hedger first: a hedge placed onto a draining batcher would
+            # either race its flush or delay the drain for nothing.
+            self.hedger.stop()
         stopping = [
             r for r in self.replicas if r.state not in ("drained", "ejected")
         ]

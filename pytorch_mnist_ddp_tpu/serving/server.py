@@ -52,6 +52,7 @@ from ..models.net import INPUT_SHAPE
 from .batcher import MicroBatcher, RejectedError, RequestTimeout
 from .engine import InferenceEngine
 from .metrics import ServingMetrics
+from .qos import QOS_CLASSES
 
 
 def decode_instances(body: dict) -> np.ndarray:
@@ -213,6 +214,17 @@ class ServingHandler(BaseHTTPRequestHandler):
                         f"unknown dtype {dtype!r}; served dtypes: "
                         f"{list(served)}"
                     )
+            # QoS class (docs/SERVING.md tail latency): "qos" selects
+            # the scheduling class the weighted admission queue orders
+            # by; omitted = interactive (the pre-QoS behavior).  An
+            # unknown class is a client error, not backpressure.
+            qos = body.get("qos")
+            if qos is not None:
+                classes = getattr(srv.batcher, "qos_classes", QOS_CLASSES)
+                if not isinstance(qos, str) or qos not in classes:
+                    raise ValueError(
+                        f"unknown qos {qos!r}; classes: {list(classes)}"
+                    )
         except ValueError as e:
             self._send_json(400, {"error": str(e)})
             return
@@ -248,7 +260,7 @@ class ServingHandler(BaseHTTPRequestHandler):
                     )
                 )
                 request = srv.batcher.submit(
-                    x, dtype=dtype, timeout_ms=remaining_ms
+                    x, dtype=dtype, qos=qos, timeout_ms=remaining_ms
                 )
                 if attempt:
                     # The retry tally (serving_request_retries_total +

@@ -1563,6 +1563,105 @@ def test_jl015_waiver():
 
 
 # ---------------------------------------------------------------------------
+# JL016 — deadline-blind fixed linger in a dispatch loop
+
+
+JL016_BAD_CONST_SLEEP = """\
+import time
+
+def serve(queue, engine):
+    while True:
+        batch = [queue.get()]
+        time.sleep(0.002)
+        while not queue.empty():
+            batch.append(queue.get_nowait())
+        engine.launch(batch, len(batch))
+"""
+
+JL016_BAD_LINGER_NAME = """\
+import time
+
+LINGER_S = 0.002
+
+def serve(queue, engine):
+    while True:
+        batch = [queue.get()]
+        time.sleep(LINGER_S)
+        engine.launch(batch, len(batch))
+"""
+
+JL016_GOOD_DEADLINE_CLOSE = """\
+import time
+
+def serve(queue, engine):
+    while True:
+        first = queue.get()
+        close_at = min(
+            time.perf_counter() + 0.002,
+            first.deadline - 0.001,
+        )
+        remaining = close_at - time.perf_counter()
+        if remaining > 0:
+            time.sleep(remaining)
+        engine.launch([first], 1)
+"""
+
+JL016_GOOD_EXPIRY_CHECK = """\
+import time
+
+def serve(queue, engine):
+    while True:
+        batch = [queue.get()]
+        time.sleep(0.002)
+        batch = [r for r in batch if not r.expired()]
+        engine.launch(batch, len(batch))
+"""
+
+JL016_GOOD_NO_DISPATCH = """\
+import time
+
+def poll(path):
+    while True:
+        time.sleep(0.5)
+        with open(path) as f:
+            if f.read():
+                return
+"""
+
+JL016_GOOD_BOUNDED_REPLAY = """\
+import time
+
+def replay(engine, trace):
+    for i in range(16):
+        time.sleep(0.01)
+        engine.launch(trace[i], 1)
+"""
+
+
+def test_jl016_fires_on_fixed_linger_sleep():
+    assert_fires(JL016_BAD_CONST_SLEEP, "JL016", line=6)
+    assert_fires(JL016_BAD_LINGER_NAME, "JL016", line=8)
+
+
+def test_jl016_silent_on_deadline_aware_loops():
+    assert_silent(JL016_GOOD_DEADLINE_CLOSE, "JL016")
+    assert_silent(JL016_GOOD_EXPIRY_CHECK, "JL016")
+
+
+def test_jl016_silent_without_dispatch_or_unbounded_loop():
+    assert_silent(JL016_GOOD_NO_DISPATCH, "JL016")
+    assert_silent(JL016_GOOD_BOUNDED_REPLAY, "JL016")
+
+
+def test_jl016_waiver():
+    waived = JL016_BAD_CONST_SLEEP.replace(
+        "time.sleep(0.002)",
+        "time.sleep(0.002)  # jaxlint: disable=JL016 -- metronome replay, cadence is the point",
+    )
+    assert_silent(waived, "JL016")
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

@@ -566,7 +566,7 @@ def test_http_resubmits_drain_flushed_request_once():
             self.submits = 0
             self.retry_timeouts = []  # timeout_ms of each retry submit
 
-        def submit(self, x, dtype=None, timeout_ms=None):
+        def submit(self, x, dtype=None, qos=None, timeout_ms=None):
             self.submits += 1
             if self.submits > 1:
                 self.retry_timeouts.append(timeout_ms)

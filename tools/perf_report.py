@@ -524,6 +524,62 @@ def summarize_telemetry(directory: str) -> str | None:
                     f"p50 {1e3 * percentile(ds, 50):.2f} ms, "
                     f"p99 {1e3 * percentile(ds, 99):.2f} ms"
                 )
+    # Tail-latency section (serving/qos.py + the router's hedger,
+    # docs/SERVING.md): per-QoS-class request percentiles, load-shed
+    # counts, and the hedge dispatch/outcome tallies with win rate —
+    # the operator's receipt of what the SLO-aware scheduler did.
+    sheds = [e for e in events if e.get("event") == "qos_shed"]
+    hedge_dispatches = [
+        e for e in events if e.get("event") == "hedge_dispatch"
+    ]
+    hedge_outcomes = [e for e in events if e.get("event") == "hedge_outcome"]
+    qos_tagged = any("qos" in e for e in sreqs)
+    if qos_tagged or sheds or hedge_dispatches or hedge_outcomes:
+        by_qos: dict[str, list[float]] = {}
+        for e in sreqs:
+            if "latency_s" in e:
+                # Schema note (serving/batcher.py): the default class is
+                # untagged so pre-QoS JSONL stays byte-stable.
+                by_qos.setdefault(e.get("qos", "interactive"), []).append(
+                    e["latency_s"]
+                )
+        shed_by_qos: dict[str, int] = {}
+        for e in sheds:
+            name = e.get("qos", "?")
+            shed_by_qos[name] = shed_by_qos.get(name, 0) + 1
+        lines.append(
+            f"  tail latency: {sum(len(v) for v in by_qos.values())} "
+            f"classed request(s), {len(sheds)} shed, "
+            f"{len(hedge_dispatches)} hedge dispatch(es)"
+        )
+        for name, ds in sorted(by_qos.items()):
+            ds.sort()
+            lines.append(
+                f"    qos {name}: {len(ds)} requests, "
+                f"p50 {1e3 * percentile(ds, 50):.2f} ms, "
+                f"p95 {1e3 * percentile(ds, 95):.2f} ms, "
+                f"p99 {1e3 * percentile(ds, 99):.2f} ms"
+                + (f", {shed_by_qos[name]} shed"
+                   if shed_by_qos.get(name) else "")
+            )
+        for name in sorted(set(shed_by_qos) - set(by_qos)):
+            lines.append(
+                f"    qos {name}: 0 completed, {shed_by_qos[name]} shed"
+            )
+        if hedge_outcomes:
+            tally: dict[str, int] = {}
+            for e in hedge_outcomes:
+                tally[e.get("outcome", "?")] = (
+                    tally.get(e.get("outcome", "?"), 0) + 1
+                )
+            placed = tally.get("won", 0) + tally.get("lost", 0)
+            lines.append(
+                f"    hedges: {tally.get('won', 0)} won, "
+                f"{tally.get('lost', 0)} lost, "
+                f"{tally.get('cancelled', 0)} cancelled"
+                + (f"; win rate {tally.get('won', 0) / placed:.1%}"
+                   if placed else "")
+            )
     # Scale-out telemetry (serving/pool.py + router.py): per-replica
     # request share, router decision tallies by policy, drain/re-add
     # durations, and the load-imbalance ratio (max/mean replica share) —

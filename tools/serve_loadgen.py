@@ -38,6 +38,15 @@ per-device engine pool behind the queue-aware router
 workload against each count in turn, writing goodput vs. replicas at
 fixed p99 plus scaling efficiency to ``BENCH_serving_scaleout.json``.
 
+Tail-latency mode (docs/SERVING.md QoS section): ``--qos-mix
+interactive=0.8,batch=0.2`` labels every request with a seeded QoS
+class (the ``/predict`` ``"qos"`` field) and the report gains per-class
+latency percentiles; ``--hedge`` / ``--hedge-delay-ms`` enable hedged
+dispatch on the self-serve pool; and ``--ab-tail`` drives the SAME
+open-loop trace against a feature-off and a feature-on pool, writing
+per-class p50/p95/p99 deltas to ``BENCH_tail.json`` and FAILING on any
+lost response or duplicated client-visible outcome.
+
 Chaos mode (docs/ROBUSTNESS.md): ``--chaos SPEC`` arms a fault schedule
 (``fail:launch:r1:count=6;hang:complete:r0:for=2``) against the
 self-serve pool while the workload runs, then FAILS the run on any lost
@@ -103,7 +112,9 @@ def fetch_text(url: str, timeout: float = 30.0) -> str:
         return resp.read().decode()
 
 
-def _request_payload(rng: random.Random, n: int, dtype: str = "f32") -> dict:
+def _request_payload(
+    rng: random.Random, n: int, dtype: str = "f32", qos: str | None = None
+) -> dict:
     payload = {
         "instances": [
             [rng.randint(0, 255) for _ in range(784)] for _ in range(n)
@@ -114,7 +125,60 @@ def _request_payload(rng: random.Random, n: int, dtype: str = "f32") -> dict:
         # request to one named variant; the default payload stays
         # byte-compatible with pre-dtype servers.
         payload["dtype"] = dtype
+    if qos is not None:
+        # The tail-latency A/B knob: name the scheduling class.  Omitted
+        # = interactive (the server default), so pre-QoS payloads are
+        # unchanged.
+        payload["qos"] = qos
     return payload
+
+
+def _parse_qos_mix(spec: str) -> dict[str, float]:
+    """``interactive=0.8,batch=0.2`` -> class -> probability (must sum
+    to ~1; names must be served classes — a typo'd class would 400 on
+    every request of the featured rung and report a vacuously green
+    A/B from empty percentile windows)."""
+    from pytorch_mnist_ddp_tpu.serving.qos import QOS_CLASSES
+
+    mix: dict[str, float] = {}
+    for part in spec.split(","):
+        name, _, frac = part.partition("=")
+        try:
+            mix[name.strip()] = float(frac)
+        except ValueError:
+            frac = ""
+        if not frac:
+            raise SystemExit(
+                f"--qos-mix part {part!r} must be CLASS=FRACTION"
+            )
+    unknown = sorted(set(mix) - set(QOS_CLASSES))
+    if unknown:
+        raise SystemExit(
+            f"--qos-mix names unknown class(es) {unknown}; "
+            f"served classes: {list(QOS_CLASSES)}"
+        )
+    total = sum(mix.values())
+    if not 0.999 <= total <= 1.001:
+        raise SystemExit(
+            f"--qos-mix fractions must sum to 1, got {total:g} ({spec!r})"
+        )
+    return mix
+
+
+def _draw_qos_labels(
+    mix: dict[str, float] | None, requests: int, seed: int
+) -> list[str | None]:
+    """Per-request class labels, reproducible from --seed.  A None mix
+    labels every request None (no qos field is sent).  The ab-tail mode
+    draws ONE label trace and reuses it for both rungs, sending the
+    field only on the featured rung — so the per-class percentile
+    comparison slices identical request populations."""
+    if not mix:
+        return [None] * requests
+    rng = random.Random(seed + 7919)  # distinct stream from sizes/arrivals
+    names = list(mix)
+    weights = [mix[n] for n in names]
+    return rng.choices(names, weights=weights, k=requests)
 
 
 def run_open_loop(
@@ -126,6 +190,8 @@ def run_open_loop(
     timeout_s: float,
     max_workers: int,
     dtype: str = "f32",
+    qos_labels: list | None = None,
+    send_qos: bool = True,
 ) -> dict:
     """Poisson arrivals at ``rate`` req/s, fired independently of
     completions, bounded by ``max_workers`` outstanding requests.
@@ -140,6 +206,7 @@ def run_open_loop(
 
     rng = random.Random(seed)
     sizes = [rng.randint(1, max_request) for _ in range(requests)]
+    qos_labels = qos_labels if qos_labels is not None else [None] * requests
     # Pre-draw the whole arrival schedule so the trace is reproducible
     # from --seed and the firing loop does no RNG work.
     arrivals: list[float] = []
@@ -148,13 +215,17 @@ def run_open_loop(
         t += rng.expovariate(rate)
         arrivals.append(t)
 
-    def one(i: int, scheduled: float) -> tuple[int, float]:
+    def one(i: int, scheduled: float) -> tuple[int, float, str | None]:
         wrng = random.Random(seed * 1000 + i)
         status, _body = fetch_json(
-            f"{url}/predict", _request_payload(wrng, sizes[i], dtype),
+            f"{url}/predict",
+            _request_payload(
+                wrng, sizes[i], dtype,
+                qos=qos_labels[i] if send_qos else None,
+            ),
             timeout=timeout_s,
         )
-        return status, time.perf_counter() - scheduled
+        return status, time.perf_counter() - scheduled, qos_labels[i]
 
     t_start = time.perf_counter()
     last_fired = t_start
@@ -191,12 +262,16 @@ def run_load(
     seed: int,
     timeout_s: float,
     dtype: str = "f32",
+    qos_labels: list | None = None,
+    send_qos: bool = True,
 ) -> dict:
-    """Drive the endpoint; returns raw per-request (status, latency_s)."""
+    """Drive the endpoint; returns raw per-request (status, latency_s,
+    qos)."""
     rng = random.Random(seed)
     # Pre-generate request sizes so the mix is reproducible from --seed.
     sizes = [rng.randint(1, max_request) for _ in range(requests)]
-    results: list[tuple[int, float]] = []
+    qos_labels = qos_labels if qos_labels is not None else [None] * requests
+    results: list[tuple[int, float, str | None]] = []
     lock = threading.Lock()
     cursor = [0]
 
@@ -210,12 +285,16 @@ def run_load(
                 cursor[0] += 1
             t0 = time.perf_counter()
             status, _body = fetch_json(
-                f"{url}/predict", _request_payload(wrng, sizes[i], dtype),
+                f"{url}/predict",
+                _request_payload(
+                    wrng, sizes[i], dtype,
+                    qos=qos_labels[i] if send_qos else None,
+                ),
                 timeout=timeout_s,
             )
             elapsed = time.perf_counter() - t0
             with lock:
-                results.append((status, elapsed))
+                results.append((status, elapsed, qos_labels[i]))
 
     threads = [
         threading.Thread(target=worker, args=(w,)) for w in range(concurrency)
@@ -236,10 +315,21 @@ def summarize(raw: dict, before: dict, after: dict) -> dict:
     from pytorch_mnist_ddp_tpu.serving.metrics import percentile
 
     results = raw["results"]
-    ok = sorted(lat for status, lat in results if status == 200)
+    ok = sorted(lat for status, lat, *_ in results if status == 200)
     by_status: dict[str, int] = {}
-    for status, _ in results:
+    for status, *_ in results:
         by_status[str(status)] = by_status.get(str(status), 0) + 1
+    # Per-QoS-class client-side view (the tail-latency A/B reads these):
+    # latency percentiles over 200s plus shed/reject counts, per class.
+    by_qos: dict[str, dict] = {}
+    for status, lat, *rest in results:
+        qos = rest[0] if rest else None
+        if qos is None:
+            continue
+        entry = by_qos.setdefault(qos, {"ok": [], "statuses": {}})
+        entry["statuses"][str(status)] = entry["statuses"].get(str(status), 0) + 1
+        if status == 200:
+            entry["ok"].append(lat)
     compiles_before = before.get("compiles")
     compiles_after = after.get("compiles")
     additional = (
@@ -273,6 +363,20 @@ def summarize(raw: dict, before: dict, after: dict) -> dict:
             "p99": 1e3 * percentile(ok, 99),
             "mean": 1e3 * sum(ok) / len(ok) if ok else 0.0,
         },
+        "qos_latency_ms": {
+            qos: {
+                "requests": sum(entry["statuses"].values()),
+                "ok": len(entry["ok"]),
+                "rejected": entry["statuses"].get("503", 0),
+                "timed_out": entry["statuses"].get("504", 0),
+                "p50": 1e3 * percentile(sorted(entry["ok"]), 50),
+                "p95": 1e3 * percentile(sorted(entry["ok"]), 95),
+                "p99": 1e3 * percentile(sorted(entry["ok"]), 99),
+            }
+            for qos, entry in sorted(by_qos.items())
+        } or None,
+        "server_qos": after.get("qos"),
+        "server_hedges": after.get("hedges"),
         "server_replicas": after.get("replicas"),
         "server_batch_occupancy_pct": after.get("batch_occupancy_pct"),
         "server_padding_waste_pct": after.get("padding_waste_pct"),
@@ -301,6 +405,11 @@ def _spin_self_serve(args, replicas: int | None):
         linger_ms=args.linger_ms, queue_depth=args.queue_depth,
         timeout_ms=args.timeout_ms, max_inflight=args.max_inflight,
         adaptive_linger=not args.no_adaptive_linger,
+        deadline_aware=not getattr(args, "no_deadline_close", False),
+    )
+    hedge = bool(
+        getattr(args, "hedge", False)
+        or getattr(args, "hedge_delay_ms", None) is not None
     )
     sink = open_sink(args.telemetry_dir)
     if replicas is not None:
@@ -335,14 +444,20 @@ def _spin_self_serve(args, replicas: int | None):
             )
         router = pool.start(
             router_policy=args.router_policy, sink=sink,
-            supervisor_kwargs=supervisor_kwargs, **batcher_kwargs
+            supervisor_kwargs=supervisor_kwargs,
+            hedge=hedge,
+            hedge_delay_ms=getattr(args, "hedge_delay_ms", None),
+            **batcher_kwargs
         )
         server = make_server(pool, metrics, port=0, batcher=router)
         threading.Thread(target=server.serve_forever, daemon=True).start()
         url = f"http://127.0.0.1:{server.server_address[1]}"
         print(
             f"self-serve pool: {url} ({pool.n_replicas} replicas, "
-            f"router policy {args.router_policy})"
+            f"router policy {args.router_policy}, hedging "
+            # The RESOLVED state: a 1-replica pool has no hedger even
+            # when the flag asked for one.
+            f"{'on' if hedge and pool.n_replicas > 1 else 'off'})"
         )
         return server, sink, url
     engine = InferenceEngine.from_seed(
@@ -390,18 +505,28 @@ def _teardown_self_serve(server, sink) -> None:
         sink.close()
 
 
-def _drive(args, url: str) -> dict:
-    """Fire the configured workload (open or closed loop) at ``url``."""
+def _drive(args, url: str, send_qos: bool = True) -> dict:
+    """Fire the configured workload (open or closed loop) at ``url``.
+
+    ``send_qos=False`` keeps the per-request class LABELS (for the
+    report's per-class slices) but omits the payload field — the
+    baseline rung of the tail A/B."""
+    mix = _parse_qos_mix(args.qos_mix) if args.qos_mix else None
+    qos_labels = _draw_qos_labels(mix, args.requests, args.seed)
     if args.open_loop:
         print(
             f"driving {args.requests} open-loop Poisson arrivals of "
             f"1..{args.max_request} samples at {args.rate:.0f} req/s"
+            + (f" (qos mix {args.qos_mix}"
+               + (", field sent" if send_qos else ", labels only") + ")"
+               if mix else "")
         )
         return run_open_loop(
             url, args.requests, args.rate, args.max_request,
             args.seed, args.timeout_s,
             max_workers=args.concurrency,
             dtype=args.dtype,
+            qos_labels=qos_labels, send_qos=send_qos,
         )
     print(
         f"driving {args.requests} requests of 1..{args.max_request} "
@@ -410,6 +535,7 @@ def _drive(args, url: str) -> dict:
     return run_load(
         url, args.requests, args.concurrency, args.max_request,
         args.seed, args.timeout_s, dtype=args.dtype,
+        qos_labels=qos_labels, send_qos=send_qos,
     )
 
 
@@ -571,6 +697,178 @@ def run_replica_sweep(args) -> int:
     return rc
 
 
+def run_ab_tail(args) -> int:
+    """The tail-latency A/B (docs/SERVING.md QoS section): the SAME
+    open-loop Poisson trace — identical arrivals, sizes, and per-request
+    class labels — against two self-serve pools:
+
+    - **baseline**: feature off.  No ``qos`` field is sent (every
+      request is default-class FIFO), batch close honors the global
+      linger, no hedging.
+    - **tail**: feature on.  The class labels ride the payload, batches
+      close deadline-aware, and stragglers hedge to a second replica
+      (``--hedge-delay-ms``, or the per-class p99 digest).
+
+    Per-class p50/p95/p99 deltas land in ``--tail-report``
+    (BENCH_tail.json).  The run FAILS on any lost response, any
+    transport error, any duplicated client-visible outcome (the server's
+    completed counter moving past the client's request count — the
+    hedge-double-count check), or any post-warmup compile.
+    """
+    if not args.open_loop:
+        raise SystemExit(
+            "--ab-tail is an open-loop A/B (the tail is an arrival-rate "
+            "phenomenon); add --open-loop --rate R"
+        )
+    if args.max_request > max(int(b) for b in args.buckets.split(",")):
+        # A request bigger than the top bucket shards into N chunks and
+        # the server counts each chunk's completion — the
+        # completed-vs-(200s+504s) duplicate check below would read the
+        # fan-out as phantom hedge double-counts and FAIL a correct run.
+        raise SystemExit(
+            "--ab-tail needs --max-request <= the top bucket (sharded "
+            "chunk fan-out breaks the per-request completed-count "
+            "accounting the duplicate check relies on)"
+        )
+    if args.replicas is None:
+        args.replicas = 2  # hedging needs a second replica
+    elif args.replicas < 2:
+        # A 1-replica pool has no hedger (Router silently skips it) —
+        # the "feature-on" rung would be unhedged while BENCH_tail.json
+        # labels it hedged.  0 (one per visible device) is also refused:
+        # it can resolve to 1 on a single-device host.
+        raise SystemExit(
+            "--ab-tail needs --replicas >= 2: the feature-on rung hedges, "
+            "and a lone replica has no second replica to hedge onto"
+        )
+    if not args.qos_mix:
+        args.qos_mix = "interactive=0.8,batch=0.2"
+    rungs = []
+    rc = 0
+    for label, send_qos, overrides in (
+        ("baseline", False, dict(
+            no_deadline_close=True, hedge=False, hedge_delay_ms=None)),
+        ("tail", True, dict(
+            no_deadline_close=False, hedge=True,
+            hedge_delay_ms=args.hedge_delay_ms)),
+    ):
+        rung_args = argparse.Namespace(**{**vars(args), **overrides})
+        print(f"--- ab-tail rung: {label} ---")
+        server, sink, url = _spin_self_serve(
+            rung_args, replicas=rung_args.replicas
+        )
+        try:
+            _status, before = fetch_json(f"{url}/metrics")
+            raw = _drive(rung_args, url, send_qos=send_qos)
+            _status, after = fetch_json(f"{url}/metrics")
+            if args.prom_dump and label == "tail":
+                with open(args.prom_dump, "w") as f:
+                    f.write(fetch_text(f"{url}/metrics?format=prom"))
+                print(f"prometheus exposition (tail rung): {args.prom_dump}")
+        finally:
+            _teardown_self_serve(server, sink)
+        report = summarize(raw, before, after)
+        results = raw["results"]
+        lost = args.requests - len(results)
+        transport = sum(1 for status, *_ in results if status == 0)
+        completed_delta = (
+            after["requests"]["completed"] - before["requests"]["completed"]
+        )
+        # Exactly-one-outcome check: every server-side completion must
+        # correspond to a client 200, or to a client 504 whose late
+        # result landed after the client stopped waiting.  Anything
+        # beyond that is a duplicated outcome (a hedge double-count).
+        # Bounding by ok+504 — not by args.requests — keeps the check
+        # honest under load: sheds and rejections must not open
+        # headroom that masks real duplicates.
+        ok_count = sum(1 for status, *_ in results if status == 200)
+        client_504 = sum(1 for status, *_ in results if status == 504)
+        duplicates = max(0, completed_delta - ok_count - client_504)
+        if lost or transport or duplicates:
+            print(
+                f"AB-TAIL FAIL [{label}]: {lost} lost response(s), "
+                f"{transport} transport error(s), {duplicates} "
+                "duplicated client-visible outcome(s)"
+            )
+            rc = 1
+        extra = report["additional_compiles"]
+        if extra and not args.no_check_compiles:
+            print(f"AB-TAIL FAIL [{label}]: {extra} additional compile(s)")
+            rc = 1
+        rungs.append({
+            "label": label,
+            "qos_sent": send_qos,
+            "lost": lost,
+            "transport_errors": transport,
+            "completed_delta": completed_delta,
+            "duplicates": duplicates,
+            "goodput_rps": report["goodput_rps"],
+            "latency_ms": report["latency_ms"],
+            "qos_latency_ms": report["qos_latency_ms"],
+            "server_qos": report["server_qos"],
+            "server_hedges": report["server_hedges"],
+            "rejected": report["rejected"],
+            "timed_out": report["timed_out"],
+            "additional_compiles": extra,
+        })
+    base, tail = rungs
+    deltas: dict[str, dict] = {}
+    for qos in sorted(set(base["qos_latency_ms"] or {})
+                      & set(tail["qos_latency_ms"] or {})):
+        b = base["qos_latency_ms"][qos]
+        t = tail["qos_latency_ms"][qos]
+        deltas[qos] = {
+            key: {
+                "baseline_ms": b[key],
+                "tail_ms": t[key],
+                "delta_ms": t[key] - b[key],
+                "delta_pct": (
+                    100.0 * (t[key] - b[key]) / b[key] if b[key] else None
+                ),
+            }
+            for key in ("p50", "p95", "p99")
+        }
+    goodput_ratio = (
+        tail["goodput_rps"] / base["goodput_rps"]
+        if base["goodput_rps"] else None
+    )
+    ab_report = {
+        "mode": "ab-tail",
+        "offered_rate_rps": args.rate,
+        "requests": args.requests,
+        "replicas": args.replicas,
+        "qos_mix": args.qos_mix,
+        "hedge_delay_ms": args.hedge_delay_ms,
+        "buckets": [int(b) for b in args.buckets.split(",")],
+        "rungs": rungs,
+        "deltas": deltas,
+        "goodput_ratio_tail_vs_baseline": goodput_ratio,
+    }
+    with open(args.tail_report, "w") as f:
+        json.dump(ab_report, f, indent=2)
+    print(f"tail A/B report: {args.tail_report}")
+    for qos, d in deltas.items():
+        print(
+            f"  {qos}: p50 {d['p50']['baseline_ms']:.1f} -> "
+            f"{d['p50']['tail_ms']:.1f} ms, p99 "
+            f"{d['p99']['baseline_ms']:.1f} -> {d['p99']['tail_ms']:.1f} ms "
+            f"({d['p99']['delta_pct']:+.1f}%)"
+            if d["p99"]["delta_pct"] is not None else f"  {qos}: (no data)"
+        )
+    hedges = tail["server_hedges"] or {}
+    placed = hedges.get("won", 0) + hedges.get("lost", 0)
+    print(
+        "  goodput ratio "
+        + (f"{goodput_ratio:.3f}" if goodput_ratio is not None
+           else "n/a (baseline completed zero requests)")
+        + f", hedges {hedges.get('won', 0)} won / "
+        f"{hedges.get('lost', 0)} lost / "
+        f"{hedges.get('cancelled', 0)} cancelled"
+        + (f" (win rate {hedges.get('won', 0) / placed:.1%})" if placed else "")
+    )
+    return rc
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     parser.add_argument(
@@ -639,6 +937,42 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--no-adaptive-linger", action="store_true",
         help="pin the linger at --linger-ms in --self-serve mode",
+    )
+    parser.add_argument(
+        "--no-deadline-close", action="store_true",
+        help="--self-serve mode: disable deadline-aware batch close "
+        "(batches then honor the global linger even when the oldest "
+        "member's deadline budget is nearly spent)",
+    )
+    parser.add_argument(
+        "--qos-mix", default=None, metavar="CLASS=FRAC,...",
+        help="per-request QoS class mix, e.g. interactive=0.8,batch=0.2: "
+        "each request is labeled from this distribution (seeded) and "
+        "the label is sent as the /predict \"qos\" field; the report "
+        "gains per-class latency percentiles (docs/SERVING.md)",
+    )
+    parser.add_argument(
+        "--hedge", action="store_true",
+        help="--self-serve pool mode: enable hedged dispatch with the "
+        "per-class p99 digest delay (docs/SERVING.md tail latency)",
+    )
+    parser.add_argument(
+        "--hedge-delay-ms", type=float, default=None, metavar="MS",
+        help="fixed hedge delay in ms (implies --hedge); straggler "
+        "requests re-dispatch to a second replica after this wait, "
+        "first completion wins",
+    )
+    parser.add_argument(
+        "--ab-tail", action="store_true",
+        help="tail-latency A/B: drive the SAME open-loop trace against "
+        "a feature-off pool (no QoS, global linger, no hedging) and a "
+        "feature-on pool (QoS mix + deadline-aware close + hedging), "
+        "report per-class p50/p95/p99 deltas to --tail-report, and FAIL "
+        "on any lost response or duplicated client-visible outcome",
+    )
+    parser.add_argument(
+        "--tail-report", default="BENCH_tail.json",
+        help="where --ab-tail writes its report",
     )
     parser.add_argument(
         "--telemetry-dir", default=None,
@@ -732,6 +1066,22 @@ def main(argv: list[str] | None = None) -> int:
         parser.error("--chaos needs --replicas N: fault tolerance is a "
                      "pool property (a lone engine has no survivors to "
                      "retry on)")
+    if args.hedge or args.hedge_delay_ms is not None:
+        if args.url:
+            parser.error("--hedge is --self-serve pool only; a --url "
+                         "endpoint configures its own hedging")
+        if args.replicas is None and not args.ab_tail and not args.replicas_sweep:
+            # The single-engine self-serve branch has no hedger; running
+            # it under a --hedge flag would measure an unhedged engine
+            # while the operator believes otherwise (the serving CLI
+            # hard-errors on the same combination).
+            parser.error("--hedge needs --replicas N (>= 2): a lone "
+                         "engine has no second replica to hedge onto")
+    if args.ab_tail:
+        if args.url or args.replicas_sweep or args.chaos:
+            parser.error("--ab-tail drives its own pair of self-serve "
+                         "pools; drop --url / --replicas-sweep / --chaos")
+        return run_ab_tail(args)
     if args.replicas_sweep:
         if args.url:
             parser.error("--replicas-sweep drives self-serve pools; "
@@ -771,7 +1121,7 @@ def main(argv: list[str] | None = None) -> int:
         # client), shed stayed bounded, and the pool healed.
         results = raw["results"]
         lost = args.requests - len(results)
-        transport = sum(1 for status, _ in results if status == 0)
+        transport = sum(1 for status, *_ in results if status == 0)
         rate_503 = (
             report["rejected"] / len(results) if results else 0.0
         )
