@@ -1,4 +1,4 @@
-"""The jaxlint rule set: JL001–JL016, the JAX hazards this repo has
+"""The jaxlint rule set: JL001–JL017, the JAX hazards this repo has
 actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, the
 serving layer's per-request-shape retrace class, the telemetry layer's
 record-at-trace-time class, the serving pipeline's
@@ -7,8 +7,9 @@ class, the steady-state input pipeline's host-blocking-feed class, the
 replica pool's per-replica-re-trace class, the fault-tolerance
 layer's swallowed-dispatch-error class, the resilient trainer's
 torn-file / uncadenced-checkpoint-write class, the elastic
-runtime's unbounded-rendezvous / unsupervised-launch class, and the
-tail-latency layer's deadline-blind fixed-linger class).
+runtime's unbounded-rendezvous / unsupervised-launch class, the
+tail-latency layer's deadline-blind fixed-linger class, and the fleet
+tier's timeout-less blocking-network-read class).
 
 Every rule is a heuristic over one module's AST — no type inference, no
 cross-file call graph.  "Traced context" below means: a function that is
@@ -2135,6 +2136,132 @@ class FixedLingerDispatchRule(Rule):
                     )
 
 
+# ---------------------------------------------------------------------------
+# JL017 — blocking network read without a timeout in an unbounded loop
+
+
+# Calls with a ``timeout`` PARAMETER the author left unset.  Value:
+# (dotted-name spellings, positional index of ``timeout``) — a call
+# covering the index positionally has set it.
+_TIMEOUT_PARAM_CALLS = (
+    ({"urlopen", "urllib.request.urlopen", "request.urlopen"}, 2),
+    ({"create_connection", "socket.create_connection"}, 1),
+)
+
+# Raw reads with NO timeout parameter of their own: the deadline lives
+# on the socket (``settimeout``) or in the loop's own budget math, so
+# these only fire when the loop body shows neither.
+_RAW_READ_ATTRS = {"recv", "recv_into", "getresponse", "accept"}
+
+_NET_DEADLINE_HINTS = (
+    "deadline", "remaining", "budget", "timeout", "expire", "due",
+)
+
+
+class BlockingNetReadLoopRule(Rule):
+    """JL017: a blocking socket/HTTP read without a timeout inside an
+    unbounded control-plane or dispatch loop.
+
+    The fleet tier's hazard class (docs/SERVING.md): a supervisor,
+    poller, or proxy loop that calls ``urlopen(url)`` (no timeout),
+    ``socket.create_connection(addr)`` (no timeout), or a raw
+    ``sock.recv()`` / ``conn.getresponse()`` with no socket deadline
+    anywhere in the loop hangs FOREVER the first time the peer wedges —
+    and in a control plane, the hung loop is the component whose whole
+    job was to detect exactly that wedge.  The taught idiom is the
+    fleet front's per-attempt deadline (serving/fleet.py
+    ``Backend.request``): every attempt carries ``timeout_s``, computed
+    from the request's remaining budget.
+
+    Heuristics: fires inside an unbounded loop (any ``while``, or a
+    ``for`` over something other than a literal ``range(...)`` — JL016's
+    resolution) on (a) a timeout-parameterized call (``urlopen``,
+    ``create_connection``) whose ``timeout`` is neither a keyword nor
+    covered positionally — these fire regardless of loop context,
+    because the fix is one argument; and (b) a raw read
+    (``.recv``/``.recv_into``/``.getresponse``/``.accept``) when
+    NOTHING in the loop body mentions a deadline-shaped name
+    (deadline/remaining/budget/timeout/expire/due — a ``settimeout`` or
+    budget computation anywhere in the loop is taken as awareness).  A
+    deliberately blocking accept loop (a test fixture server) is waived
+    inline with a reason.
+    """
+
+    rule_id = "JL017"
+    severity = Severity.WARNING
+    summary = (
+        "blocking network read without a timeout in an unbounded loop"
+    )
+
+    @staticmethod
+    def _missing_timeout_call(node: ast.AST) -> bool:
+        """A timeout-parameterized net call that leaves timeout unset."""
+        if not isinstance(node, ast.Call):
+            return False
+        name = dotted_name(node.func)
+        for spellings, timeout_pos in _TIMEOUT_PARAM_CALLS:
+            if name in spellings:
+                if any(kw.arg == "timeout" for kw in node.keywords):
+                    return False
+                if any(kw.arg is None for kw in node.keywords):
+                    return False  # **kwargs may carry it; benefit of doubt
+                return len(node.args) <= timeout_pos
+        return False
+
+    @staticmethod
+    def _raw_read_call(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _RAW_READ_ATTRS
+        )
+
+    @staticmethod
+    def _mentions_net_deadline(body_nodes: list[ast.AST]) -> bool:
+        for node in body_nodes:
+            label = ""
+            if isinstance(node, ast.Attribute):
+                label = (dotted_name(node) or node.attr).lower()
+            elif isinstance(node, ast.Name):
+                label = node.id.lower()
+            elif isinstance(node, ast.keyword) and node.arg:
+                label = node.arg.lower()
+            if label and any(h in label for h in _NET_DEADLINE_HINTS):
+                return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            if SwallowedDispatchErrorRule._is_bounded_for(loop):
+                continue  # a bounded replay/retry is not a control loop
+            body_nodes = list(iter_loop_body_nodes(loop))
+            deadline_aware = self._mentions_net_deadline(body_nodes)
+            for node in body_nodes:
+                if self._missing_timeout_call(node):
+                    yield self.finding(
+                        ctx, node,
+                        "network call with its timeout parameter unset "
+                        "inside an unbounded loop: the first wedged peer "
+                        "hangs this loop forever — and a control-plane "
+                        "loop is usually the thing that was supposed to "
+                        "DETECT the wedge; pass timeout= (the fleet "
+                        "tier's per-attempt deadline, serving/fleet.py "
+                        "Backend.request)",
+                    )
+                elif not deadline_aware and self._raw_read_call(node):
+                    yield self.finding(
+                        ctx, node,
+                        "raw blocking read (.recv/.getresponse/.accept) "
+                        "in an unbounded loop that never touches a "
+                        "timeout or deadline: set a socket timeout "
+                        "(settimeout) or compute a per-attempt deadline "
+                        "from the remaining budget (serving/fleet.py "
+                        "Backend.request)",
+                    )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -2152,6 +2279,7 @@ ALL_RULES: tuple[Rule, ...] = (
     CheckpointWriteRule(),
     ElasticLaunchRule(),
     FixedLingerDispatchRule(),
+    BlockingNetReadLoopRule(),
 )
 
 

@@ -42,17 +42,28 @@ Telemetry flows through the standard obs surfaces: counters
 (docs/OBSERVABILITY.md, docs/ROBUSTNESS.md).
 
 stdlib-only, no jax import: the supervisor must keep working exactly
-when the thing it supervises is the part that is broken.
+when the thing it supervises is the part that is broken.  The liveness
+primitives themselves (heartbeat files, the seeded backoff ladder,
+group-signalling) live in liveness.py (package root), shared with the
+serving fleet's control plane (serving/fleet.py) — this module keeps
+the rank-shaped wrappers.
 """
 
 from __future__ import annotations
 
 import os
-import random
 import signal
 import subprocess
 import sys
 import time
+
+from ..liveness import (
+    BackoffLadder,
+    Heartbeat,
+    heartbeat_age_s,
+    signal_process_group as _signal_proc,
+)
+from ..liveness import heartbeat_path as _liveness_heartbeat_path
 
 # sysexits.h EX_UNAVAILABLE: the gang's restart budget is exhausted —
 # the world cannot be (re)formed.  Sibling of EXIT_STALLED (75) and
@@ -70,46 +81,14 @@ _FORWARDED_SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
 
 def heartbeat_path(directory: str, rank: int) -> str:
-    return os.path.join(directory, f"rank{int(rank)}.hb")
+    return _liveness_heartbeat_path(directory, f"rank{int(rank)}")
 
 
-def heartbeat_age_s(path: str, now_wall: float | None = None) -> float | None:
-    """Seconds since the last beat, or None when the rank has not
-    written its first beat yet (startup — rendezvous + first-step
-    compile — is covered by process liveness, not by heartbeat age)."""
-    try:
-        mtime = os.stat(path).st_mtime
-    except OSError:
-        return None
-    now_wall = time.time() if now_wall is None else now_wall
-    return max(0.0, now_wall - mtime)
-
-
-class RankHeartbeat:
-    """Trainer-side heartbeat writer: a throttled file touch.
-
-    ``beat()`` is called at every step boundary (resilience/runtime.py
-    ``after_step``) but only touches the file once per ``interval_s`` —
-    one ``os.utime`` per half second, never a per-step syscall storm.
-    The first beat creates the file, which is the supervisor's signal
-    that startup (rendezvous, first-step compile) is over and the age
-    clock may run.
-    """
-
-    def __init__(self, path: str, interval_s: float = 0.5):
-        self.path = path
-        self.interval_s = float(interval_s)
-        self._last = 0.0
-        parent = os.path.dirname(os.path.abspath(path))
-        os.makedirs(parent, exist_ok=True)
-
-    def beat(self, force: bool = False) -> None:
-        now = time.monotonic()
-        if not force and now - self._last < self.interval_s:
-            return
-        self._last = now
-        with open(self.path, "a"):
-            os.utime(self.path, None)
+class RankHeartbeat(Heartbeat):
+    """Trainer-side heartbeat writer (liveness.Heartbeat with
+    the rank env contract): ``beat()`` is called at every step boundary
+    (resilience/runtime.py ``after_step``), throttled to one touch per
+    ``interval_s``."""
 
     @classmethod
     def from_env(cls) -> "RankHeartbeat | None":
@@ -234,8 +213,11 @@ class GangSupervisor:
         self._registry = registry
         self._sink = sink
         # Seeded: the backoff ladder must not make two chaos runs
-        # diverge (serving/pool.py discipline).
-        self._rng = random.Random(seed)
+        # diverge (liveness.py discipline).
+        self._ladder = BackoffLadder(
+            base_s=self.backoff_base_s, max_s=self.backoff_max_s,
+            jitter=self.backoff_jitter, seed=seed,
+        )
         self.attempts = 0        # restarts since the last healthy spell
         self.restarts = 0        # lifetime gang restarts
         self.recovery_s: list[float] = []
@@ -249,10 +231,7 @@ class GangSupervisor:
     def backoff_s(self, attempts: int) -> float:
         """Rung ``attempts`` of the seeded exponential ladder — public
         so the determinism test can replay the schedule."""
-        backoff = min(
-            self.backoff_max_s, self.backoff_base_s * (2 ** attempts)
-        )
-        return backoff * (1.0 + self.backoff_jitter * self._rng.random())
+        return self._ladder.delay_s(attempts)
 
     # -- signal forwarding ---------------------------------------------------
 
@@ -462,20 +441,3 @@ class GangSupervisor:
                 reason=reason,
             )
         return None
-
-
-def _signal_proc(proc: subprocess.Popen, signum: int) -> None:
-    """Signal a child's whole process GROUP (children run in their own
-    sessions) — falling back to the single pid when the group is gone,
-    or when the child SHARES the supervisor's group (a non-detached
-    spawn: signalling that group would kill the supervisor itself)."""
-    try:
-        pgid = os.getpgid(proc.pid)
-        if pgid == os.getpgrp():
-            raise PermissionError("child shares the supervisor's group")
-        os.killpg(pgid, signum)
-    except (ProcessLookupError, PermissionError, OSError):
-        try:
-            proc.send_signal(signum)
-        except (ProcessLookupError, OSError):
-            pass

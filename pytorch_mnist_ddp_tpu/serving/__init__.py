@@ -55,43 +55,88 @@ ROADMAP's "heavy traffic" north star:
   replica after a p99-derived delay, first-wins completion, no
   double-counted outcomes).
 
+- :mod:`.fleet` — the multi-host tier (PR 12, docs/SERVING.md fleet
+  section): a jax-free front (:class:`~.fleet.Fleet` +
+  :class:`~.fleet.FleetRouter`) that speaks HTTP to N backend serving
+  PROCESSES over keep-alive pools with per-attempt timeouts, places by
+  the PR-7 policies fed from polled ``/metrics`` snapshots, wraps each
+  backend in a :class:`~.circuit.CircuitBreaker`, REPLACES dead/wedged
+  backends (:class:`~.fleet.FleetSupervisor`: liveness + ``/readyz``
+  probes + heartbeat files, seeded-backoff budget, warm-start off the
+  shared AOT cache — zero new traces), and autoscales
+  (:class:`~.fleet.FleetAutoscaler`: watermark + sustain-window +
+  cooldown hysteresis; drain → settle → kill loses nothing).  Run it
+  with ``python -m pytorch_mnist_ddp_tpu.serving --fleet N
+  [--autoscale]``.
+
 Load-test with ``tools/serve_loadgen.py``; see docs/SERVING.md.
 """
 
-from .batcher import (
-    AdaptiveLinger,
-    MicroBatcher,
-    RejectedError,
-    ReplicaDeadError,
-    RequestTimeout,
-)
-from .faults import FaultError, FaultInjector
-from .buckets import (
-    StagingPool,
-    bucket_for,
-    pad_to_bucket,
-    pow2_buckets,
-    validate_buckets,
-)
-from .engine import InferenceEngine
-from .metrics import ServingMetrics
-from .pool import EnginePool, ReplicaSupervisor
-from .qos import DEFAULT_QOS, QOS_CLASSES, QoSQueue
-from .router import (
-    CircuitBreaker,
-    HedgeManager,
-    Replica,
-    Router,
-    ShardedRequest,
-)
+# Lazy exports (PEP 562).  The fleet front tier (`--fleet`,
+# serving/fleet.py) is a jax-free control plane that must come up in
+# milliseconds and keep working when jax — the thing its backends own —
+# is the broken part; an eager `from .engine import ...` here would pay
+# the full jax import on EVERY `import pytorch_mnist_ddp_tpu.serving`,
+# including the front's.  Attribute access resolves the submodule on
+# first touch, so `from pytorch_mnist_ddp_tpu.serving import Fleet`
+# stays light while `... import EnginePool` still works (and pays jax
+# only then).
+_EXPORTS = {
+    "batcher": (
+        "AdaptiveLinger", "MicroBatcher", "RejectedError",
+        "ReplicaDeadError", "RequestTimeout",
+    ),
+    "buckets": (
+        "StagingPool", "bucket_for", "pad_to_bucket", "pow2_buckets",
+        "validate_buckets",
+    ),
+    "circuit": ("CircuitBreaker",),
+    "engine": ("InferenceEngine",),
+    "faults": ("FaultError", "FaultInjector"),
+    "fleet": (
+        "Backend", "FakeBackendServer", "Fleet", "FleetAutoscaler",
+        "FleetRouter", "FleetSupervisor", "fake_backend_spawner",
+        "make_fleet_server",
+    ),
+    "metrics": ("ServingMetrics",),
+    "pool": ("EnginePool", "ReplicaSupervisor"),
+    "qos": ("DEFAULT_QOS", "QOS_CLASSES", "QoSQueue"),
+    "router": ("HedgeManager", "Replica", "Router", "ShardedRequest"),
+}
+_EXPORT_TO_MODULE = {
+    name: module for module, names in _EXPORTS.items() for name in names
+}
+
+
+def __getattr__(name: str):
+    module = _EXPORT_TO_MODULE.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    value = getattr(importlib.import_module(f".{module}", __name__), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORT_TO_MODULE))
 
 __all__ = [
     "AdaptiveLinger",
+    "Backend",
     "CircuitBreaker",
     "DEFAULT_QOS",
     "EnginePool",
+    "FakeBackendServer",
     "FaultError",
     "FaultInjector",
+    "Fleet",
+    "FleetAutoscaler",
+    "FleetRouter",
+    "FleetSupervisor",
     "HedgeManager",
     "InferenceEngine",
     "MicroBatcher",
@@ -107,6 +152,8 @@ __all__ = [
     "ShardedRequest",
     "StagingPool",
     "bucket_for",
+    "fake_backend_spawner",
+    "make_fleet_server",
     "pad_to_bucket",
     "pow2_buckets",
     "validate_buckets",

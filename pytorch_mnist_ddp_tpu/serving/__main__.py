@@ -22,8 +22,6 @@ import signal
 import sys
 import threading
 
-from ..utils.compile_cache import enable_persistent_cache
-
 
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
@@ -148,6 +146,72 @@ def build_parser() -> argparse.ArgumentParser:
         "EWMA; falls back to least-loaded until samples exist)",
     )
     parser.add_argument(
+        "--fleet", type=int, default=None, metavar="N",
+        help="run a multi-PROCESS serving fleet (docs/SERVING.md fleet "
+        "section): this process becomes a jax-free front tier on --port "
+        "that spawns N backend serving processes (each this same CLI on "
+        "--fleet-base-port+i, sharing one AOT cache so replacements "
+        "warm-start), routes /predict to them by --router-policy, "
+        "liveness-probes and REPLACES dead or wedged backends under a "
+        "seeded backoff restart budget, and (with --autoscale) "
+        "adds/drains whole backends from the load signal",
+    )
+    parser.add_argument(
+        "--fleet-base-port", type=int, default=None, metavar="PORT",
+        help="first backend port with --fleet (default --port + 1; "
+        "backend i listens on base+i, a replacement reuses its port)",
+    )
+    parser.add_argument(
+        "--fleet-restart-budget", type=int, default=3,
+        help="consecutive failed backend replacements before a backend "
+        "is permanently ejected from the fleet",
+    )
+    parser.add_argument(
+        "--fleet-heartbeat-timeout-s", type=float, default=10.0,
+        help="a backend whose dispatch-loop heartbeat file is older "
+        "than this is treated as wedged and replaced (0 disables; "
+        "process death and /readyz probes still apply)",
+    )
+    parser.add_argument(
+        "--fleet-ready-timeout-s", type=float, default=300.0,
+        help="bring-up bound per backend (cold warmup on CPU is slow; "
+        "warm AOT starts are seconds)",
+    )
+    parser.add_argument(
+        "--autoscale", action="store_true",
+        help="with --fleet: add a backend when the smoothed per-backend "
+        "backlog breaches --scale-high for --scale-window-s, drain the "
+        "newest at --scale-low (drain -> settle -> kill, nothing "
+        "lost), with cooldown hysteresis and --scale-min/--scale-max "
+        "bounds",
+    )
+    parser.add_argument(
+        "--scale-high", type=float, default=8.0, metavar="DEPTH",
+        help="autoscaler high-water mark: smoothed mean backlog "
+        "(queue depth + in-flight) per active backend",
+    )
+    parser.add_argument(
+        "--scale-low", type=float, default=1.0, metavar="DEPTH",
+        help="autoscaler low-water mark (must be < --scale-high; the "
+        "gap is the hysteresis band)",
+    )
+    parser.add_argument("--scale-min", type=int, default=1)
+    parser.add_argument("--scale-max", type=int, default=4)
+    parser.add_argument(
+        "--scale-window-s", type=float, default=2.0,
+        help="a watermark breach must sustain this long before acting",
+    )
+    parser.add_argument(
+        "--scale-cooldown-s", type=float, default=10.0,
+        help="minimum quiet time after any scale event",
+    )
+    parser.add_argument(
+        "--request-timeout-s", type=float, default=30.0,
+        help="handler-connection socket timeout: a client that connects "
+        "and goes silent is closed (or answered 408 mid-body) within "
+        "this bound instead of pinning a handler thread forever",
+    )
+    parser.add_argument(
         "--no-supervise", action="store_true",
         help="with --replicas: disable the replica supervisor "
         "(quarantine / backoff restart / ejection of replicas that "
@@ -198,7 +262,48 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
-    args = build_parser().parse_args(argv)
+    raw_argv = list(sys.argv[1:]) if argv is None else list(argv)
+    args = build_parser().parse_args(raw_argv)
+
+    if args.fleet is not None:
+        # The fleet front is a pure control plane + proxy: no engine, no
+        # checkpoint, no jax — it must come up instantly and keep
+        # working when a backend (the part that owns devices) is the
+        # part that is broken.  Delegate BEFORE any jax import.
+        if args.fleet < 1:
+            print(f"error: --fleet must be >= 1, got {args.fleet}")
+            return 2
+        if args.autoscale and args.scale_low >= args.scale_high:
+            print(
+                f"error: --scale-low {args.scale_low:g} must be < "
+                f"--scale-high {args.scale_high:g} (the hysteresis band)"
+            )
+            return 2
+        if args.autoscale and not (
+            1 <= args.scale_min <= args.fleet <= args.scale_max
+        ):
+            # Pre-flight, not after minutes of backend bring-up: the
+            # autoscaler constructor would reject these anyway, but only
+            # once every backend has already warmed.
+            print(
+                f"error: need 1 <= --scale-min ({args.scale_min}) <= "
+                f"--fleet ({args.fleet}) <= --scale-max ({args.scale_max})"
+            )
+            return 2
+        if args.warmup_only:
+            # Passed through, every backend would warm, exit 0, and the
+            # front would report an opaque bring-up failure.
+            print("error: --warmup-only is a backend concern; run it "
+                  "without --fleet")
+            return 2
+        from .fleet import run_fleet
+
+        return run_fleet(args, raw_argv)
+
+    # Deferred import: utils/__init__ pulls jax, and the fleet branch
+    # above must stay jax-free (the front is up in milliseconds and
+    # survives a broken jax install — serving/fleet.py).
+    from ..utils.compile_cache import enable_persistent_cache
 
     # Satellite wiring: the cache must be configured before the first jit
     # compile or the warmup programs miss it.  Log the directory actually
@@ -397,6 +502,14 @@ def main(argv: list[str] | None = None) -> int:
     if args.warmup_only:
         sink.close()
         return 0
+    # Fleet liveness (docs/SERVING.md): when a fleet front spawned this
+    # backend it exported SERVE_HEARTBEAT_FILE; the batcher dispatch
+    # loop(s) beat it, so a wedged loop is detectable by mtime age.
+    # Flagless runs build nothing.
+    from ..liveness import Heartbeat
+    from .fleet import ENV_FLEET_HEARTBEAT_FILE
+
+    hb = Heartbeat.from_env(ENV_FLEET_HEARTBEAT_FILE)
     batcher_kwargs = dict(
         linger_ms=args.linger_ms,
         queue_depth=args.queue_depth,
@@ -405,6 +518,7 @@ def main(argv: list[str] | None = None) -> int:
         adaptive_linger=not args.no_adaptive_linger,
         deadline_aware=not args.no_deadline_close,
         qos_weights=qos_weights,
+        heartbeat=hb.beat if hb is not None else None,
     )
     if pool_mode:
         router = engine.start(
@@ -419,12 +533,14 @@ def main(argv: list[str] | None = None) -> int:
             **batcher_kwargs,
         )
         server = make_server(
-            engine, metrics, host=args.host, port=args.port, batcher=router
+            engine, metrics, host=args.host, port=args.port, batcher=router,
+            request_timeout_s=args.request_timeout_s,
         )
     else:
         server = make_server(
             engine, metrics, host=args.host, port=args.port,
-            sink=sink, **batcher_kwargs,
+            sink=sink, request_timeout_s=args.request_timeout_s,
+            **batcher_kwargs,
         )
     host, port = server.server_address[:2]
     print(

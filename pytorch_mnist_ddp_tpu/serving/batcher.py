@@ -331,6 +331,7 @@ class MicroBatcher:
         deadline_aware: bool = True,
         qos_classes: tuple[str, ...] = QOS_CLASSES,
         qos_weights: dict[str, int] | None = None,
+        heartbeat=None,
     ):
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
@@ -403,6 +404,12 @@ class MicroBatcher:
         # age) and the current launch-failure streak.
         self._live: set[_InFlight] = set()
         self.consecutive_launch_failures = 0
+        # Fleet liveness (serving/fleet.py): a throttled callable beaten
+        # once per dispatch-loop iteration, so a backend whose dispatch
+        # loop wedges stops beating even while its process answers
+        # poll() — the supervisor's mtime-age signal
+        # (liveness.Heartbeat.beat; None = flagless no-op).
+        self._heartbeat = heartbeat
         self._aborted = False
         self._closed = threading.Event()
         self._stop_lock = threading.Lock()  # stop() is concurrency-safe
@@ -823,6 +830,8 @@ class MicroBatcher:
     def _run(self) -> None:
         carry: PendingRequest | None = None
         while True:
+            if self._heartbeat is not None:
+                self._heartbeat()
             if carry is not None:
                 first, carry = carry, None
             else:

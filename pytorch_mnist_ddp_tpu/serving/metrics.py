@@ -228,6 +228,20 @@ class ServingMetrics:
                 qos=qos,
             )
 
+    def ensure_fleet(self) -> None:
+        """Pre-register the fleet-tier families (serving/fleet.py) so a
+        short smoke's exposition carries them before the first scale
+        event or restart — same scrapeable-from-first-exposition
+        rationale as :meth:`ensure_qos`.  The per-backend restart
+        counters register as each backend joins (Fleet._register);
+        here live the backend-agnostic families."""
+        for direction in ("up", "down"):
+            self.registry.counter(
+                "fleet_scale_events_total",
+                help="autoscaler actions by direction",
+                direction=direction,
+            )
+
     def ensure_hedges(self) -> None:
         """Pre-register the hedge outcome family (the router's hedger
         calls this once when hedging is enabled) — same scrapeable-from-

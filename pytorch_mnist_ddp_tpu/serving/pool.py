@@ -48,11 +48,11 @@ and eight.
 
 from __future__ import annotations
 
-import random
 import threading
 import time
 from typing import Any, Sequence
 
+from ..liveness import BackoffLadder
 from ..parallel.mesh import replica_devices, single_device_mesh
 from .buckets import DEFAULT_MAX_BUCKET, pow2_buckets
 from .engine import InferenceEngine
@@ -145,8 +145,12 @@ class ReplicaSupervisor:
         self.restart_budget = max(0, restart_budget)
         self._registry = registry
         self._sink = sink
-        # Seeded: backoff jitter must not make two chaos runs diverge.
-        self._rng = random.Random(seed)
+        # Seeded: backoff jitter must not make two chaos runs diverge
+        # (liveness.py, the ladder every supervisor climbs).
+        self._ladder = BackoffLadder(
+            base_s=backoff_base_s, max_s=backoff_max_s,
+            jitter=backoff_jitter, seed=seed,
+        )
         self._watch: dict[str, _ReplicaWatch] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -221,10 +225,7 @@ class ReplicaSupervisor:
     def _backoff(self, attempts: int) -> float:
         """Exponential backoff with seeded jitter for the given rung of
         the ladder (``attempts`` completed restart attempts)."""
-        backoff = min(
-            self.backoff_max_s, self.backoff_base_s * (2 ** attempts)
-        )
-        return backoff * (1.0 + self.backoff_jitter * self._rng.random())
+        return self._ladder.delay_s(attempts)
 
     def _quarantine(self, replica, watch, reason, now) -> None:
         if watch.attempts >= self.restart_budget:

@@ -34,7 +34,12 @@ input, 503 admission rejected (queue full or draining — retry later),
 ``ThreadingHTTPServer`` gives one handler thread per in-flight request;
 handlers only parse, ``submit()`` to the batcher's bounded queue, and
 wait — the single batcher worker owns all jax dispatch, so concurrency
-here costs no device-side contention.
+here costs no device-side contention.  Every handler connection carries
+a socket timeout (``request_timeout_s``, default 30 s): a client that
+connects and goes silent is closed (idle keep-alive / absent request
+line) or answered 408 (stall mid-body) instead of pinning its thread
+forever — a fleet front (serving/fleet.py) multiplies held connections,
+so a leak here scales with fan-in.
 """
 
 from __future__ import annotations
@@ -100,6 +105,19 @@ class ServingHandler(BaseHTTPRequestHandler):
     # rates; /metrics is the observability story.
     def log_message(self, format, *args):  # noqa: A002 - stdlib signature
         pass
+
+    def setup(self):
+        # Handler-connection socket timeout: without one, a client that
+        # connects and then goes silent (dead peer, stalled proxy, a
+        # fleet front holding keep-alives) pins this handler thread
+        # FOREVER — ThreadingHTTPServer threads block in rfile reads
+        # with no deadline, and a fleet multiplies held connections by
+        # fan-in.  With the timeout set, an idle keep-alive or a
+        # never-sent request line times out in handle_one_request
+        # (stdlib closes the connection); a mid-body stall surfaces in
+        # do_POST, which answers 408 and closes (below).
+        self.timeout = getattr(self.server, "request_timeout_s", 30.0)
+        super().setup()
 
     def _send_json(self, status: int, payload: dict) -> None:
         body = json.dumps(payload).encode()
@@ -199,7 +217,23 @@ class ServingHandler(BaseHTTPRequestHandler):
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(length) or b"{}")
+        except ValueError:
+            self._send_json(400, {"error": "malformed Content-Length"})
+            return
+        try:
+            raw = self.rfile.read(length)
+        except (TimeoutError, OSError):
+            # The client sent headers then went silent mid-body: answer
+            # 408 (best effort — the peer may be gone) and drop the
+            # connection so the handler thread frees NOW, not never.
+            try:
+                self._send_json(408, {"error": "request body read timed out"})
+            except OSError:
+                pass
+            self.close_connection = True
+            return
+        try:
+            body = json.loads(raw or b"{}")
             x = decode_instances(body)
             # Variant selection (docs/SERVING.md): "dtype" picks a
             # reduced-precision serving path.  Unknown names are a
@@ -314,11 +348,15 @@ class ServingHTTPServer(ThreadingHTTPServer):
         engine: InferenceEngine,
         batcher: MicroBatcher,
         metrics: ServingMetrics,
+        request_timeout_s: float = 30.0,
     ):
         super().__init__(address, ServingHandler)
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
+        # Handler-connection socket timeout (ServingHandler.setup): an
+        # idle or half-dead client frees its thread within this bound.
+        self.request_timeout_s = request_timeout_s
 
     def snapshot(self) -> dict:
         # Pool mode: the router exposes the same depth/inflight surface
@@ -349,6 +387,7 @@ def make_server(
     host: str = "127.0.0.1",
     port: int = 0,
     batcher=None,
+    request_timeout_s: float = 30.0,
     **batcher_kwargs,
 ) -> ServingHTTPServer:
     """Wire engine + metrics + a started batcher into a ready-to-run
@@ -366,4 +405,7 @@ def make_server(
             "pass batcher kwargs to the pool's start(), not make_server, "
             "when injecting a router"
         )
-    return ServingHTTPServer((host, port), engine, batcher, metrics)
+    return ServingHTTPServer(
+        (host, port), engine, batcher, metrics,
+        request_timeout_s=request_timeout_s,
+    )

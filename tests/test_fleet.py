@@ -1,0 +1,656 @@
+"""Multi-process serving fleet tests (ISSUE 13): front-tier routing
+over network backends, per-backend circuit breakers, exactly-one-503 on
+fleet-wide outage, kill → replace → warm-start with zero new traces,
+autoscaler hysteresis, drain-down losing nothing, heartbeat hang
+detection, and the structural 4-backends-beat-1 scaling pin.
+
+Run alone with ``pytest -m fleet`` (the CI ``fleet`` job); everything
+here also rides the default smoke tier.  Every test drives the REAL
+fleet tier — router, supervisor, autoscaler, connection pools — over
+real loopback sockets; the backends are ``FakeBackendServer``\\ s with
+serial capacity (serving/fleet.py), so the whole suite runs at
+interactive speed without N jax processes fighting the CI box's two
+cores (the host-bound caveat, docs/SERVING.md).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from pytorch_mnist_ddp_tpu.serving.fleet import (
+    ACTIVE,
+    EJECTED,
+    Backend,
+    FakeBackendServer,
+    Fleet,
+    FleetAutoscaler,
+    FleetSupervisor,
+    backend_argv,
+    fake_backend_spawner,
+    make_fleet_server,
+)
+from pytorch_mnist_ddp_tpu.serving.metrics import ServingMetrics
+
+pytestmark = pytest.mark.fleet
+
+BODY = json.dumps({"instances": [[0.0] * 784], "normalized": True}).encode()
+
+# Compressed supervision for interactive-speed incident drills.
+FAST_SUPERVISOR = dict(
+    interval_s=0.02, probe_timeout_s=0.5, probe_failures=3,
+    backoff_base_s=0.02, backoff_max_s=0.1, grace_s=1.0,
+    ready_timeout_s=10.0,
+)
+
+
+def spin_fleet(
+    n,
+    service_s=0.005,
+    supervise=False,
+    supervisor_kwargs=None,
+    heartbeat_dir=None,
+    **fleet_kwargs,
+):
+    fakes = {}
+    spawn = fake_backend_spawner(
+        service_s=service_s, registry=fakes, heartbeat_dir=heartbeat_dir,
+    )
+    fleet = Fleet(
+        spawn, poll_s=0.05, default_timeout_s=5.0, grace_s=1.0,
+        **fleet_kwargs,
+    )
+    fleet.start(
+        n, wait_ready_s=10.0, supervise=supervise,
+        supervisor_kwargs={**FAST_SUPERVISOR, **(supervisor_kwargs or {})},
+    )
+    return fleet, fakes
+
+
+def drive(fleet, requests, concurrency=8, timeout_s=10.0):
+    """Closed-loop drive straight into the front router (saturating —
+    wall time measures fleet capacity, not an arrival schedule)."""
+    results = []
+    lock = threading.Lock()
+    cursor = [0]
+
+    def worker():
+        while True:
+            with lock:
+                if cursor[0] >= requests:
+                    return
+                cursor[0] += 1
+            status, _data = fleet.router.submit(BODY, timeout_s=timeout_s)
+            with lock:
+                results.append(status)
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, time.perf_counter() - t0
+
+
+def wait_for(predicate, timeout_s=10.0, interval_s=0.02):
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Routing policies over fake network backends
+
+
+def test_roundrobin_spreads_evenly():
+    fleet, _fakes = spin_fleet(3, policy="roundrobin")
+    try:
+        for _ in range(30):
+            status, _data = fleet.router.submit(BODY)
+            assert status == 200
+        counts = [
+            fleet.metrics.registry.counter(
+                "fleet_route_decisions_total", backend=f"b{i}"
+            ).value
+            for i in range(3)
+        ]
+        assert counts == [10, 10, 10]
+    finally:
+        fleet.stop()
+
+
+def test_least_loaded_avoids_the_backlogged_backend():
+    fleet, fakes = spin_fleet(2, policy="least-loaded")
+    try:
+        # Fake a deep backlog on b0 via the polled load signal the
+        # policy consumes (the poller would overwrite it, but the
+        # placement read happens immediately).
+        fleet.backend("b0").polled_depth = 50
+        placed = []
+        for _ in range(6):
+            order = fleet.router._order(fleet.active_backends())
+            placed.append(order[0].name)
+        assert set(placed) == {"b1"}
+    finally:
+        fleet.stop()
+
+
+def test_cost_policy_prefers_the_faster_backend():
+    fleet, _fakes = spin_fleet(2, policy="cost")
+    try:
+        fleet.backend("b0").observe_latency(0.5)
+        fleet.backend("b1").observe_latency(0.01)
+        order = fleet.router._order(fleet.active_backends())
+        assert order[0].name == "b1"
+    finally:
+        fleet.stop()
+
+
+def test_front_http_surface_proxies_and_reports():
+    import urllib.request
+
+    fleet, _fakes = spin_fleet(2)
+    server = make_fleet_server(fleet, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            url + "/predict", data=BODY,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+            assert json.load(resp)["predictions"] == [0]
+        with urllib.request.urlopen(url + "/readyz", timeout=5) as resp:
+            assert resp.status == 200
+        with urllib.request.urlopen(url + "/metrics", timeout=5) as resp:
+            snap = json.load(resp)
+        assert set(snap["backends"]) == {"b0", "b1"}
+        assert snap["fleet"]["routable"] == 2
+        assert snap["compiles"] == 4  # 2 cold fakes x 2 buckets
+    finally:
+        server.shutdown()
+        server.server_close()
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# Circuit breakers on network backends
+
+
+def test_breaker_trips_on_backend_500s_and_routes_away():
+    fleet, fakes = spin_fleet(2, failure_threshold=3)
+    try:
+        fakes["b0"].fail_predict = True
+        statuses = [fleet.router.submit(BODY)[0] for _ in range(20)]
+        # Clients may see up to failure_threshold 500s (a backend 500 is
+        # a client-visible outcome, PR-8 semantics); after the trip
+        # every placement lands on b1.
+        assert statuses.count(500) <= 3
+        assert statuses.count(200) >= 17
+        assert fleet.backend("b0").breaker.state == "open"
+        assert fleet.routable_count() == 1
+    finally:
+        fleet.stop()
+
+
+def test_supervisor_replaces_tripped_backend_and_half_open_heals():
+    """A backend that answers /readyz but poisons /predict trips its
+    breaker; the supervisor treats the OPEN circuit itself as sickness
+    (the ReplicaSupervisor rule, one level up), replaces the backend,
+    and re-admits it through a half-open trial that closes the circuit."""
+    fleet, fakes = spin_fleet(2, supervise=True, failure_threshold=2)
+    try:
+        fakes["b0"].fail_predict = True
+        for _ in range(4):
+            fleet.router.submit(BODY)
+        # The replacement spawns a FRESH fake (fail_predict off) under
+        # the same name; the circuit closes once a trial passes.
+        assert wait_for(
+            lambda: fleet.metrics.registry.counter(
+                "fleet_backend_restarts_total", backend="b0"
+            ).value >= 1
+        )
+        assert wait_for(lambda: fleet.backend("b0").state == ACTIVE)
+        assert fleet.backend("b0").breaker.state in ("half-open", "closed")
+        assert wait_for(
+            lambda: [fleet.router.submit(BODY)[0] for _ in range(3)]
+            and fleet.backend("b0").breaker.state == "closed"
+        )
+    finally:
+        fleet.stop()
+
+
+def test_backend_504_is_not_a_breaker_failure():
+    """A backend's own 504 is queueing, not sickness: it must reach the
+    client as the outcome WITHOUT striking the circuit breaker (three
+    spaced 504s under a load spike must not unroute a healthy backend)."""
+    fleet, _fakes = spin_fleet(1, failure_threshold=2)
+    try:
+        backend = fleet.backend("b0")
+        backend.request = lambda *a, **k: (504, b'{"error": "deadline"}')
+        for _ in range(5):
+            status, _data = fleet.router.submit(BODY)
+            assert status == 504
+        assert backend.breaker.state == "closed"
+        assert fleet.metrics.timed_out == 5
+        assert fleet.metrics.failed == 0
+    finally:
+        fleet.stop()
+
+
+def test_stale_pooled_keepalive_retries_on_a_fresh_connection():
+    """The backend's handler idle timeout (this PR's server.py fix)
+    closes keep-alives that sat in the front's pool; the next request
+    over that stale socket must transparently retry on a FRESH
+    connection instead of surfacing a transport error (which would feed
+    the breaker on every sufficiently-spaced request)."""
+    import socket
+
+    fake = FakeBackendServer(name="s", service_s=0.0)
+    backend = Backend("s", "127.0.0.1", fake.port)
+    listener = socket.socket()
+    try:
+        status, _data = backend.request("GET", "/readyz", timeout_s=2.0)
+        assert status == 200  # the connection is now pooled, keep-alive
+        assert backend._idle
+        # Dead keep-alive: swap in a socket whose PEER already closed
+        # (the handler idle timeout's FIN, made deterministic) — the
+        # next exchange over it reads an empty status line
+        # (RemoteDisconnected), exactly the stale-pool failure mode.
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        dead = socket.create_connection(listener.getsockname(), timeout=2.0)
+        server_side, _addr = listener.accept()
+        server_side.close()  # FIN
+        backend._idle[0].sock.close()
+        backend._idle[0].sock = dead
+        status, _data = backend.request("GET", "/readyz", timeout_s=2.0)
+        assert status == 200  # stale conn failed -> fresh retry succeeded
+    finally:
+        listener.close()
+        backend.close_connections()
+        fake.shutdown()
+
+
+def test_read_timeout_is_not_retried_as_stale():
+    """A slow backend's read timeout must NOT trigger the stale-pool
+    retry — re-sending would double the attempt's deadline and the
+    backend's load exactly when it is overloaded."""
+    fake = FakeBackendServer(name="t", service_s=0.5)
+    backend = Backend("t", "127.0.0.1", fake.port)
+    try:
+        status, _data = backend.request("GET", "/readyz", timeout_s=2.0)
+        assert status == 200  # pool a keep-alive connection
+        t0 = time.perf_counter()
+        with pytest.raises(TimeoutError):
+            backend.request(
+                "POST", "/predict", BODY, timeout_s=0.15,
+            )
+        # One attempt, not two: well under 2x the per-attempt timeout.
+        assert time.perf_counter() - t0 < 0.4
+    finally:
+        backend.close_connections()
+        fake.shutdown()
+
+
+def test_fleet_front_surface_is_jax_free():
+    """The front tier's contract: `from pytorch_mnist_ddp_tpu.serving
+    import Fleet` must not import jax — the control plane comes up in
+    milliseconds and keeps working when jax (the thing its backends
+    own) is the broken part.  Fresh interpreter: this suite's conftest
+    already imported jax here."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import sys\n"
+        "from pytorch_mnist_ddp_tpu.serving import Fleet, FleetRouter, "
+        "FleetSupervisor, FleetAutoscaler, fake_backend_spawner\n"
+        "assert 'jax' not in sys.modules, 'fleet surface pulled jax'\n"
+    )
+    subprocess.run(
+        [sys.executable, "-c", code], check=True, cwd=repo,
+        timeout=60,
+    )
+
+
+def test_exactly_one_503_on_fleet_wide_outage():
+    fleet, _fakes = spin_fleet(2)
+    try:
+        for b in fleet.backends_snapshot():
+            fleet.set_state(b, EJECTED)
+        before = fleet.metrics.rejected
+        status, data = fleet.router.submit(BODY)
+        assert status == 503
+        assert b"no active backends" in data
+        # Exactly ONE client-visible rejection however many backends
+        # exist (the per-attempt skips are not client outcomes).
+        assert fleet.metrics.rejected == before + 1
+    finally:
+        fleet.stop()
+
+
+def test_transport_failure_retries_on_surviving_backend():
+    """A dead-but-not-yet-detected backend: the front's per-attempt
+    transport failure is absorbed by the next backend on the remaining
+    deadline — the client sees 200, not an error."""
+    fleet, fakes = spin_fleet(2, policy="roundrobin")
+    try:
+        fakes["b1"].kill()  # router still believes b1 is active
+        statuses = [fleet.router.submit(BODY)[0] for _ in range(8)]
+        assert statuses == [200] * 8
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# Kill -> replace -> warm start (zero new traces)
+
+
+def test_kill_replace_warm_start_zero_new_compiles():
+    fleet, fakes = spin_fleet(3, supervise=True)
+    try:
+        snap = fleet.snapshot()
+        assert snap["backends"]["b1"]["compiles"] == 2  # cold first start
+        fakes["b1"].kill()
+        assert wait_for(
+            lambda: fleet.backend("b1").state == ACTIVE
+            and fleet.backend("b1").proc.poll() is None
+        )
+        snap = fleet.snapshot()
+        # The replacement found its grid in the shared warm store: a
+        # pure deserialize, ZERO compiles (the AOT warm-start pin at
+        # fleet scope).
+        assert snap["backends"]["b1"]["compiles"] == 0
+        restarts = fleet.metrics.registry.counter(
+            "fleet_backend_restarts_total", backend="b1"
+        ).value
+        assert restarts == 1
+        assert snap["fleet"]["supervisor"]["restarts_total"] == 1
+        status, _data = fleet.router.submit(BODY)
+        assert status == 200
+    finally:
+        fleet.stop()
+
+
+def test_kill_under_load_loses_nothing():
+    """The acceptance drill at test scope: SIGKILL one backend mid-drive;
+    every request still gets exactly one terminal outcome and the
+    backend is replaced."""
+    fleet, fakes = spin_fleet(3, supervise=True)
+    try:
+        killer = threading.Timer(0.1, fakes["b2"].kill)
+        killer.start()
+        results, _wall = drive(fleet, 120, concurrency=8)
+        killer.join()
+        assert len(results) == 120  # nothing lost
+        assert all(s == 200 for s in results), results
+        assert wait_for(
+            lambda: all(
+                b.state == ACTIVE for b in fleet.backends_snapshot()
+            )
+        )
+    finally:
+        fleet.stop()
+
+
+def test_restart_budget_exhaustion_ejects():
+    calls = {"n": 0}
+    store: set = set()
+
+    def dying_spawn(name: str) -> Backend:
+        calls["n"] += 1
+        fake = FakeBackendServer(name=name, service_s=0.001, warm_store=store)
+        if calls["n"] > 1:
+            fake.kill()  # every replacement is dead on arrival
+        return Backend(name, "127.0.0.1", fake.port, proc=fake.proc)
+
+    fleet = Fleet(dying_spawn, poll_s=0.05, grace_s=0.5)
+    fleet.start(1, wait_ready_s=10.0, supervise=False)
+    sup = FleetSupervisor(fleet, restart_budget=2, **FAST_SUPERVISOR)
+    try:
+        b0 = fleet.backend("b0")
+        b0.proc.kill()
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline:
+            sup.tick()
+            if fleet.backend("b0").state == EJECTED:
+                break
+            time.sleep(0.01)
+        assert fleet.backend("b0").state == EJECTED
+        assert fleet.backend("b0").breaker.state == "open"
+        # budget consumed: initial incident + 2 respawn attempts
+        assert sup._watch["b0"].attempts == 2
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat hang detection
+
+
+def test_heartbeat_hang_is_an_incident(tmp_path):
+    fleet, fakes = spin_fleet(
+        2, supervise=True, heartbeat_dir=str(tmp_path),
+        supervisor_kwargs=dict(heartbeat_timeout_s=0.2),
+    )
+    try:
+        assert wait_for(
+            lambda: fleet.backend("b0").heartbeat_age() is not None
+        )
+        # b0 wedges: still alive, still answering HTTP, but its
+        # dispatch-loop heartbeat goes silent.
+        fakes["b0"].stop_heartbeat()
+        assert wait_for(
+            lambda: fleet.metrics.registry.counter(
+                "fleet_backend_restarts_total", backend="b0"
+            ).value >= 1,
+            timeout_s=15.0,
+        )
+        assert fleet.backend("b0").state == ACTIVE
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler: hysteresis, bounds, drain-down
+
+
+def test_autoscaler_scales_up_on_sustained_breach_only():
+    fleet, _fakes = spin_fleet(1)
+    scaler = FleetAutoscaler(
+        fleet, high_water=4.0, low_water=0.5, window_s=0.5,
+        cooldown_s=0.2, min_backends=1, max_backends=3, alpha=1.0,
+    )
+    try:
+        t = 1000.0
+        # A single spike is NOT sustained: no scale.
+        scaler.tick(now=t, raw=50.0)
+        scaler.tick(now=t + 0.1, raw=0.0)
+        assert fleet.scalable_count() == 1
+        # Sustained breach: scale up once the window elapses.
+        for i in range(8):
+            scaler.tick(now=t + 10 + 0.1 * i, raw=10.0)
+        assert fleet.scalable_count() == 2
+        up = fleet.metrics.registry.counter(
+            "fleet_scale_events_total", direction="up"
+        ).value
+        assert up == 1
+    finally:
+        fleet.stop()
+
+
+def test_autoscaler_no_flap_on_oscillating_signal():
+    fleet, _fakes = spin_fleet(2)
+    scaler = FleetAutoscaler(
+        fleet, high_water=4.0, low_water=0.5, window_s=0.3,
+        cooldown_s=0.1, min_backends=1, max_backends=4, alpha=1.0,
+    )
+    try:
+        t = 1000.0
+        # Oscillation INSIDE the hysteresis band: both breach clocks
+        # reset every other tick; nothing may scale, ever.
+        for i in range(50):
+            scaler.tick(now=t + 0.1 * i, raw=3.5 if i % 2 else 1.0)
+        assert fleet.scalable_count() == 2
+        registry = fleet.metrics.registry
+        assert registry.counter(
+            "fleet_scale_events_total", direction="up"
+        ).value == 0
+        assert registry.counter(
+            "fleet_scale_events_total", direction="down"
+        ).value == 0
+    finally:
+        fleet.stop()
+
+
+def test_autoscaler_drain_down_loses_nothing():
+    fleet, _fakes = spin_fleet(3, service_s=0.002)
+    scaler = FleetAutoscaler(
+        fleet, high_water=50.0, low_water=1.0, window_s=0.05,
+        cooldown_s=0.05, min_backends=2, max_backends=3, alpha=1.0,
+    )
+    try:
+        # Drain b2 while traffic flows: every request must still get a
+        # 200 (drain -> settle -> kill, nothing lost).
+        results = []
+        done = threading.Event()
+
+        def pump():
+            while not done.is_set():
+                results.append(fleet.router.submit(BODY)[0])
+
+        pumps = [threading.Thread(target=pump) for _ in range(4)]
+        for p in pumps:
+            p.start()
+        t = 1000.0
+        for i in range(6):
+            scaler.tick(now=t + 0.1 * i, raw=0.0)
+        done.set()
+        for p in pumps:
+            p.join()
+        assert fleet.scalable_count() == 2
+        assert [b.name for b in fleet.retired] == ["b2"]
+        assert results and all(s == 200 for s in results)
+        down = fleet.metrics.registry.counter(
+            "fleet_scale_events_total", direction="down"
+        ).value
+        assert down == 1
+    finally:
+        fleet.stop()
+
+
+def test_autoscaler_respects_min_and_max_bounds():
+    fleet, _fakes = spin_fleet(1)
+    scaler = FleetAutoscaler(
+        fleet, high_water=4.0, low_water=0.5, window_s=0.1,
+        cooldown_s=0.0, min_backends=1, max_backends=2, alpha=1.0,
+    )
+    try:
+        t = 1000.0
+        for i in range(20):
+            scaler.tick(now=t + 0.1 * i, raw=100.0)
+        assert fleet.scalable_count() == 2  # capped at max
+        for i in range(20):
+            scaler.tick(now=t + 50 + 0.1 * i, raw=0.0)
+        assert fleet.scalable_count() == 1  # floored at min
+    finally:
+        fleet.stop()
+
+
+def test_autoscaler_validates_watermarks():
+    fleet, _fakes = spin_fleet(1)
+    try:
+        with pytest.raises(ValueError, match="hysteresis"):
+            FleetAutoscaler(fleet, high_water=2.0, low_water=2.0)
+        with pytest.raises(ValueError, match="min_backends"):
+            FleetAutoscaler(fleet, min_backends=3, max_backends=2)
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# The structural scaling pin
+
+
+def test_four_backends_beat_one_by_2p5x_wall():
+    """The fleet-scope throughput pin (docs/SERVING.md): with serial
+    per-backend capacity, 4 backends must finish the same saturating
+    closed-loop workload in well under half the 1-backend wall —
+    >2.5x, structurally, independent of this box's core count."""
+    # Sleep-dominated service + roundrobin: the fakes' simulated device
+    # time dwarfs the shared-interpreter HTTP overhead (front, drive
+    # workers, and fake backends all share THIS process's GIL), so wall
+    # time measures fleet capacity, not Python parsing.
+    requests, service_s = 40, 0.05
+    walls = {}
+    for n in (1, 4):
+        fleet, _fakes = spin_fleet(
+            n, service_s=service_s, policy="roundrobin"
+        )
+        try:
+            results, wall = drive(fleet, requests, concurrency=12)
+            assert all(s == 200 for s in results)
+            walls[n] = wall
+        finally:
+            fleet.stop()
+    speedup = walls[1] / walls[4]
+    assert speedup > 2.5, (
+        f"4 backends only {speedup:.2f}x faster ({walls}); the fleet "
+        "tier is serializing somewhere"
+    )
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing
+
+
+def test_backend_argv_strips_fleet_flags():
+    argv = [
+        "--fleet", "4", "--autoscale", "--scale-high", "12",
+        "--port", "8000", "--host", "0.0.0.0",
+        "--buckets", "4,8", "--timeout-ms", "500",
+        "--fleet-base-port=9000", "--telemetry-dir", "/tmp/t",
+        "--aot-cache", "/tmp/aot",
+    ]
+    assert backend_argv(argv) == ["--buckets", "4,8", "--timeout-ms", "500"]
+
+
+def test_fleet_snapshot_shape():
+    fleet, _fakes = spin_fleet(2)
+    try:
+        snap = fleet.snapshot()
+        assert snap["queue_depth"] == 0
+        assert snap["fleet"]["policy"] == "cost"
+        assert snap["fleet"]["autoscaler"] is None
+        for name in ("b0", "b1"):
+            entry = snap["backends"][name]
+            assert entry["state"] == ACTIVE
+            assert entry["circuit"] == "closed"
+            assert entry["url"].startswith("http://127.0.0.1:")
+    finally:
+        fleet.stop()
+
+
+def test_metrics_prom_exposition_carries_fleet_families():
+    from pytorch_mnist_ddp_tpu.obs.export import render_prometheus
+
+    fleet, _fakes = spin_fleet(1)
+    try:
+        fleet.router.submit(BODY)
+        text = render_prometheus(fleet.metrics.registry)
+        assert 'fleet_backends{state="active"} 1' in text
+        assert 'fleet_scale_events_total{direction="up"} 0' in text
+        assert 'fleet_scale_events_total{direction="down"} 0' in text
+        assert 'fleet_route_decisions_total{backend="b0"} 1' in text
+        assert 'fleet_backend_restarts_total{backend="b0"} 0' in text
+    finally:
+        fleet.stop()

@@ -1662,6 +1662,121 @@ def test_jl016_waiver():
 
 
 # ---------------------------------------------------------------------------
+# JL017 — blocking network read without a timeout in an unbounded loop
+
+
+JL017_BAD_URLOPEN = """\
+import time
+import urllib.request
+
+def poll_backends(urls):
+    while True:
+        for url in urls:
+            with urllib.request.urlopen(url) as resp:
+                resp.read()
+        time.sleep(0.25)
+"""
+
+JL017_BAD_CREATE_CONNECTION = """\
+import socket
+
+def probe(host, port):
+    while True:
+        with socket.create_connection((host, port)):
+            pass
+"""
+
+JL017_BAD_RAW_RECV = """\
+def pump(sock, handler):
+    while True:
+        chunk = sock.recv(4096)
+        if not chunk:
+            return
+        handler(chunk)
+"""
+
+JL017_GOOD_URLOPEN_TIMEOUT = """\
+import time
+import urllib.request
+
+def poll_backends(urls):
+    while True:
+        for url in urls:
+            with urllib.request.urlopen(url, timeout=0.5) as resp:
+                resp.read()
+        time.sleep(0.25)
+"""
+
+JL017_GOOD_URLOPEN_POSITIONAL = """\
+import urllib.request
+
+def poll(url):
+    while True:
+        with urllib.request.urlopen(url, None, 0.5) as resp:
+            resp.read()
+"""
+
+JL017_GOOD_RECV_WITH_SETTIMEOUT = """\
+def pump(sock, handler):
+    while True:
+        sock.settimeout(0.5)
+        chunk = sock.recv(4096)
+        handler(chunk)
+"""
+
+JL017_GOOD_RECV_WITH_DEADLINE = """\
+import time
+
+def pump(sock, handler, budget_s):
+    deadline = time.monotonic() + budget_s
+    while True:
+        if time.monotonic() > deadline:
+            return
+        chunk = sock.recv(4096)
+        handler(chunk)
+"""
+
+JL017_GOOD_BOUNDED_RETRY = """\
+import urllib.request
+
+def fetch_with_retries(url):
+    for attempt in range(3):
+        try:
+            with urllib.request.urlopen(url) as resp:
+                return resp.read()
+        except OSError:
+            continue
+"""
+
+
+def test_jl017_fires_on_timeoutless_net_calls_in_unbounded_loops():
+    assert_fires(JL017_BAD_URLOPEN, "JL017", line=7)
+    assert_fires(JL017_BAD_CREATE_CONNECTION, "JL017", line=5)
+    assert_fires(JL017_BAD_RAW_RECV, "JL017", line=3)
+
+
+def test_jl017_silent_when_a_timeout_is_set():
+    assert_silent(JL017_GOOD_URLOPEN_TIMEOUT, "JL017")
+    assert_silent(JL017_GOOD_URLOPEN_POSITIONAL, "JL017")
+    assert_silent(JL017_GOOD_RECV_WITH_SETTIMEOUT, "JL017")
+    assert_silent(JL017_GOOD_RECV_WITH_DEADLINE, "JL017")
+
+
+def test_jl017_silent_in_bounded_retry():
+    # A literal-range retry loop is not an unbounded control loop: its
+    # worst case is attempts x (TCP stack default), not forever.
+    assert_silent(JL017_GOOD_BOUNDED_RETRY, "JL017")
+
+
+def test_jl017_waiver():
+    waived = JL017_BAD_RAW_RECV.replace(
+        "chunk = sock.recv(4096)",
+        "chunk = sock.recv(4096)  # jaxlint: disable=JL017 -- test fixture server, blocking accept loop is the harness",
+    )
+    assert_silent(waived, "JL017")
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

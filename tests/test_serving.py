@@ -910,3 +910,90 @@ def test_perf_report_serving_section_from_synthetic_events(tmp_path):
     assert "serving: 2 requests" in summary
     assert "serving batches: 1, mean fill 62.5%" in summary
     assert "1 stalled dispatches" in summary
+
+
+# ---------------------------------------------------------------------------
+# Handler-connection socket timeout (ISSUE 13 satellite bugfix)
+
+
+class _IdleProbeEngine:
+    """Just enough engine surface for /healthz; never dispatches."""
+
+    warmed = True
+    buckets = (8,)
+    dtypes = ("f32",)
+
+    def variant_verified(self, dtype):
+        return True
+
+    def compile_count(self):
+        return 0
+
+
+class _IdleProbeBatcher:
+    """Never reached by the hang paths; present for handler attrs."""
+
+    max_inflight = 1
+    timeout_s = 1.0
+    current_linger_ms = 0.0
+
+    def depth(self):
+        return 0
+
+    def inflight(self):
+        return 0
+
+
+def test_handler_socket_timeout_frees_a_connect_then_hang_client():
+    """A client that connects and never sends a request line used to pin
+    a ThreadingHTTPServer handler thread FOREVER (no socket timeout on
+    the handler connection) — and a fleet front multiplies held
+    connections by fan-in.  With request_timeout_s set, the server must
+    close the idle connection within the bound, and a stalled mid-body
+    client must get a 408."""
+    import socket
+
+    from pytorch_mnist_ddp_tpu.serving.server import ServingHTTPServer
+
+    server = ServingHTTPServer(
+        ("127.0.0.1", 0), _IdleProbeEngine(), _IdleProbeBatcher(),
+        ServingMetrics(), request_timeout_s=0.5,
+    )
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    addr = ("127.0.0.1", server.server_address[1])
+    try:
+        # 1) connect-then-hang: no request line at all.  The server must
+        # hang up (recv -> b"") within ~timeout, not hold the thread.
+        idle = socket.create_connection(addr, timeout=5.0)
+        idle.settimeout(5.0)
+        t0 = time.perf_counter()
+        assert idle.recv(1024) == b""  # server closed on us
+        assert time.perf_counter() - t0 < 4.0
+        idle.close()
+
+        # 2) headers sent, body stalls: the read times out and the
+        # server answers 408 then closes.
+        stall = socket.create_connection(addr, timeout=5.0)
+        stall.settimeout(5.0)
+        stall.sendall(
+            b"POST /predict HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: 100\r\n\r\n{\"inst"
+        )
+        chunks = b""
+        while b"\r\n\r\n" not in chunks:
+            chunk = stall.recv(4096)
+            if not chunk:
+                break
+            chunks += chunk
+        assert b"408" in chunks.split(b"\r\n", 1)[0]
+        stall.close()
+
+        # 3) the server is not wedged: a normal request still answers.
+        with urllib.request.urlopen(
+            f"http://{addr[0]}:{addr[1]}/healthz", timeout=5.0
+        ) as resp:
+            assert resp.status == 200
+    finally:
+        server.shutdown()
+        server.server_close()

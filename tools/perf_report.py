@@ -725,6 +725,82 @@ def summarize_telemetry(directory: str) -> str | None:
                 f"({e.get('reason', '?')}, after {e.get('attempts', '?')} "
                 "restart(s))"
             )
+    # Fleet section (serving/fleet.py, docs/SERVING.md fleet tier):
+    # per-backend placement share with the load-imbalance ratio, the
+    # autoscaler's event timeline, and mean backend-replacement time
+    # (incident -> serving again) — the operator's receipt of what the
+    # fleet control plane did.  Grouped per run_id like the scale-out
+    # lines: a sweep accumulates one run per rung in one directory.
+    froutes = [e for e in events if e.get("event") == "fleet_route"]
+    fdeaths = [e for e in events if e.get("event") == "backend_death"]
+    freplaces = [e for e in events if e.get("event") == "backend_replace"]
+    fejects = [e for e in events if e.get("event") == "backend_eject"]
+    fdrains = [e for e in events if e.get("event") == "backend_drain"]
+    fscales = [e for e in events if e.get("event") == "fleet_scale"]
+    if froutes or fdeaths or freplaces or fscales or fdrains or fejects:
+        lines.append(
+            f"  fleet: {len(froutes)} placement(s), {len(fdeaths)} "
+            f"backend death(s), {len(freplaces)} replacement(s), "
+            f"{len(fscales)} scale event(s), {len(fdrains)} drain-down(s)"
+        )
+        fshare_runs: dict[object, dict[str, int]] = {}
+        for e in froutes:
+            tally = fshare_runs.setdefault(e.get("run_id"), {})
+            name = e.get("backend", "?")
+            tally[name] = tally.get(name, 0) + 1
+        for rid, tally in fshare_runs.items():
+            total = sum(tally.values())
+            mean = total / len(tally)
+            imbalance = max(tally.values()) / mean if mean else 0.0
+            shares = ", ".join(
+                f"{name} {100.0 * n / total:.1f}% ({n})"
+                for name, n in sorted(tally.items())
+            )
+            suffix = (
+                f" [run {str(rid)[-6:]}]" if len(fshare_runs) > 1 else ""
+            )
+            lines.append(
+                f"    backend share: {shares}; imbalance (max/mean) "
+                f"{imbalance:.2f}{suffix}"
+            )
+        if freplaces:
+            downs = [e.get("downtime_s", 0.0) for e in freplaces]
+            by_backend: dict[str, int] = {}
+            for e in freplaces:
+                name = e.get("backend", "?")
+                by_backend[name] = by_backend.get(name, 0) + 1
+            lines.append(
+                "    replacements: "
+                + ", ".join(
+                    f"{name} x{n}" for name, n in sorted(by_backend.items())
+                )
+                + f" (mean replacement {sum(downs) / len(downs):.2f} s)"
+            )
+        if fscales:
+            # Timeline relative to each run's first event, so the
+            # up/down story reads in run seconds, not epoch ts.
+            run_t0: dict[object, float] = {}
+            for e in events:
+                rid = e.get("run_id")
+                ts = e.get("ts")
+                if ts is None:
+                    continue
+                if rid not in run_t0 or ts < run_t0[rid]:
+                    run_t0[rid] = ts
+            for e in fscales:
+                rel = e.get("ts", 0.0) - run_t0.get(e.get("run_id"), 0.0)
+                lines.append(
+                    f"    scale {e.get('direction', '?')} at +{rel:.1f}s: "
+                    f"{e.get('backends', '?')} backend(s), "
+                    f"{e.get('kind', 'depth')} signal "
+                    f"{e.get('signal', 0.0):.2f}"
+                )
+        for e in fejects:
+            lines.append(
+                f"    ejected: {e.get('backend', '?')} "
+                f"({e.get('reason', '?')}, after {e.get('attempts', '?')} "
+                "attempt(s))"
+            )
     gates = [e for e in events if e.get("event") == "parity_gate"]
     if gates:
         for e in gates:

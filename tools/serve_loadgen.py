@@ -697,6 +697,397 @@ def run_replica_sweep(args) -> int:
     return rc
 
 
+def _spin_fleet(args, n: int, autoscale: bool = False):
+    """Bring up an n-backend FLEET behind an in-process front server
+    (docs/SERVING.md fleet section): real serving subprocesses sharing
+    one AOT cache by default, or — with ``--fleet-fake`` — in-process
+    fake backends with serial capacity (the structural mode for the
+    host-bound CI box).  Returns ``(server, fleet, fakes, sink, url)``;
+    the caller owns teardown."""
+    import tempfile
+
+    from pytorch_mnist_ddp_tpu.obs.events import EventSink, NullSink
+    from pytorch_mnist_ddp_tpu.serving.fleet import (
+        Fleet,
+        fake_backend_spawner,
+        make_fleet_server,
+        subprocess_backend_spawner,
+    )
+    from pytorch_mnist_ddp_tpu.serving.metrics import ServingMetrics
+
+    sink = (
+        EventSink(args.telemetry_dir, filename="events-fleet.jsonl")
+        if args.telemetry_dir else NullSink()
+    )
+    fakes: dict = {}
+    hb_dir = tempfile.mkdtemp(prefix="fleet-hb-")
+    if args.fleet_fake:
+        spawn = fake_backend_spawner(
+            service_s=args.fleet_service_ms / 1e3,
+            buckets=tuple(int(b) for b in args.buckets.split(",")),
+            heartbeat_dir=hb_dir,
+            registry=fakes,
+        )
+        # Compressed supervision, like --chaos: the kill round injects
+        # an outage measured in milliseconds, so detection and backoff
+        # must compress with it.
+        supervisor_kwargs = dict(
+            interval_s=0.05, probe_timeout_s=0.5, probe_failures=3,
+            backoff_base_s=0.05, backoff_max_s=0.5, grace_s=2.0,
+            heartbeat_timeout_s=2.0, ready_timeout_s=30.0,
+        )
+    else:
+        aot = args.aot_cache or tempfile.mkdtemp(prefix="fleet-aot-")
+        spawn = subprocess_backend_spawner(
+            [
+                "--buckets", args.buckets,
+                "--timeout-ms", str(args.timeout_ms),
+                "--queue-depth", str(args.queue_depth),
+                "--max-inflight", str(args.max_inflight),
+                "--aot-cache", aot,
+            ],
+            base_port=args.fleet_base_port,
+            heartbeat_dir=hb_dir,
+            log_dir=args.telemetry_dir,
+        )
+        supervisor_kwargs = dict(
+            interval_s=0.2, probe_timeout_s=1.0, probe_failures=3,
+            backoff_base_s=0.2, backoff_max_s=1.0, grace_s=5.0,
+            heartbeat_timeout_s=10.0, ready_timeout_s=180.0,
+        )
+    fleet = Fleet(
+        spawn, policy=args.router_policy, metrics=ServingMetrics(),
+        sink=sink, poll_s=0.1,
+        default_timeout_s=args.timeout_ms / 1e3 + 2.0,
+    )
+    print(
+        f"fleet: bringing up {n} "
+        f"{'fake' if args.fleet_fake else 'real'} backend(s) "
+        f"(policy {args.router_policy})"
+    )
+    fleet.start(
+        n, wait_ready_s=300.0, supervise=True,
+        supervisor_kwargs=supervisor_kwargs,
+        autoscale=autoscale,
+        # Compressed control loop, matched to the fakes' compressed
+        # service times: high water a few queued requests per backend,
+        # sub-second sustain window, everything interactive-speed.
+        autoscaler_kwargs=dict(
+            high_water=3.0, low_water=0.5, window_s=0.3,
+            cooldown_s=1.0, min_backends=n, max_backends=n + 1,
+            interval_s=0.05,
+        ) if autoscale else None,
+    )
+    server = make_fleet_server(fleet, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    print(f"fleet front: {url} ({n} backends ready)")
+    return server, fleet, fakes, sink, url
+
+
+def _teardown_fleet(server, fleet, sink) -> None:
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+    if fleet is not None:
+        fleet.stop()
+    if sink is not None:
+        sink.close()
+
+
+def _fleet_kill_round(args, rows_max: int) -> tuple[dict, int]:
+    """Recovery-under-kill: drive the open-loop trace against the
+    biggest fleet and SIGKILL one backend mid-drive.  The front must
+    absorb it: zero lost responses, zero client transport errors, 503
+    rate within the bound, the backend REPLACED (restart counter >= 1,
+    everything active again) and the replacement serving with zero
+    post-warmup compiles (shared-AOT warm start).  Returns the report
+    section and an exit code contribution."""
+    import signal as _signal
+
+    from pytorch_mnist_ddp_tpu.liveness import signal_process_group
+
+    rc = 0
+    server, fleet, fakes, sink, url = _spin_fleet(args, rows_max)
+    victim = fleet.backends_snapshot()[-1].name
+    kill_at_s = 0.4 * args.requests / args.rate
+
+    def _kill():
+        print(f"fleet: KILLING backend {victim} (SIGKILL, mid-drive)")
+        if args.fleet_fake:
+            fakes[victim].kill()
+        else:
+            signal_process_group(
+                fleet.backend(victim).proc, _signal.SIGKILL
+            )
+
+    timer = threading.Timer(kill_at_s, _kill)
+    timer.start()
+    try:
+        _status, before = fetch_json(f"{url}/metrics")
+        raw = _drive(args, url)
+        timer.join()
+        # Post-drive settle: the replacement must be serving again
+        # within the recovery window.
+        deadline = time.perf_counter() + args.fleet_recovery_wait
+        replaced = False
+        while time.perf_counter() < deadline:
+            _status, snap = fetch_json(f"{url}/metrics")
+            states = {
+                name: b["state"]
+                for name, b in (snap.get("backends") or {}).items()
+                if b["state"] != "retired"
+            }
+            sup = (snap.get("fleet") or {}).get("supervisor") or {}
+            if (states and all(s == "active" for s in states.values())
+                    and (sup.get("restarts_total") or 0) >= 1):
+                replaced = True
+                break
+            time.sleep(0.1)
+        _status, after = fetch_json(f"{url}/metrics")
+        if args.prom_dump:
+            with open(args.prom_dump, "w") as f:
+                f.write(fetch_text(f"{url}/metrics?format=prom"))
+            print(f"prometheus exposition (kill round): {args.prom_dump}")
+    finally:
+        timer.cancel()
+        _teardown_fleet(server, fleet, sink)
+    results = raw["results"]
+    lost = args.requests - len(results)
+    transport = sum(1 for status, *_ in results if status == 0)
+    rejected = sum(1 for status, *_ in results if status == 503)
+    rate_503 = rejected / len(results) if results else 0.0
+    replacement_compiles = (
+        (after.get("backends") or {}).get(victim, {}).get("compiles")
+    )
+    sup = (after.get("fleet") or {}).get("supervisor") or {}
+    recovery = {
+        "backends": rows_max,
+        "killed": victim,
+        "kill_at_s": kill_at_s,
+        "lost": lost,
+        "transport_errors": transport,
+        "rejected": rejected,
+        "rejected_rate": rate_503,
+        "replaced": replaced,
+        "restarts_total": sup.get("restarts_total"),
+        "mean_replacement_s": sup.get("mean_recovery_s"),
+        "replacement_compiles": replacement_compiles,
+        "goodput_rps": (
+            sum(1 for status, *_ in results if status == 200) / raw["wall_s"]
+            if raw["wall_s"] else 0.0
+        ),
+    }
+    if lost or transport:
+        print(
+            f"FLEET-KILL FAIL: {lost} lost response(s), "
+            f"{transport} client transport error(s) — the front must "
+            "absorb a backend kill"
+        )
+        rc = 1
+    if rate_503 > args.fleet_max_503_rate:
+        print(
+            f"FLEET-KILL FAIL: 503 rate {rate_503:.1%} exceeds the "
+            f"--fleet-max-503-rate bound {args.fleet_max_503_rate:.1%}"
+        )
+        rc = 1
+    if not replaced:
+        print(
+            f"FLEET-KILL FAIL: {victim} not replaced within "
+            f"{args.fleet_recovery_wait:.0f}s"
+        )
+        rc = 1
+    if replacement_compiles:
+        print(
+            f"FLEET-KILL FAIL: replacement {victim} reports "
+            f"{replacement_compiles} compile(s) — a warm start off the "
+            "shared AOT cache must deserialize, not trace"
+        )
+        rc = 1
+    if rc == 0:
+        print(
+            f"fleet kill round: {victim} killed at {kill_at_s:.1f}s, "
+            f"replaced in {recovery['mean_replacement_s'] or 0.0:.2f}s, "
+            f"0 lost, 503 rate {rate_503:.1%}, replacement compiles "
+            f"{replacement_compiles}"
+        )
+    return recovery, rc
+
+
+def _fleet_autoscale_round(args) -> tuple[dict, int]:
+    """The elasticity drill (--fleet-fake only — real backends on a
+    2-core box cannot be saturated honestly): start ONE backend with the
+    autoscaler on, drive a sustained over-capacity open-loop trace so
+    the smoothed backlog breaches the high-water mark and the fleet
+    scales 1 -> 2, then go idle so it drains the newest backend back
+    down (drain -> settle -> kill).  Fails on any lost response, any
+    non-200 outcome, a missing scale-up, or a missing drain-down."""
+    rc = 0
+    server, fleet, _fakes, sink, url = _spin_fleet(args, 1, autoscale=True)
+    try:
+        _status, before = fetch_json(f"{url}/metrics")
+        raw = _drive(args, url)
+        # Idle: the backlog signal decays below the low-water mark and
+        # the newest backend drains back out.
+        deadline = time.perf_counter() + args.fleet_recovery_wait
+        drained = False
+        while time.perf_counter() < deadline:
+            _status, snap = fetch_json(f"{url}/metrics")
+            states = [
+                b["state"]
+                for b in (snap.get("backends") or {}).values()
+            ]
+            if states.count("active") == 1 and "retired" in states:
+                drained = True
+                break
+            time.sleep(0.1)
+        _status, after = fetch_json(f"{url}/metrics")
+    finally:
+        _teardown_fleet(server, fleet, sink)
+    results = raw["results"]
+    lost = args.requests - len(results)
+    non_200 = sum(1 for status, *_ in results if status != 200)
+    scaled_up = any(
+        b["state"] in ("active", "retired")
+        for name, b in (after.get("backends") or {}).items()
+        if name != "b0"
+    )
+    section = {
+        "offered_rate_rps": args.rate,
+        "requests": args.requests,
+        "lost": lost,
+        "non_200": non_200,
+        "scaled_up": scaled_up,
+        "drained_back": drained,
+        "final_backends": {
+            name: b["state"]
+            for name, b in (after.get("backends") or {}).items()
+        },
+    }
+    if lost or non_200:
+        print(
+            f"FLEET-AUTOSCALE FAIL: {lost} lost, {non_200} non-200 "
+            "outcome(s) — scaling must lose nothing"
+        )
+        rc = 1
+    if not scaled_up:
+        print("FLEET-AUTOSCALE FAIL: never scaled 1 -> 2 under sustained "
+              "over-capacity load")
+        rc = 1
+    if not drained:
+        print("FLEET-AUTOSCALE FAIL: never drained back down at idle "
+              f"within {args.fleet_recovery_wait:.0f}s")
+        rc = 1
+    if rc == 0:
+        print(
+            f"fleet autoscale round: scaled 1 -> 2 under load, drained "
+            f"back at idle, 0 lost ({section['final_backends']})"
+        )
+    return section, rc
+
+
+def run_fleet_sweep(args) -> int:
+    """The fleet scale-out A/B (docs/SERVING.md): the SAME open-loop
+    trace against fleets of increasing backend count → goodput / p99 /
+    scaling efficiency per rung, then the recovery-under-kill round —
+    all recorded in ``--fleet-report`` (BENCH_fleet.json).
+
+    On the 2-core CI box the REAL sweep is host-bound (the PR-4/7
+    caveat: N jax processes share two cores, so goodput flattens);
+    ``--fleet-fake`` swaps in serial-capacity fake backends over real
+    sockets, which pins the routing/scaling structure (4 backends beat
+    1 by >2.5x wall) without the host bound — the same split as the
+    replica sweep's fake-device pin."""
+    if not args.open_loop:
+        raise SystemExit(
+            "--fleet-sweep is an open-loop drill (the kill round's "
+            "arrival schedule must not re-close around the outage); add "
+            "--open-loop --rate R"
+        )
+    counts = [int(c) for c in args.fleet_sweep.split(",")]
+    if any(c < 1 for c in counts):
+        raise SystemExit("--fleet-sweep counts must be >= 1")
+    rows = []
+    rc = 0
+    for n in counts:
+        server, fleet, _fakes, sink, url = _spin_fleet(args, n)
+        try:
+            _status, before = fetch_json(f"{url}/metrics")
+            raw = _drive(args, url)
+            _status, after = fetch_json(f"{url}/metrics")
+        finally:
+            _teardown_fleet(server, fleet, sink)
+        report = summarize(raw, before, after)
+        extra = report["additional_compiles"]
+        if extra and extra > 0 and not args.no_check_compiles:
+            print(f"RETRACE at {n} backends: {extra} additional compile(s)")
+            rc = 1
+        rows.append({
+            "backends": n,
+            "goodput_rps": report["goodput_rps"],
+            "answered_rps": report["answered_rps"],
+            "wall_s": raw["wall_s"],
+            "p50_ms": report["latency_ms"]["p50"],
+            "p99_ms": report["latency_ms"]["p99"],
+            "rejected": report["rejected"],
+            "timed_out": report["timed_out"],
+            "additional_compiles": extra,
+        })
+    base = rows[0] if rows[0]["backends"] == 1 else None
+    for row in rows:
+        row["speedup_vs_1"] = (
+            row["goodput_rps"] / base["goodput_rps"]
+            if base and base["goodput_rps"] else None
+        )
+        row["scaling_efficiency"] = (
+            row["goodput_rps"] / (row["backends"] * base["goodput_rps"])
+            if base and base["goodput_rps"] else None
+        )
+    recovery = None
+    if not args.no_fleet_kill:
+        recovery, kill_rc = _fleet_kill_round(args, max(counts))
+        rc = rc or kill_rc
+    autoscale_round = None
+    if args.fleet_fake and not args.no_fleet_autoscale:
+        autoscale_round, scale_rc = _fleet_autoscale_round(args)
+        rc = rc or scale_rc
+    fleet_report = {
+        "mode": "fleet-sweep",
+        "backend_kind": "fake" if args.fleet_fake else "process",
+        "host_bound_caveat": (
+            None if args.fleet_fake else
+            "real backends share this host's cores; on a small CI box "
+            "goodput flattens at the host bound (docs/SERVING.md) — the "
+            "scaling structure is pinned by the --fleet-fake rung and "
+            "tests/test_fleet.py"
+        ),
+        "router_policy": args.router_policy,
+        "requests": args.requests,
+        "offered_rate_rps": args.rate,
+        "max_request": args.max_request,
+        "buckets": [int(b) for b in args.buckets.split(",")],
+        "fake_service_ms": (
+            args.fleet_service_ms if args.fleet_fake else None
+        ),
+        "sweep": rows,
+        "recovery_under_kill": recovery,
+        "autoscale_round": autoscale_round,
+    }
+    with open(args.fleet_report, "w") as f:
+        json.dump(fleet_report, f, indent=2)
+    print(f"fleet report: {args.fleet_report}")
+    for row in rows:
+        eff = row["scaling_efficiency"]
+        print(
+            f"  {row['backends']} backend(s): "
+            f"{row['goodput_rps']:.1f} goodput req/s, wall "
+            f"{row['wall_s']:.2f}s, p99 {row['p99_ms']:.2f} ms, "
+            f"{row['rejected']} rejected"
+            + (f", efficiency {eff:.2f}" if eff is not None else "")
+        )
+    return rc
+
+
 def run_ab_tail(args) -> int:
     """The tail-latency A/B (docs/SERVING.md QoS section): the SAME
     open-loop Poisson trace — identical arrivals, sizes, and per-request
@@ -1009,6 +1400,55 @@ def main(argv: list[str] | None = None) -> int:
         help="where --replicas-sweep writes its report",
     )
     parser.add_argument(
+        "--fleet-sweep", default=None, metavar="N1,N2,...",
+        help="multi-PROCESS fleet sweep (docs/SERVING.md fleet section): "
+        "bring up a fleet of each listed backend count (real serving "
+        "subprocesses sharing one AOT cache, or fakes with "
+        "--fleet-fake), drive the SAME open-loop trace through the "
+        "front tier, then run a recovery-under-kill round at the top "
+        "rung — goodput/p99/scaling-efficiency per count plus the "
+        "recovery receipt land in --fleet-report; requires --open-loop",
+    )
+    parser.add_argument(
+        "--fleet-fake", action="store_true",
+        help="with --fleet-sweep: in-process fake backends with SERIAL "
+        "capacity over real sockets — the structural scaling pin for "
+        "host-bound boxes (N real jax processes on 2 cores flatten at "
+        "the host bound; the fakes do not)",
+    )
+    parser.add_argument(
+        "--fleet-service-ms", type=float, default=20.0,
+        help="fake-backend per-request service time (--fleet-fake)",
+    )
+    parser.add_argument(
+        "--no-fleet-kill", action="store_true",
+        help="skip the recovery-under-kill round after the sweep",
+    )
+    parser.add_argument(
+        "--no-fleet-autoscale", action="store_true",
+        help="skip the autoscale round (--fleet-fake sweeps only: "
+        "1 backend under sustained over-capacity load must scale to 2, "
+        "then drain back at idle with nothing lost)",
+    )
+    parser.add_argument(
+        "--fleet-report", default="BENCH_fleet.json",
+        help="where --fleet-sweep writes its report",
+    )
+    parser.add_argument(
+        "--fleet-base-port", type=int, default=18411,
+        help="first real-backend port for --fleet-sweep",
+    )
+    parser.add_argument(
+        "--fleet-max-503-rate", type=float, default=0.25,
+        help="maximum tolerated client-visible 503 fraction during the "
+        "kill round (the bounded-shed contract at fleet scope)",
+    )
+    parser.add_argument(
+        "--fleet-recovery-wait", type=float, default=60.0,
+        help="post-drive wait for the killed backend's replacement to "
+        "serve again before the kill round fails",
+    )
+    parser.add_argument(
         "--aot-cache", default=None, metavar="DIR",
         help="--self-serve mode: shared serialized-executable store for "
         "the engine(s) (compile/aot.ExecutableStore; a warm pool start "
@@ -1077,6 +1517,14 @@ def main(argv: list[str] | None = None) -> int:
             # hard-errors on the same combination).
             parser.error("--hedge needs --replicas N (>= 2): a lone "
                          "engine has no second replica to hedge onto")
+    if args.fleet_sweep:
+        if args.url or args.replicas_sweep or args.chaos or args.ab_tail:
+            parser.error("--fleet-sweep drives its own fleets; drop "
+                         "--url / --replicas-sweep / --chaos / --ab-tail")
+        if args.replicas is not None:
+            parser.error("--fleet-sweep backends choose their own "
+                         "replica layout; drop --replicas")
+        return run_fleet_sweep(args)
     if args.ab_tail:
         if args.url or args.replicas_sweep or args.chaos:
             parser.error("--ab-tail drives its own pair of self-serve "
