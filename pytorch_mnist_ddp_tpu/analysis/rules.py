@@ -1,4 +1,4 @@
-"""The jaxlint rule set: JL001–JL017, the JAX hazards this repo has
+"""The jaxlint rule set: JL001–JL018, the JAX hazards this repo has
 actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, the
 serving layer's per-request-shape retrace class, the telemetry layer's
 record-at-trace-time class, the serving pipeline's
@@ -8,8 +8,9 @@ replica pool's per-replica-re-trace class, the fault-tolerance
 layer's swallowed-dispatch-error class, the resilient trainer's
 torn-file / uncadenced-checkpoint-write class, the elastic
 runtime's unbounded-rendezvous / unsupervised-launch class, the
-tail-latency layer's deadline-blind fixed-linger class, and the fleet
-tier's timeout-less blocking-network-read class).
+tail-latency layer's deadline-blind fixed-linger class, the fleet
+tier's timeout-less blocking-network-read class, and the host hot
+path's float-list-JSON-in-a-serve-loop class).
 
 Every rule is a heuristic over one module's AST — no type inference, no
 cross-file call graph.  "Traced context" below means: a function that is
@@ -2262,6 +2263,87 @@ class BlockingNetReadLoopRule(Rule):
                     )
 
 
+# ---------------------------------------------------------------------------
+# JL018 — float-list JSON serialization in an unbounded dispatch/serve loop
+
+
+# json-render spellings (the serializer half of the pattern).
+_JSON_DUMP_CALLS = {"json.dumps", "dumps", "json.dump"}
+
+
+class FloatListJSONLoopRule(Rule):
+    """JL018: ``json.dumps`` of ``.tolist()``'d array data inside an
+    unbounded dispatch/serve loop.
+
+    The host hot path's hazard class (docs/SERVING.md wire protocol):
+    rendering an array as a JSON float list costs ~1 µs per element on
+    the way out and the same again at the peer's parse — for a 784-pixel
+    MNIST row batch that is MILLISECONDS of pure text work per request,
+    paid on every iteration of a loop that never ends.  The committed
+    sweeps showed this exact cost class as the serving ceiling
+    ("host-bound on 2 cores").  The taught idiom is the binary wire
+    path (serving/wire.py): a fixed header plus ``tobytes()`` raw
+    float32, parsed by the peer with one zero-copy ``np.frombuffer`` —
+    and for one-shot reports/artifacts (bounded work), float-list JSON
+    is fine and this rule stays silent.
+
+    Heuristics: fires on a ``json.dumps``/``json.dump`` call whose
+    argument subtree contains a ``.tolist()`` call (the array-shaped
+    giveaway — ``tolist`` is the numpy/jax array spelling, so the value
+    is known array data) inside an unbounded loop (any ``while``, or a
+    ``for`` over a non-``range`` iterable — JL016's resolution; bounded
+    literal replays are not serve loops).  A deliberately-JSON streamer
+    (a debug endpoint, a compatibility shim) is waived inline with a
+    reason.
+    """
+
+    rule_id = "JL018"
+    severity = Severity.WARNING
+    summary = (
+        "float-list JSON serialization of array data in an unbounded "
+        "dispatch/serve loop"
+    )
+
+    @staticmethod
+    def _tolist_inside(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "tolist"):
+                return True
+        return False
+
+    def _dumps_of_tolist(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        if dotted_name(node.func) not in _JSON_DUMP_CALLS:
+            return False
+        return any(self._tolist_inside(arg) for arg in node.args) or any(
+            kw.value is not None and self._tolist_inside(kw.value)
+            for kw in node.keywords
+        )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            if SwallowedDispatchErrorRule._is_bounded_for(loop):
+                continue  # a bounded replay/report pass is not a serve loop
+            for node in iter_loop_body_nodes(loop):
+                if self._dumps_of_tolist(node):
+                    yield self.finding(
+                        ctx, node,
+                        "array data rendered as a JSON float list inside "
+                        "an unbounded loop: every iteration pays "
+                        "per-element text encode (and the peer pays the "
+                        "matching parse) — milliseconds per request of "
+                        "pure host work, the measured serving ceiling; "
+                        "send raw bytes instead (serving/wire.py: fixed "
+                        "header + tobytes(), parsed with one zero-copy "
+                        "np.frombuffer)",
+                    )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -2280,6 +2362,7 @@ ALL_RULES: tuple[Rule, ...] = (
     ElasticLaunchRule(),
     FixedLingerDispatchRule(),
     BlockingNetReadLoopRule(),
+    FloatListJSONLoopRule(),
 )
 
 

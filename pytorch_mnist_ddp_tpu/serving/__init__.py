@@ -69,6 +69,18 @@ ROADMAP's "heavy traffic" north star:
   with ``python -m pytorch_mnist_ddp_tpu.serving --fleet N
   [--autoscale]``.
 
+- :mod:`.wire` / :mod:`.cache` — the host hot path (PR 14,
+  docs/SERVING.md): a binary wire protocol for ``/predict``
+  (``Content-Type: application/x-mnist-f32`` — fixed little-endian
+  header + raw float32 rows, parsed with ONE zero-copy
+  ``np.frombuffer``; responses are raw logits bytes; JSON stays the
+  byte-identical default) that the fleet front proxies verbatim, and a
+  content-addressed response cache with single-flight dedup
+  (``--response-cache N``: deterministic inference keyed on
+  (weights digest, dtype, payload hash); concurrent identical requests
+  coalesce onto one dispatch; a failed dispatch fails every coalesced
+  waiter and never leaves a stale fill; off by default).
+
 Load-test with ``tools/serve_loadgen.py``; see docs/SERVING.md.
 """
 
@@ -90,6 +102,7 @@ _EXPORTS = {
         "StagingPool", "bucket_for", "pad_to_bucket", "pow2_buckets",
         "validate_buckets",
     ),
+    "cache": ("ResponseCache",),
     "circuit": ("CircuitBreaker",),
     "engine": ("InferenceEngine",),
     "faults": ("FaultError", "FaultInjector"),
@@ -102,6 +115,7 @@ _EXPORTS = {
     "pool": ("EnginePool", "ReplicaSupervisor"),
     "qos": ("DEFAULT_QOS", "QOS_CLASSES", "QoSQueue"),
     "router": ("HedgeManager", "Replica", "Router", "ShardedRequest"),
+    "wire": ("WireError", "WireRequest"),
 }
 _EXPORT_TO_MODULE = {
     name: module for module, names in _EXPORTS.items() for name in names
@@ -143,6 +157,7 @@ __all__ = [
     "QOS_CLASSES",
     "QoSQueue",
     "RejectedError",
+    "ResponseCache",
     "Replica",
     "ReplicaDeadError",
     "ReplicaSupervisor",
@@ -151,6 +166,8 @@ __all__ = [
     "ServingMetrics",
     "ShardedRequest",
     "StagingPool",
+    "WireError",
+    "WireRequest",
     "bucket_for",
     "fake_backend_spawner",
     "make_fleet_server",

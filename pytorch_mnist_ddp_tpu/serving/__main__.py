@@ -96,6 +96,17 @@ def build_parser() -> argparse.ArgumentParser:
         "(implies --hedge; pool mode only)",
     )
     parser.add_argument(
+        "--response-cache", type=int, default=None, metavar="N",
+        help="enable the content-addressed response cache with "
+        "single-flight dedup, bounded at N entries (serving/cache.py): "
+        "deterministic inference means identical (weights, dtype, rows) "
+        "answer from cache, and concurrent identical requests coalesce "
+        "onto one dispatch; keyed on the weights digest so an "
+        "engine swap invalidates.  With --fleet the front caches raw "
+        "proxied bodies AND the flag propagates to every backend "
+        "(both tiers, docs/SERVING.md).  Off by default",
+    )
+    parser.add_argument(
         "--telemetry-dir", default=None,
         help="write serving JSONL telemetry (serving_request/serving_batch "
         "events, pad/dispatch/complete spans) into this directory "
@@ -265,6 +276,10 @@ def main(argv: list[str] | None = None) -> int:
     raw_argv = list(sys.argv[1:]) if argv is None else list(argv)
     args = build_parser().parse_args(raw_argv)
 
+    if args.response_cache is not None and args.response_cache < 1:
+        print(f"error: --response-cache must be >= 1, got "
+              f"{args.response_cache}")
+        return 2
     if args.fleet is not None:
         # The fleet front is a pure control plane + proxy: no engine, no
         # checkpoint, no jax — it must come up instantly and keep
@@ -535,12 +550,22 @@ def main(argv: list[str] | None = None) -> int:
         server = make_server(
             engine, metrics, host=args.host, port=args.port, batcher=router,
             request_timeout_s=args.request_timeout_s,
+            response_cache=args.response_cache, sink=sink,
         )
     else:
         server = make_server(
             engine, metrics, host=args.host, port=args.port,
             sink=sink, request_timeout_s=args.request_timeout_s,
+            response_cache=args.response_cache,
             **batcher_kwargs,
+        )
+    if args.response_cache:
+        # Printed only when the flag is set: flagless stdout stays
+        # byte-identical (the PR-4 contract).
+        print(
+            f"response cache: {args.response_cache} entries "
+            f"(weights digest {engine.weights_digest[:12]}, "
+            "single-flight dedup on)"
         )
     host, port = server.server_address[:2]
     print(

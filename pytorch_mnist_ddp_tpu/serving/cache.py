@@ -1,0 +1,234 @@
+"""Content-addressed response cache with single-flight dedup.
+
+Inference here is deterministic: the same (model weights, served dtype,
+input rows) always produces the same logits — the property every parity
+gate and byte-identity test in this repo already leans on.  So repeated
+identical work is pure host+device waste, and it is common waste: retry
+storms, hedged clients, dashboards re-probing a canary row, zipf-shaped
+request popularity.  This module deletes it at two points
+(docs/SERVING.md):
+
+- the serving admission point (serving/server.py): keyed on
+  ``(model digest, dtype, payload hash)`` where the payload hash covers
+  the MODEL-READY float32 rows — so a JSON request and a binary-wire
+  request carrying the same pixels hit the same entry;
+- the fleet front (serving/fleet.py): keyed on the raw proxied body
+  (content-type ++ bytes), so a hit answers without touching a backend.
+
+**Single-flight**: a miss CLAIMS the key; concurrent identical requests
+JOIN the claimant's in-flight computation instead of dispatching their
+own copy — one device dispatch, N waiters.  The claimant completes or
+fails the flight; a failure wakes every joiner with the same error
+(each maps it to its own client outcome — exactly one outcome per
+waiter, the PR-8 first-wins discipline one level up) and the entry is
+DROPPED, never cached: a killed dispatch must not become a stale fill
+that later requests read as truth.  Joiners additionally wait only
+their OWN deadline budget; a slow flight 504s the joiner without
+disturbing the claimant.
+
+**Invalidation**: the key embeds a ``model_digest`` (the engine's
+weights digest, serving/engine.py) plus a local generation counter
+bumped by :meth:`invalidate` — any engine/weights swap makes every old
+key unreachable, and the LRU bound retires the dead entries.  The
+whole tier is OFF by default (``--response-cache N`` enables it with an
+N-entry bound); with it off, not a single code path changes.
+
+Values are opaque to this module (the server caches logits arrays, the
+front caches ``(status, content_type, body)`` tuples), so one
+implementation serves both tiers.  stdlib-only; no jax, no numpy.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+# claim() outcomes (also the serving_cache_total{outcome=} label values;
+# docs/OBSERVABILITY.md).
+HIT = "hit"
+MISS = "miss"
+COALESCED = "coalesced"
+CACHE_OUTCOMES = (HIT, MISS, COALESCED)
+
+
+class FlightTimeout(TimeoutError):
+    """A joiner's own deadline expired before the claimed flight
+    resolved — the joiner's 504, not a verdict on the flight."""
+
+
+class Flight:
+    """One in-flight computation a claimant owns and joiners await."""
+
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error: BaseException | None = None
+
+    def _resolve(self, value, error) -> None:
+        # First writer wins; the cache's claim/complete discipline means
+        # there is only ever one writer, but a double-complete from a
+        # buggy caller must not clobber what joiners already read.
+        if self._event.is_set():
+            return
+        self._value = value
+        self._error = error
+        self._event.set()
+
+    def result(self, timeout_s: float | None = None):
+        """Block until the claimant resolves the flight; re-raises the
+        claimant's error verbatim so the joiner's status mapping treats
+        it exactly like its own failure (one outcome per waiter)."""
+        if not self._event.wait(timeout_s):
+            raise FlightTimeout(
+                "deadline expired waiting on a coalesced in-flight request"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+def payload_digest(*parts) -> str:
+    """Stable content address for request payload bytes (blake2b-128:
+    fast, stdlib, and 128 bits is far past birthday range for any
+    realistic cache population).  ``parts`` are any buffer-protocol
+    objects (bytes, a contiguous array's memoryview) — hashed in place,
+    never copied."""
+    h = hashlib.blake2b(digest_size=16)
+    for part in parts:
+        h.update(part)
+    return h.hexdigest()
+
+
+class ResponseCache:
+    """Bounded-LRU deterministic-response cache with single-flight.
+
+    ``capacity`` bounds COMPLETED entries (an in-flight claim is not
+    evictable — joiners hold it; the handler-thread bound already caps
+    how many can exist).  ``metrics`` (ServingMetrics) receives the
+    ``serving_cache_total{outcome=}`` counts; ``sink`` gets a
+    ``cache_hit`` event per served-from-cache response.  ``scope``
+    labels events ("server" admission tier vs "front" fleet tier).
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        model_digest: str = "",
+        metrics=None,
+        sink=None,
+        scope: str = "server",
+    ):
+        if capacity < 1:
+            raise ValueError(f"cache capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.model_digest = model_digest
+        self.metrics = metrics
+        self.sink = sink
+        self.scope = scope
+        self._generation = 0
+        self._lock = threading.Lock()
+        self._done: OrderedDict[tuple, object] = OrderedDict()
+        self._pending: dict[tuple, Flight] = {}
+        if metrics is not None:
+            # Scrapeable-from-first-exposition (the CI grep contract):
+            # all three outcome series exist before the first request.
+            metrics.ensure_cache()
+
+    # -- keys ------------------------------------------------------------------
+
+    def key(self, *payload_parts, dtype: str = "f32") -> tuple:
+        """The content address: (generation, model digest, dtype,
+        payload hash).  Generation + digest make every entry from a
+        previous engine/weights unreachable after a swap.  Multiple
+        buffer-protocol ``payload_parts`` hash in sequence without
+        being concatenated — no payload-sized copy at either tier."""
+        return (
+            self._generation, self.model_digest, dtype,
+            payload_digest(*payload_parts),
+        )
+
+    # -- the single-flight protocol -------------------------------------------
+
+    def claim(self, key: tuple):
+        """Look up ``key``; returns one of
+
+        - ``(HIT, value)`` — a completed entry (LRU-refreshed);
+        - ``(COALESCED, flight)`` — another request holds the claim;
+          call ``flight.result(my_remaining_budget)``;
+        - ``(MISS, flight)`` — the caller now OWNS the flight and must
+          call :meth:`complete` or :meth:`fail` on every exit path (a
+          leaked claim would coalesce future identical requests onto a
+          flight that never resolves).
+        """
+        with self._lock:
+            if key in self._done:
+                self._done.move_to_end(key)
+                value = self._done[key]
+                outcome = HIT
+            elif key in self._pending:
+                value = self._pending[key]
+                outcome = COALESCED
+            else:
+                value = self._pending[key] = Flight()
+                outcome = MISS
+        if self.metrics is not None:
+            self.metrics.record_cache(outcome)
+        if outcome == HIT and self.sink:
+            self.sink.emit("cache_hit", scope=self.scope)
+        return outcome, value
+
+    def complete(self, key: tuple, flight: Flight, value, store: bool = True) -> None:
+        """Resolve a claimed flight with ``value`` and wake every
+        joiner; ``store=False`` delivers without filling (the front
+        caches only 200s — a 503 is an outcome for current waiters, not
+        a fact about the payload)."""
+        with self._lock:
+            if self._pending.get(key) is flight:
+                del self._pending[key]
+            if store and key[0] == self._generation:
+                # A fill racing invalidate() must lose: its value was
+                # computed against the pre-swap model.
+                self._done[key] = value
+                while len(self._done) > self.capacity:
+                    self._done.popitem(last=False)
+        flight._resolve(value, None)
+
+    def fail(self, key: tuple, flight: Flight, error: BaseException) -> None:
+        """Resolve a claimed flight with ``error``: every joiner raises
+        it as its own, and NOTHING is cached — the
+        never-a-stale-fill rule."""
+        with self._lock:
+            if self._pending.get(key) is flight:
+                del self._pending[key]
+        flight._resolve(None, error)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def invalidate(self, model_digest: str | None = None) -> None:
+        """Engine/weights swap: drop every completed entry and bump the
+        generation so in-flight fills from the old world cannot land.
+        ``model_digest`` updates the key component when the new weights'
+        digest is known (a swap to identical weights still invalidates —
+        correctness over hit rate)."""
+        with self._lock:
+            self._generation += 1
+            if model_digest is not None:
+                self.model_digest = model_digest
+            self._done.clear()
+        if self.sink:
+            self.sink.emit(
+                "cache_invalidate", scope=self.scope,
+                generation=self._generation,
+            )
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "entries": len(self._done),
+                "pending": len(self._pending),
+                "generation": self._generation,
+            }

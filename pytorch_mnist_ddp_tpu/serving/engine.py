@@ -82,6 +82,22 @@ PARITY_ROWS = 64
 PARITY_SEED = 20260803
 
 
+def weights_digest(variables) -> str:
+    """Deterministic content hash of a variable tree (host pass, done
+    once at engine construction).  Leaf order is jax's tree-flatten
+    order (sorted dict keys — stable across processes), and each leaf
+    contributes its shape/dtype tag plus raw bytes, so two trees hash
+    equal iff they would serve identical logits."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    for leaf in jax.tree_util.tree_leaves(variables):
+        arr = np.asarray(leaf)
+        h.update(f"{arr.shape}{arr.dtype}".encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
 class UnverifiedVariantError(RuntimeError):
     """A reduced-precision variant was asked to serve before (or after
     failing) its parity gate — the refusal contract, docs/SERVING.md."""
@@ -198,6 +214,13 @@ class InferenceEngine:
                 "instead"
             )
         self._conv_impl = conv_impl
+        # Content address of the served weights (the response cache's
+        # model-digest key component, serving/cache.py): hashed from the
+        # HOST-side tree before placement, so it costs one pass at
+        # construction and a swapped engine — new checkpoint, new seed,
+        # retrained weights — necessarily changes it, making every old
+        # cache entry unreachable without an explicit invalidation hook.
+        self.weights_digest = weights_digest(served)
         self._variables = replicate_params(served, self.mesh)
         self.metrics = metrics
         registry = metrics.registry if metrics is not None else None

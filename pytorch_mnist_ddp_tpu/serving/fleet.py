@@ -66,6 +66,7 @@ from ..liveness import (
     heartbeat_age_s,
     heartbeat_path,
 )
+from .cache import COALESCED, HIT, FlightTimeout, ResponseCache
 from .circuit import (
     CIRCUIT_CLOSED,
     CIRCUIT_HALF_OPEN,
@@ -73,6 +74,7 @@ from .circuit import (
     CircuitBreaker,
 )
 from .metrics import ServingMetrics
+from .wire import WIRE_REQUEST_TYPE
 
 FLEET_POLICIES = ("roundrobin", "least-loaded", "cost")
 
@@ -93,6 +95,8 @@ ENV_FLEET_HEARTBEAT_FILE = "SERVE_HEARTBEAT_FILE"
 
 # Front-measured latency EWMA smoothing (serving/router.py's constant).
 EWMA_ALPHA = 0.2
+
+_JSON_TYPE = "application/json"
 
 
 class Backend:
@@ -147,8 +151,11 @@ class Backend:
 
     def _exchange(
         self, conn, method, path, body, timeout_s, headers,
-    ) -> tuple[int, bytes, bool]:
-        """One raw exchange on ``conn``; (status, body, keep-alive?)."""
+    ) -> tuple[int, bytes, str, bool]:
+        """One raw exchange on ``conn``; (status, body, content-type,
+        keep-alive?).  ``headers`` override the JSON default wholesale —
+        a proxied binary-wire body (serving/wire.py) must reach the
+        backend under ITS content type, never re-labeled."""
         conn.timeout = timeout_s
         if conn.sock is not None:
             conn.sock.settimeout(timeout_s)
@@ -158,7 +165,8 @@ class Backend:
         conn.request(method, path, body=body, headers=hdrs)
         resp = conn.getresponse()
         data = resp.read()
-        return resp.status, data, not resp.will_close
+        ctype = resp.headers.get("Content-Type") or "application/json"
+        return resp.status, data, ctype, not resp.will_close
 
     def request(
         self,
@@ -168,7 +176,25 @@ class Backend:
         timeout_s: float = 5.0,
         headers: dict | None = None,
     ) -> tuple[int, bytes]:
-        """One HTTP exchange over a pooled keep-alive connection.
+        """:meth:`request_full` without the response content type (the
+        probe/metrics callers' surface, unchanged)."""
+        status, data, _ctype = self.request_full(
+            method, path, body=body, timeout_s=timeout_s, headers=headers
+        )
+        return status, data
+
+    def request_full(
+        self,
+        method: str,
+        path: str,
+        body: bytes | None = None,
+        timeout_s: float = 5.0,
+        headers: dict | None = None,
+    ) -> tuple[int, bytes, str]:
+        """One HTTP exchange over a pooled keep-alive connection,
+        returning ``(status, body, content_type)`` — the proxy path
+        needs the content type to pass a binary response through
+        verbatim (docs/SERVING.md wire protocol).
 
         ``timeout_s`` is the per-attempt socket timeout (applied to this
         attempt's connect and reads) — the fleet tier never blocks
@@ -190,7 +216,7 @@ class Backend:
                 self.host, self.port, timeout=timeout_s
             )
         try:
-            status, data, keep = self._exchange(
+            status, data, ctype, keep = self._exchange(
                 conn, method, path, body, timeout_s, headers
             )
         except Exception as e:
@@ -222,7 +248,7 @@ class Backend:
                 self.host, self.port, timeout=timeout_s
             )
             try:
-                status, data, keep = self._exchange(
+                status, data, ctype, keep = self._exchange(
                     conn, method, path, body, timeout_s, headers
                 )
             except Exception:
@@ -242,7 +268,7 @@ class Backend:
             # overflow socket left to the finalizer leaks FDs under
             # sustained over-pool_size concurrency).
             conn.close()
-        return status, data
+        return status, data, ctype
 
     def metrics_json(self, timeout_s: float = 0.5) -> dict | None:
         """The backend's /metrics JSON snapshot, or None when it cannot
@@ -421,8 +447,12 @@ class FleetRouter:
         body: bytes,
         timeout_s: float | None = None,
         headers: dict | None = None,
-    ) -> tuple[int, bytes]:
-        """Proxy one /predict body; returns the client outcome."""
+    ) -> tuple[int, bytes, str]:
+        """Proxy one /predict body; returns the client outcome as
+        ``(status, body, content_type)``.  The body AND its content
+        type pass through verbatim in both directions — the front never
+        decodes or re-encodes a payload (the zero-copy proxy contract,
+        docs/SERVING.md wire protocol)."""
         metrics = self.fleet.metrics
         metrics.record_admitted()
         t0 = time.perf_counter()
@@ -432,8 +462,8 @@ class FleetRouter:
         active = self.fleet.active_backends()
         if not active:
             metrics.record_rejected()
-            return 503, b'{"error": "no active backends"}'
-        last_503: bytes | None = None
+            return 503, b'{"error": "no active backends"}', _JSON_TYPE
+        last_503: tuple[bytes, str] | None = None
         transport_errors = 0
         for backend in self._order(active):
             breaker = backend.breaker
@@ -448,7 +478,7 @@ class FleetRouter:
             backend.inflight_enter()
             t_attempt = time.perf_counter()
             try:
-                status, data = backend.request(
+                status, data, ctype = backend.request_full(
                     "POST", "/predict", body,
                     timeout_s=remaining, headers=headers,
                 )
@@ -469,7 +499,7 @@ class FleetRouter:
                 # fleet-wide refusal surfaces (exactly one 503).
                 if breaker is not None:
                     breaker.release()
-                last_503 = data
+                last_503 = (data, ctype)
                 continue
             if status == 504:
                 # The backend's own deadline verdict — ordered BEFORE
@@ -494,18 +524,18 @@ class FleetRouter:
                 # 4xx: a client error is no verdict on the backend.
                 if breaker is not None:
                     breaker.release()
-            return status, data
+            return status, data, ctype
         if time.perf_counter() >= deadline:
             metrics.record_timeout()
-            return 504, b'{"error": "fleet deadline expired"}'
+            return 504, b'{"error": "fleet deadline expired"}', _JSON_TYPE
         metrics.record_rejected()
         if last_503 is not None:
-            return 503, last_503
+            return 503, last_503[0], last_503[1]
         return 503, json.dumps({
             "error": "no routable backends "
             f"({transport_errors} unreachable, every circuit open or "
             "backend draining)"
-        }).encode()
+        }).encode(), _JSON_TYPE
 
 
 class _BackendWatch:
@@ -1034,10 +1064,28 @@ class Fleet:
         settle_timeout_s: float = 30.0,
         grace_s: float = 5.0,
         name_prefix: str = "b",
+        response_cache: int | None = None,
     ):
         self.spawn = spawn
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.sink = sink
+        # Front-tier host hot path (docs/SERVING.md): wire-format
+        # accounting for the proxy, and — with ``response_cache`` — a
+        # content-addressed response cache keyed on the RAW proxied
+        # body, so a hit answers without touching a backend and
+        # concurrent identical bodies coalesce onto one proxied
+        # dispatch.  Backends serve one fixed checkpoint per fleet run
+        # (replacements re-exec the same argv), so the raw body IS the
+        # content address; ``response_cache.invalidate()`` is the
+        # operator hook if weights ever swap under a live front.
+        self.metrics.ensure_wire()
+        self.response_cache = (
+            ResponseCache(
+                response_cache, metrics=self.metrics, sink=sink,
+                scope="front",
+            )
+            if response_cache else None
+        )
         self.poll_s = poll_s
         self.poll_timeout_s = poll_timeout_s
         self.settle_timeout_s = settle_timeout_s
@@ -1482,8 +1530,80 @@ class FleetHandler(BaseHTTPRequestHandler):
                 pass
             self.close_connection = True
             return
-        status, data = fleet.router.submit(body)
-        self._send_raw(status, data)
+        # Pass-through proxy: the request's content type rides to the
+        # backend and the backend's rides back — a binary-wire body
+        # (serving/wire.py) is never decoded, re-encoded, or re-labeled
+        # at this tier (the zero-copy proxy contract, pinned by
+        # tests/test_hostpath.py).
+        req_ctype = self.headers.get("Content-Type") or "application/json"
+        fmt = (
+            "binary"
+            if req_ctype.split(";")[0].strip().lower() == WIRE_REQUEST_TYPE
+            else "json"
+        )
+        headers = {"Content-Type": req_ctype}
+        cache = fleet.response_cache
+
+        def reply(status, data, ctype):
+            fleet.metrics.record_wire(
+                fmt, bytes_in=len(body), bytes_out=len(data)
+            )
+            self._send_raw(status, data, content_type=ctype)
+
+        if cache is None:
+            status, data, ctype = fleet.router.submit(body, headers=headers)
+            reply(status, data, ctype)
+            return
+        # Front-tier cache + single-flight: the content address is the
+        # RAW body under its content type (identical bytes -> identical
+        # backend answer, since every backend serves the same weights).
+        # Only 200s fill the cache; any other outcome resolves current
+        # waiters and is dropped — a refused or failed proxy must never
+        # become a stale fill.
+        # Multi-part hash: the body is never concatenated or copied on
+        # this pass-through tier (the zero-copy proxy discipline).
+        key = cache.key(req_ctype.encode(), b"\x00", body)
+        outcome, val = cache.claim(key)
+        if outcome == HIT:
+            reply(*val)
+            return
+        if outcome == COALESCED:
+            try:
+                result = val.result(fleet.router.default_timeout_s + 1.0)
+            except FlightTimeout:
+                # This joiner's own deadline — counted like any other
+                # client-visible 504 (the claimant's outcome, whatever
+                # it ends up being, is counted by router.submit).
+                fleet.metrics.record_timeout()
+                reply(
+                    504, b'{"error": "fleet deadline expired"}',
+                    "application/json",
+                )
+                return
+            except BaseException as e:
+                # The claimant's submit raised (cache.fail re-raised it
+                # to every joiner — BaseException included, whatever
+                # killed that thread): each waiter still gets exactly
+                # one HTTP outcome, never a dropped connection.
+                reply(
+                    500,
+                    json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}
+                    ).encode(),
+                    "application/json",
+                )
+                return
+            reply(*result)
+            return
+        try:
+            status, data, ctype = fleet.router.submit(body, headers=headers)
+        except BaseException as e:
+            cache.fail(key, val, e)
+            raise
+        cache.complete(
+            key, val, (status, data, ctype), store=status == 200
+        )
+        reply(status, data, ctype)
 
 
 class FleetHTTPServer(ThreadingHTTPServer):
@@ -1889,6 +2009,10 @@ def run_fleet(args, argv: list[str]) -> int:
         # (the informative one) and the front's synthetic 504 is only
         # the backstop for a hung transport.
         default_timeout_s=args.timeout_ms / 1e3 + 2.0,
+        # Two-tier caching: the flag also rides backend_argv (it is not
+        # a front-only flag), so backends cache at their own admission
+        # points while the front absorbs exact-repeat bodies here.
+        response_cache=args.response_cache,
     )
     print(
         f"fleet: spawning {args.fleet} backend(s) on ports "

@@ -113,6 +113,15 @@ class ServingMetrics:
         self._qos_latency: dict[str, object] = {}
         self._shed: dict[str, object] = {}
         self._hedges: dict[str, object] = {}
+        # Host hot path (docs/SERVING.md wire protocol + response
+        # cache): per-wire-format request counts, wire byte totals, and
+        # the cache outcome tally.  Registered by ensure_wire (the
+        # server, at construction) / ensure_cache (the ResponseCache,
+        # only when --response-cache enables the tier) so short CI
+        # smokes scrape fully-born families.
+        self._wire_requests: dict[str, object] = {}
+        self._wire_bytes: dict[str, object] = {}
+        self._cache: dict[str, object] = {}
 
     # -- counter views (back-compat attribute surface) ------------------------
 
@@ -256,6 +265,60 @@ class ServingMetrics:
                 outcome=outcome,
             )
 
+    def ensure_wire(self) -> None:
+        """Pre-register the wire-protocol families (docs/SERVING.md
+        binary wire path) — both formats and both byte directions exist
+        from the first exposition, same rationale as
+        :meth:`ensure_qos`."""
+        if self._wire_requests:
+            return
+        with self.registry.locked():
+            for fmt in ("json", "binary"):
+                self._wire_requests[fmt] = self.registry.counter(
+                    "serving_wire_requests_total",
+                    help="/predict requests by wire format (json = the "
+                    "default text protocol, binary = "
+                    "application/x-mnist-f32)",
+                    format=fmt,
+                )
+            for direction in ("in", "out"):
+                self._wire_bytes[direction] = self.registry.counter(
+                    "serving_wire_bytes_total",
+                    help="/predict payload bytes by direction (request "
+                    "bodies in, response bodies out)",
+                    direction=direction,
+                )
+
+    def ensure_cache(self) -> None:
+        """Pre-register the response-cache outcome family
+        (serving/cache.py; only called when --response-cache enables
+        the tier, so cache-off expositions are unchanged)."""
+        if self._cache:
+            return
+        with self.registry.locked():
+            for outcome in ("hit", "miss", "coalesced"):
+                self._cache[outcome] = self.registry.counter(
+                    "serving_cache_total",
+                    help="response-cache lookups by outcome (hit = "
+                    "served from cache, miss = claimed the dispatch, "
+                    "coalesced = joined an identical in-flight request)",
+                    outcome=outcome,
+                )
+
+    def record_wire(self, fmt: str, bytes_in: int = 0, bytes_out: int = 0) -> None:
+        """One /predict exchange on wire format ``fmt`` moving
+        ``bytes_in``/``bytes_out`` payload bytes."""
+        self.ensure_wire()
+        self._wire_requests[fmt].inc()
+        if bytes_in:
+            self._wire_bytes["in"].inc(bytes_in)
+        if bytes_out:
+            self._wire_bytes["out"].inc(bytes_out)
+
+    def record_cache(self, outcome: str) -> None:
+        self.ensure_cache()
+        self._cache[outcome].inc()
+
     def record_shed(self, qos: str) -> None:
         """One request evicted from the admission queue to admit a
         higher class under pressure (serving/qos.py)."""
@@ -363,6 +426,18 @@ class ServingMetrics:
                 outcome: counter.value
                 for outcome, counter in self._hedges.items()
             }
+            cache = {
+                outcome: counter.value
+                for outcome, counter in self._cache.items()
+            }
+            wire = {
+                fmt: counter.value
+                for fmt, counter in self._wire_requests.items()
+            }
+            wire_bytes = {
+                direction: counter.value
+                for direction, counter in self._wire_bytes.items()
+            }
             fills = self._fill.values()
             stalls = sorted(self._stall.values())
             stall_count, stall_sum = self._stall.count, self._stall.sum
@@ -440,6 +515,24 @@ class ServingMetrics:
             }
         if hedges:
             snap["hedges"] = dict(sorted(hedges.items()))
+        if cache:
+            # Present only when the response-cache tier is enabled
+            # (--response-cache; serving/cache.py registers the family),
+            # so cache-off snapshots stay byte-identical.
+            lookups = sum(cache.values())
+            snap["cache"] = {
+                **dict(sorted(cache.items())),
+                "hit_rate": cache.get("hit", 0) / lookups if lookups else 0.0,
+            }
+        if wire.get("binary"):
+            # The wire block appears once a BINARY request has been
+            # seen: JSON-only traffic keeps the pre-wire snapshot (and
+            # the shutdown report) byte-identical, while the Prometheus
+            # exposition carries both formats from the first scrape.
+            snap["wire"] = {
+                "requests": dict(sorted(wire.items())),
+                "bytes": dict(sorted(wire_bytes.items())),
+            }
         gauges = [
             ("serving_uptime_seconds", "process uptime", uptime),
             ("serving_batch_occupancy_pct", "real samples / dispatched slots",
@@ -524,6 +617,21 @@ class ServingMetrics:
                 f"/ {h.get('cancelled', 0)} cancelled"
                 + (f" (win rate {h.get('won', 0) / placed:.1%})"
                    if placed else "")
+            )
+        if "cache" in s:
+            c = s["cache"]
+            lines.append(
+                f"  cache: {c.get('hit', 0)} hit / {c.get('miss', 0)} miss "
+                f"/ {c.get('coalesced', 0)} coalesced "
+                f"(hit rate {c['hit_rate']:.1%})"
+            )
+        if "wire" in s:
+            w = s["wire"]
+            lines.append(
+                f"  wire: {w['requests'].get('binary', 0)} binary / "
+                f"{w['requests'].get('json', 0)} json requests, "
+                f"{w['bytes'].get('in', 0)} B in / "
+                f"{w['bytes'].get('out', 0)} B out"
             )
         if "compiles" in s:
             lines.append(

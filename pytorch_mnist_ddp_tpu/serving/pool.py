@@ -458,6 +458,13 @@ class EnginePool:
         return [_replica_name(i) for i in range(len(self.engines))]
 
     @property
+    def weights_digest(self) -> str:
+        """The pool serves ONE checkpoint placed per replica, so the
+        response cache's model digest (serving/cache.py) is any
+        replica's — they hash identically by construction."""
+        return self.engines[0].weights_digest
+
+    @property
     def buckets(self):
         return self.engines[0].buckets
 

@@ -1,4 +1,4 @@
-"""stdlib-only JSON serving endpoint over ``http.server``.
+"""stdlib-only serving endpoint over ``http.server``.
 
 Endpoints:
 
@@ -13,6 +13,23 @@ Endpoints:
   parity gate passes — docs/SERVING.md).  Response:
   ``{"predictions": [digit, ...]}``, plus per-class ``"log_probs"`` when
   ``"return_log_probs": true``.
+
+  With ``Content-Type: application/x-mnist-f32`` the SAME endpoint
+  speaks the binary wire protocol (serving/wire.py): a fixed
+  little-endian header plus raw float32 rows, parsed with one zero-copy
+  ``np.frombuffer`` — no per-pixel text parsing — and answered with raw
+  logits bytes (``application/x-mnist-logits-f32``).  JSON stays the
+  default and is byte-identical to the pre-wire server; an unrecognized
+  content type falls back to JSON parsing (a ``wire_fallback`` event
+  notes it).  ``serving_wire_requests_total{format=}`` /
+  ``serving_wire_bytes_total{direction=}`` count both paths.
+
+  ``--response-cache N`` adds a content-addressed response cache with
+  single-flight dedup at this admission point (serving/cache.py):
+  deterministic inference means identical (weights, dtype, rows) can be
+  answered from an N-entry LRU, and concurrent identical requests
+  coalesce onto ONE dispatch.  Off by default; when off, no code path
+  changes.
 - ``GET /metrics`` — the full ServingMetrics snapshot (queue depth,
   occupancy, p50/p95/p99 latency, compile count) as JSON; with
   ``Accept: text/plain`` or ``?format=prom``, the same registry renders
@@ -54,7 +71,9 @@ import numpy as np
 from ..data.transforms import normalize
 from ..obs.export import render_prometheus
 from ..models.net import INPUT_SHAPE
+from . import wire
 from .batcher import MicroBatcher, RejectedError, RequestTimeout
+from .cache import COALESCED, HIT, FlightTimeout, ResponseCache
 from .engine import InferenceEngine
 from .metrics import ServingMetrics
 from .qos import QOS_CLASSES
@@ -215,10 +234,36 @@ class ServingHandler(BaseHTTPRequestHandler):
         if self.path != "/predict":
             self._send_json(404, {"error": f"no such path {self.path!r}"})
             return
+        ctype = (
+            (self.headers.get("Content-Type") or "")
+            .split(";")[0].strip().lower()
+        )
+        binary = ctype == wire.WIRE_REQUEST_TYPE
+        fmt = "binary" if binary else "json"
+
+        # Every /predict outcome goes out through here so the wire
+        # accounting (serving_wire_requests_total{format=} +
+        # serving_wire_bytes_total{direction=}) counts each exchange
+        # exactly once, whatever status it ends with.
+        def reply(status, data, content_type="application/json"):
+            if srv.metrics is not None:
+                srv.metrics.record_wire(
+                    fmt, bytes_in=len(raw), bytes_out=len(data)
+                )
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def reply_json(status, payload):
+            reply(status, json.dumps(payload).encode())
+
         try:
             length = int(self.headers.get("Content-Length", 0))
         except ValueError:
-            self._send_json(400, {"error": "malformed Content-Length"})
+            raw = b""
+            reply_json(400, {"error": "malformed Content-Length"})
             return
         try:
             raw = self.rfile.read(length)
@@ -226,21 +271,44 @@ class ServingHandler(BaseHTTPRequestHandler):
             # The client sent headers then went silent mid-body: answer
             # 408 (best effort — the peer may be gone) and drop the
             # connection so the handler thread frees NOW, not never.
+            raw = b""
             try:
-                self._send_json(408, {"error": "request body read timed out"})
+                reply_json(408, {"error": "request body read timed out"})
             except OSError:
                 pass
             self.close_connection = True
             return
+        deadline_ms = None
+        return_log_probs = False
         try:
-            body = json.loads(raw or b"{}")
-            x = decode_instances(body)
+            if binary:
+                # Binary wire path (serving/wire.py): one zero-copy
+                # frombuffer view instead of ~784·n parsed text floats;
+                # a malformed or truncated message is a WireError ->
+                # the same 400 contract as malformed JSON, never a
+                # hung handler.
+                wreq = wire.decode_request(raw)
+                x = wire.to_model_input(wreq)
+                dtype = None if wreq.dtype == "f32" else wreq.dtype
+                qos = wreq.qos
+                deadline_ms = wreq.deadline_ms
+            else:
+                if ctype not in ("", "application/json") and srv.sink:
+                    # Fallback rule (docs/SERVING.md): any content type
+                    # that is not the binary format parses as JSON (the
+                    # default protocol), with an operator breadcrumb —
+                    # a silent fallback would hide a client that thinks
+                    # it is speaking binary but typo'd the header.
+                    srv.sink.emit("wire_fallback", content_type=ctype)
+                body = json.loads(raw or b"{}")
+                x = decode_instances(body)
+                dtype = body.get("dtype")
+                return_log_probs = bool(body.get("return_log_probs", False))
             # Variant selection (docs/SERVING.md): "dtype" picks a
             # reduced-precision serving path.  Unknown names are a
             # client error (400); a known-but-unverified variant is
             # rejected by the batcher below (503 — the parity-gate
             # refusal contract).
-            dtype = body.get("dtype")
             if dtype is not None:
                 served = getattr(srv.engine, "dtypes", ("f32",))
                 if not isinstance(dtype, str) or dtype not in served:
@@ -252,16 +320,66 @@ class ServingHandler(BaseHTTPRequestHandler):
             # the scheduling class the weighted admission queue orders
             # by; omitted = interactive (the pre-QoS behavior).  An
             # unknown class is a client error, not backpressure.
-            qos = body.get("qos")
+            if not binary:
+                qos = body.get("qos")
             if qos is not None:
                 classes = getattr(srv.batcher, "qos_classes", QOS_CLASSES)
                 if not isinstance(qos, str) or qos not in classes:
                     raise ValueError(
                         f"unknown qos {qos!r}; classes: {list(classes)}"
                     )
-        except ValueError as e:
-            self._send_json(400, {"error": str(e)})
+        except ValueError as e:  # WireError subclasses ValueError
+            reply_json(400, {"error": str(e)})
             return
+        # Content-addressed response cache + single-flight
+        # (serving/cache.py; off unless --response-cache).  The key
+        # hashes the MODEL-READY rows, so identical pixels hit across
+        # wire formats; a miss claims the flight and the dispatch below
+        # feeds every coalesced waiter through first-wins completion.
+        cache = srv.response_cache
+        flight = key = None
+        base_timeout_s = (
+            deadline_ms / 1e3 if deadline_ms
+            else getattr(srv.batcher, "timeout_s", 30.0)
+        )
+        if cache is not None:
+            # memoryview, not tobytes(): blake2b hashes the contiguous
+            # rows in place — no payload-sized copy on the path whose
+            # whole point is deleting per-request host work.
+            key = cache.key(
+                np.ascontiguousarray(x).data,
+                dtype=dtype or getattr(srv.engine, "default_dtype", "f32"),
+            )
+            outcome, val = cache.claim(key)
+            if outcome == HIT:
+                self._reply_logits(reply, reply_json, val,
+                                   binary, return_log_probs)
+                return
+            if outcome == COALESCED:
+                # Join the claimant's in-flight dispatch on THIS
+                # request's own deadline budget (plus the same grace
+                # result() allows a launched batch).
+                try:
+                    logits = val.result(base_timeout_s + 1.0)
+                except RejectedError as e:
+                    reply_json(503, {"error": str(e)})
+                    return
+                except (RequestTimeout, FlightTimeout) as e:
+                    reply_json(504, {"error": str(e)})
+                    return
+                except BaseException as e:
+                    # BaseException included: the error is the
+                    # CLAIMANT's, re-raised by the flight — whatever
+                    # killed that thread, this joiner still owes its
+                    # client one HTTP outcome, never a torn connection.
+                    reply_json(
+                        500, {"error": f"{type(e).__name__}: {e}"}
+                    )
+                    return
+                self._reply_logits(reply, reply_json, logits,
+                                   binary, return_log_probs)
+                return
+            flight = val  # MISS: this request owns the dispatch
         try:
             # Pool mode only: a drain race OR a replica death can flush
             # an already-admitted request back out with RejectedError /
@@ -283,12 +401,14 @@ class ServingHandler(BaseHTTPRequestHandler):
                 # The retry runs on the REMAINING budget of the original
                 # admission (router.timeout_s = min over replicas), not a
                 # fresh full deadline — the drain race must not double
-                # the client's worst-case latency.
+                # the client's worst-case latency.  Attempt 0 carries the
+                # binary header's per-request deadline override when one
+                # was sent (None = the server default).
                 remaining_ms = (
-                    None if attempt == 0 else max(
+                    deadline_ms if attempt == 0 else max(
                         0.0,
                         1e3 * (
-                            srv.batcher.timeout_s
+                            base_timeout_s
                             - (time.perf_counter() - t0)
                         ),
                     )
@@ -320,21 +440,52 @@ class ServingHandler(BaseHTTPRequestHandler):
                     if attempts > 1 and srv.metrics is not None:
                         srv.metrics.record_rejected()
                     raise
+        # A claimed flight resolves on EVERY exit path: a success fills
+        # the cache and wakes coalesced waiters with the value; any
+        # failure — rejection, expiry, a chaos-killed dispatch — wakes
+        # them with the error and caches NOTHING (the never-a-stale-fill
+        # rule, serving/cache.py).
         except RejectedError as e:
-            self._send_json(503, {"error": str(e)})
+            if flight is not None:
+                cache.fail(key, flight, e)
+            reply_json(503, {"error": str(e)})
             return
         except RequestTimeout as e:
-            self._send_json(504, {"error": str(e)})
+            if flight is not None:
+                cache.fail(key, flight, e)
+            reply_json(504, {"error": str(e)})
             return
         except Exception as e:  # engine failure propagated by the worker
-            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            if flight is not None:
+                cache.fail(key, flight, e)
+            reply_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        except BaseException as e:
+            # A non-Exception (thread teardown, interrupt) must still
+            # resolve the claim: a leaked flight would coalesce every
+            # future identical request onto a dispatch that never
+            # resolves — a permanent per-payload outage.
+            if flight is not None:
+                cache.fail(key, flight, e)
+            raise
+        if flight is not None:
+            cache.complete(key, flight, np.asarray(logits))
+        self._reply_logits(reply, reply_json, logits, binary, return_log_probs)
+
+    @staticmethod
+    def _reply_logits(reply, reply_json, logits, binary, return_log_probs):
+        """One computed-or-cached ``[n, classes]`` logits block -> the
+        client's 200, on whichever wire the REQUEST arrived (cached
+        logits serve both formats bit-identically)."""
+        if binary:
+            reply(200, wire.encode_response(logits), wire.WIRE_RESPONSE_TYPE)
             return
         payload: dict = {
             "predictions": [int(p) for p in logits.argmax(axis=1)]
         }
-        if bool(body.get("return_log_probs", False)):
+        if return_log_probs:
             payload["log_probs"] = [[float(v) for v in row] for row in logits]
-        self._send_json(200, payload)
+        reply_json(200, payload)
 
 
 class ServingHTTPServer(ThreadingHTTPServer):
@@ -349,6 +500,8 @@ class ServingHTTPServer(ThreadingHTTPServer):
         batcher: MicroBatcher,
         metrics: ServingMetrics,
         request_timeout_s: float = 30.0,
+        response_cache: ResponseCache | None = None,
+        sink=None,
     ):
         super().__init__(address, ServingHandler)
         self.engine = engine
@@ -357,6 +510,15 @@ class ServingHTTPServer(ThreadingHTTPServer):
         # Handler-connection socket timeout (ServingHandler.setup): an
         # idle or half-dead client frees its thread within this bound.
         self.request_timeout_s = request_timeout_s
+        # Host hot path (docs/SERVING.md): the admission-point response
+        # cache (None = tier off) and the event sink for cache_hit /
+        # wire_fallback breadcrumbs.
+        self.response_cache = response_cache
+        self.sink = sink
+        # Both wire formats scrapeable from the first exposition (the
+        # CI grep contract): the server speaks binary unconditionally.
+        if metrics is not None:
+            metrics.ensure_wire()
 
     def snapshot(self) -> dict:
         # Pool mode: the router exposes the same depth/inflight surface
@@ -388,6 +550,8 @@ def make_server(
     port: int = 0,
     batcher=None,
     request_timeout_s: float = 30.0,
+    response_cache: int | ResponseCache | None = None,
+    sink=None,
     **batcher_kwargs,
 ) -> ServingHTTPServer:
     """Wire engine + metrics + a started batcher into a ready-to-run
@@ -397,9 +561,23 @@ def make_server(
     ``batcher`` injects an already-started admission front instead —
     the replica pool's Router (serving/router.py), whose submit/depth/
     inflight surface is batcher-compatible; ``engine`` is then the
-    EnginePool (same buckets/dtypes/compile_count surface)."""
+    EnginePool (same buckets/dtypes/compile_count surface).
+
+    ``response_cache`` enables the admission-point response cache
+    (serving/cache.py): an int is an entry capacity (the CLI's
+    ``--response-cache N``), keyed on the engine's weights digest; a
+    pre-built :class:`ResponseCache` is used as-is (tests drive the
+    invalidation hook through it)."""
+    if isinstance(response_cache, int):
+        response_cache = ResponseCache(
+            response_cache,
+            model_digest=getattr(engine, "weights_digest", ""),
+            metrics=metrics, sink=sink, scope="server",
+        )
     if batcher is None:
-        batcher = MicroBatcher(engine, metrics=metrics, **batcher_kwargs).start()
+        batcher = MicroBatcher(
+            engine, metrics=metrics, sink=sink, **batcher_kwargs
+        ).start()
     elif batcher_kwargs:
         raise ValueError(
             "pass batcher kwargs to the pool's start(), not make_server, "
@@ -408,4 +586,5 @@ def make_server(
     return ServingHTTPServer(
         (host, port), engine, batcher, metrics,
         request_timeout_s=request_timeout_s,
+        response_cache=response_cache, sink=sink,
     )

@@ -81,7 +81,7 @@ def drive(fleet, requests, concurrency=8, timeout_s=10.0):
                 if cursor[0] >= requests:
                     return
                 cursor[0] += 1
-            status, _data = fleet.router.submit(BODY, timeout_s=timeout_s)
+            status, _data, _ctype = fleet.router.submit(BODY, timeout_s=timeout_s)
             with lock:
                 results.append(status)
 
@@ -111,7 +111,7 @@ def test_roundrobin_spreads_evenly():
     fleet, _fakes = spin_fleet(3, policy="roundrobin")
     try:
         for _ in range(30):
-            status, _data = fleet.router.submit(BODY)
+            status, _data, _ctype = fleet.router.submit(BODY)
             assert status == 200
         counts = [
             fleet.metrics.registry.counter(
@@ -233,9 +233,11 @@ def test_backend_504_is_not_a_breaker_failure():
     fleet, _fakes = spin_fleet(1, failure_threshold=2)
     try:
         backend = fleet.backend("b0")
-        backend.request = lambda *a, **k: (504, b'{"error": "deadline"}')
+        backend.request_full = lambda *a, **k: (
+            504, b'{"error": "deadline"}', "application/json"
+        )
         for _ in range(5):
-            status, _data = fleet.router.submit(BODY)
+            status, _data, _ctype = fleet.router.submit(BODY)
             assert status == 504
         assert backend.breaker.state == "closed"
         assert fleet.metrics.timed_out == 5
@@ -328,7 +330,7 @@ def test_exactly_one_503_on_fleet_wide_outage():
         for b in fleet.backends_snapshot():
             fleet.set_state(b, EJECTED)
         before = fleet.metrics.rejected
-        status, data = fleet.router.submit(BODY)
+        status, data, _ctype = fleet.router.submit(BODY)
         assert status == 503
         assert b"no active backends" in data
         # Exactly ONE client-visible rejection however many backends
@@ -375,7 +377,7 @@ def test_kill_replace_warm_start_zero_new_compiles():
         ).value
         assert restarts == 1
         assert snap["fleet"]["supervisor"]["restarts_total"] == 1
-        status, _data = fleet.router.submit(BODY)
+        status, _data, _ctype = fleet.router.submit(BODY)
         assert status == 200
     finally:
         fleet.stop()

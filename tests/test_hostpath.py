@@ -1,0 +1,735 @@
+"""Host hot-path tests (ISSUE 14): binary wire protocol, content-
+addressed response cache with single-flight dedup, fleet-front verbatim
+proxying, and the loadgen's encode-outside-the-clock discipline.
+
+Run alone with ``pytest -m hostpath`` (the CI hostpath job); everything
+here also rides the default smoke tier.  Wire/cache mechanics use the
+fake engine from test_serving's contract (no jax dispatch); the
+binary↔JSON bit-identity tests compile one real bucket executable on
+the CPU mesh.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pytorch_mnist_ddp_tpu.models.net import NUM_CLASSES
+from pytorch_mnist_ddp_tpu.serving import (
+    InferenceEngine,
+    ResponseCache,
+    ServingMetrics,
+    WireError,
+)
+from pytorch_mnist_ddp_tpu.serving import cache as cache_mod
+from pytorch_mnist_ddp_tpu.serving import wire
+from pytorch_mnist_ddp_tpu.serving.server import make_server
+
+pytestmark = pytest.mark.hostpath
+
+
+# ---------------------------------------------------------------------------
+# Wire codec (pure host-side)
+
+
+def _pixels(n, seed=0):
+    return np.random.RandomState(seed).randint(0, 256, (n, 784)).astype(
+        np.float32
+    )
+
+
+def test_wire_request_roundtrip_zero_copy():
+    x = _pixels(5)
+    body = wire.encode_request(x, dtype="f32", qos="batch", deadline_ms=250)
+    req = wire.decode_request(body)
+    assert req.n == 5
+    assert req.dtype == "f32" and req.qos == "batch"
+    assert req.deadline_ms == 250.0
+    assert not req.normalized
+    np.testing.assert_array_equal(req.rows, x)
+    # Zero-copy: the rows VIEW the body bytes, no float was parsed.
+    assert req.rows.base is not None
+    # A requested deadline never silently becomes "no override" (0 on
+    # the wire): sub-ms rounds UP to 1, out-of-field raises WireError.
+    sub_ms = wire.decode_request(wire.encode_request(x, deadline_ms=0.4))
+    assert sub_ms.deadline_ms == 1.0
+    with pytest.raises(WireError, match="deadline_ms"):
+        wire.encode_request(x, deadline_ms=1 << 32)
+    with pytest.raises(WireError, match="deadline_ms"):
+        wire.encode_request(x, deadline_ms=-5)
+
+def test_wire_request_accepts_every_json_shape():
+    flat = _pixels(3)
+    for shaped in (flat, flat.reshape(3, 28, 28), flat.reshape(3, 28, 28, 1)):
+        req = wire.decode_request(wire.encode_request(shaped))
+        np.testing.assert_array_equal(req.rows, flat)
+
+
+def test_wire_model_input_matches_json_decode_bitwise():
+    # The cross-wire cache-key property: identical pixels through either
+    # decode path produce BIT-identical model-ready rows.
+    from pytorch_mnist_ddp_tpu.serving.server import decode_instances
+
+    raw = np.random.RandomState(1).randint(0, 256, (4, 784))
+    via_json = decode_instances({"instances": raw.tolist()})
+    via_wire = wire.to_model_input(
+        wire.decode_request(wire.encode_request(raw.astype(np.float32)))
+    )
+    np.testing.assert_array_equal(via_json, via_wire)
+    assert via_json.tobytes() == via_wire.tobytes()
+
+
+def test_wire_response_roundtrip():
+    logits = np.random.RandomState(2).randn(6, NUM_CLASSES).astype(np.float32)
+    out = wire.decode_response(wire.encode_response(logits))
+    np.testing.assert_array_equal(out, logits)
+
+
+def test_wire_decode_rejects_malformed():
+    good = wire.encode_request(_pixels(2))
+    with pytest.raises(WireError, match="shorter than"):
+        wire.decode_request(good[:10])
+    with pytest.raises(WireError, match="bad magic"):
+        wire.decode_request(b"XXXX" + good[4:])
+    with pytest.raises(WireError, match="promises"):
+        wire.decode_request(good[:-4])  # truncated payload
+    with pytest.raises(WireError, match="promises"):
+        wire.decode_request(good + b"\x00\x00\x00\x00")  # trailing junk
+    # A header claiming rows the body doesn't carry must fail on the
+    # LENGTH check, not allocate.
+    import struct
+
+    header = struct.pack(
+        "<4sHHIIBBHI", b"MNW1", 24, 0, 1 << 19, 784, 0, 0, 0, 0
+    )
+    with pytest.raises(WireError, match="promises"):
+        wire.decode_request(header + b"\x00" * 784 * 4)
+    bad_dtype = bytearray(good)
+    bad_dtype[16] = 9
+    with pytest.raises(WireError, match="dtype code"):
+        wire.decode_request(bytes(bad_dtype))
+    bad_flags = bytearray(good)
+    bad_flags[6] = 0xF0
+    with pytest.raises(WireError, match="reserved flag"):
+        wire.decode_request(bytes(bad_flags))
+    with pytest.raises(WireError, match="bad response magic"):
+        wire.decode_response(good)
+
+
+# ---------------------------------------------------------------------------
+# ResponseCache + single-flight (no HTTP, no engine)
+
+
+def test_cache_hit_miss_lru_and_counters():
+    m = ServingMetrics()
+    c = ResponseCache(2, model_digest="w1", metrics=m)
+    k1 = c.key(b"payload-1")
+    outcome, flight = c.claim(k1)
+    assert outcome == cache_mod.MISS
+    c.complete(k1, flight, "v1")
+    assert c.claim(k1) == (cache_mod.HIT, "v1")
+    # LRU bound: filling 2 more evicts the oldest.
+    for i in (2, 3):
+        k = c.key(b"payload-%d" % i)
+        _, f = c.claim(k)
+        c.complete(k, f, f"v{i}")
+    assert c.claim(c.key(b"payload-1"))[0] == cache_mod.MISS
+    snap = m.snapshot()
+    assert snap["cache"]["hit"] == 1
+    assert snap["cache"]["miss"] == 4  # incl. the re-miss after eviction
+
+
+def test_cache_single_flight_coalesces_and_failure_fails_all_waiters():
+    c = ResponseCache(4)
+    key = c.key(b"same")
+    outcome, flight = c.claim(key)
+    assert outcome == cache_mod.MISS
+    got = []
+
+    def joiner():
+        o, f = c.claim(key)
+        assert o == cache_mod.COALESCED
+        try:
+            got.append(("ok", f.result(5.0)))
+        except RuntimeError as e:
+            got.append(("err", str(e)))
+
+    threads = [threading.Thread(target=joiner) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    c.fail(key, flight, RuntimeError("dispatch killed"))
+    for t in threads:
+        t.join()
+    # Every coalesced waiter got EXACTLY the claimant's error...
+    assert got == [("err", "dispatch killed")] * 4
+    # ...and nothing was cached: the next claim recomputes (never a
+    # stale fill from a killed dispatch).
+    assert c.claim(key)[0] == cache_mod.MISS
+
+
+def test_cache_invalidate_unreaches_old_entries():
+    c = ResponseCache(8, model_digest="w1")
+    k = c.key(b"x")
+    _, f = c.claim(k)
+    c.complete(k, f, "old")
+    assert c.claim(c.key(b"x"))[0] == cache_mod.HIT
+    c.invalidate(model_digest="w2")
+    assert c.claim(c.key(b"x"))[0] == cache_mod.MISS
+    # A fill computed against the OLD generation must not land either.
+    c.invalidate()
+    stale_key = k  # generation-0 key, two invalidations ago
+    c.complete(stale_key, cache_mod.Flight(), "stale")
+    assert c.claim(c.key(b"x"))[0] == cache_mod.MISS
+
+
+def test_cache_joiner_timeout_is_its_own_504():
+    c = ResponseCache(4)
+    key = c.key(b"slow")
+    _, flight = c.claim(key)  # never resolved by this test's claimant
+    o, f = c.claim(key)
+    assert o == cache_mod.COALESCED
+    with pytest.raises(cache_mod.FlightTimeout):
+        f.result(0.02)
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface over a fake engine (wire + cache mechanics, no jax)
+
+
+class _GateEngine:
+    """Fake engine: logits[i, 0] = first pixel of row i; optional
+    failure switch and dispatch tally for the single-flight pins."""
+
+    def __init__(self, buckets=(8,)):
+        self.buckets = tuple(buckets)
+        self.metrics = None
+        self.dispatches = []
+        self.fail_next = 0
+        self.weights_digest = "fake-w1"
+
+    def launch(self, staged, n):
+        self.dispatches.append(n)
+        if self.fail_next:
+            self.fail_next -= 1
+            raise RuntimeError("injected launch failure")
+        out = np.zeros((len(staged), NUM_CLASSES), np.float32)
+        out[:, 0] = staged.reshape(len(staged), -1)[:, 0]
+        return out
+
+
+def _post_raw(url, body, content_type, timeout=10.0):
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": content_type}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read(), resp.headers.get("Content-Type")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get("Content-Type")
+
+
+def _serve(engine, metrics, **kwargs):
+    kwargs.setdefault("linger_ms", 1.0)
+    server = make_server(engine, metrics, port=0, **kwargs)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def test_http_binary_wire_end_to_end_fake():
+    m = ServingMetrics()
+    server, base = _serve(_GateEngine(), m)
+    try:
+        x = np.zeros((3, 784), np.float32)
+        x[:, 0] = [7.0, 8.0, 9.0]
+        body = wire.encode_request(x, normalized=True)
+        status, data, ctype = _post_raw(
+            f"{base}/predict", body, wire.WIRE_REQUEST_TYPE
+        )
+        assert status == 200
+        assert ctype == wire.WIRE_RESPONSE_TYPE
+        logits = wire.decode_response(data)
+        assert logits.shape == (3, NUM_CLASSES)
+        np.testing.assert_array_equal(logits[:, 0], [7.0, 8.0, 9.0])
+        # Wire accounting: one binary request, bytes both directions.
+        snap = m.snapshot()
+        assert snap["wire"]["requests"]["binary"] == 1
+        assert snap["wire"]["bytes"]["in"] == len(body)
+        assert snap["wire"]["bytes"]["out"] == len(data)
+    finally:
+        server.shutdown()
+        server.batcher.stop(drain=False)
+        server.server_close()
+
+
+def test_http_malformed_binary_is_a_fast_400_not_a_hang():
+    m = ServingMetrics()
+    server, base = _serve(_GateEngine(), m)
+    try:
+        good = wire.encode_request(np.zeros((2, 784), np.float32))
+        t0 = time.perf_counter()
+        for bad in (b"", b"garbage", good[:20], good[:-8], b"XXXX" + good[4:]):
+            status, data, _ctype = _post_raw(
+                f"{base}/predict", bad, wire.WIRE_REQUEST_TYPE, timeout=5.0
+            )
+            assert status == 400
+            assert b"error" in data
+        # The contract is 400 NOW — a handler that waits on body bytes
+        # that never come would blow this bound.
+        assert time.perf_counter() - t0 < 5.0
+    finally:
+        server.shutdown()
+        server.batcher.stop(drain=False)
+        server.server_close()
+
+
+def test_http_unknown_content_type_falls_back_to_json():
+    class _Sink:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, event, **fields):
+            self.events.append((event, fields))
+
+        def __bool__(self):
+            return True
+
+    sink = _Sink()
+    m = ServingMetrics()
+    server, base = _serve(_GateEngine(), m, sink=sink)
+    try:
+        payload = json.dumps(
+            {"instances": [[0.0] * 784], "normalized": True}
+        ).encode()
+        status, _data, _ctype = _post_raw(
+            f"{base}/predict", payload, "text/weird"
+        )
+        assert status == 200  # parsed as JSON (the fallback rule)
+        assert ("wire_fallback", {"content_type": "text/weird"}) in sink.events
+    finally:
+        server.shutdown()
+        server.batcher.stop(drain=False)
+        server.server_close()
+
+
+def test_http_cache_hit_bit_identity_and_invalidation_on_swap():
+    m = ServingMetrics()
+    engine = _GateEngine()
+    cache = ResponseCache(
+        8, model_digest=engine.weights_digest, metrics=m, scope="server"
+    )
+    server, base = _serve(engine, m, response_cache=cache)
+    try:
+        x = np.zeros((2, 784), np.float32)
+        x[:, 0] = [3.0, 4.0]
+        body = wire.encode_request(x, normalized=True)
+        s1, d1, _ = _post_raw(f"{base}/predict", body, wire.WIRE_REQUEST_TYPE)
+        s2, d2, _ = _post_raw(f"{base}/predict", body, wire.WIRE_REQUEST_TYPE)
+        assert s1 == s2 == 200
+        assert d1 == d2  # bit-identical response bytes from the hit
+        assert engine.dispatches == [2]  # ONE dispatch served both
+        # Cross-wire hit: the JSON spelling of the same rows is the
+        # same content address (key = model-ready rows).
+        jbody = json.dumps(
+            {"instances": x.reshape(2, 28, 28).tolist(), "normalized": True,
+             "return_log_probs": True}
+        ).encode()
+        s3, d3, _ = _post_raw(f"{base}/predict", jbody, "application/json")
+        assert s3 == 200
+        assert engine.dispatches == [2]  # still one dispatch
+        log_probs = np.asarray(
+            json.loads(d3)["log_probs"], np.float32
+        )
+        np.testing.assert_array_equal(log_probs, wire.decode_response(d1))
+        snap = m.snapshot()
+        assert snap["cache"]["hit"] == 2 and snap["cache"]["miss"] == 1
+        # Weights swap: invalidation makes every old entry unreachable.
+        engine.weights_digest = "fake-w2"
+        cache.invalidate(model_digest="fake-w2")
+        s4, _d4, _ = _post_raw(f"{base}/predict", body, wire.WIRE_REQUEST_TYPE)
+        assert s4 == 200
+        assert engine.dispatches == [2, 2]  # recomputed post-swap
+    finally:
+        server.shutdown()
+        server.batcher.stop(drain=False)
+        server.server_close()
+
+
+def test_http_single_flight_coalesces_concurrent_identical_requests():
+    m = ServingMetrics()
+    engine = _GateEngine()
+    server, base = _serve(
+        engine, m, response_cache=ResponseCache(8, metrics=m),
+        linger_ms=40.0,  # hold the batch open so joiners pile up
+    )
+    try:
+        x = np.zeros((1, 784), np.float32)
+        x[:, 0] = 5.0
+        body = wire.encode_request(x, normalized=True)
+        results = []
+
+        def client():
+            results.append(
+                _post_raw(f"{base}/predict", body, wire.WIRE_REQUEST_TYPE)
+            )
+
+        threads = [threading.Thread(target=client) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert [status for status, *_ in results] == [200] * 6
+        datas = {data for _s, data, _c in results}
+        assert len(datas) == 1  # every waiter got the identical bytes
+        assert engine.dispatches == [1]  # exactly ONE dispatch for six
+        snap = m.snapshot()
+        assert snap["cache"]["miss"] == 1
+        assert snap["cache"]["hit"] + snap["cache"]["coalesced"] == 5
+    finally:
+        server.shutdown()
+        server.batcher.stop(drain=False)
+        server.server_close()
+
+
+def test_http_single_flight_killed_dispatch_fails_all_never_stale_fills():
+    # The PR-8 chaos grammar drives the kill: the single-engine batcher's
+    # launch fault point fires once, exactly where a dying device would.
+    from pytorch_mnist_ddp_tpu.serving import faults
+
+    m = ServingMetrics()
+    engine = _GateEngine()
+    server, base = _serve(
+        engine, m, response_cache=ResponseCache(8, metrics=m),
+        linger_ms=40.0,
+    )
+    injector = faults.install(faults.FaultInjector("fail:launch:count=1"))
+    injector.start()
+    try:
+        x = np.zeros((1, 784), np.float32)
+        x[:, 0] = 6.0
+        body = wire.encode_request(x, normalized=True)
+        results = []
+
+        def client():
+            results.append(
+                _post_raw(f"{base}/predict", body, wire.WIRE_REQUEST_TYPE)
+            )
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # Exactly one outcome per waiter, all the SAME failure — the
+        # killed dispatch fed every coalesced client, duplicated nothing.
+        statuses = [status for status, *_ in results]
+        assert statuses == [500] * 4
+        assert injector.fired_counts().get("fail:launch:count=1") == 1
+        # Never a stale fill: the next identical request is a fresh MISS
+        # that dispatches and succeeds.
+        status, data, _ = _post_raw(
+            f"{base}/predict", body, wire.WIRE_REQUEST_TYPE
+        )
+        assert status == 200
+        assert wire.decode_response(data)[0, 0] == 6.0
+        snap = m.snapshot()
+        assert snap["cache"]["miss"] == 2  # the failed claim + the retry
+    finally:
+        faults.uninstall()
+        server.shutdown()
+        server.batcher.stop(drain=False)
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Real engine: binary <-> JSON logits bit-identity (single + fleet front)
+
+
+def test_binary_json_parity_real_engine_and_fleet_front(devices):
+    from pytorch_mnist_ddp_tpu.serving.fleet import (
+        Backend,
+        Fleet,
+        make_fleet_server,
+    )
+
+    m = ServingMetrics()
+    engine = InferenceEngine.from_seed(buckets=(8,), metrics=m)
+    engine.warmup()
+    server, base = _serve(engine, m)
+    fleet = None
+    front = None
+    try:
+        raw = np.random.RandomState(0).randint(0, 256, (3, 784))
+        jbody = json.dumps(
+            {"instances": raw.tolist(), "return_log_probs": True}
+        ).encode()
+        bbody = wire.encode_request(raw.astype(np.float32))
+        js, jd, _ = _post_raw(f"{base}/predict", jbody, "application/json")
+        bs, bd, bct = _post_raw(
+            f"{base}/predict", bbody, wire.WIRE_REQUEST_TYPE
+        )
+        assert js == bs == 200 and bct == wire.WIRE_RESPONSE_TYPE
+        json_logits = np.asarray(json.loads(jd)["log_probs"], np.float32)
+        bin_logits = wire.decode_response(bd)
+        # Bit-identical: same rows, same engine, two wires.  (JSON's
+        # float(v) renders the exact f32 value; f32 -> double -> f32
+        # round-trips exactly.)
+        assert json_logits.tobytes() == bin_logits.tobytes()
+
+        # Through the fleet front: the in-process server IS the backend
+        # (Backend is duck-typed over host/port), and both wires must
+        # come back bit-identical to the direct answers.
+        host, port = server.server_address[:2]
+        fleet = Fleet(
+            lambda name: Backend(name, host, port), poll_s=5.0,
+        )
+        fleet.start(1, wait_ready_s=30.0, supervise=False)
+        front = make_fleet_server(fleet, port=0)
+        threading.Thread(target=front.serve_forever, daemon=True).start()
+        furl = f"http://127.0.0.1:{front.server_address[1]}"
+        fjs, fjd, _ = _post_raw(f"{furl}/predict", jbody, "application/json")
+        fbs, fbd, fbct = _post_raw(
+            f"{furl}/predict", bbody, wire.WIRE_REQUEST_TYPE
+        )
+        assert fjs == fbs == 200
+        assert fbct.split(";")[0] == wire.WIRE_RESPONSE_TYPE
+        assert fjd == jd    # proxied bytes verbatim
+        assert fbd == bd
+    finally:
+        if front is not None:
+            front.shutdown()
+            front.server_close()
+        if fleet is not None:
+            fleet.stop()
+        server.shutdown()
+        server.batcher.stop(drain=True)
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet front: verbatim proxy pin + front-tier cache
+
+
+class _EchoBackendHandler:
+    pass  # (the recording backend below is a plain HTTP server)
+
+
+def _recording_backend():
+    """A real-HTTP backend that records exactly what it received and
+    answers with marked bytes under a marked content type."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    seen = []
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: A002
+            pass
+
+        def do_GET(self):  # noqa: N802
+            body = b'{"status": "ready"}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length", 0))
+            seen.append(
+                (self.rfile.read(n), self.headers.get("Content-Type"))
+            )
+            body = b"\x01\x02raw-backend-reply\x03"
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-test-raw")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, seen
+
+
+def test_fleet_front_proxies_bytes_and_content_type_verbatim():
+    from pytorch_mnist_ddp_tpu.serving.fleet import (
+        Backend,
+        Fleet,
+        make_fleet_server,
+    )
+
+    httpd, seen = _recording_backend()
+    fleet = Fleet(
+        lambda name: Backend(name, "127.0.0.1", httpd.server_address[1]),
+        poll_s=5.0,
+    )
+    front = None
+    try:
+        fleet.start(1, wait_ready_s=10.0, supervise=False)
+        front = make_fleet_server(fleet, port=0)
+        threading.Thread(target=front.serve_forever, daemon=True).start()
+        furl = f"http://127.0.0.1:{front.server_address[1]}"
+        # Arbitrary bytes (NOT valid JSON, NOT valid wire) under the
+        # binary content type: the front must not parse, re-encode, or
+        # re-label in either direction.
+        body = bytes(range(256)) * 4
+        status, data, ctype = _post_raw(
+            f"{furl}/predict", body, wire.WIRE_REQUEST_TYPE
+        )
+        assert status == 200
+        assert data == b"\x01\x02raw-backend-reply\x03"
+        assert ctype.split(";")[0] == "application/x-test-raw"
+        assert len(seen) == 1
+        got_body, got_ctype = seen[0]
+        assert got_body == body
+        assert got_ctype == wire.WIRE_REQUEST_TYPE
+        # Front wire accounting saw one binary exchange.
+        snap = fleet.metrics.snapshot()
+        assert snap["wire"]["requests"]["binary"] == 1
+    finally:
+        if front is not None:
+            front.shutdown()
+            front.server_close()
+        fleet.stop()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_fleet_front_cache_hits_and_single_flight():
+    from pytorch_mnist_ddp_tpu.serving.fleet import (
+        Backend,
+        Fleet,
+        make_fleet_server,
+    )
+
+    httpd, seen = _recording_backend()
+    fleet = Fleet(
+        lambda name: Backend(name, "127.0.0.1", httpd.server_address[1]),
+        poll_s=5.0, response_cache=8,
+    )
+    front = None
+    try:
+        fleet.start(1, wait_ready_s=10.0, supervise=False)
+        front = make_fleet_server(fleet, port=0)
+        threading.Thread(target=front.serve_forever, daemon=True).start()
+        furl = f"http://127.0.0.1:{front.server_address[1]}"
+        body = b"identical-request-bytes"
+        r1 = _post_raw(f"{furl}/predict", body, wire.WIRE_REQUEST_TYPE)
+        r2 = _post_raw(f"{furl}/predict", body, wire.WIRE_REQUEST_TYPE)
+        assert r1 == r2  # status, bytes, AND content type identical
+        assert len(seen) == 1  # the hit never touched the backend
+        # A different body (or the same bytes under a different content
+        # type) is a different content address.
+        _post_raw(f"{furl}/predict", body, "application/json")
+        assert len(seen) == 2
+        snap = fleet.metrics.snapshot()
+        assert snap["cache"]["hit"] == 1 and snap["cache"]["miss"] == 2
+    finally:
+        if front is not None:
+            front.shutdown()
+            front.server_close()
+        fleet.stop()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Loadgen: encode-outside-the-clock + zipf plan structure
+
+
+def _load_tool(name):
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(root, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _plan_args(**over):
+    import argparse
+
+    base = dict(
+        requests=12, seed=3, max_request=4, dtype="f32", qos_mix=None,
+        wire="json", repeat_dist=None,
+    )
+    base.update(over)
+    return argparse.Namespace(**base)
+
+
+def test_loadgen_bodies_are_encoded_before_the_drive(monkeypatch):
+    loadgen = _load_tool("serve_loadgen")
+    args = _plan_args(wire="binary")
+    plan = loadgen.build_plan(args)
+    assert len(plan["bodies"]) == 12
+    # THE pin: once the plan exists, the drive loops never encode — any
+    # call into the encode funnel during the drive is a regression that
+    # puts serialization back inside the latency-measured window.
+    def _boom(*a, **k):
+        raise AssertionError("request encoded inside the drive window")
+
+    monkeypatch.setattr(loadgen, "_encode_body", _boom)
+    fired = []
+
+    def fake_fetch(url, body, headers, timeout=0.0):
+        fired.append(body)
+        return 200, b""
+
+    monkeypatch.setattr(loadgen, "fetch_raw", fake_fetch)
+    monkeypatch.setattr(loadgen, "_decode_reply", lambda *a: None)
+    raw = loadgen.run_open_loop(
+        "http://x", plan, rate=10000.0, seed=3, timeout_s=1.0, max_workers=4
+    )
+    assert len(raw["results"]) == 12
+    # The fired bodies are the PLAN's objects — pre-encoded, byte for
+    # byte, not rebuilt.
+    assert all(f is b for f, b in zip(fired, plan["bodies"]))
+
+
+def test_loadgen_zipf_plan_is_seeded_and_repeats_share_bytes():
+    loadgen = _load_tool("serve_loadgen")
+    args = _plan_args(requests=64, repeat_dist="zipf:1.2:8", wire="binary")
+    p1 = loadgen.build_plan(args)
+    p2 = loadgen.build_plan(args)
+    assert p1["payload_ids"] == p2["payload_ids"]  # seeded
+    assert p1["distinct"] == 8
+    assert len(set(p1["payload_ids"])) <= 8
+    assert sum(p1["repeat_flags"]) > 0  # repeats exist at 64 draws of 8
+    # Repeats are the SAME bytes object — what makes them cache hits.
+    by_pid = {}
+    for pid, body in zip(p1["payload_ids"], p1["bodies"]):
+        if pid in by_pid:
+            assert body is by_pid[pid]
+        by_pid[pid] = body
+    # zipf skew: rank 0 is the most popular payload.
+    counts = [p1["payload_ids"].count(i) for i in range(8)]
+    assert counts[0] == max(counts)
+    with pytest.raises(SystemExit):
+        loadgen._parse_repeat_dist("zipf")
+    with pytest.raises(SystemExit):
+        loadgen._parse_repeat_dist("uniform:2")
+
+
+def test_loadgen_closed_loop_uses_plan_bodies(monkeypatch):
+    loadgen = _load_tool("serve_loadgen")
+    args = _plan_args()
+    plan = loadgen.build_plan(args)
+    monkeypatch.setattr(
+        loadgen, "_encode_body",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("re-encode")),
+    )
+    monkeypatch.setattr(
+        loadgen, "fetch_raw", lambda *a, **k: (200, b"")
+    )
+    monkeypatch.setattr(loadgen, "_decode_reply", lambda *a: None)
+    raw = loadgen.run_load("http://x", plan, concurrency=3, timeout_s=1.0)
+    assert len(raw["results"]) == 12

@@ -1777,6 +1777,95 @@ def test_jl017_waiver():
 
 
 # ---------------------------------------------------------------------------
+# JL018 — float-list JSON serialization in an unbounded dispatch/serve loop
+
+
+JL018_BAD_SERVE_LOOP = """\
+import json
+
+def serve(queue, sock):
+    while True:
+        logits = queue.get()
+        body = json.dumps({"log_probs": logits.tolist()})
+        sock.sendall(body.encode())
+"""
+
+JL018_BAD_FOR_OVER_REQUESTS = """\
+import json
+
+def pump(requests, out):
+    for req in requests:
+        out.write(json.dumps(req.x.tolist()))
+"""
+
+JL018_BAD_KWARG = """\
+import json
+
+def stream(batches, fh):
+    while True:
+        batch = next(batches)
+        json.dump({"rows": batch.tolist()}, fp=fh)
+"""
+
+JL018_GOOD_BINARY_WIRE = """\
+def serve(queue, sock):
+    while True:
+        logits = queue.get()
+        sock.sendall(logits.astype("<f4").tobytes())
+"""
+
+JL018_GOOD_ONESHOT_REPORT = """\
+import json
+
+def write_report(path, curve):
+    with open(path, "w") as f:
+        json.dump({"loss_curve": curve.tolist()}, f)
+"""
+
+JL018_GOOD_BOUNDED_REPLAY = """\
+import json
+
+def replay(sock, batch):
+    for _ in range(3):
+        sock.sendall(json.dumps(batch.tolist()).encode())
+"""
+
+JL018_GOOD_NO_ARRAY = """\
+import json
+
+def serve(queue, sock):
+    while True:
+        counts = queue.get()
+        sock.sendall(json.dumps({"counts": counts}).encode())
+"""
+
+
+def test_jl018_fires_on_float_list_json_in_serve_loops():
+    assert_fires(JL018_BAD_SERVE_LOOP, "JL018", line=6)
+    assert_fires(JL018_BAD_FOR_OVER_REQUESTS, "JL018", line=5)
+    assert_fires(JL018_BAD_KWARG, "JL018", line=6)
+
+
+def test_jl018_silent_on_binary_wire_and_bounded_work():
+    assert_silent(JL018_GOOD_BINARY_WIRE, "JL018")
+    # One-shot artifacts (a report written once) are not serve loops.
+    assert_silent(JL018_GOOD_ONESHOT_REPORT, "JL018")
+    # A literal-range replay is bounded — JL016/JL017's resolution.
+    assert_silent(JL018_GOOD_BOUNDED_REPLAY, "JL018")
+    # No .tolist() = no evidence of array data; plain JSON in a loop is
+    # someone's control plane, not the float-list hot path.
+    assert_silent(JL018_GOOD_NO_ARRAY, "JL018")
+
+
+def test_jl018_waiver():
+    waived = JL018_BAD_SERVE_LOOP.replace(
+        'body = json.dumps({"log_probs": logits.tolist()})',
+        'body = json.dumps({"log_probs": logits.tolist()})  # jaxlint: disable=JL018 -- debug endpoint, compatibility over speed',
+    )
+    assert_silent(waived, "JL018")
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

@@ -801,6 +801,40 @@ def summarize_telemetry(directory: str) -> str | None:
                 f"({e.get('reason', '?')}, after {e.get('attempts', '?')} "
                 "attempt(s))"
             )
+    # Host path section (serving/wire.py + serving/cache.py,
+    # docs/SERVING.md): the response cache's served-from-cache tally by
+    # tier (admission point vs fleet front), invalidations, and any
+    # wire_fallback breadcrumbs — a client that THINKS it speaks binary
+    # but typo'd the content type shows up here, not as a silent
+    # latency regression.
+    chits = [e for e in events if e.get("event") == "cache_hit"]
+    cinvs = [e for e in events if e.get("event") == "cache_invalidate"]
+    wfalls = [e for e in events if e.get("event") == "wire_fallback"]
+    if chits or cinvs or wfalls:
+        by_scope: dict[str, int] = {}
+        for e in chits:
+            scope = e.get("scope", "server")
+            by_scope[scope] = by_scope.get(scope, 0) + 1
+        scopes = ", ".join(
+            f"{n} at the {scope}"
+            for scope, n in sorted(by_scope.items())
+        ) or "0"
+        lines.append(
+            f"  host path: {len(chits)} cache hit(s) ({scopes}), "
+            f"{len(cinvs)} invalidation(s), {len(wfalls)} wire "
+            "fallback(s)"
+        )
+        if wfalls:
+            types: dict[str, int] = {}
+            for e in wfalls:
+                ct = e.get("content_type", "?")
+                types[ct] = types.get(ct, 0) + 1
+            lines.append(
+                "    fallback content types: "
+                + ", ".join(
+                    f"{ct} x{n}" for ct, n in sorted(types.items())
+                )
+            )
     gates = [e for e in events if e.get("event") == "parity_gate"]
     if gates:
         for e in gates:

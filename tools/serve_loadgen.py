@@ -57,6 +57,18 @@ fault receipt into the report's ``chaos`` section.  This is the
 operator-facing proof that the supervisor + circuit breakers actually
 absorb the failure classes they claim to.
 
+Host hot path (docs/SERVING.md): ``--wire {json,binary}`` picks the
+request format (binary = ``application/x-mnist-f32``, serving/wire.py;
+bodies are pre-encoded BEFORE the arrival clock in both formats, so the
+measured window never contains request serialization), ``--repeat-dist
+zipf:S[:K]`` draws payloads from a seeded zipf-popularity catalog (the
+response-cache hit distribution), ``--response-cache N`` enables the
+self-serve server's cache tier, and ``--hostpath-ab`` runs the whole
+A/B — same open-loop trace per wire format at equal offered rate, then
+a zipf cache round — into ``BENCH_hostpath.json``, failing on any lost
+or duplicated response, post-warmup compile, zero cache hits, or a
+hit-path p99 not under the miss-path p99.
+
 Usage::
 
     python tools/serve_loadgen.py                       # self-contained
@@ -105,6 +117,30 @@ def fetch_json(url: str, payload: dict | None = None, timeout: float = 30.0) -> 
         return 0, {"error": str(e)}
 
 
+def fetch_raw(
+    url: str, body: bytes, headers: dict, timeout: float = 30.0
+) -> tuple[int, bytes]:
+    """Transport-only /predict exchange for a PRE-ENCODED body.
+
+    The drive loops send through here so the latency-measured window
+    contains zero request serialization work — bodies are built once,
+    before the arrival clock starts (the per-request re-encode audit,
+    pinned by tests/test_hostpath.py).  Same status-0-on-transport-error
+    contract as :func:`fetch_json`."""
+    req = urllib.request.Request(url, data=body, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        try:
+            data = e.read()
+        except Exception:
+            data = b""
+        return e.code, data
+    except (urllib.error.URLError, OSError, TimeoutError):
+        return 0, b""
+
+
 def fetch_text(url: str, timeout: float = 30.0) -> str:
     """GET a text body (the Prometheus exposition for --prom-dump)."""
     req = urllib.request.Request(url, headers={"Accept": "text/plain"})
@@ -112,14 +148,31 @@ def fetch_text(url: str, timeout: float = 30.0) -> str:
         return resp.read().decode()
 
 
-def _request_payload(
-    rng: random.Random, n: int, dtype: str = "f32", qos: str | None = None
-) -> dict:
-    payload = {
-        "instances": [
-            [rng.randint(0, 255) for _ in range(784)] for _ in range(n)
-        ]
-    }
+def _encode_body(
+    pixels: list, wire_fmt: str, dtype: str, qos: str | None,
+    log_probs: bool = False,
+) -> tuple[bytes, dict]:
+    """One request's (body bytes, headers) — the SINGLE request-encode
+    funnel.  Every body is built through here at PLAN time, before the
+    arrival clock starts; the drive loops only move bytes (the
+    re-encode-in-window audit, tests/test_hostpath.py).
+
+    ``log_probs`` asks the JSON server for the full per-class logits —
+    the equal-information response to the binary wire's raw logits
+    bytes (the hostpath A/B sets it on the JSON rung so neither format
+    answers with less than the other)."""
+    if wire_fmt == "binary":
+        import numpy as np
+
+        from pytorch_mnist_ddp_tpu.serving import wire
+
+        body = wire.encode_request(
+            np.asarray(pixels, np.float32), dtype=dtype, qos=qos
+        )
+        return body, {"Content-Type": wire.WIRE_REQUEST_TYPE}
+    payload = {"instances": pixels}
+    if log_probs:
+        payload["return_log_probs"] = True
     if dtype != "f32":
         # The reduced-precision A/B knob (docs/SERVING.md): route every
         # request to one named variant; the default payload stays
@@ -130,7 +183,119 @@ def _request_payload(
         # = interactive (the server default), so pre-QoS payloads are
         # unchanged.
         payload["qos"] = qos
-    return payload
+    return json.dumps(payload).encode(), {"Content-Type": "application/json"}
+
+
+def _parse_repeat_dist(spec: str) -> tuple[float, int]:
+    """``zipf:S[:K]`` -> (exponent, catalog size).  Rank r of K distinct
+    payloads is drawn with probability proportional to r^-S — the
+    classic popularity skew a response cache actually meets (S ~ 1 is
+    web-like; bigger = spikier).  Default catalog 16."""
+    parts = spec.split(":")
+    if parts[0] != "zipf" or len(parts) not in (2, 3):
+        raise SystemExit(
+            f"--repeat-dist {spec!r} must be zipf:S or zipf:S:K "
+            "(S = exponent, K = distinct payloads)"
+        )
+    try:
+        s_exp = float(parts[1])
+        catalog = int(parts[2]) if len(parts) == 3 else 16
+    except ValueError:
+        raise SystemExit(f"--repeat-dist {spec!r}: S/K are not numeric")
+    if s_exp <= 0 or catalog < 1:
+        raise SystemExit(
+            f"--repeat-dist {spec!r}: need S > 0 and K >= 1"
+        )
+    return s_exp, catalog
+
+
+def build_plan(args, send_qos: bool = True) -> dict:
+    """The full request plan, encoded BEFORE the clock starts: per-
+    request pre-built bodies + headers, sizes, seeded QoS labels, and —
+    with ``--repeat-dist`` — the payload catalog structure (which
+    requests repeat an earlier payload; the cache A/B's client-side
+    hit/miss split reads it).  Deterministic from --seed."""
+    requests = args.requests
+    rng = random.Random(args.seed)
+    wire_fmt = getattr(args, "wire", "json") or "json"
+    repeat_spec = getattr(args, "repeat_dist", None)
+    if requests > 20000 and not repeat_spec:
+        # Pre-encoding holds one body per DISTINCT payload for the whole
+        # run (the encode-outside-the-window contract); with no repeat
+        # catalog that is O(requests) resident bodies.  Say so rather
+        # than surprise the host at six figures.
+        print(
+            f"note: pre-encoding {requests} distinct request bodies "
+            "up front (~KBs each); use --repeat-dist zipf:S:K to bound "
+            "the catalog for very large runs"
+        )
+    if repeat_spec:
+        s_exp, catalog_n = _parse_repeat_dist(repeat_spec)
+        catalog_n = min(catalog_n, requests)
+        weights = [1.0 / (r ** s_exp) for r in range(1, catalog_n + 1)]
+        payload_ids = rng.choices(
+            range(catalog_n), weights=weights, k=requests
+        )
+    else:
+        catalog_n = requests
+        payload_ids = list(range(requests))
+    # Sizes are a per-PAYLOAD property (a repeated payload is the same
+    # bytes, so necessarily the same rows).
+    sizes_catalog = [rng.randint(1, args.max_request) for _ in range(catalog_n)]
+    mix = _parse_qos_mix(args.qos_mix) if args.qos_mix else None
+    qos_labels = _draw_qos_labels(mix, requests, args.seed)
+    # Encode each distinct (payload, qos) exactly once; repeats share
+    # the SAME bytes object — what makes them cache hits on the wire.
+    encoded: dict[tuple, tuple[bytes, dict]] = {}
+    bodies: list[bytes] = []
+    headers: list[dict] = []
+    for i, pid in enumerate(payload_ids):
+        qos = qos_labels[i] if send_qos else None
+        key = (pid, qos)
+        if key not in encoded:
+            prng = random.Random(args.seed * 1000 + pid)
+            pixels = [
+                [prng.randint(0, 255) for _ in range(784)]
+                for _ in range(sizes_catalog[pid])
+            ]
+            encoded[key] = _encode_body(
+                pixels, wire_fmt, args.dtype, qos,
+                log_probs=getattr(args, "json_log_probs", False),
+            )
+        body, hdrs = encoded[key]
+        bodies.append(body)
+        headers.append(hdrs)
+    seen: set[int] = set()
+    repeat_flags = []
+    for pid in payload_ids:
+        repeat_flags.append(pid in seen)
+        seen.add(pid)
+    return {
+        "bodies": bodies,
+        "headers": headers,
+        "sizes": [sizes_catalog[pid] for pid in payload_ids],
+        "payload_ids": payload_ids,
+        "repeat_flags": repeat_flags,
+        "qos_labels": qos_labels,
+        "distinct": catalog_n,
+        "wire": wire_fmt,
+        "repeat_dist": repeat_spec,
+    }
+
+
+def _decode_reply(wire_fmt: str, status: int, data: bytes) -> None:
+    """Client-side response decode (inside the measured window, like a
+    real client): JSON parses the reply document, binary views the raw
+    logits.  Each format pays its own decode cost — the honest half of
+    the wire A/B."""
+    if status != 200:
+        return
+    if wire_fmt == "binary":
+        from pytorch_mnist_ddp_tpu.serving import wire
+
+        wire.decode_response(data)
+    else:
+        json.loads(data)
 
 
 def _parse_qos_mix(spec: str) -> dict[str, float]:
@@ -183,15 +348,12 @@ def _draw_qos_labels(
 
 def run_open_loop(
     url: str,
-    requests: int,
+    plan: dict,
     rate: float,
-    max_request: int,
     seed: int,
     timeout_s: float,
     max_workers: int,
     dtype: str = "f32",
-    qos_labels: list | None = None,
-    send_qos: bool = True,
 ) -> dict:
     """Poisson arrivals at ``rate`` req/s, fired independently of
     completions, bounded by ``max_workers`` outstanding requests.
@@ -200,13 +362,14 @@ def run_open_loop(
     when an executor thread picks it up — otherwise a saturated worker
     pool silently re-closes the loop and hides client-side queueing from
     the percentiles (the coordinated-omission trap open-loop load
-    generation exists to avoid).
+    generation exists to avoid).  Bodies come PRE-ENCODED from ``plan``
+    (build_plan): the measured window contains transport + response
+    decode only, never request serialization.
     """
     from concurrent.futures import ThreadPoolExecutor
 
+    requests = len(plan["bodies"])
     rng = random.Random(seed)
-    sizes = [rng.randint(1, max_request) for _ in range(requests)]
-    qos_labels = qos_labels if qos_labels is not None else [None] * requests
     # Pre-draw the whole arrival schedule so the trace is reproducible
     # from --seed and the firing loop does no RNG work.
     arrivals: list[float] = []
@@ -214,17 +377,15 @@ def run_open_loop(
     for _ in range(requests):
         t += rng.expovariate(rate)
         arrivals.append(t)
+    bodies, headers = plan["bodies"], plan["headers"]
+    qos_labels = plan["qos_labels"]
+    wire_fmt = plan["wire"]
 
     def one(i: int, scheduled: float) -> tuple[int, float, str | None]:
-        wrng = random.Random(seed * 1000 + i)
-        status, _body = fetch_json(
-            f"{url}/predict",
-            _request_payload(
-                wrng, sizes[i], dtype,
-                qos=qos_labels[i] if send_qos else None,
-            ),
-            timeout=timeout_s,
+        status, data = fetch_raw(
+            f"{url}/predict", bodies[i], headers[i], timeout=timeout_s
         )
+        _decode_reply(wire_fmt, status, data)
         return status, time.perf_counter() - scheduled, qos_labels[i]
 
     t_start = time.perf_counter()
@@ -246,7 +407,8 @@ def run_open_loop(
     return {
         "results": results,
         "wall_s": wall,
-        "sizes": sizes,
+        "sizes": plan["sizes"],
+        "plan": plan,
         "mode": "open-loop",
         "dtype": dtype,
         "offered_rate_rps": rate,
@@ -256,27 +418,22 @@ def run_open_loop(
 
 def run_load(
     url: str,
-    requests: int,
+    plan: dict,
     concurrency: int,
-    max_request: int,
-    seed: int,
     timeout_s: float,
     dtype: str = "f32",
-    qos_labels: list | None = None,
-    send_qos: bool = True,
 ) -> dict:
-    """Drive the endpoint; returns raw per-request (status, latency_s,
-    qos)."""
-    rng = random.Random(seed)
-    # Pre-generate request sizes so the mix is reproducible from --seed.
-    sizes = [rng.randint(1, max_request) for _ in range(requests)]
-    qos_labels = qos_labels if qos_labels is not None else [None] * requests
+    """Drive the endpoint closed-loop over ``plan``'s pre-encoded
+    bodies; returns raw per-request (status, latency_s, qos)."""
+    requests = len(plan["bodies"])
+    bodies, headers = plan["bodies"], plan["headers"]
+    qos_labels = plan["qos_labels"]
+    wire_fmt = plan["wire"]
     results: list[tuple[int, float, str | None]] = []
     lock = threading.Lock()
     cursor = [0]
 
     def worker(wid: int) -> None:
-        wrng = random.Random(seed * 1000 + wid)
         while True:
             with lock:
                 i = cursor[0]
@@ -284,14 +441,10 @@ def run_load(
                     return
                 cursor[0] += 1
             t0 = time.perf_counter()
-            status, _body = fetch_json(
-                f"{url}/predict",
-                _request_payload(
-                    wrng, sizes[i], dtype,
-                    qos=qos_labels[i] if send_qos else None,
-                ),
-                timeout=timeout_s,
+            status, data = fetch_raw(
+                f"{url}/predict", bodies[i], headers[i], timeout=timeout_s
             )
+            _decode_reply(wire_fmt, status, data)
             elapsed = time.perf_counter() - t0
             with lock:
                 results.append((status, elapsed, qos_labels[i]))
@@ -306,8 +459,8 @@ def run_load(
         t.join()
     wall = time.perf_counter() - t_start
     return {
-        "results": results, "wall_s": wall, "sizes": sizes,
-        "mode": "closed-loop", "dtype": dtype,
+        "results": results, "wall_s": wall, "sizes": plan["sizes"],
+        "plan": plan, "mode": "closed-loop", "dtype": dtype,
     }
 
 
@@ -337,7 +490,43 @@ def summarize(raw: dict, before: dict, after: dict) -> dict:
         if compiles_before is not None and compiles_after is not None
         else None
     )
+    # Host-path extras, present only when the new knobs were used so
+    # pre-existing report schemas stay unchanged: the wire format, the
+    # repeat-workload client split (first occurrence ~ cache-miss path,
+    # repeat ~ hit-eligible path), and the server's cache counters.
+    plan = raw.get("plan") or {}
+    extras: dict = {}
+    if plan.get("wire", "json") != "json" or plan.get("repeat_dist"):
+        extras["wire"] = plan.get("wire", "json")
+    if plan.get("repeat_dist"):
+        flags = plan["repeat_flags"]
+        first = sorted(
+            lat for (status, lat, *_), rep in zip(results, flags)
+            if status == 200 and not rep
+        )
+        repeat = sorted(
+            lat for (status, lat, *_), rep in zip(results, flags)
+            if status == 200 and rep
+        )
+        extras["repeat_workload"] = {
+            "repeat_dist": plan["repeat_dist"],
+            "distinct_payloads": plan["distinct"],
+            "repeat_fraction": sum(flags) / len(flags) if flags else 0.0,
+            "first_ms": {
+                "count": len(first),
+                "p50": 1e3 * percentile(first, 50),
+                "p99": 1e3 * percentile(first, 99),
+            },
+            "repeat_ms": {
+                "count": len(repeat),
+                "p50": 1e3 * percentile(repeat, 50),
+                "p99": 1e3 * percentile(repeat, 99),
+            },
+        }
+    if after.get("cache") is not None:
+        extras["server_cache"] = after.get("cache")
     return {
+        **extras,
         "mode": raw.get("mode", "closed-loop"),
         "dtype": raw.get("dtype", "f32"),
         "offered_rate_rps": raw.get("offered_rate_rps"),
@@ -449,7 +638,11 @@ def _spin_self_serve(args, replicas: int | None):
             hedge_delay_ms=getattr(args, "hedge_delay_ms", None),
             **batcher_kwargs
         )
-        server = make_server(pool, metrics, port=0, batcher=router)
+        server = make_server(
+            pool, metrics, port=0, batcher=router,
+            response_cache=getattr(args, "response_cache", None),
+            sink=sink,
+        )
         threading.Thread(target=server.serve_forever, daemon=True).start()
         url = f"http://127.0.0.1:{server.server_address[1]}"
         print(
@@ -479,7 +672,11 @@ def _spin_self_serve(args, replicas: int | None):
             f"(max|dlogit| {gate['max_abs_logit_diff']:.2e} <= "
             f"{gate['tolerance']:g}, argmax identical)"
         )
-    server = make_server(engine, metrics, port=0, sink=sink, **batcher_kwargs)
+    server = make_server(
+        engine, metrics, port=0, sink=sink,
+        response_cache=getattr(args, "response_cache", None),
+        **batcher_kwargs,
+    )
     threading.Thread(target=server.serve_forever, daemon=True).start()
     url = f"http://127.0.0.1:{server.server_address[1]}"
     print(
@@ -510,32 +707,34 @@ def _drive(args, url: str, send_qos: bool = True) -> dict:
 
     ``send_qos=False`` keeps the per-request class LABELS (for the
     report's per-class slices) but omits the payload field — the
-    baseline rung of the tail A/B."""
-    mix = _parse_qos_mix(args.qos_mix) if args.qos_mix else None
-    qos_labels = _draw_qos_labels(mix, args.requests, args.seed)
+    baseline rung of the tail A/B.  The WHOLE plan (sizes, labels,
+    repeat structure, encoded bodies) is built here, before the clock."""
+    plan = build_plan(args, send_qos=send_qos)
+    wire_note = f", wire {plan['wire']}" if plan["wire"] != "json" else ""
+    repeat_note = (
+        f", repeat-dist {plan['repeat_dist']} ({plan['distinct']} distinct)"
+        if plan["repeat_dist"] else ""
+    )
     if args.open_loop:
         print(
             f"driving {args.requests} open-loop Poisson arrivals of "
             f"1..{args.max_request} samples at {args.rate:.0f} req/s"
+            f"{wire_note}{repeat_note}"
             + (f" (qos mix {args.qos_mix}"
                + (", field sent" if send_qos else ", labels only") + ")"
-               if mix else "")
+               if args.qos_mix else "")
         )
         return run_open_loop(
-            url, args.requests, args.rate, args.max_request,
-            args.seed, args.timeout_s,
+            url, plan, args.rate, args.seed, args.timeout_s,
             max_workers=args.concurrency,
             dtype=args.dtype,
-            qos_labels=qos_labels, send_qos=send_qos,
         )
     print(
         f"driving {args.requests} requests of 1..{args.max_request} "
-        f"samples at concurrency {args.concurrency}"
+        f"samples at concurrency {args.concurrency}{wire_note}{repeat_note}"
     )
     return run_load(
-        url, args.requests, args.concurrency, args.max_request,
-        args.seed, args.timeout_s, dtype=args.dtype,
-        qos_labels=qos_labels, send_qos=send_qos,
+        url, plan, args.concurrency, args.timeout_s, dtype=args.dtype,
     )
 
 
@@ -1260,6 +1459,202 @@ def run_ab_tail(args) -> int:
     return rc
 
 
+def _rung_verdict(args, raw, before, after, report, label) -> tuple[dict, int]:
+    """Shared per-rung accounting for the hostpath rounds: loss,
+    transport errors, duplicated outcomes (server completions beyond
+    client 200s+504s — cache hits/coalesces complete nothing server-side
+    so they only SHRINK the delta), and the retrace check."""
+    rc = 0
+    results = raw["results"]
+    lost = args.requests - len(results)
+    transport = sum(1 for status, *_ in results if status == 0)
+    ok = sum(1 for status, *_ in results if status == 200)
+    c504 = sum(1 for status, *_ in results if status == 504)
+    completed_delta = (
+        after["requests"]["completed"] - before["requests"]["completed"]
+    )
+    duplicates = max(0, completed_delta - ok - c504)
+    extra = report["additional_compiles"]
+    if lost or transport or duplicates:
+        print(
+            f"HOSTPATH FAIL [{label}]: {lost} lost response(s), "
+            f"{transport} transport error(s), {duplicates} duplicated "
+            "client-visible outcome(s)"
+        )
+        rc = 1
+    if extra and not args.no_check_compiles:
+        print(f"HOSTPATH FAIL [{label}]: {extra} additional compile(s)")
+        rc = 1
+    row = {
+        "label": label,
+        "requests": len(results),
+        "lost": lost,
+        "transport_errors": transport,
+        "duplicates": duplicates,
+        "goodput_rps": report["goodput_rps"],
+        "answered_rps": report["answered_rps"],
+        "latency_ms": report["latency_ms"],
+        "rejected": report["rejected"],
+        "timed_out": report["timed_out"],
+        "additional_compiles": extra,
+        "server_wire": (after.get("wire") or {}),
+    }
+    return row, rc
+
+
+def run_hostpath(args) -> int:
+    """The host hot-path A/B (docs/SERVING.md; BENCH_hostpath.json):
+
+    1. **wire A/B** — the SAME open-loop trace (arrivals, sizes,
+       payload pixels) against a fresh self-serve stack twice, once per
+       wire format at equal offered rate.  Binary's win is pure host
+       work deleted: no per-pixel text parse server-side, no JSON
+       document client-side.
+    2. **cache round** — a zipf-repeated payload workload
+       (``--repeat-dist``, default ``zipf:1.1:16``) on the binary wire
+       with the response cache on (``--response-cache``, default 64):
+       server hit/miss/coalesced counters plus the client-side
+       first-occurrence (miss path) vs repeat (hit path) percentile
+       split.
+
+    Every round fails on lost responses, transport errors, duplicated
+    outcomes, or post-warmup compiles; the cache round additionally
+    fails on a zero hit count or a hit-path p99 that is not under the
+    miss-path p99.
+    """
+    if not args.open_loop:
+        raise SystemExit(
+            "--hostpath-ab is an open-loop A/B (the win is host work "
+            "deleted at a FIXED offered rate; a closed loop would "
+            "re-close around the faster path); add --open-loop --rate R"
+        )
+    rc = 0
+    rungs: dict[str, dict] = {}
+    for wire_fmt in ("json", "binary"):
+        rung_args = argparse.Namespace(**{
+            **vars(args),
+            "wire": wire_fmt, "repeat_dist": None, "response_cache": None,
+            # Equal information per response: the binary wire always
+            # returns the full logits, so the JSON rung asks for
+            # log_probs rather than the (smaller) predictions-only
+            # answer.
+            "json_log_probs": True,
+        })
+        print(f"--- hostpath rung: wire {wire_fmt} ---")
+        server, sink, url = _spin_self_serve(rung_args, replicas=args.replicas)
+        try:
+            _status, before = fetch_json(f"{url}/metrics")
+            raw = _drive(rung_args, url)
+            _status, after = fetch_json(f"{url}/metrics")
+        finally:
+            _teardown_self_serve(server, sink)
+        report = summarize(raw, before, after)
+        row, rung_rc = _rung_verdict(args, raw, before, after, report, wire_fmt)
+        rc = rc or rung_rc
+        rungs[wire_fmt] = row
+    goodput_ratio = (
+        rungs["binary"]["goodput_rps"] / rungs["json"]["goodput_rps"]
+        if rungs["json"]["goodput_rps"] else None
+    )
+    p50_ratio = (
+        rungs["binary"]["latency_ms"]["p50"] / rungs["json"]["latency_ms"]["p50"]
+        if rungs["json"]["latency_ms"]["p50"] else None
+    )
+    # The cache round: binary wire (the taught fast path), seeded zipf
+    # repeats, cache on at both tiers the self-serve stack has (the
+    # admission point; there is no fleet front here).
+    cache_args = argparse.Namespace(**{
+        **vars(args),
+        "wire": "binary",
+        "repeat_dist": args.repeat_dist or "zipf:1.1:16",
+        "response_cache": args.response_cache or 64,
+        "rate": args.cache_rate or args.rate,
+    })
+    print(
+        f"--- hostpath rung: response cache "
+        f"({cache_args.repeat_dist}, {cache_args.response_cache} entries, "
+        f"{cache_args.rate:.0f} req/s) ---"
+    )
+    server, sink, url = _spin_self_serve(cache_args, replicas=args.replicas)
+    try:
+        _status, before = fetch_json(f"{url}/metrics")
+        raw = _drive(cache_args, url)
+        _status, after = fetch_json(f"{url}/metrics")
+        if args.prom_dump:
+            with open(args.prom_dump, "w") as f:
+                f.write(fetch_text(f"{url}/metrics?format=prom"))
+            print(f"prometheus exposition (cache round): {args.prom_dump}")
+    finally:
+        _teardown_self_serve(server, sink)
+    report = summarize(raw, before, after)
+    row, rung_rc = _rung_verdict(args, raw, before, after, report, "cache")
+    rc = rc or rung_rc
+    server_cache = report.get("server_cache") or {}
+    split = report.get("repeat_workload") or {}
+    hits = server_cache.get("hit", 0)
+    first_p99 = (split.get("first_ms") or {}).get("p99")
+    repeat_p99 = (split.get("repeat_ms") or {}).get("p99")
+    if not hits:
+        print("HOSTPATH FAIL [cache]: zero cache hits under a zipf "
+              "repeat workload — the cache tier did nothing")
+        rc = 1
+    elif first_p99 and repeat_p99 is not None and repeat_p99 >= first_p99:
+        print(
+            f"HOSTPATH FAIL [cache]: hit-path p99 {repeat_p99:.2f} ms is "
+            f"not under miss-path p99 {first_p99:.2f} ms"
+        )
+        rc = 1
+    cache_round = {
+        **row,
+        "offered_rate_rps": cache_args.rate,
+        "repeat_dist": cache_args.repeat_dist,
+        "response_cache": cache_args.response_cache,
+        "server_cache": server_cache,
+        "repeat_workload": split,
+    }
+    hostpath_report = {
+        "mode": "hostpath-ab",
+        "offered_rate_rps": args.rate,
+        "requests": args.requests,
+        "max_request": args.max_request,
+        "buckets": [int(b) for b in args.buckets.split(",")],
+        "replicas": args.replicas,
+        "wire_ab": {
+            "rungs": rungs,
+            "goodput_ratio_binary_vs_json": goodput_ratio,
+            "p50_ratio_binary_vs_json": p50_ratio,
+        },
+        "cache_round": cache_round,
+    }
+    with open(args.hostpath_report, "w") as f:
+        json.dump(hostpath_report, f, indent=2)
+    print(f"hostpath report: {args.hostpath_report}")
+    for fmt in ("json", "binary"):
+        r = rungs[fmt]
+        print(
+            f"  wire {fmt}: {r['goodput_rps']:.1f} goodput req/s, "
+            f"p50 {r['latency_ms']['p50']:.2f} ms / "
+            f"p99 {r['latency_ms']['p99']:.2f} ms, "
+            f"{r['rejected']} rejected, {r['timed_out']} timed out"
+        )
+    print(
+        "  binary vs json: goodput "
+        + (f"{goodput_ratio:.2f}x" if goodput_ratio else "n/a")
+        + ", p50 "
+        + (f"{p50_ratio:.2f}x" if p50_ratio else "n/a")
+    )
+    print(
+        f"  cache round: {hits} hit / {server_cache.get('miss', 0)} miss "
+        f"/ {server_cache.get('coalesced', 0)} coalesced "
+        f"(hit rate {server_cache.get('hit_rate', 0.0):.1%}), "
+        "hit-path p99 "
+        + (f"{repeat_p99:.2f} ms" if repeat_p99 is not None else "n/a")
+        + " vs miss-path p99 "
+        + (f"{first_p99:.2f} ms" if first_p99 is not None else "n/a")
+    )
+    return rc
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     parser.add_argument(
@@ -1299,6 +1694,50 @@ def main(argv: list[str] | None = None) -> int:
         "\"dtype\" field) — the reduced-precision A/B knob; in "
         "--self-serve mode the variant is warmed and parity-gated "
         "before the run (docs/SERVING.md)",
+    )
+    parser.add_argument(
+        "--wire", default="json", choices=("json", "binary"),
+        help="request wire format (docs/SERVING.md): json = the default "
+        "text protocol; binary = application/x-mnist-f32 (fixed header "
+        "+ raw float32 rows, responses as raw logits bytes) — the "
+        "host-path A/B knob.  Bodies are pre-encoded before the "
+        "arrival clock either way",
+    )
+    parser.add_argument(
+        "--repeat-dist", default=None, metavar="zipf:S[:K]",
+        help="repeated-payload workload: draw each request's payload "
+        "from a catalog of K distinct payloads (default 16) with "
+        "zipf(S) popularity — the realistic hit distribution for the "
+        "response-cache A/B; the report gains a first-occurrence vs "
+        "repeat client percentile split",
+    )
+    parser.add_argument(
+        "--response-cache", type=int, default=None, metavar="N",
+        help="--self-serve mode: enable the server's content-addressed "
+        "response cache + single-flight dedup, bounded at N entries "
+        "(serving/cache.py; the /predict --response-cache flag)",
+    )
+    parser.add_argument(
+        "--hostpath-ab", action="store_true",
+        help="host hot-path A/B (docs/SERVING.md): drive the SAME "
+        "open-loop trace with --wire json then --wire binary at equal "
+        "offered rate, then a zipf repeat workload with the response "
+        "cache on; write goodput/latency ratios + cache hit stats to "
+        "--hostpath-report and FAIL on lost/duplicated responses, "
+        "post-warmup compiles, zero hits, or a hit-path p99 not under "
+        "the miss-path p99",
+    )
+    parser.add_argument(
+        "--hostpath-report", default="BENCH_hostpath.json",
+        help="where --hostpath-ab writes its report",
+    )
+    parser.add_argument(
+        "--cache-rate", type=float, default=None, metavar="RPS",
+        help="offered rate for --hostpath-ab's cache round (default "
+        "--rate).  The wire A/B deliberately saturates the host; the "
+        "cache round wants a rate the MISS path can sustain, so the "
+        "hit/miss latency split measures the cache, not client-side "
+        "queueing",
     )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--timeout-s", type=float, default=30.0)
@@ -1517,6 +1956,21 @@ def main(argv: list[str] | None = None) -> int:
             # hard-errors on the same combination).
             parser.error("--hedge needs --replicas N (>= 2): a lone "
                          "engine has no second replica to hedge onto")
+    if args.response_cache is not None and args.url:
+        parser.error("--response-cache is --self-serve only; a --url "
+                     "endpoint configures its own cache")
+    if args.response_cache is not None and args.response_cache < 1:
+        # Fail at the flag surface, not after minutes of warmup (the
+        # serving CLI's pre-flight rule).
+        parser.error(f"--response-cache must be >= 1, got "
+                     f"{args.response_cache}")
+    if args.hostpath_ab:
+        if args.url or args.replicas_sweep or args.chaos or args.ab_tail \
+                or args.fleet_sweep:
+            parser.error("--hostpath-ab drives its own self-serve "
+                         "stacks; drop --url / --replicas-sweep / "
+                         "--chaos / --ab-tail / --fleet-sweep")
+        return run_hostpath(args)
     if args.fleet_sweep:
         if args.url or args.replicas_sweep or args.chaos or args.ab_tail:
             parser.error("--fleet-sweep drives its own fleets; drop "
