@@ -89,11 +89,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(metrics.prom) at end of run; stdout is unchanged "
                         "(docs/OBSERVABILITY.md)")
     p.add_argument("--aot-cache", type=str, default=None, metavar="DIR",
-                   help="(--fused) persist the compiled run as a "
-                        "serialized AOT executable in DIR: a warm start "
+                   help="persist the run's compiled Programs (the fused "
+                        "whole-run, or the per-batch train/eval steps) as "
+                        "serialized AOT executables in DIR: a warm start "
                         "deserializes instead of re-tracing + re-lowering, "
                         "falling back to a fresh compile on any config/"
                         "source/jax mismatch (docs/COMPILE.md)")
+    p.add_argument("--serve-prewarm", action="store_true", default=False,
+                   help="(per-batch, with --aot-cache) also build the "
+                        "serving engine's f32 predict grid into the AOT "
+                        "cache through the canonical Program config — a "
+                        "serving engine warming the matching mesh/buckets "
+                        "from the same --aot-cache then starts with zero "
+                        "compiles (the train-to-serve handoff, "
+                        "docs/COMPILE.md)")
     p.add_argument("--compile-cache-dir", type=str, default=None,
                    metavar="DIR",
                    help="persistent XLA compile-cache directory (default: "

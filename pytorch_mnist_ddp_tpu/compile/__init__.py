@@ -14,21 +14,41 @@ an open serving socket) as fast as the hardware allows:
   correctness gate that falls back to a fresh compile on any mismatch.
 - :mod:`.overlap` — :class:`StartupTasks`, named concurrent startup
   jobs with a measuring rendezvous (``startup_overlap_ratio``).
+- :mod:`.program` — :class:`Program`, the unified compile/AOT/dispatch
+  artifact every surface (trainer, serving engine/pool, bench tools)
+  constructs its compiled steps through: jit fn + abstract args + AOT
+  key + recompile budget + compile spans in one object, with the
+  canonical config composition that makes AOT entries reusable ACROSS
+  surfaces.
 
 The service and overlap runner are stdlib-only (jobs are opaque
-callables); only the AOT store touches jax, lazily.
+callables); the AOT store and Program touch jax, lazily.
 """
 
 from __future__ import annotations
 
 from .aot import ExecutableStore, source_digest
 from .overlap import StartupTasks
+from .program import (
+    Program,
+    build_programs,
+    predict_config,
+    predict_store_size,
+    serving_predict_programs,
+    train_config,
+)
 from .service import CompileJob, CompileService
 
 __all__ = [
     "CompileJob",
     "CompileService",
     "ExecutableStore",
+    "Program",
     "StartupTasks",
+    "build_programs",
+    "predict_config",
+    "predict_store_size",
+    "serving_predict_programs",
     "source_digest",
+    "train_config",
 ]

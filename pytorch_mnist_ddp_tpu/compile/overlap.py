@@ -32,9 +32,9 @@ class StartupTasks:
     Usage::
 
         tasks = StartupTasks(service)
-        tasks.add("compile", lambda: run_fn.lower(*args).compile())
+        tasks.add("compile", program.build)  # a compile/program.py Program
         tasks.add("restore", load_checkpoint)
-        compiled = tasks.result("compile")   # blocks on that task only
+        tasks.result("compile")              # blocks on that task only
         tasks.rendezvous()                   # everything done; ratio recorded
     """
 

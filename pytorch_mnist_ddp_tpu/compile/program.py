@@ -1,0 +1,391 @@
+"""The unified ``Program`` artifact: ONE compile/AOT/dispatch path for
+the trainer, the serving engine/pool, and the bench tools.
+
+Before this module the repo hand-rolled trace → lower → compile → AOT
+key → sentinel budget → telemetry in four places (trainer fused startup,
+serving warmup, supervisor warm-restart, bench tools), each with its own
+key composition and dispatch idiom.  A :class:`Program` bundles all of
+it:
+
+- the **jit fn** (donation spec and shardings are baked in at
+  ``jax.jit`` time — ``donate_argnums``, ``shard_map`` specs);
+- the **abstract args** (``jax.ShapeDtypeStruct`` with shardings, or
+  concrete examples) that fix the one signature the program serves;
+- the **AOT key config** — the dict the
+  :class:`~.aot.ExecutableStore` digests together with the package
+  source and environment, composed by ONE function per program family
+  (:func:`predict_config`) so two surfaces that mean the same program
+  produce the same digest and the second surface starts as a pure
+  deserialize (cross-surface reuse, docs/COMPILE.md);
+- the **recompile budget** — an optional shared
+  :class:`~..analysis.sentinel.RecompileSentinel` that guards jit-mode
+  dispatch exactly as before (budgets unchanged: warm-mode builds
+  produce the same trace counts the old ladders did);
+- the **compile span / telemetry identity** — ``Program.name`` is the
+  label on ``compile_seconds_total{fn=}``, the ``compile`` span, and
+  the ``aot_executable`` events, whichever surface builds it.
+
+Dispatch is the slimmed steady-state path: after :meth:`Program.build`,
+:attr:`Program.call` is bound to the compiled executable's C++ fast
+path — zero Python wrapper frames, the same per-call host overhead as a
+direct ``jax.jit`` call (pinned structurally in tests/test_program.py).
+
+Three build modes, chosen by what the Program was constructed with:
+
+==========  =============================  ================================
+mode        chosen when                    ``build()`` does
+==========  =============================  ================================
+``store``   ``store`` given                ``store.load_or_compile`` (hit =
+                                           zero traces); binds executable
+``aot``     no store, no sentinel          ``jit_fn.lower(*args).compile()``;
+                                           binds executable
+``warm``    sentinel, no store             calls the sentinel once with the
+                                           example args (one trace, counted
+                                           against the budget); dispatch
+                                           stays on the sentinel wrapper
+==========  =============================  ================================
+
+An UNBUILT Program dispatches through the sentinel (or the raw jit fn)
+— exactly the lazy compile-on-first-call behavior the per-batch trainer
+had before this module, so wrapping a step in a Program is always
+behavior-preserving until someone builds it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+# NOTE: jax is imported lazily inside methods — Programs are constructed
+# by stdlib-only tools (tools/step_attr_bench.py exports RUNG_NAMES for
+# the window-promotion rule without paying a jax import).
+
+
+def compiled_fastpath(compiled) -> Callable[..., Any]:
+    """Bind a ``jax.stages.Compiled`` to its C++ fast-path callable.
+
+    ``Compiled.__call__`` creates this callable lazily on the first
+    invocation and then delegates to it forever; binding it eagerly
+    removes the one Python wrapper frame from every steady-state call —
+    measured on the pinned jaxlib, ``Program.call`` then costs the same
+    as a direct jit call (0 Python frames).  Falls back to the Compiled
+    object itself if the internals move under a future jax.
+    """
+    try:
+        call = compiled._executable.create_cpp_call(
+            compiled._no_kwargs, compiled.in_tree, compiled.out_tree
+        )
+        if call is not None:
+            return call
+    except AttributeError:
+        pass
+    return compiled
+
+
+class Program:
+    """One compiled-program artifact (module docstring for the contract).
+
+    Parameters
+    ----------
+    name:
+        Telemetry identity: the ``compile_seconds_total{fn=}`` label,
+        the ``compile`` span's ``fn`` field, the ``aot_executable``
+        event name.  Keep it stable across runs so cold/warm and
+        cross-surface comparisons line up.
+    jit_fn:
+        The ``jax.jit`` callable (donation and shardings baked in).
+    example_args:
+        Tuple of args fixing the signature — ``jax.ShapeDtypeStruct``
+        (with shardings) and/or concrete arrays; or a zero-arg callable
+        returning that tuple, evaluated at build time (for args that
+        only exist after another startup task, e.g. a restored
+        checkpoint).  Warm mode calls the fn with them, so there they
+        must be concrete.
+    config:
+        The AOT key config dict (with ``store``).  Compose it through
+        the canonical helper of the program family
+        (:func:`predict_config` for the serving forward) — digests only
+        match across surfaces when the composition is shared.
+    store:
+        Optional :class:`~.aot.ExecutableStore`; build becomes
+        ``load_or_compile`` and a warm start deserializes (zero traces).
+    sentinel:
+        Optional shared :class:`RecompileSentinel` wrapping ``jit_fn``
+        — the recompile budget.  Without a store, build warms THROUGH
+        it (one counted trace) and dispatch keeps its guard.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        jit_fn: Callable[..., Any],
+        *,
+        example_args: Sequence[Any] | Callable[[], Sequence[Any]] | None = None,
+        config: dict | None = None,
+        store=None,
+        sentinel=None,
+    ):
+        if store is not None and config is None:
+            raise ValueError(
+                f"Program {name!r}: a store needs a config dict to key the "
+                "AOT entry (compose it with the family's canonical helper)"
+            )
+        self.name = name
+        self.jit_fn = jit_fn
+        self.sentinel = sentinel
+        self.config = config
+        self.store = store
+        self._example_args = example_args
+        self._compiled = None
+        self.built = False
+        self.outcome: str | None = None  # hit/miss/fallback (store mode)
+        # Lazy dispatch until built: the sentinel wrapper (budget guard)
+        # or the raw jit fn — compile-on-first-call, exactly the
+        # pre-Program behavior.
+        self.call: Callable[..., Any] = (
+            sentinel if sentinel is not None else jit_fn
+        )
+
+    # -- introspection ---------------------------------------------------------
+
+    @property
+    def compiled(self):
+        """The bound ``jax.stages.Compiled`` (None in warm/lazy mode).
+        Exposes ``cost_analysis()`` etc. for the bench tools."""
+        return self._compiled
+
+    def key(self) -> str:
+        """The AOT store key this Program's config digests to (store
+        mode only) — what must MATCH between two surfaces for the
+        second to start as a pure deserialize."""
+        if self.store is None:
+            raise ValueError(f"Program {self.name!r} has no store to key for")
+        return self.store.key_for(self.config)
+
+    def trace_count(self) -> int:
+        """Distinct traces of the underlying jit fn (0 after a pure
+        store hit — the zero-traces warm-start contract)."""
+        if self.sentinel is not None:
+            return self.sentinel.trace_count()
+        cache_size = getattr(self.jit_fn, "_cache_size", None)
+        return int(cache_size()) if callable(cache_size) else 0
+
+    # -- build ----------------------------------------------------------------
+
+    def _example(self) -> tuple:
+        args = self._example_args
+        if args is None:
+            raise ValueError(
+                f"Program {self.name!r} has no example args; pass "
+                "example_args= to build it (or dispatch lazily)"
+            )
+        if callable(args):
+            args = args()
+        return tuple(args)
+
+    def _build_compiled(self):
+        return self.jit_fn.lower(*self._example()).compile()
+
+    def _bind(self, compiled) -> None:
+        self._compiled = compiled
+        self.call = compiled_fastpath(compiled)
+        self.built = True
+
+    def build(self) -> str | None:
+        """Obtain the executable; returns the store outcome (hit/miss/
+        fallback) or None without a store.  Idempotent.  Safe to fan out
+        over a :class:`~.service.CompileService` — concurrent builds of
+        DISTINCT Programs compile in parallel (XLA releases the GIL);
+        that is :func:`build_programs`."""
+        if self.built:
+            return self.outcome
+        if self.store is not None:
+            compiled, outcome = self.store.load_or_compile(
+                self.name, self.config, self._build_compiled
+            )
+            self._bind(compiled)
+            self.outcome = outcome
+            return outcome
+        if self.sentinel is not None:
+            # Warm mode: one trace through the guarded wrapper — the
+            # budget observes it, dispatch keeps the guard, and the jit
+            # cache (not a detached executable) serves the steady state.
+            self.sentinel(*self._example())
+            self.built = True
+            return None
+        self._bind(self._build_compiled())
+        return None
+
+
+def build_programs(
+    programs: Sequence[Program | None],
+    registry=None,
+    sink=None,
+    max_workers: int | None = None,
+) -> None:
+    """Fan ``Program.build`` out over a :class:`CompileService`.
+
+    The trainer-side analogue of serving's parallel warmup: N programs
+    (train step, eval step, the serve-prewarm predict grid) lower and
+    compile CONCURRENTLY in the wall time of the slowest, each timed
+    onto ``compile_seconds_total{fn=name}`` inside a ``compile`` span.
+    One program builds inline (no pool spin-up for nothing).
+    """
+    from .service import CompileService
+
+    progs = [p for p in programs if p is not None]
+    if not progs:
+        return
+    if len(progs) == 1:
+        progs[0].build()
+        return
+    with CompileService(
+        max_workers=min(len(progs), max_workers or 8),
+        registry=registry,
+        sink=sink,
+    ) as svc:
+        for p in progs:
+            svc.submit(p.name, p.build)
+        svc.wait_all()
+
+
+# ---------------------------------------------------------------------------
+# Canonical config composition — the cross-surface reuse contract.
+#
+# An ExecutableStore entry is reusable across surfaces iff the config
+# digests match; that only happens when every surface composes the dict
+# through the SAME function.  One helper per program family lives here.
+
+
+def default_device_stage(mesh) -> bool:
+    """The serving engine's device-staging default (auto: on when every
+    mesh device is process-local) — the trainer-side handoff must
+    compute the identical value or its entries can never hit."""
+    import jax
+
+    return all(
+        d.process_index == jax.process_index() for d in mesh.devices.flat
+    )
+
+
+def predict_config(
+    mesh,
+    dtype: str,
+    bucket: int,
+    *,
+    use_bn: bool,
+    conv_impl: str,
+    device_stage: bool,
+) -> dict:
+    """AOT key config for one serving-forward rung (dtype x bucket).
+
+    Field-for-field the serving engine's historical composition —
+    concrete device ids included, because a serialized executable pins
+    its compile-time devices (two same-shape meshes on different
+    devices must never alias one entry).
+    """
+    import jax
+
+    return {
+        "program": "predict_step",
+        "dtype": dtype,
+        "bucket": int(bucket),
+        "mesh": {str(k): int(s) for k, s in mesh.shape.items()},
+        "devices": [int(d.id) for d in mesh.devices.flat],
+        "use_bn": bool(use_bn),
+        "conv_impl": conv_impl,
+        "device_stage": bool(device_stage),
+        "prng_impl": str(jax.config.jax_default_prng_impl),
+    }
+
+
+def train_config(mesh, program: str, **extra) -> dict:
+    """AOT key config for a trainer-side program (train/eval step, the
+    fused run): mesh shape + device ids + PRNG impl, plus whatever
+    parameterizes the program (batch sizes, dtype, flags) via
+    ``extra``."""
+    import jax
+
+    return {
+        "program": program,
+        "mesh": {str(k): int(s) for k, s in mesh.shape.items()},
+        "devices": [int(d.id) for d in mesh.devices.flat],
+        "prng_impl": str(jax.config.jax_default_prng_impl),
+        **extra,
+    }
+
+
+def predict_store_size(replicas: int, n_dtypes: int, n_buckets: int) -> int:
+    """Shared ExecutableStore sizing for a replicas x dtypes x buckets
+    predict grid (+ headroom for one config change) — one formula for
+    the single engine, the pool, and the trainer handoff, so no surface
+    can under-size the store another populates."""
+    return 2 * max(1, replicas) * max(1, n_dtypes) * max(1, n_buckets) + 4
+
+
+def serving_predict_programs(
+    mesh,
+    variables,
+    buckets: Sequence[int],
+    *,
+    store,
+    use_bn: bool = False,
+    conv_impl: str = "conv",
+    device_stage: bool | None = None,
+) -> list[Program]:
+    """Trainer-side twin of the serving engine's f32 warmup grid — the
+    train-to-serve handoff.
+
+    Builds one :class:`Program` per bucket with the engine's EXACT fn
+    construction and :func:`predict_config` composition, so the entries
+    a training process persists are pure deserializes when the serving
+    engine warms the same mesh/buckets from the same store
+    (``--serve-prewarm``; pinned in tests/test_program.py).  ``variables``
+    is the tree the engine will serve: bare params, or the
+    ``{"params", "batch_stats"}`` dict for BN checkpoints — only its
+    avals matter here (lowering never reads values).
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models.net import INPUT_SHAPE
+    from ..parallel.ddp import make_predict_step
+    from ..parallel.mesh import DATA_AXIS
+
+    if device_stage is None:
+        device_stage = default_device_stage(mesh)
+    fn = make_predict_step(
+        mesh, compute_dtype=jax.numpy.float32, use_bn=use_bn,
+        conv_impl=conv_impl,
+    )
+    var_spec = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(
+            np.shape(a), np.asarray(a).dtype,
+            sharding=getattr(a, "sharding", None),
+        ),
+        variables,
+    )
+    input_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    programs = []
+    for b in buckets:
+        x_spec = jax.ShapeDtypeStruct(
+            (int(b), *INPUT_SHAPE), np.float32,
+            # Staged (device-committed) inputs lower against the data-axis
+            # sharding; unstaged lower shardingless — the same fork the
+            # engine's _stage makes, and part of the config for the same
+            # reason.
+            sharding=input_sharding if device_stage else None,
+        )
+        programs.append(
+            Program(
+                f"predict_step[f32][{int(b)}]",
+                fn,
+                example_args=(var_spec, x_spec),
+                config=predict_config(
+                    mesh, "f32", b, use_bn=use_bn, conv_impl=conv_impl,
+                    device_stage=device_stage,
+                ),
+                store=store,
+            )
+        )
+    return programs

@@ -109,11 +109,13 @@ class ParityError(AssertionError):
 
 class _Variant:
     """One served dtype: its jitted forward (sentinel-wrapped), its
-    variable tree, its gate state, and (AOT mode) its per-bucket
-    executable table."""
+    variable tree, its gate state, and its per-bucket
+    :class:`~..compile.Program` grid (the unified compile/AOT/dispatch
+    artifact — one Program per rung, sharing this variant's jit fn and
+    sentinel budget)."""
 
     __slots__ = ("name", "jit_fn", "predict", "variables", "verified",
-                 "parity", "table")
+                 "parity", "programs", "aot")
 
     def __init__(self, name, jit_fn, predict, variables, verified=False):
         self.name = name
@@ -122,7 +124,8 @@ class _Variant:
         self.variables = variables
         self.verified = verified
         self.parity: dict | None = None
-        self.table: dict[int, Any] | None = None
+        self.programs: dict[int, Any] = {}
+        self.aot = False
 
 
 class InferenceEngine:
@@ -268,7 +271,7 @@ class InferenceEngine:
             )
         self._aot_store = None
         if aot_cache:
-            from ..compile import ExecutableStore
+            from ..compile import ExecutableStore, predict_store_size
 
             if isinstance(aot_cache, ExecutableStore):
                 # Pool mode (serving/pool.py): N replicas share ONE
@@ -284,10 +287,12 @@ class InferenceEngine:
                     # Hold the whole dtype x bucket grid plus headroom for
                     # one config change; the default bound would prune
                     # mid-grid.
-                    max_entries=2 * len(self._variants) * len(self.buckets) + 4,
+                    max_entries=predict_store_size(
+                        1, len(self._variants), len(self.buckets)
+                    ),
                 )
             for v in self._variants.values():
-                v.table = {}
+                v.aot = True
         self.warmed = False
         # Direct-call staging: one preallocated pad target per bucket, so
         # the serial predict_logits path allocates nothing per dispatch
@@ -403,41 +408,47 @@ class InferenceEngine:
     def _run_variant(self, v: _Variant, staged):
         """Dispatch one bucket-shaped batch on a variant, bypassing the
         verified gate (warmup and the parity gate itself come through
-        here; request traffic goes through :meth:`launch`)."""
+        here; request traffic goes through :meth:`launch`).  Steady
+        state is ``Program.call`` — the executable fast path in AOT
+        mode, the sentinel-guarded jit wrapper otherwise."""
         staged = self._stage(staged)
-        if v.table is not None and len(staged) in v.table:
-            return v.table[len(staged)](v.variables, staged)
+        prog = v.programs.get(len(staged))
+        if prog is not None:
+            return prog.call(v.variables, staged)
         return v.predict(v.variables, staged)
 
-    def _warm_one(self, v: _Variant, b: int) -> None:
-        x = self._stage(np.zeros((b, *INPUT_SHAPE), np.float32))
-        if v.table is not None:
-            config = {
-                "program": "predict_step",
-                "dtype": v.name,
-                "bucket": int(b),
-                "mesh": {str(k): int(s) for k, s in self.mesh.shape.items()},
-                # Concrete device ids, not just the mesh shape: a
-                # serialized executable pins its compile-time devices
-                # (jax pickles them BY ID and the XLA device assignment
-                # rides the payload), so two replicas' same-shape meshes
-                # on different devices must never alias one entry — the
-                # deserialized program would silently run on the wrong
-                # chip or refuse the replica's committed inputs.
-                "devices": [int(d.id) for d in self.mesh.devices.flat],
-                "use_bn": self.use_bn,
-                "conv_impl": self._conv_impl,
-                "device_stage": self.device_stage,
-                "prng_impl": str(jax.config.jax_default_prng_impl),
-            }
-            compiled, _outcome = self._aot_store.load_or_compile(
+    def _program_for(self, v: _Variant, b: int):
+        """The (variant, bucket) rung as a :class:`~..compile.Program`:
+        shared jit fn + sentinel budget, canonical
+        :func:`~..compile.predict_config` AOT key (concrete device ids
+        included — serialized executables pin their compile-time
+        devices, so two replicas' same-shape meshes on different
+        devices never alias one entry), staged example input."""
+        prog = v.programs.get(b)
+        if prog is None:
+            from ..compile import Program, predict_config
+
+            prog = Program(
                 f"predict_step[{v.name}][{b}]",
-                config,
-                lambda: v.jit_fn.lower(v.variables, x).compile(),
+                v.jit_fn,
+                sentinel=None if v.aot else v.predict,
+                example_args=lambda: (
+                    v.variables,
+                    self._stage(np.zeros((b, *INPUT_SHAPE), np.float32)),
+                ),
+                config=predict_config(
+                    self.mesh, v.name, b,
+                    use_bn=self.use_bn,
+                    conv_impl=self._conv_impl,
+                    device_stage=self.device_stage,
+                ),
+                store=self._aot_store if v.aot else None,
             )
-            v.table[b] = compiled
-        else:
-            v.predict(v.variables, x)
+            v.programs[b] = prog
+        return prog
+
+    def _warm_one(self, v: _Variant, b: int) -> None:
+        self._program_for(v, b).build()
 
     def warmup(
         self,
@@ -513,8 +524,11 @@ class InferenceEngine:
                 warm_one(vname, b)
         report = [(b, done[b]) for b in self.buckets]
         for v in self._variants.values():
-            if v.table is not None:
-                missing = [b for b in self.buckets if b not in v.table]
+            if v.aot:
+                missing = [
+                    b for b in self.buckets
+                    if b not in v.programs or not v.programs[b].built
+                ]
                 if missing:
                     raise RecompileError(
                         f"AOT warmup left {v.name} buckets {missing} "

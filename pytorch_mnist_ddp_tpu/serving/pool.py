@@ -391,16 +391,19 @@ class EnginePool:
             max_bucket = None
         self._store = None
         if aot_cache:
-            from ..compile import ExecutableStore
+            from ..compile import ExecutableStore, predict_store_size
 
             # Sized for the WHOLE pool grid (+ headroom for one config
-            # change): per-engine sizing would let replica 8's warmup
-            # prune replica 1's just-written entries.
+            # change) through the one shared formula (compile/program.py
+            # predict_store_size — the same sizing the single engine and
+            # the trainer's serve-prewarm handoff use): per-engine sizing
+            # would let replica 8's warmup prune replica 1's just-written
+            # entries.  Each engine's rungs are Programs over this store.
             self._store = ExecutableStore(
                 aot_cache,
                 registry=registry,
-                max_entries=(
-                    2 * len(assigned) * (1 + len(dtypes)) * len(buckets) + 4
+                max_entries=predict_store_size(
+                    len(assigned), 1 + len(dtypes), len(buckets)
                 ),
             )
         self.engines: list[InferenceEngine] = []

@@ -448,6 +448,22 @@ def _fit_body(
         getattr(args, "fused", False)
     ):
         raise ValueError("--pregather is the fused input path; add --fused")
+    # --serve-prewarm (the train-to-serve handoff, compile/program.py):
+    # validated here so every caller fails loudly before any device work.
+    if bool(getattr(args, "serve_prewarm", False)):
+        if not getattr(args, "aot_cache", None):
+            raise ValueError(
+                "--serve-prewarm persists the serving predict grid as "
+                "serialized AOT executables; add --aot-cache DIR"
+            )
+        if bool(getattr(args, "fused", False)):
+            raise ValueError(
+                "--serve-prewarm rides the per-batch step loop; drop --fused"
+            )
+        if num_model > 1:
+            raise ValueError(
+                "--serve-prewarm rides the DP paths; drop --tp/--pp"
+            )
     # Full-state continuation (--save-state / --resume-state): the whole
     # TrainState travels, so the continued run is bit-identical to an
     # uninterrupted one (utils/checkpoint.save_train_state).
@@ -686,7 +702,13 @@ def _fit_body(
     if fused:
         import time as _time
 
-        from .compile import CompileService, ExecutableStore, StartupTasks
+        from .compile import (
+            CompileService,
+            ExecutableStore,
+            Program,
+            StartupTasks,
+            train_config,
+        )
         from .parallel.fused import device_put_dataset, make_fused_run
 
         if (
@@ -786,60 +808,58 @@ def _fit_body(
             tasks = StartupTasks(svc, registry=_registry, sink=_sink)
             tasks.add("restore", _make_lead)
 
-            def _build_compiled():
+            def _example_args():
                 # A from_key run lowers against the (instantly available)
                 # init key, so trace+compile never waits on anything; a
                 # resume run rendezvous on the restored state first — its
                 # shapes and optimizer layout parameterize the program.
                 lead_in = keys["init"] if from_key else tasks.result("restore")
-                return run_fn.lower(
+                return (
                     lead_in, tr_x, tr_y, te_x, te_y,
                     keys["shuffle"], keys["dropout"], lrs,
-                ).compile()
+                )
 
-            if aot_dir:
-                # Serialized AOT executable: a warm start deserializes —
-                # zero tracing — with a gate that falls back to a fresh
-                # compile on any config/source/environment mismatch.
-                store = ExecutableStore(aot_dir, registry=_registry, sink=_sink)
-                aot_config = {
-                    "program": "fused_run",
-                    "mesh": {str(k): int(v) for k, v in mesh.shape.items()},
-                    "train_size": len(train_set),
-                    "test_size": len(test_set),
-                    "global_batch": global_batch,
-                    "eval_batch": eval_batch,
-                    "epochs": args.epochs,
-                    "compute_dtype": jnp.dtype(compute_dtype).name,
-                    "use_pallas": bool(use_pallas),
-                    "from_key": from_key,
-                    "use_bn": syncbn,
-                    "start_epoch": epoch0 + 1,
-                    "pregather": bool(getattr(args, "pregather", False)),
-                    "conv_impl": conv_impl,
-                    "zero": zero,
-                    "prng_impl": str(jax.config.jax_default_prng_impl),
-                }
-                tasks.add(
-                    "fused_run",
-                    lambda: store.load_or_compile(
-                        "fused_run", aot_config, _build_compiled
-                    ),
-                    kind="compile",
-                )
-            else:
-                tasks.add(
-                    "fused_run",
-                    lambda: (_build_compiled(), None),
-                    kind="compile",
-                )
+            # The whole-run program as ONE Program artifact (compile/
+            # program.py): jit fn + deferred example args + AOT key.
+            # With --aot-cache a warm start deserializes the serialized
+            # executable — zero tracing — behind a gate that falls back
+            # to a fresh compile on any config/source/environment
+            # mismatch; without it, build() is a plain lower+compile.
+            # Dispatch below is Program.call, the executable fast path.
+            store = (
+                ExecutableStore(aot_dir, registry=_registry, sink=_sink)
+                if aot_dir else None
+            )
+            program = Program(
+                "fused_run",
+                run_fn,
+                example_args=_example_args,
+                config=train_config(
+                    mesh, "fused_run",
+                    train_size=len(train_set),
+                    test_size=len(test_set),
+                    global_batch=global_batch,
+                    eval_batch=eval_batch,
+                    epochs=args.epochs,
+                    compute_dtype=jnp.dtype(compute_dtype).name,
+                    use_pallas=bool(use_pallas),
+                    from_key=from_key,
+                    use_bn=syncbn,
+                    start_epoch=epoch0 + 1,
+                    pregather=bool(getattr(args, "pregather", False)),
+                    conv_impl=conv_impl,
+                    zero=zero,
+                ),
+                store=store,
+            )
+            tasks.add("fused_run", program.build, kind="compile")
             # The H2D transfer tail as its own measured rendezvous leg.
             tasks.add(
                 "data",
                 lambda: jax.block_until_ready((tr_x, tr_y, te_x, te_y)),
             )
             lead = tasks.result("restore")
-            compiled, aot_outcome = tasks.result("fused_run")
+            aot_outcome = tasks.result("fused_run")
             overlap_ratio = tasks.rendezvous()
         run_args = (
             lead, tr_x, tr_y, te_x, te_y,
@@ -857,7 +877,7 @@ def _fit_body(
             if aot_outcome is not None:
                 timings["aot_executable"] = aot_outcome
             _t1 = _time.perf_counter()
-            state, losses, evals = compiled(*run_args)
+            state, losses, evals = program.call(*run_args)
             # Materialize the outputs on host INSIDE the timed window:
             # through the remote-accelerator tunnel, block_until_ready can
             # return while device work is still in flight, which would park
@@ -871,7 +891,7 @@ def _fit_body(
             timings["epoch1_test_accuracy"] = float(evals_np[0, 1]) / len(test_set)
             timings["final_test_accuracy"] = float(evals_np[-1, 1]) / len(test_set)
         else:
-            state, losses, evals = compiled(*run_args)
+            state, losses, evals = program.call(*run_args)
             losses_np = evals_np = None
         if dist.is_chief:
             # One transfer for the whole run, then the reference's exact
@@ -1039,6 +1059,143 @@ def _fit_body(
                 mesh, compute_dtype=compute_dtype, use_bn=syncbn,
                 conv_impl=conv_impl,
             )
+        # Unified Program artifact (compile/program.py, docs/COMPILE.md):
+        # the DP-family train and eval steps become Programs built
+        # CONCURRENTLY through the compile-service fan-out — the eval
+        # program no longer compiles serially at the first eval pass —
+        # and the step loop dispatches through Program.call, the bound
+        # executable's C++ fast path (per-call host overhead pinned at
+        # the direct-jit level in tests/test_program.py).  Shapes are
+        # static by the loader's pad-to-batch contract, so ONE lowered
+        # signature serves the whole run; numerics are the same
+        # executable jit would have cached, so stdout and params stay
+        # byte-identical (pinned).  With --aot-cache the programs
+        # persist as serialized executables (warm trainer restart =
+        # pure deserialize), and --serve-prewarm additionally builds
+        # the serving engine's f32 predict grid through the SAME
+        # canonical config composition — the train-to-serve handoff: a
+        # serving engine warming the matching mesh/buckets from this
+        # store starts with ZERO compiles (cross-surface reuse).
+        # The model-axis modes (--tp/--pp) keep lazy jit dispatch.
+        serve_prewarm = bool(getattr(args, "serve_prewarm", False))
+        if tp_degree == 1 and not pp_on:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .compile import (
+                ExecutableStore,
+                Program,
+                build_programs,
+                predict_store_size,
+                serving_predict_programs,
+                train_config,
+            )
+            from .models.net import INPUT_SHAPE
+
+            aot_dir = getattr(args, "aot_cache", None)
+            batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+            def _batch_specs(batch: int) -> tuple:
+                # The loader's static batch schema (data/loader.py: final
+                # partial batches pad to shape, placement commits to the
+                # data-axis sharding) — the one signature each program
+                # ever sees.
+                return (
+                    jax.ShapeDtypeStruct(
+                        (batch, *INPUT_SHAPE), jnp.float32,
+                        sharding=batch_sharding,
+                    ),
+                    jax.ShapeDtypeStruct(
+                        (batch,), jnp.int32, sharding=batch_sharding
+                    ),
+                    jax.ShapeDtypeStruct(
+                        (batch,), jnp.float32, sharding=batch_sharding
+                    ),
+                )
+
+            def _spec_of(tree):
+                return jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(
+                        np.shape(a), np.asarray(a).dtype
+                        if not hasattr(a, "dtype") else a.dtype,
+                        sharding=getattr(a, "sharding", None),
+                    ),
+                    tree,
+                )
+
+            handoff_buckets = []
+            if serve_prewarm:
+                from .serving.buckets import DEFAULT_MAX_BUCKET, pow2_buckets
+
+                handoff_buckets = pow2_buckets(
+                    n_shards, max(n_shards, min(DEFAULT_MAX_BUCKET, eval_batch))
+                )
+            store = None
+            if aot_dir:
+                store = ExecutableStore(
+                    aot_dir,
+                    registry=obs_registry,
+                    sink=obs_sink,
+                    # Train + eval entries plus the handoff grid, with
+                    # the shared headroom formula — the default bound
+                    # would prune the grid mid-prewarm.
+                    max_entries=4 + predict_store_size(
+                        1, 1, max(1, len(handoff_buckets))
+                    ),
+                )
+            extras = dict(
+                compute_dtype=jnp.dtype(compute_dtype).name,
+                use_bn=syncbn,
+                conv_impl=conv_impl,
+                zero=zero,
+            )
+            step_program = Program(
+                "train_step",
+                step_fn,
+                example_args=(
+                    _spec_of(state), *_batch_specs(global_batch),
+                    keys["dropout"], jnp.float32(0.0),
+                ),
+                config=train_config(
+                    mesh, "train_step", global_batch=global_batch,
+                    use_pallas=bool(use_pallas), **extras,
+                ),
+                store=store,
+            )
+            eval_program = Program(
+                "eval_step",
+                eval_fn,
+                example_args=(
+                    _spec_of(eval_variables(
+                        state.params, state.batch_stats, syncbn
+                    )),
+                    *_batch_specs(eval_batch),
+                ),
+                config=train_config(
+                    mesh, "eval_step", eval_batch=eval_batch, **extras
+                ),
+                store=store,
+            )
+            programs = [step_program, eval_program]
+            if serve_prewarm:
+                programs.extend(
+                    serving_predict_programs(
+                        mesh,
+                        eval_variables(state.params, state.batch_stats, syncbn),
+                        handoff_buckets,
+                        store=store,
+                        use_bn=syncbn,
+                        conv_impl=conv_impl,
+                    )
+                )
+            startup_span = (
+                telemetry.span("startup")
+                if telemetry is not None
+                else contextlib.nullcontext()
+            )
+            with startup_span:
+                build_programs(programs, registry=obs_registry, sink=obs_sink)
+            step_fn = step_program.call
+            eval_fn = eval_program.call
         want_stats = bool(getattr(args, "step_stats", False))
         # Resilient runtime (resilience/, docs/ROBUSTNESS.md): constructed
         # when a resilience flag is set OR a fault injector is installed

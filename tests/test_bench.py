@@ -384,6 +384,36 @@ def test_window_promote_rules(tmp_path):
     assert json.loads(ldst.read_text())["full"] == 640.0
 
 
+def test_count_rungs_ignores_float_metadata_keys(tmp_path):
+    """The round-5 advisor's clobber scenario: count_rungs must count
+    only keys from the known rung-name set (step_attr_bench.RUNG_NAMES),
+    so a truncated partial padded with top-level float METADATA keys
+    (elapsed_s, budget_s, a future addition...) can never outrank — and
+    clobber — a more complete committed baseline."""
+    import window_promote as wp
+    from step_attr_bench import RUNG_NAMES
+
+    # The exported set is the ladder's real rung inventory.
+    assert "full" in RUNG_NAMES and "eval" in RUNG_NAMES
+
+    # 1 real rung + 3 float metadata keys must count as 1, not 4.
+    truncated = {"batch": 200, "full": 900.0, "elapsed_s": 12.5,
+                 "budget_s": 540.0, "overhead_s": 0.25, "partial": True}
+    assert wp.count_rungs(truncated) == 1
+    # A failed rung records None — not a measured rung either.
+    assert wp.count_rungs({"full": 900.0, "fwd_bwd": None}) == 1
+    assert wp.count_rungs(None) == -1
+
+    # End to end: the padded partial must NOT clobber a 3-rung baseline.
+    lsrc = tmp_path / "partial.json"
+    ldst = tmp_path / "baseline.json"
+    ldst.write_text(json.dumps({"batch": 200, "full": 810.0,
+                                "fwd_bwd": 690.0, "eval": 900.0}))
+    lsrc.write_text(json.dumps(truncated))
+    assert "kept incumbent" in wp.promote_rungs(str(lsrc), str(ldst))
+    assert json.loads(ldst.read_text())["full"] == 810.0
+
+
 def test_step_attr_budget_zero_emits_parseable_partial():
     """The watcher's window budget machinery: a fully budget-starved
     ladder must still exit 0 with ONE parseable JSON line marking every

@@ -1,0 +1,407 @@
+"""Unified Program runtime tests (ISSUE 15): build modes (lazy jit /
+warm-through-sentinel / AOT-store), the slimmed dispatch path's
+structural no-regression pin vs a direct jit call, canonical AOT-config
+composition, the cross-surface trainer↔serving executable-reuse pin
+(second surface starts with ZERO compiles), the trainer's
+--serve-prewarm handoff through the real fit() path, and the SLO gate's
+parsing/verdict units.
+
+Run alone with ``pytest -m program``; everything here also rides the
+default smoke tier except the full slo_gate subprocess e2e (slow — the
+CI ``slo`` job runs it green AND injected on every push).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_mnist_ddp_tpu.analysis.sentinel import RecompileError, RecompileSentinel
+from pytorch_mnist_ddp_tpu.compile import (
+    ExecutableStore,
+    Program,
+    build_programs,
+    predict_config,
+    predict_store_size,
+    serving_predict_programs,
+)
+from pytorch_mnist_ddp_tpu.obs.registry import Registry
+
+pytestmark = pytest.mark.program
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(1, os.path.join(REPO, "tools"))  # for slo_gate
+
+
+def _mesh1():
+    from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(num_data=1, devices=jax.devices()[:1])
+
+
+# ---------------------------------------------------------------------------
+# Build modes
+
+
+def test_lazy_program_dispatches_through_jit_unchanged():
+    fn = jax.jit(lambda a: a * 2)
+    prog = Program("double", fn)
+    assert prog.call is fn and not prog.built
+    x = jnp.arange(4.0)
+    assert np.array_equal(np.asarray(prog.call(x)), np.asarray(fn(x)))
+
+
+def test_aot_build_binds_executable_bit_identical():
+    fn = jax.jit(lambda a: jnp.sin(a) @ jnp.cos(a).T)
+    x = jnp.asarray(np.random.RandomState(0).rand(8, 8), jnp.float32)
+    prog = Program("sincos", fn, example_args=(x,))
+    assert prog.build() is None and prog.built and prog.compiled is not None
+    out_prog = np.asarray(prog.call(x))
+    out_jit = np.asarray(fn(x))
+    assert out_prog.tobytes() == out_jit.tobytes()
+    # Idempotent: a second build is a no-op, not a recompile.
+    compiled = prog.compiled
+    prog.build()
+    assert prog.compiled is compiled
+
+
+def test_warm_mode_traces_once_through_sentinel_budget():
+    fn = jax.jit(lambda a: a + 1)
+    sentinel = RecompileSentinel(fn, max_traces=1, name="warmed")
+    x = jnp.zeros(4)
+    prog = Program("warmed", fn, sentinel=sentinel, example_args=(x,))
+    prog.build()
+    assert prog.trace_count() == 1 and prog.call is sentinel
+    prog.call(x)  # same shape: no new trace
+    assert prog.trace_count() == 1
+    # The budget still guards dispatch: a leaked shape raises exactly as
+    # it did before Programs existed.
+    with pytest.raises(RecompileError):
+        prog.call(jnp.zeros(5))
+
+
+def test_store_mode_warm_start_is_pure_hit_with_zero_traces(tmp_path):
+    def build(store):
+        fn = jax.jit(lambda a: a * 3 + 1)
+        return Program(
+            "tripler", fn,
+            example_args=(jax.ShapeDtypeStruct((4,), jnp.float32),),
+            config={"program": "tripler", "n": 4},
+            store=store,
+        )
+
+    x = jnp.arange(4.0)
+    cold = build(ExecutableStore(str(tmp_path)))
+    assert cold.build() == "miss"
+    warm = build(ExecutableStore(str(tmp_path)))
+    assert warm.build() == "hit"
+    assert warm.trace_count() == 0  # pure deserialize: zero traces
+    assert (
+        np.asarray(warm.call(x)).tobytes()
+        == np.asarray(cold.call(x)).tobytes()
+    )
+
+
+def test_store_mode_requires_config():
+    with pytest.raises(ValueError, match="config"):
+        Program("x", jax.jit(lambda a: a), store=object())
+
+
+def test_build_without_example_args_is_loud():
+    prog = Program("noargs", jax.jit(lambda a: a))
+    with pytest.raises(ValueError, match="example args"):
+        prog.build()
+
+
+def test_build_programs_fans_out_and_records_compile_seconds():
+    registry = Registry()
+    progs = [
+        Program(f"p{i}", jax.jit(lambda a, i=i: a + i),
+                example_args=(jnp.zeros(4),))
+        for i in range(3)
+    ]
+    build_programs(progs, registry=registry)
+    assert all(p.built for p in progs)
+    families = {name: ch for name, _, _, ch in registry.collect()}
+    labels = [lbl for lbl, _ in families["compile_seconds_total"]]
+    assert {"fn": "p0"} in labels and {"fn": "p2"} in labels
+
+
+# ---------------------------------------------------------------------------
+# The slimmed dispatch path: structural A/B vs the direct jit call
+
+
+def _python_call_frames(fn, *args) -> int:
+    """Python 'call' events fired while invoking ``fn`` — the structural
+    host-overhead measure (deterministic, unlike wall clock on a shared
+    CI box)."""
+    count = [0]
+
+    def prof(frame, event, arg):
+        if event == "call":
+            count[0] += 1
+
+    prev = sys.getprofile()
+    sys.setprofile(prof)
+    try:
+        fn(*args)
+    finally:
+        sys.setprofile(prev)
+    return count[0]
+
+
+def test_program_call_adds_no_python_frames_over_direct_jit():
+    # The tentpole's no-regression contract: Program.call binds the
+    # executable's C++ fast path, so steady-state dispatch pays ZERO
+    # Python wrapper frames — exactly a direct jit call's profile, and
+    # strictly fewer than the sentinel-wrapped path the serving engine
+    # dispatched through before.
+    fn = jax.jit(lambda a: a + 1)
+    x = jnp.zeros(8)
+    prog = Program("fast", fn, example_args=(x,))
+    prog.build()
+    sentinel = RecompileSentinel(jax.jit(lambda a: a + 1), max_traces=1)
+    fn(x), prog.call(x), sentinel(x)  # settle every fast path first
+    jit_frames = _python_call_frames(fn, x)
+    prog_frames = _python_call_frames(prog.call, x)
+    sentinel_frames = _python_call_frames(sentinel, x)
+    assert prog_frames <= jit_frames, (prog_frames, jit_frames)
+    assert prog_frames < sentinel_frames, (prog_frames, sentinel_frames)
+
+
+# ---------------------------------------------------------------------------
+# Canonical config + cross-surface reuse
+
+
+def test_predict_config_composition_is_canonical():
+    mesh = _mesh1()
+    cfg = predict_config(
+        mesh, "f32", 8, use_bn=False, conv_impl="conv", device_stage=True
+    )
+    assert cfg["program"] == "predict_step" and cfg["bucket"] == 8
+    assert cfg["devices"] == [int(d.id) for d in mesh.devices.flat]
+    # Any drift in these fields silently unshares the cross-surface
+    # cache; pin the exact key set.
+    assert set(cfg) == {
+        "program", "dtype", "bucket", "mesh", "devices", "use_bn",
+        "conv_impl", "device_stage", "prng_impl",
+    }
+
+
+def test_predict_store_size_shared_formula():
+    # engine (1 replica), pool (N replicas), and the handoff all size
+    # through this; it must hold the whole grid plus headroom.
+    assert predict_store_size(1, 2, 5) == 2 * 2 * 5 + 4
+    assert predict_store_size(4, 3, 10) == 2 * 4 * 3 * 10 + 4
+
+
+def test_cross_surface_trainer_to_serving_reuse_zero_compiles(tmp_path):
+    """THE cross-surface pin: a trainer-side surface persists the
+    predict grid through serving_predict_programs; a serving engine
+    warming the same mesh/buckets from the same store starts with ZERO
+    compiles — every rung a pure ExecutableStore deserialize."""
+    from pytorch_mnist_ddp_tpu.models.net import init_params
+    from pytorch_mnist_ddp_tpu.serving import InferenceEngine, ServingMetrics
+    from pytorch_mnist_ddp_tpu.utils.rng import root_key, split_streams
+
+    mesh = _mesh1()
+    params = init_params(split_streams(root_key(1))["init"])
+    buckets = (1, 2, 4)
+
+    # Surface 1 ("trainer"): build + persist the grid.  The variables
+    # argument is the SERVED tree — bare params for a non-BN model,
+    # exactly what eval_variables() hands the trainer's wiring.
+    store = ExecutableStore(str(tmp_path))
+    progs = serving_predict_programs(mesh, params, buckets, store=store)
+    build_programs(progs)
+    assert [p.outcome for p in progs] == ["miss"] * len(buckets)
+
+    # Surface 2 ("serving"): the engine's own warmup over the same dir.
+    metrics = ServingMetrics()
+    engine = InferenceEngine(
+        {"params": params}, mesh=mesh, buckets=buckets,
+        metrics=metrics, aot_cache=str(tmp_path),
+    )
+    engine.warmup()
+    assert engine.compile_count() == 0  # zero traces in the second surface
+    families = {n: ch for n, _, _, ch in metrics.registry.collect()}
+    outcomes = {
+        lbl["outcome"]: c.value
+        for lbl, c in families["aot_executables_total"]
+    }
+    assert outcomes == {"hit": float(len(buckets))}
+    # And the warm engine actually serves.
+    out = engine.predict_logits(np.zeros((2, 28, 28, 1), np.float32))
+    assert out.shape == (2, 10)
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration: the real fit() path
+
+
+def _tiny_mnist(monkeypatch):
+    import pytorch_mnist_ddp_tpu.data.mnist as M
+
+    rng = np.random.RandomState(0)
+    train = (
+        rng.randint(0, 256, (64, 28, 28), np.uint8),
+        rng.randint(0, 10, 64).astype(np.uint8),
+    )
+    test = (
+        rng.randint(0, 256, (32, 28, 28), np.uint8),
+        rng.randint(0, 10, 32).astype(np.uint8),
+    )
+
+    def tiny(root="./data", split="train", *a, return_source=False, **kw):
+        arrays = train if split == "train" else test
+        return (*arrays, "idx") if return_source else arrays
+
+    monkeypatch.setattr(M, "load_mnist_arrays", tiny)
+
+
+def _fit_args(**overrides):
+    from argparse import Namespace
+
+    base = dict(
+        batch_size=16, test_batch_size=16, epochs=1, lr=1.0, gamma=0.7,
+        seed=1, log_interval=2, dry_run=True, save_model=False, fused=False,
+        data_root="./data", profile=None, step_stats=False,
+        telemetry_dir=None, aot_cache=None, serve_prewarm=False,
+    )
+    base.update(overrides)
+    return Namespace(**base)
+
+
+def test_fit_serve_prewarm_seeds_the_serving_store(tmp_path, monkeypatch, capsys):
+    """The train-to-serve handoff end to end: a per-batch fit() with
+    --aot-cache --serve-prewarm leaves a store a serving engine
+    warm-starts from with zero compiles (and the trainer's own warm
+    restart is a pure hit too)."""
+    from pytorch_mnist_ddp_tpu.models.net import init_params
+    from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
+    from pytorch_mnist_ddp_tpu.serving import InferenceEngine, ServingMetrics
+    from pytorch_mnist_ddp_tpu.trainer import fit
+    from pytorch_mnist_ddp_tpu.utils.rng import root_key, split_streams
+
+    _tiny_mnist(monkeypatch)
+    dist = DistState(devices=jax.devices()[:1])
+    aot_dir = str(tmp_path / "aot")
+    fit(_fit_args(aot_cache=aot_dir, serve_prewarm=True), dist)
+    capsys.readouterr()
+    # eval_batch 16 -> handoff grid (1,2,4,8,16); train + eval + 5 rungs.
+    entries = [f for f in os.listdir(aot_dir) if f.endswith(".jexec")]
+    assert len(entries) == 2 + 5
+
+    # The serving surface: same mesh/buckets, same store — zero
+    # compiles (AOT entries key on config, not weights, so any
+    # checkpoint this engine serves rides the prewarmed grid).
+    metrics = ServingMetrics()
+    engine = InferenceEngine(
+        {"params": init_params(split_streams(root_key(1))["init"])},
+        mesh=_mesh1(),
+        buckets=(1, 2, 4, 8, 16),
+        metrics=metrics,
+        aot_cache=aot_dir,
+    )
+    engine.warmup()
+    assert engine.compile_count() == 0
+
+
+def test_fit_serve_prewarm_without_aot_cache_is_loud(monkeypatch):
+    from pytorch_mnist_ddp_tpu.parallel.distributed import DistState
+    from pytorch_mnist_ddp_tpu.trainer import fit
+
+    _tiny_mnist(monkeypatch)
+    dist = DistState(devices=jax.devices()[:1])
+    with pytest.raises(ValueError, match="aot-cache"):
+        fit(_fit_args(serve_prewarm=True), dist)
+    with pytest.raises(ValueError, match="fused"):
+        fit(_fit_args(serve_prewarm=True, fused=True,
+                      aot_cache="/tmp/x"), dist)
+
+
+# ---------------------------------------------------------------------------
+# SLO gate units (the full subprocess e2e is the slow test below + CI)
+
+
+def test_slo_gate_prom_parsing(tmp_path):
+    import importlib
+
+    slo_gate = importlib.import_module("slo_gate")
+    prom = tmp_path / "m.prom"
+    prom.write_text(
+        "# HELP serving_batch_fill_ratio x\n"
+        "# TYPE serving_batch_fill_ratio summary\n"
+        'serving_batch_fill_ratio{quantile="0.5"} 0.75\n'
+        "serving_batch_fill_ratio_sum 12.5\n"
+        "serving_batch_fill_ratio_count 20\n"
+        'jax_compiles_total{fn="predict_step"} 4\n'
+        'jax_compiles_total{fn="predict_step_bf16"} 2\n'
+    )
+    parsed = slo_gate._read_prom(str(prom))
+    assert parsed["serving_batch_fill_ratio_sum"] == 12.5
+    assert slo_gate._prom_sum(parsed, "jax_compiles_total") == 6.0
+    # _sum must not leak into the bare-family match.
+    assert slo_gate._prom_sum(parsed, "serving_batch_fill_ratio_count") == 20.0
+
+
+def test_slo_budgets_schema_and_chaos_specs_parse():
+    """The committed budget file must stay loadable and its chaos
+    clauses must stay valid under the fault grammar — a typo'd clause
+    would otherwise surface as a vacuously green (or spuriously red)
+    gate in CI."""
+    from pytorch_mnist_ddp_tpu.serving.faults import FaultInjector
+
+    with open(os.path.join(REPO, "tools", "slo_budgets.json")) as f:
+        spec = json.load(f)
+    protocol, budgets = spec["protocol"], spec["budgets"]
+    assert {"virtual_devices", "replicas", "rate_rps", "requests",
+            "buckets", "seed", "recovery_chaos",
+            "inject_p99_chaos"} <= set(protocol)
+    assert {"client_p99_ms", "server_p99_ms", "min_mean_fill_ratio",
+            "max_stall_seconds_total", "max_mean_recovery_s",
+            "min_restarts"} <= set(budgets)
+    for clause in ("recovery_chaos", "inject_p99_chaos"):
+        injector = FaultInjector(protocol[clause])
+        assert injector.specs, clause
+
+
+def test_committed_slo_trajectory_is_green():
+    """BENCH_slo.json is a committed artifact: every recorded
+    non-injected run must have passed its own budgets (a red row means
+    someone committed a known regression)."""
+    with open(os.path.join(REPO, "BENCH_slo.json")) as f:
+        rows = json.load(f)
+    assert isinstance(rows, list) and rows
+    for row in rows:
+        if row.get("injected"):
+            continue
+        assert row["pass"] is True, row
+        assert row["measured"]["additional_compiles"] == 0
+
+
+@pytest.mark.slow  # two full loadgen rounds x two gate runs (~1-2 min)
+def test_slo_gate_green_then_injected_regression_fails(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    gate = [sys.executable, os.path.join(REPO, "tools", "slo_gate.py"),
+            "--no-append"]
+    green = subprocess.run(
+        gate, cwd=REPO, env=env, capture_output=True, text=True, timeout=600
+    )
+    assert green.returncode == 0, green.stdout + green.stderr
+    assert "SLO GATE: PASS" in green.stdout
+    injected = subprocess.run(
+        gate + ["--inject", "p99"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=600,
+    )
+    assert injected.returncode == 1, injected.stdout + injected.stderr
+    # The breach list must name the p99 budgets specifically — every
+    # injected run's output contains the literal "p99" (the [injected=
+    # p99] tag, the echoed command), so anything looser is vacuous.
+    assert "SLO GATE: FAIL (breached: client_p99_ms" in injected.stdout

@@ -82,6 +82,12 @@ def build_report() -> str | None:
     conv_c1 = _load("stepattr_im2col_c1.json", prefix)
     conv_all = _load("stepattr_im2col.json", prefix)
     # Batch-scaling diagnostic ladder (batch 1000 vs the baseline 200).
+    # Both sides of the verdict's ratio are cross-window minima: the
+    # watcher records each window's run to `_b1000_run.json` and
+    # promotes onto this artifact through the window_promote `rungs`
+    # rule (full-rung tie-break), the same discipline as the unsuffixed
+    # baseline — docs/PERF.md rule 2 (decision ratios must not mix
+    # bimodal throughput modes; round-5 advisor finding).
     b1000 = _load("stepattr_b1000.json", prefix)
 
     g = ladder.get  # µs per iteration, or None
@@ -198,13 +204,15 @@ def build_report() -> str | None:
                 f"{ratio:.1f}x the batch-{base_batch} step "
                 f"({scale:.0f}x the work) — the step is dominated by "
                 f"per-op/latency overhead inside the scan body; fewer, "
-                f"larger ops (or bigger per-step batches) are the lever."
+                f"larger ops (or bigger per-step batches) are the lever "
+                f"(ratio of min-promoted artifacts, both sides)."
             )
         else:
             verdicts.append(
                 f"Batch-scaling: full scales {ratio:.1f}x for {scale:.0f}x "
                 f"batch — the step is bandwidth/compute-bound at these "
-                f"shapes, not overhead-bound."
+                f"shapes, not overhead-bound (ratio of min-promoted "
+                f"artifacts, both sides)."
             )
     if attr and attr.get("gap_share") is not None:
         verdicts.append(

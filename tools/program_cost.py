@@ -72,11 +72,21 @@ def main() -> int:
     )
     lrs = jnp.asarray([1.0 * 0.7 ** e for e in range(args.epochs)],
                       jnp.float32)
-    compiled = run_fn.lower(
-        jax.random.PRNGKey(0), *tr, *te,
-        jax.random.PRNGKey(2), jax.random.PRNGKey(3), lrs,
-    ).compile()
-    cost = compiled.cost_analysis()
+    # The exact headline program as a Program artifact (compile/
+    # program.py) — the same build path trainer.py dispatches through,
+    # so the cost analysis can never drift from the shipped executable.
+    from pytorch_mnist_ddp_tpu.compile import Program
+
+    program = Program(
+        "fused_run",
+        run_fn,
+        example_args=(
+            jax.random.PRNGKey(0), *tr, *te,
+            jax.random.PRNGKey(2), jax.random.PRNGKey(3), lrs,
+        ),
+    )
+    program.build()
+    cost = program.compiled.cost_analysis()
     if isinstance(cost, list):  # older jax returns one dict per device
         cost = cost[0]
     flops = float(cost.get("flops", 0.0))

@@ -39,6 +39,25 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The known rung-name set, exported for consumers that must tell rungs
+# from metadata WITHOUT importing jax: tools/window_promote.py counts
+# measured rungs against exactly this set, so a future top-level float
+# metadata key (elapsed_s, budget_s, ...) can never inflate a truncated
+# partial's rung count past a more complete committed baseline.  Keep in
+# sync with the variants dict in main() (asserted there).
+RUNG_NAMES = (
+    "full",
+    "fwd_bwd",
+    "full_nogather",
+    "full_pregather",
+    "gather_norm",
+    "empty_scan",
+    "gather_epoch",
+    "full_nodrop",
+    "fwd",
+    "eval",
+)
+
 
 def main() -> int:
     p = argparse.ArgumentParser()
@@ -255,6 +274,12 @@ def main() -> int:
         "fwd": make_fwd(),
         "eval": make_eval(),
     }
+    # RUNG_NAMES is the module-level export the promotion rule counts
+    # against; a rung added here without updating it would be invisible
+    # to window_promote's clobber guard.
+    assert set(variants) == set(RUNG_NAMES), (
+        sorted(variants), sorted(RUNG_NAMES)
+    )
 
     if args.only:
         wanted = [w.strip() for w in args.only.split(",") if w.strip()]
@@ -289,6 +314,8 @@ def main() -> int:
     budget_s = args.budget_s
     t_start = time.perf_counter()
 
+    from pytorch_mnist_ddp_tpu.compile import Program
+
     for name, fn in variants.items():
         if time.perf_counter() - t_start > budget_s:
             result.setdefault("skipped", []).append(name)
@@ -296,15 +323,20 @@ def main() -> int:
         # us per ITERATION of that variant's scan ("eval" iterates
         # eval-steps batches; everything else `steps` train steps).
         iters = args.eval_steps if name == "eval" else args.steps
-        jitted = jax.jit(fn)  # jaxlint: disable=JL004 -- one compile per variant IS the measurement (compile_s below)
+        # Each rung is a Program (compile/program.py): build() is the
+        # lower+compile (or persistent-cache load), call the bound
+        # executable — the same artifact the trainer and serving
+        # dispatch through, so the ladder measures the shipped path.
+        rung = Program(name, jax.jit(fn), example_args=())  # jaxlint: disable=JL004 -- one compile per variant IS the measurement (compile_s below)
         try:
             t_c0 = time.perf_counter()
-            jax.block_until_ready(jitted())  # compile (or cache load)
+            rung.build()
+            jax.block_until_ready(rung.call())  # compile -> first result
             compile_s = time.perf_counter() - t_c0
             best = float("inf")
             for _ in range(args.reps):
                 t0 = time.perf_counter()
-                jax.block_until_ready(jitted())
+                jax.block_until_ready(rung.call())
                 best = min(best, time.perf_counter() - t0)
             result[name] = round(best / iters * 1e6, 2)
             result.setdefault("compile_s", {})[name] = round(compile_s, 1)

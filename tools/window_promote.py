@@ -15,11 +15,14 @@ headline), so recorded rows are never latest-wins:
   which copied a failure row onto an absent dst.)
 - ``rungs``: copy src over dst only if src carries at least as many
   measured ladder rungs — the rule for the unsuffixed step-attribution
-  baseline `tools/perf_report.py` reads.  Rungs are counted
-  structurally (float-valued keys: the ladder tool rounds every
-  measured rung to a float; metadata keys are str/int/dict/bool), so a
-  budget- or SIGTERM-truncated partial can never clobber a more
-  complete committed artifact, while the FIRST partial still lands.
+  baseline `tools/perf_report.py` reads, AND for the batch-scaling
+  `_b1000` artifact (so both sides of perf_report's batch-scaling
+  ratio are cross-window minima, per docs/PERF.md rule 2).  Rungs are
+  counted against the KNOWN rung-name set `step_attr_bench.RUNG_NAMES`
+  (numeric values only — a failed rung records None), so a future
+  top-level float metadata key (elapsed_s, budget_s, ...) can never
+  inflate a truncated partial's count and let it clobber a more
+  complete committed baseline, while the FIRST partial still lands.
 
 Usage: python tools/window_promote.py {value|rungs} SRC.json DST.json
 Exit 0 either way (promotion declined is not an error); 2 on bad usage.
@@ -31,6 +34,11 @@ import json
 import os
 import shutil
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+# The ladder tool's own rung-name export (stdlib-only import): the one
+# source of truth for what counts as a measured rung.
+from step_attr_bench import RUNG_NAMES
 
 
 def _load(path: str) -> dict | None:
@@ -59,10 +67,18 @@ def promote_value(src: str, dst: str) -> str:
 
 
 def count_rungs(row: dict | None) -> int:
-    """Measured-rung count of a ladder artifact (float-valued keys)."""
+    """Measured-rung count of a ladder artifact: keys from the known
+    rung-name set (``step_attr_bench.RUNG_NAMES``) holding a numeric
+    measurement.  A failed rung records None (not counted); top-level
+    numeric METADATA keys are not rungs and must never let a truncated
+    partial outrank a more complete committed baseline."""
     if not isinstance(row, dict):
         return -1
-    return sum(1 for v in row.values() if isinstance(v, float))
+    return sum(
+        1 for k, v in row.items()
+        if k in RUNG_NAMES
+        and isinstance(v, (int, float)) and not isinstance(v, bool)
+    )
 
 
 def promote_rungs(src: str, dst: str) -> str:

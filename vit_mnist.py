@@ -431,15 +431,20 @@ def main() -> None:
             # print (trainer.py:437-458 documents both hazards).
             import json as _json
 
+            from pytorch_mnist_ddp_tpu.compile import Program
+
             timings = {"dataset": tr_src}
             _t1 = time.perf_counter()
-            compiled = run_fn.lower(*run_inputs).compile()
+            program = Program(
+                "fused_vit_run", run_fn, example_args=run_inputs
+            )
+            program.build()
             timings["compile_s"] = time.perf_counter() - _t1
             _t1 = time.perf_counter()
             jax.block_until_ready((tr_dev, te_dev))
             timings["data_s"] = _data_dispatch + time.perf_counter() - _t1
             _t1 = time.perf_counter()
-            state, losses, evals = compiled(*run_inputs)
+            state, losses, evals = program.call(*run_inputs)
             losses, evals = np.asarray(losses), np.asarray(evals)
             timings["run_s"] = time.perf_counter() - _t1
             timings.update(
