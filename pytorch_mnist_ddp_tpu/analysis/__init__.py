@@ -9,25 +9,37 @@ equivalent guardrail, run as part of the test suite and CI:
 
 - :mod:`.engine` — AST rule engine: file walker, per-rule visitors,
   structured findings, inline ``# jaxlint: disable=RULE`` suppressions.
-- :mod:`.rules` — the JL001–JL009 rule set (see docs/ANALYSIS.md).
+- :mod:`.rules` — the JL001–JL018 rule set (see docs/ANALYSIS.md).
+- :mod:`.concurrency` — the JL019–JL021 concurrency pass: per-class
+  lock/thread indexing, lock-order cycles, unguarded shared state,
+  blocking calls under a lock (``--concurrency``).
+- :mod:`.lockwatch` — runtime lock-order tracer (``JAXLINT_LOCKWATCH=1``):
+  traced locks record acquisition orders into the obs registry and the
+  observed graph is asserted acyclic at teardown.
 - :mod:`.sentinel` — :class:`RecompileSentinel`, a runtime wrapper that
   fails tests when a jitted function retraces more than expected.
 
 CLI: ``python -m pytorch_mnist_ddp_tpu.analysis [paths] [--json]
-[--fail-on-warning]`` (or ``tools/jaxlint.py``).
+[--fail-on-warning] [--concurrency] [--rules JL0xx,...] [--baseline
+FILE]`` (or ``tools/jaxlint.py``).
 """
 
+from .concurrency import CONCURRENCY_RULES
 from .engine import Finding, LintEngine, Severity, iter_python_files
+from .lockwatch import LockOrderError, make_lock
 from .rules import ALL_RULES, rule_by_id
 from .sentinel import RecompileError, RecompileSentinel
 
 __all__ = [
     "ALL_RULES",
+    "CONCURRENCY_RULES",
     "Finding",
     "LintEngine",
+    "LockOrderError",
     "RecompileError",
     "RecompileSentinel",
     "Severity",
     "iter_python_files",
+    "make_lock",
     "rule_by_id",
 ]

@@ -12,6 +12,7 @@ import json
 import os
 import sys
 
+from .concurrency import CONCURRENCY_RULES
 from .engine import LintEngine, Severity
 from .rules import ALL_RULES
 
@@ -21,11 +22,35 @@ def _default_target() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _baseline_keys(path: str) -> set[tuple[str, str, str]] | None:
+    """Load a ``--baseline`` file: the ``--json`` report format (or a
+    bare findings list).  Findings match on (path, rule, message) —
+    line numbers drift with every edit, messages name the class/method
+    and move with the code."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError) as exc:
+        print(f"jaxlint: cannot read baseline {path}: {exc}", file=sys.stderr)
+        return None
+    rows = data.get("findings", data) if isinstance(data, dict) else data
+    keys: set[tuple[str, str, str]] = set()
+    for row in rows:
+        if isinstance(row, dict):
+            keys.add((
+                str(row.get("path", "")),
+                str(row.get("rule", row.get("rule_id", ""))),
+                str(row.get("message", "")),
+            ))
+    return keys
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="jaxlint",
-        description="AST-based JAX correctness analyzer (rules JL001-JL009; "
-        "see docs/ANALYSIS.md)",
+        description="AST-based JAX correctness analyzer (rules JL001-JL018, "
+        "concurrency rules JL019-JL021 via --concurrency; see "
+        "docs/ANALYSIS.md)",
     )
     parser.add_argument(
         "paths", nargs="*",
@@ -44,12 +69,49 @@ def main(argv: list[str] | None = None) -> int:
         "--list-rules", action="store_true",
         help="print the rule catalog and exit",
     )
+    parser.add_argument(
+        "--concurrency", action="store_true",
+        help="run the concurrency pass (JL019-JL021: lock order, "
+        "unguarded shared state, blocking under a lock) instead of the "
+        "default rule set",
+    )
+    parser.add_argument(
+        "--rules", metavar="JL0xx[,JL0yy]",
+        help="run only these rule ids (drawn from the active set; "
+        "composes with --concurrency)",
+    )
+    parser.add_argument(
+        "--baseline", metavar="FILE",
+        help="suppress findings recorded in FILE (a previous --json "
+        "report); only NEW findings count toward the exit code",
+    )
     args = parser.parse_args(argv)
 
+    rules = CONCURRENCY_RULES if args.concurrency else ALL_RULES
     if args.list_rules:
-        for rule in ALL_RULES:
+        for rule in rules:
             print(f"{rule.rule_id} [{rule.severity}] {rule.summary}")
         return 0
+
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+        by_id = {rule.rule_id: rule for rule in rules}
+        unknown = wanted - set(by_id)
+        if unknown:
+            print(
+                f"jaxlint: unknown rule id(s) for this rule set: "
+                f"{', '.join(sorted(unknown))}",
+                file=sys.stderr,
+            )
+            return 2
+        rules = tuple(rule for rule in rules if rule.rule_id in wanted)
+
+    baseline: set[tuple[str, str, str]] = set()
+    if args.baseline:
+        loaded = _baseline_keys(args.baseline)
+        if loaded is None:
+            return 2
+        baseline = loaded
 
     paths = args.paths or [_default_target()]
     for path in paths:
@@ -57,8 +119,16 @@ def main(argv: list[str] | None = None) -> int:
             print(f"jaxlint: no such path: {path}", file=sys.stderr)
             return 2
 
-    engine = LintEngine(ALL_RULES)
+    engine = LintEngine(rules)
     findings, suppressed = engine.run(paths)
+    if baseline:
+        kept = []
+        for f in findings:
+            if (f.path, f.rule_id, f.message) in baseline:
+                suppressed += 1
+            else:
+                kept.append(f)
+        findings = kept
     errors = sum(1 for f in findings if f.severity is Severity.ERROR)
     warnings = len(findings) - errors
 

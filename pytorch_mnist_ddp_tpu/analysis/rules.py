@@ -111,6 +111,77 @@ def iter_loop_body_nodes(loop: ast.AST) -> Iterator[ast.AST]:
         stack.extend(ast.iter_child_nodes(node))
 
 
+def is_jit_value(value: ast.AST) -> bool:
+    """Is this expression a jit-compiled callable?  True for the jit
+    constructors (``jax.jit(...)``/``pjit``/``pmap``) and for
+    ``RecompileSentinel(...)``, which wraps a jitted callable by contract
+    (sentinel.py rejects anything else at runtime).
+
+    The single source of truth for "is this name/attr a jit or launch
+    target" — JL007/JL009/JL010/JL011/JL013/JL016 and the concurrency
+    pass all resolve through here (it had drifted into three near-copies
+    before PR 16).
+    """
+    if not isinstance(value, ast.Call):
+        return False
+    name = dotted_name(value.func)
+    if name in _JIT_CONSTRUCTORS:
+        return True
+    return bool(name) and name.split(".")[-1] == "RecompileSentinel"
+
+
+def module_jit_names(tree: ast.Module) -> set[str]:
+    """Module-level names bound to jitted callables — visible inside
+    every function (the ``predict = jax.jit(...)`` -> ``def serve(...)``
+    shape)."""
+    out: set[str] = set()
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and is_jit_value(node.value)):
+            out.add(node.targets[0].id)
+    return out
+
+
+def jit_attr_names(tree: ast.Module) -> set[str]:
+    """Attribute names bound to jitted callables anywhere in the module
+    (``self._predict = RecompileSentinel(jax.jit(...))``)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and is_jit_value(node.value):
+            for target in node.targets:
+                if isinstance(target, ast.Attribute):
+                    out.add(target.attr)
+    return out
+
+
+def is_jit_call(node: ast.AST, jit_names: set[str], jit_attrs: set[str]) -> bool:
+    """Does this Call dispatch through a known jitted name or attr?"""
+    if not isinstance(node, ast.Call):
+        return False
+    if isinstance(node.func, ast.Name) and node.func.id in jit_names:
+        return True
+    return isinstance(node.func, ast.Attribute) and node.func.attr in jit_attrs
+
+
+def iter_scope_nodes(scope: ast.AST) -> list[ast.AST]:
+    """All nodes of one scope, not descending into nested scopes: a
+    module's top-level statements flattened (so ``if __name__`` guards
+    and try/except import shims are transparent), or a def/lambda body
+    via :func:`iter_own_body`."""
+    if isinstance(scope, ast.Module):
+        nodes: list[ast.AST] = []
+        stack: list[ast.AST] = list(scope.body)
+        while stack:
+            node = stack.pop()
+            nodes.append(node)
+            if not isinstance(node, _SCOPE_NODES):
+                stack.extend(ast.iter_child_nodes(node))
+        return nodes
+    return list(iter_own_body(scope))
+
+
 def _decorator_is_transform(dec: ast.AST) -> bool:
     name = dotted_name(dec)
     if name in _TRANSFORM_CALLS:
@@ -891,16 +962,9 @@ class BucketShapeRule(Rule):
     severity = Severity.WARNING
     summary = "jit-compiled call fed raw len()-dependent shapes; bucket them"
 
-    @staticmethod
-    def _is_jit_value(value: ast.AST) -> bool:
-        if not isinstance(value, ast.Call):
-            return False
-        name = dotted_name(value.func)
-        if name in _JIT_CONSTRUCTORS:
-            return True
-        # RecompileSentinel(jit_fn, ...) wraps a jitted callable by
-        # contract (sentinel.py rejects anything else at runtime).
-        return bool(name) and name.split(".")[-1] == "RecompileSentinel"
+    # Kept as an alias: callers and fixtures address the shared helper
+    # through the rule that introduced it.
+    _is_jit_value = staticmethod(is_jit_value)
 
     @staticmethod
     def _is_bucket_call(node: ast.AST) -> bool:
@@ -925,15 +989,7 @@ class BucketShapeRule(Rule):
         return None
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        # Module-level jit bindings are visible inside every function
-        # (the `predict = jax.jit(...)` -> `def serve(...)` shape).
-        module_jit: set[str] = set()
-        for node in ctx.tree.body:
-            if (isinstance(node, ast.Assign)
-                    and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
-                    and self._is_jit_value(node.value)):
-                module_jit.add(node.targets[0].id)
+        module_jit = module_jit_names(ctx.tree)
 
         scopes: list[ast.AST] = [ctx.tree] + [
             d for d in ast.walk(ctx.tree)
@@ -944,16 +1000,7 @@ class BucketShapeRule(Rule):
             # Bucket/pad helpers are where raw sizes legitimately live.
             if any(tag in label.lower() for tag in ("bucket", "pad")):
                 continue
-            if isinstance(scope, ast.Module):
-                nodes = []
-                stack = list(scope.body)
-                while stack:
-                    node = stack.pop()
-                    nodes.append(node)
-                    if not isinstance(node, _SCOPE_NODES):
-                        stack.extend(ast.iter_child_nodes(node))
-            else:
-                nodes = list(iter_own_body(scope))
+            nodes = iter_scope_nodes(scope)
             nodes.sort(key=lambda n: (getattr(n, "lineno", 0),
                                       getattr(n, "col_offset", 0)))
             jit_names = set(module_jit)
@@ -1026,27 +1073,10 @@ class BlockingReadLoopRule(Rule):
     severity = Severity.WARNING
     summary = "blocking host read of a jit output inside its dispatch loop"
 
-    @staticmethod
-    def _jit_attr_names(tree: ast.Module) -> set[str]:
-        """Attribute names bound to jitted callables anywhere in the
-        module (``self._predict = RecompileSentinel(jax.jit(...))``)."""
-        out: set[str] = set()
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Assign) and BucketShapeRule._is_jit_value(
-                node.value
-            ):
-                for target in node.targets:
-                    if isinstance(target, ast.Attribute):
-                        out.add(target.attr)
-        return out
-
-    @staticmethod
-    def _is_jit_call(node: ast.AST, jit_names: set[str], jit_attrs: set[str]) -> bool:
-        if not isinstance(node, ast.Call):
-            return False
-        if isinstance(node.func, ast.Name) and node.func.id in jit_names:
-            return True
-        return isinstance(node.func, ast.Attribute) and node.func.attr in jit_attrs
+    # Aliases for the shared helpers (historical access path; the bodies
+    # live at module level since PR 16's de-duplication sweep).
+    _jit_attr_names = staticmethod(jit_attr_names)
+    _is_jit_call = staticmethod(is_jit_call)
 
     @classmethod
     def _jit_output_taint(
@@ -1064,36 +1094,21 @@ class BlockingReadLoopRule(Rule):
         )
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        module_jit: set[str] = set()
-        for node in ctx.tree.body:
-            if (isinstance(node, ast.Assign)
-                    and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
-                    and BucketShapeRule._is_jit_value(node.value)):
-                module_jit.add(node.targets[0].id)
-        jit_attrs = self._jit_attr_names(ctx.tree)
+        module_jit = module_jit_names(ctx.tree)
+        jit_attrs = jit_attr_names(ctx.tree)
 
         scopes: list[ast.AST] = [ctx.tree] + [
             d for d in ast.walk(ctx.tree)
             if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
         ]
         for scope in scopes:
-            if isinstance(scope, ast.Module):
-                nodes: list[ast.AST] = []
-                stack = list(scope.body)
-                while stack:
-                    node = stack.pop()
-                    nodes.append(node)
-                    if not isinstance(node, _SCOPE_NODES):
-                        stack.extend(ast.iter_child_nodes(node))
-            else:
-                nodes = list(iter_own_body(scope))
+            nodes = iter_scope_nodes(scope)
             jit_names = set(module_jit)
             for node in nodes:
                 if (isinstance(node, ast.Assign)
                         and len(node.targets) == 1
                         and isinstance(node.targets[0], ast.Name)
-                        and BucketShapeRule._is_jit_value(node.value)):
+                        and is_jit_value(node.value)):
                     jit_names.add(node.targets[0].id)
             for node in nodes:
                 if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
@@ -1188,36 +1203,21 @@ class SerialWarmupRule(Rule):
         return False
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        module_jit: set[str] = set()
-        for node in ctx.tree.body:
-            if (isinstance(node, ast.Assign)
-                    and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
-                    and BucketShapeRule._is_jit_value(node.value)):
-                module_jit.add(node.targets[0].id)
-        jit_attrs = BlockingReadLoopRule._jit_attr_names(ctx.tree)
+        module_jit = module_jit_names(ctx.tree)
+        jit_attrs = jit_attr_names(ctx.tree)
 
         scopes: list[ast.AST] = [ctx.tree] + [
             d for d in ast.walk(ctx.tree)
             if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
         ]
         for scope in scopes:
-            if isinstance(scope, ast.Module):
-                nodes: list[ast.AST] = []
-                stack = list(scope.body)
-                while stack:
-                    node = stack.pop()
-                    nodes.append(node)
-                    if not isinstance(node, _SCOPE_NODES):
-                        stack.extend(ast.iter_child_nodes(node))
-            else:
-                nodes = list(iter_own_body(scope))
+            nodes = iter_scope_nodes(scope)
             jit_names = set(module_jit)
             for node in nodes:
                 if (isinstance(node, ast.Assign)
                         and len(node.targets) == 1
                         and isinstance(node.targets[0], ast.Name)
-                        and BucketShapeRule._is_jit_value(node.value)):
+                        and is_jit_value(node.value)):
                     jit_names.add(node.targets[0].id)
             for node in nodes:
                 if isinstance(node, (ast.For, ast.AsyncFor)):
@@ -1346,36 +1346,21 @@ class HostBlockingFeedRule(Rule):
         return None
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        module_jit: set[str] = set()
-        for node in ctx.tree.body:
-            if (isinstance(node, ast.Assign)
-                    and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
-                    and BucketShapeRule._is_jit_value(node.value)):
-                module_jit.add(node.targets[0].id)
-        jit_attrs = BlockingReadLoopRule._jit_attr_names(ctx.tree)
+        module_jit = module_jit_names(ctx.tree)
+        jit_attrs = jit_attr_names(ctx.tree)
 
         scopes: list[ast.AST] = [ctx.tree] + [
             d for d in ast.walk(ctx.tree)
             if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
         ]
         for scope in scopes:
-            if isinstance(scope, ast.Module):
-                nodes: list[ast.AST] = []
-                stack = list(scope.body)
-                while stack:
-                    node = stack.pop()
-                    nodes.append(node)
-                    if not isinstance(node, _SCOPE_NODES):
-                        stack.extend(ast.iter_child_nodes(node))
-            else:
-                nodes = list(iter_own_body(scope))
+            nodes = iter_scope_nodes(scope)
             jit_names = set(module_jit)
             for node in nodes:
                 if (isinstance(node, ast.Assign)
                         and len(node.targets) == 1
                         and isinstance(node.targets[0], ast.Name)
-                        and BucketShapeRule._is_jit_value(node.value)):
+                        and is_jit_value(node.value)):
                     jit_names.add(node.targets[0].id)
             for node in nodes:
                 if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
@@ -1621,14 +1606,8 @@ class SwallowedDispatchErrorRule(Rule):
         return True
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        module_jit: set[str] = set()
-        for node in ctx.tree.body:
-            if (isinstance(node, ast.Assign)
-                    and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
-                    and BucketShapeRule._is_jit_value(node.value)):
-                module_jit.add(node.targets[0].id)
-        jit_attrs = BlockingReadLoopRule._jit_attr_names(ctx.tree)
+        module_jit = module_jit_names(ctx.tree)
+        jit_attrs = jit_attr_names(ctx.tree)
         for loop in ast.walk(ctx.tree):
             if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
                 continue
@@ -2095,14 +2074,8 @@ class FixedLingerDispatchRule(Rule):
         return False
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        module_jit: set[str] = set()
-        for node in ctx.tree.body:
-            if (isinstance(node, ast.Assign)
-                    and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
-                    and BucketShapeRule._is_jit_value(node.value)):
-                module_jit.add(node.targets[0].id)
-        jit_attrs = BlockingReadLoopRule._jit_attr_names(ctx.tree)
+        module_jit = module_jit_names(ctx.tree)
+        jit_attrs = jit_attr_names(ctx.tree)
         for loop in ast.walk(ctx.tree):
             if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
                 continue
@@ -2112,7 +2085,7 @@ class FixedLingerDispatchRule(Rule):
             dispatches = any(
                 isinstance(n, ast.Call)
                 and (
-                    BlockingReadLoopRule._is_jit_call(n, module_jit, jit_attrs)
+                    is_jit_call(n, module_jit, jit_attrs)
                     or (isinstance(n.func, ast.Attribute)
                         and n.func.attr == "launch")
                 )

@@ -119,8 +119,10 @@ class RecompileSentinel:
         traces = self.trace_count()
         self._report_compiles(traces)
         if traces > self.max_traces:
+            with self._lock:
+                calls = self.calls
             raise RecompileError(
-                f"{self.name} retraced: {traces} traces after {self.calls} "
+                f"{self.name} retraced: {traces} traces after {calls} "
                 f"calls (expected <= {self.max_traces}). Something in the "
                 "call signature is unstable — look for changing shapes/"
                 "dtypes (last partial batch?), Python scalars that vary per "
@@ -136,7 +138,9 @@ class RecompileSentinel:
         return out
 
     def __repr__(self) -> str:  # pragma: no cover - debugging sugar
+        with self._lock:
+            calls = self.calls
         return (
             f"RecompileSentinel({self.name}, traces={self.trace_count()}/"
-            f"{self.max_traces}, calls={self.calls})"
+            f"{self.max_traces}, calls={calls})"
         )

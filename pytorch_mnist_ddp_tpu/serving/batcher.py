@@ -60,6 +60,7 @@ import time
 
 import numpy as np
 
+from ..analysis.lockwatch import make_lock
 from ..obs.spans import span
 from .buckets import StagingPool
 from .engine import InferenceEngine
@@ -125,7 +126,7 @@ class PendingRequest:
         # replica (flushes, expiry).
         self.completed_by: str | None = None
         self._event = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock("batcher.pending")
         self._value: np.ndarray | None = None
         self._error: BaseException | None = None
 
@@ -203,10 +204,15 @@ class PendingRequest:
         timeout = max(0.0, self.deadline - time.perf_counter()) + grace_s
         if not self._event.wait(timeout):
             raise RequestTimeout("request deadline expired")
-        if self._error is not None:
-            raise self._error
-        assert self._value is not None
-        return self._value
+        # Read the outcome under the same lock the setters hold: the
+        # event wait already orders the winning write before this read,
+        # but the lock keeps the (error, value, completed_by) triple one
+        # atomic cut — no torn view if a late loser is mid-no-op.
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+            assert self._value is not None
+            return self._value
 
 
 class AdaptiveLinger:
@@ -396,7 +402,7 @@ class MicroBatcher:
         # One spare staging slot beyond the window so batch N+1 pads
         # while the window is still full with batches N-k..N.
         self._staging: StagingPool | None = None
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = make_lock("batcher.inflight")
         self._inflight = 0
         self.peak_inflight = 0
         # Health signals the supervisor polls (serving/pool.py): launched
@@ -410,25 +416,32 @@ class MicroBatcher:
         # poll() — the supervisor's mtime-age signal
         # (liveness.Heartbeat.beat; None = flagless no-op).
         self._heartbeat = heartbeat
-        self._aborted = False
+        # Monotonic abort flag: an Event, not a lock-guarded bool — the
+        # fast paths (submit, dispatch, completion) read it without any
+        # lock and Event.set() publishes with the same release ordering
+        # the old under-lock store did.
+        self._aborted = threading.Event()
         self._closed = threading.Event()
-        self._stop_lock = threading.Lock()  # stop() is concurrency-safe
+        self._stop_lock = make_lock("batcher.stop")  # stop() is concurrency-safe
         self._worker: threading.Thread | None = None
         self._completer: threading.Thread | None = None
 
     # -- lifecycle ------------------------------------------------------------
 
     def start(self) -> "MicroBatcher":
-        if self._worker is not None:
-            raise RuntimeError("batcher already started")
-        self._worker = threading.Thread(
-            target=self._run, name="serve-dispatch", daemon=True
-        )
-        self._completer = threading.Thread(
-            target=self._complete_loop, name="serve-complete", daemon=True
-        )
-        self._completer.start()
-        self._worker.start()
+        # Same lock as _stop_locked: a start() racing a concurrent
+        # stop() must see either no workers or both, never a torn pair.
+        with self._stop_lock:
+            if self._worker is not None:
+                raise RuntimeError("batcher already started")
+            self._worker = threading.Thread(
+                target=self._run, name="serve-dispatch", daemon=True
+            )
+            self._completer = threading.Thread(
+                target=self._complete_loop, name="serve-complete", daemon=True
+            )
+            self._completer.start()
+            self._worker.start()
         return self
 
     def stop(self, drain: bool = True) -> None:
@@ -443,7 +456,7 @@ class MicroBatcher:
         shutdown path's ``Router.stop()``): calls serialize, and the
         loser sees already-joined workers and returns.
         """
-        if self._aborted:
+        if self._aborted.is_set():
             # An aborted batcher's completion worker may be permanently
             # stuck inside a dead replica's D2H read; abort() already
             # completed every waiter, so there is nothing to drain and a
@@ -457,14 +470,14 @@ class MicroBatcher:
         if not drain:
             self._flush_rejected()
         if self._worker is not None:
-            self._worker.join()
+            self._worker.join()  # jaxlint: disable=JL021 -- the join IS the drain: stop() holds _stop_lock exactly so concurrent stops serialize behind worker exit; admission is already closed, so the wait is bounded
             self._worker = None
         # The dispatch worker has exited, so every launched batch is
         # already enqueued; the sentinel lands strictly after them and
         # the join below proves the in-flight window fully drained.
         if self._completer is not None:
             self._completions.put(None)
-            self._completer.join()
+            self._completer.join()  # jaxlint: disable=JL021 -- stop-path serialization, same contract as the dispatch-worker join above; the sentinel just enqueued guarantees exit
             self._completer = None
         # A submit() racing stop() can land a request AFTER the worker saw
         # the empty queue and exited; without this flush that request would
@@ -512,7 +525,7 @@ class MicroBatcher:
         """
         self._closed.set()
         with self._inflight_lock:
-            self._aborted = True
+            self._aborted.set()
             live = list(self._live)
             # Zero the in-flight bookkeeping NOW: a permanently wedged
             # completion worker never reaches its finally block, so
@@ -679,7 +692,7 @@ class MicroBatcher:
         # run and will sweep this request; if True, we sweep it
         # ourselves.  Either way the waiter gets ReplicaDeadError and
         # the handler retries on a survivor instead of idling into 504.
-        if self._aborted:
+        if self._aborted.is_set():
             self._flush_dead()
         return req
 
@@ -794,7 +807,7 @@ class MicroBatcher:
                     "evicted under pressure while a hedge was declined"
                 ))
             raise RejectedError("admission queue full; hedge declined") from None
-        if self._aborted:
+        if self._aborted.is_set():
             self._flush_dead()
 
     # -- dispatch worker ------------------------------------------------------
@@ -986,9 +999,9 @@ class MicroBatcher:
             # twitching — striking the restarted replica's breaker
             # would re-open a healthy half-open circuit, and these
             # requests were already flushed and retried.
-            if self.metrics is not None and not self._aborted and failed:
+            if self.metrics is not None and not self._aborted.is_set() and failed:
                 self.metrics.record_failed(failed)
-            if self.on_failure is not None and not self._aborted:
+            if self.on_failure is not None and not self._aborted.is_set():
                 try:
                     self.on_failure(len(batch))
                 except Exception:
@@ -998,7 +1011,7 @@ class MicroBatcher:
         item = _InFlight(batch, logits, staged, bucket, total, stall_s, dtype)
         aborted = False
         with self._inflight_lock:
-            aborted = self._aborted
+            aborted = self._aborted.is_set()
             if not aborted:
                 self._live.add(item)
                 self._inflight += 1
@@ -1073,9 +1086,9 @@ class MicroBatcher:
                 # RESTARTED batcher — a late failure striking it would
                 # re-open a healthy half-open circuit and march the
                 # supervisor's ladder toward a spurious ejection.
-                if self.metrics is not None and not self._aborted and failed:
+                if self.metrics is not None and not self._aborted.is_set() and failed:
                     self.metrics.record_failed(failed)
-                if self.on_failure is not None and not self._aborted:
+                if self.on_failure is not None and not self._aborted.is_set():
                     try:
                         self.on_failure(len(item.batch))
                     except Exception:
@@ -1100,7 +1113,7 @@ class MicroBatcher:
                 # restarted replica's half-open circuit with zero real
                 # trials.  set_result stays — first-wins discards it for
                 # already-errored waiters.
-                aborted = self._aborted
+                aborted = self._aborted.is_set()
                 offset = 0
                 for req in item.batch:
                     # First-wins gate doubles as the hedge cancellation

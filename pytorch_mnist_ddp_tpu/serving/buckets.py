@@ -18,10 +18,11 @@ without device init.
 
 from __future__ import annotations
 
-import threading
 from typing import Sequence
 
 import numpy as np
+
+from ..analysis.lockwatch import make_lock
 
 # Default ladder ceiling: 128 matches the training eval batch order of
 # magnitude; ~8 executables from bucket 1, 5 from bucket 8.
@@ -139,7 +140,7 @@ class StagingPool:
             raise ValueError(f"need >= 1 staging slot per bucket, got {slots}")
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.slots = slots
-        self._cond = threading.Condition()
+        self._cond = make_lock("buckets.staging", kind="condition")
         self._free: dict[int, list[np.ndarray]] = {
             b: [np.zeros((b, *item_shape), dtype) for _ in range(slots)]
             for b in self.buckets

@@ -44,6 +44,8 @@ import hashlib
 import threading
 from collections import OrderedDict
 
+from ..analysis.lockwatch import make_lock
+
 # claim() outcomes (also the serving_cache_total{outcome=} label values;
 # docs/OBSERVABILITY.md).
 HIT = "hit"
@@ -129,7 +131,7 @@ class ResponseCache:
         self.sink = sink
         self.scope = scope
         self._generation = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("cache.response")
         self._done: OrderedDict[tuple, object] = OrderedDict()
         self._pending: dict[tuple, Flight] = {}
         if metrics is not None:
@@ -145,10 +147,13 @@ class ResponseCache:
         previous engine/weights unreachable after a swap.  Multiple
         buffer-protocol ``payload_parts`` hash in sequence without
         being concatenated — no payload-sized copy at either tier."""
-        return (
-            self._generation, self.model_digest, dtype,
-            payload_digest(*payload_parts),
-        )
+        digest = payload_digest(*payload_parts)
+        # Generation and model digest mutate together under the lock in
+        # invalidate(); reading them lock-free could mint a chimera key
+        # (old generation, new digest) mid-swap that wrongly misses —
+        # or, worse, collides with — a post-swap fill.
+        with self._lock:
+            return (self._generation, self.model_digest, dtype, digest)
 
     # -- the single-flight protocol -------------------------------------------
 
@@ -215,13 +220,14 @@ class ResponseCache:
         correctness over hit rate)."""
         with self._lock:
             self._generation += 1
+            generation = self._generation
             if model_digest is not None:
                 self.model_digest = model_digest
             self._done.clear()
         if self.sink:
             self.sink.emit(
                 "cache_invalidate", scope=self.scope,
-                generation=self._generation,
+                generation=generation,
             )
 
     def stats(self) -> dict:

@@ -30,7 +30,7 @@ proxy threads) record while placement threads check.
 
 from __future__ import annotations
 
-import threading
+from ..analysis.lockwatch import make_lock
 
 # Circuit states, and the numeric encoding the serving_circuit_state
 # gauge exports (docs/OBSERVABILITY.md): 0 = closed (healthy), 1 =
@@ -71,7 +71,7 @@ class CircuitBreaker:
         self._consecutive_failures = 0
         self._trial_inflight = 0
         self._trial_passed = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("circuit.breaker")
         self._sink = sink
         self._gauge = (
             registry.gauge(

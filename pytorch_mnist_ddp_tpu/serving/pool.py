@@ -52,6 +52,7 @@ import threading
 import time
 from typing import Any, Sequence
 
+from ..analysis.lockwatch import make_lock
 from ..liveness import BackoffLadder
 from ..parallel.mesh import replica_devices, single_device_mesh
 from .buckets import DEFAULT_MAX_BUCKET, pow2_buckets
@@ -431,7 +432,7 @@ class EnginePool:
         self.supervisor: ReplicaSupervisor | None = None
         self._batcher_kwargs: dict = {}
         self._sink = None
-        self._add_lock = threading.Lock()
+        self._add_lock = make_lock("pool.add")
 
     # -- construction helpers (the engine's surface, pool-shaped) -------------
 

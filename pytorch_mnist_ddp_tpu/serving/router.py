@@ -71,6 +71,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..analysis.lockwatch import make_lock
 from .batcher import MicroBatcher, PendingRequest, RejectedError
 from .circuit import (  # noqa: F401 - canonical home since the fleet tier; re-exported
     CIRCUIT_CLOSED,
@@ -255,7 +256,7 @@ class HedgeManager:
         self.min_samples = min_samples
         self.digest_refresh_s = digest_refresh_s
         self._entries: list[_HedgeEntry] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("router.hedge")
         self._p99: dict[str, tuple[float, float | None]] = {}  # qos -> (t, p99)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -452,7 +453,7 @@ class Router:
         self.metrics = metrics
         self._registry = registry
         self._sink = sink
-        self._lock = threading.Lock()
+        self._lock = make_lock("router.replicas")
         self._rr = 0
         self._breaker_kwargs = dict(
             failure_threshold=failure_threshold,

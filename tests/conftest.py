@@ -26,6 +26,16 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+from pytorch_mnist_ddp_tpu.analysis import lockwatch  # noqa: E402
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Under JAXLINT_LOCKWATCH=1 every make_lock() in the serving stack
+    is traced; assert at teardown that no two locks were ever taken in
+    opposite orders anywhere in the whole run (runtime JL019)."""
+    if lockwatch.enabled():
+        lockwatch.assert_acyclic()
+
 
 @pytest.fixture(scope="session")
 def devices():

@@ -2106,5 +2106,22 @@ def main(argv: list[str] | None = None) -> int:
     return chaos_rc
 
 
+def _lockwatch_gate(rc: int) -> int:
+    """Under JAXLINT_LOCKWATCH=1, fail the run if the traced locks
+    recorded a lock-order cycle — the runtime half of jaxlint JL019,
+    checked against REAL serving traffic after the load completes."""
+    from pytorch_mnist_ddp_tpu.analysis import lockwatch
+
+    if not lockwatch.enabled():
+        return rc
+    try:
+        lockwatch.assert_acyclic()
+    except lockwatch.LockOrderError as e:
+        print(f"LOCK ORDER CYCLE: {e}", file=sys.stderr)
+        return rc or 3
+    print("lockwatch: lock acquisition order acyclic")
+    return rc
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_lockwatch_gate(main()))
