@@ -1,7 +1,7 @@
-"""The jaxlint rule set: JL001–JL018, the JAX hazards this repo has
-actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution work, the
-serving layer's per-request-shape retrace class, the telemetry layer's
-record-at-trace-time class, the serving pipeline's
+"""The jaxlint rule set: JL001–JL018 and JL022, the JAX hazards this
+repo has actually paid for (docs/ROUND3.md, docs/ROUND5.md attribution
+work, the serving layer's per-request-shape retrace class, the telemetry
+layer's record-at-trace-time class, the serving pipeline's
 blocking-read-in-dispatch-loop class, the startup phase's serial-warmup
 class, the steady-state input pipeline's host-blocking-feed class, the
 replica pool's per-replica-re-trace class, the fault-tolerance
@@ -9,8 +9,10 @@ layer's swallowed-dispatch-error class, the resilient trainer's
 torn-file / uncadenced-checkpoint-write class, the elastic
 runtime's unbounded-rendezvous / unsupervised-launch class, the
 tail-latency layer's deadline-blind fixed-linger class, the fleet
-tier's timeout-less blocking-network-read class, and the host hot
-path's float-list-JSON-in-a-serve-loop class).
+tier's timeout-less blocking-network-read class, the host hot
+path's float-list-JSON-in-a-serve-loop class, and the model
+registry's weights-mutated-behind-the-registry class; JL019–JL021,
+the concurrency pass, live in :mod:`.concurrency`).
 
 Every rule is a heuristic over one module's AST — no type inference, no
 cross-file call graph.  "Traced context" below means: a function that is
@@ -2317,6 +2319,134 @@ class FloatListJSONLoopRule(Rule):
                     )
 
 
+# ---------------------------------------------------------------------------
+# JL022 — weights loaded or mutated behind the registry's back (serving)
+
+
+# Checkpoint-load spellings whose return value is a live weight tree.
+# Matched by last segment too (`checkpoint.load_state_dict(...)` and the
+# bare from-import both fire): unlike the transform table, a serving
+# module has no legitimate same-named local helper.
+_WEIGHT_LOAD_CALLS = {
+    "load_inference_variables", "load_state_dict", "load_variables",
+}
+
+# Attributes that ARE the serving weight surface: reassigning them on a
+# foreign object is a weight swap that skips digest verification, cache
+# invalidation, and the registry manifest.
+_WEIGHT_SURFACE_ATTRS = {"variables", "weights_digest"}
+
+# Modules that legitimately own the weight surface.  registry.py is the
+# taught idiom itself; rollout.py drives it; engine.py implements the
+# publish/install primitives the registry calls; checkpoint helpers and
+# tests are out of scope by the serving/ path gate.
+_REGISTRY_SURFACE_MODULES = {"registry.py", "rollout.py", "engine.py"}
+
+
+class RegistryBypassRule(Rule):
+    """JL022: a serving module loads checkpoint weights or mutates the
+    engine weight surface directly instead of going through the model
+    registry.
+
+    The model registry's hazard class (docs/SERVING.md): once
+    ``ModelRegistry`` owns (model, version) → (checkpoint, digest,
+    Program grid), any serving-side code that calls
+    ``load_inference_variables(path)`` itself — or reassigns
+    ``engine.variables`` / ``engine.weights_digest`` from outside the
+    engine — creates a weight state the registry cannot see: the served
+    digest no longer matches the manifest, the response cache keeps
+    answering from the OLD weights (its keys embed the digest the
+    registry last published), and a later swap/rollback restores a
+    version the operator never knew had been displaced.  The taught
+    idiom is the registry surface (serving/registry.py):
+    ``ModelRegistry.resolve()`` + ``load()`` to get verified weights,
+    ``publish()`` to admit a checkpoint, and
+    ``RolloutController.swap()`` / ``engine.publish_weights()`` for a
+    live cutover — digest-checked, cache-invalidating, on the record.
+
+    Heuristics: applies only to modules under a ``serving/`` path
+    component, excluding the registry surface itself (``registry.py``,
+    ``rollout.py``, ``engine.py``).  Fires on (a) any call whose name's
+    last segment is a checkpoint-load spelling
+    (``load_inference_variables`` / ``load_state_dict`` /
+    ``load_variables``), and (b) any assignment whose target is
+    ``<non-self>.variables`` or ``<non-self>.weights_digest``
+    (``self.variables = ...`` in a module's own constructor is that
+    module's own state, not a foreign engine's).  A pre-registry CLI
+    path (``--checkpoint`` without ``--registry``) is waived inline
+    with a reason.
+    """
+
+    rule_id = "JL022"
+    severity = Severity.WARNING
+    summary = (
+        "checkpoint weights loaded or engine weight surface mutated "
+        "outside the model registry in a serving module"
+    )
+
+    @staticmethod
+    def _in_scope(ctx: ModuleContext) -> bool:
+        parts = ctx.path.replace("\\", "/").split("/")
+        if "serving" not in parts[:-1]:
+            return False
+        return parts[-1] not in _REGISTRY_SURFACE_MODULES
+
+    @staticmethod
+    def _load_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = dotted_name(node.func)
+        if not name:
+            return False
+        return name.rsplit(".", 1)[-1] in _WEIGHT_LOAD_CALLS
+
+    @staticmethod
+    def _foreign_weight_target(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr in _WEIGHT_SURFACE_ATTRS
+            and not (isinstance(node.value, ast.Name)
+                     and node.value.id == "self")
+        )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not self._in_scope(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if self._load_call(node):
+                yield self.finding(
+                    ctx, node,
+                    "checkpoint weights loaded directly in a serving "
+                    "module: the registry cannot see this weight state "
+                    "— the served digest diverges from the manifest and "
+                    "the response cache keys stay pinned to the last "
+                    "published digest; resolve through the registry "
+                    "surface instead (serving/registry.py "
+                    "ModelRegistry.resolve()/load(), publish() to admit "
+                    "a new checkpoint)",
+                )
+                continue
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for target in targets:
+                if self._foreign_weight_target(target):
+                    yield self.finding(
+                        ctx, node,
+                        "engine weight surface mutated from outside the "
+                        "engine: reassigning .variables/.weights_digest "
+                        "behind the registry skips digest verification "
+                        "and cache invalidation — a torn or invisible "
+                        "swap; use engine.publish_weights() via "
+                        "RolloutController.swap() "
+                        "(serving/rollout.py) so the cutover is "
+                        "digest-checked, cache-invalidating, and on "
+                        "the record",
+                    )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -2336,6 +2466,7 @@ ALL_RULES: tuple[Rule, ...] = (
     FixedLingerDispatchRule(),
     BlockingNetReadLoopRule(),
     FloatListJSONLoopRule(),
+    RegistryBypassRule(),
 )
 
 

@@ -275,13 +275,21 @@ def predict_config(
     use_bn: bool,
     conv_impl: str,
     device_stage: bool,
+    version: str = "",
 ) -> dict:
     """AOT key config for one serving-forward rung (dtype x bucket).
 
     Field-for-field the serving engine's historical composition —
     concrete device ids included, because a serialized executable pins
     its compile-time devices (two same-shape meshes on different
-    devices must never alias one entry).
+    devices must never alias one entry).  ``version`` is the model
+    registry's (model, version) identity (serving/registry.py): two
+    versions of the same model get DISTINCT store entries, so their
+    Program grids coexist in one shared ExecutableStore and a canary or
+    rolled-back version warm-starts without evicting the primary's
+    rungs.  The unversioned surfaces (single-checkpoint engine, trainer
+    handoff) pass the default ``""`` and keep digest-matching each
+    other.
     """
     import jax
 
@@ -295,6 +303,7 @@ def predict_config(
         "conv_impl": conv_impl,
         "device_stage": bool(device_stage),
         "prng_impl": str(jax.config.jax_default_prng_impl),
+        "version": str(version),
     }
 
 
@@ -331,6 +340,7 @@ def serving_predict_programs(
     use_bn: bool = False,
     conv_impl: str = "conv",
     device_stage: bool | None = None,
+    version: str = "",
 ) -> list[Program]:
     """Trainer-side twin of the serving engine's f32 warmup grid — the
     train-to-serve handoff.
@@ -383,7 +393,7 @@ def serving_predict_programs(
                 example_args=(var_spec, x_spec),
                 config=predict_config(
                     mesh, "f32", b, use_bn=use_bn, conv_impl=conv_impl,
-                    device_stage=device_stage,
+                    device_stage=device_stage, version=version,
                 ),
                 store=store,
             )

@@ -41,6 +41,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="init seed when no --checkpoint is given (default 1, the "
         "reference's)",
     )
+    parser.add_argument(
+        "--registry", default=None, metavar="DIR",
+        help="serve from a model registry directory (serving/registry.py): "
+        "load the manifest's default (model, version) entry, route the "
+        '/predict "model"/"version" fields through the registry, and '
+        "expose POST /admin/{swap,canary,rollback} — zero-downtime "
+        "weight swap, deterministic canary split, auto-rollback "
+        "(docs/SERVING.md).  Mutually exclusive with --checkpoint",
+    )
+    parser.add_argument(
+        "--canary", type=float, default=None, metavar="PCT",
+        help="with --registry: start with a live canary serving the "
+        "default model's HIGHEST non-default version to PCT%% of "
+        "unpinned traffic (same deterministic payload-hash split as "
+        "POST /admin/canary)",
+    )
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument(
@@ -280,6 +296,23 @@ def main(argv: list[str] | None = None) -> int:
         print(f"error: --response-cache must be >= 1, got "
               f"{args.response_cache}")
         return 2
+    # Registry flag surface (fail in milliseconds, before any jax
+    # import or warmup).  With --fleet both flags propagate to every
+    # backend unchanged (they are not in fleet.py's front-only strip
+    # lists); the jax-free front itself ignores them.
+    if args.registry and args.checkpoint:
+        print("error: --registry and --checkpoint are mutually exclusive "
+              "(the registry's manifest names the checkpoint)")
+        return 2
+    if args.canary is not None:
+        if not args.registry:
+            print("error: --canary needs --registry (the canary version "
+                  "comes from the manifest)")
+            return 2
+        if not 0.0 < args.canary <= 100.0:
+            print(f"error: --canary must be in (0, 100], got "
+                  f"{args.canary:g}")
+            return 2
     if args.fleet is not None:
         # The fleet front is a pure control plane + proxy: no engine, no
         # checkpoint, no jax — it must come up instantly and keep
@@ -415,7 +448,41 @@ def main(argv: list[str] | None = None) -> int:
         engine_kwargs["replicas"] = args.replicas or None
     else:
         factory = InferenceEngine
-    if args.checkpoint:
+    registry = entry = canary_version = None
+    if args.registry:
+        # Registry mode (docs/SERVING.md model registry): the manifest's
+        # default alias names what this process serves; the engine pins
+        # that version so its Program grid keys under it in the shared
+        # AOT store (per-version grids coexist — warm swaps).
+        from .registry import ModelRegistry
+
+        registry = ModelRegistry(args.registry)
+        try:
+            entry = registry.resolve()
+            if args.canary is not None:
+                candidates = [
+                    v for v in registry.versions(entry.model)
+                    if v != entry.version
+                ]
+                if not candidates:
+                    print(
+                        f"error: --canary needs a second registered "
+                        f"version of {entry.model!r}; the manifest only "
+                        f"has {entry.version!r}"
+                    )
+                    return 2
+                canary_version = candidates[-1]
+            print(
+                f"registry {args.registry}: serving "
+                f"{entry.model}@{entry.version} "
+                f"(digest {entry.digest[:12]})"
+            )
+            engine_kwargs["version"] = entry.version
+            engine = factory(registry.load(entry), **engine_kwargs)
+        except ValueError as e:
+            print(f"error: --registry {args.registry}: {e}")
+            return 2
+    elif args.checkpoint:
         print(f"loading checkpoint {args.checkpoint}")
         engine = factory.from_checkpoint(args.checkpoint, **engine_kwargs)
     else:
@@ -535,6 +602,13 @@ def main(argv: list[str] | None = None) -> int:
         qos_weights=qos_weights,
         heartbeat=hb.beat if hb is not None else None,
     )
+    rollout = None
+    if registry is not None:
+        from .rollout import RolloutController
+
+        rollout = RolloutController(
+            registry, engine, metrics=metrics, sink=sink,
+        )
     if pool_mode:
         router = engine.start(
             router_policy=args.router_policy, sink=sink,
@@ -550,14 +624,24 @@ def main(argv: list[str] | None = None) -> int:
         server = make_server(
             engine, metrics, host=args.host, port=args.port, batcher=router,
             request_timeout_s=args.request_timeout_s,
-            response_cache=args.response_cache, sink=sink,
+            response_cache=args.response_cache, sink=sink, rollout=rollout,
         )
     else:
         server = make_server(
             engine, metrics, host=args.host, port=args.port,
             sink=sink, request_timeout_s=args.request_timeout_s,
-            response_cache=args.response_cache,
+            response_cache=args.response_cache, rollout=rollout,
             **batcher_kwargs,
+        )
+    if rollout is not None and canary_version is not None:
+        # Startup canary (--canary PCT): same path as POST /admin/canary
+        # — pinned variants installed (zero traces), breaker armed, the
+        # divergence probe already run.
+        rollout.start_canary(canary_version, args.canary)
+        print(
+            f"canary: {entry.model}@{canary_version} at "
+            f"{args.canary:g}% of unpinned traffic (deterministic "
+            "payload-hash split, auto-rollback armed)"
         )
     if args.response_cache:
         # Printed only when the flag is set: flagless stdout stays

@@ -63,6 +63,14 @@ from .metrics import ServingMetrics
 # The default (reference-precision) variant every engine serves.
 DEFAULT_DTYPE = "f32"
 
+# Separator between a dtype and a pinned model version in a variant key
+# ("f32@v2"): the registry/rollout tier (serving/registry.py) installs a
+# canary version's weights as parallel variants under these keys, so the
+# batcher coalesces canary traffic separately and a batch is NEVER mixed
+# across versions.  Client-facing "dtype" fields must not contain it
+# (the server rejects them); only the rollout controller mints keys.
+VERSION_SEP = "@"
+
 # Reduced-precision variants an engine can additionally serve; each must
 # pass its parity gate before a single request is dispatched to it.
 VARIANT_DTYPES = ("bf16", "int8")
@@ -177,7 +185,13 @@ class InferenceEngine:
         dtypes: Sequence[str] | None = None,
         aot_cache: str | None = None,
         device_stage: bool | None = None,
+        version: str = "",
     ):
+        # The model-registry version identity of the served weights
+        # ("" = the unversioned single-checkpoint path, which keeps the
+        # canonical predict_config digest — and therefore cross-surface
+        # AOT reuse with the trainer handoff — exactly as before).
+        self.version = str(version)
         self.mesh = mesh if mesh is not None else make_mesh()
         n_shards = self.mesh.shape[DATA_AXIS]
         if buckets is None:
@@ -394,8 +408,18 @@ class InferenceEngine:
         """Distinct traces of the forward across every variant (== warmed
         buckets x variants once warmup has run in jit mode, 0 in AOT
         mode where executables deserialize; the /metrics ``compiles``
-        field)."""
-        return sum(v.predict.trace_count() for v in self._variants.values())
+        field).  Version-pinned canary variants SHARE their base
+        variant's sentinel (a canary install adds zero traces), so the
+        sum deduplicates by sentinel identity instead of double-counting
+        a shared budget."""
+        seen: set[int] = set()
+        total = 0
+        for v in self._variants.values():
+            if id(v.predict) in seen:
+                continue
+            seen.add(id(v.predict))
+            total += v.predict.trace_count()
+        return total
 
     def _stage(self, staged):
         """Commit a padded host batch to the data-axis sharding (async
@@ -437,10 +461,18 @@ class InferenceEngine:
                     self._stage(np.zeros((b, *INPUT_SHAPE), np.float32)),
                 ),
                 config=predict_config(
-                    self.mesh, v.name, b,
+                    self.mesh, v.name.split(VERSION_SEP)[0], b,
                     use_bn=self.use_bn,
                     conv_impl=self._conv_impl,
                     device_stage=self.device_stage,
+                    # A version-pinned variant ("f32@v2") keys the store
+                    # under ITS version; the primary keys under the
+                    # engine's ("" on the unversioned path — digest
+                    # compatibility with the trainer handoff).
+                    version=(
+                        v.name.split(VERSION_SEP, 1)[1]
+                        if VERSION_SEP in v.name else self.version
+                    ),
                 ),
                 store=self._aot_store if v.aot else None,
             )
@@ -576,8 +608,6 @@ class InferenceEngine:
         put real ties inside the quantization error, and the gate
         refusing to serve that is the gate working.
         """
-        from ..data.transforms import normalize
-
         pending = [
             v for v in self._variants.values()
             if v.name != DEFAULT_DTYPE and not v.verified
@@ -585,12 +615,7 @@ class InferenceEngine:
         results: dict[str, dict] = {}
         if not pending:
             return results
-        fits = [b for b in self.buckets if b <= PARITY_ROWS]
-        bucket = fits[-1] if fits else self.buckets[0]
-        raw = np.random.RandomState(PARITY_SEED).randint(
-            0, 256, (bucket, 28, 28)
-        ).astype(np.uint8)
-        x = normalize(raw)
+        x, bucket = self._parity_slice()
         ref = np.asarray(self._run_variant(self._variants[DEFAULT_DTYPE], x))
         registry = self.metrics.registry if self.metrics is not None else None
         for v in pending:
@@ -633,6 +658,187 @@ class InferenceEngine:
                     )
                 )
         return results
+
+    def _parity_slice(self) -> tuple[np.ndarray, int]:
+        """The fixed, seeded eval slice every gate dispatches (parity
+        gates AND the rollout controller's canary-drift probe) — one
+        composition so both speak about the same inputs.  Rides a
+        warmed bucket shape: zero new traces."""
+        from ..data.transforms import normalize
+
+        fits = [b for b in self.buckets if b <= PARITY_ROWS]
+        bucket = fits[-1] if fits else self.buckets[0]
+        raw = np.random.RandomState(PARITY_SEED).randint(
+            0, 256, (bucket, 28, 28)
+        ).astype(np.uint8)
+        return normalize(raw), bucket
+
+    # -- the registry swap surface (serving/registry.py, rollout.py) ----------
+    #
+    # Weight mutation enters the engine ONLY through these methods (the
+    # jaxlint JL022 idiom): every variant's forward reads ``v.variables``
+    # exactly once per dispatch (_run_variant), so one Python attribute
+    # reassignment per variant is an atomic cutover — a request is served
+    # ENTIRELY by old or entirely by new weights, never torn — and the
+    # compiled executables are keyed by shape, taking weights as a call
+    # argument, so a swap or canary install adds ZERO traces.
+
+    def _prepare_weights(self, variables: dict[str, Any]):
+        """Validate + place an incoming variable tree against the served
+        tree: same BN-ness, same structure, same leaf shapes — the
+        compiled executables are specialized to those avals, and a
+        mismatched tree must be refused here, not crash a dispatch."""
+        use_bn = "bn1" in variables.get("params", {})
+        if use_bn != self.use_bn:
+            raise ValueError(
+                f"cannot publish a {'BN' if use_bn else 'non-BN'} "
+                f"checkpoint into a {'BN' if self.use_bn else 'non-BN'} "
+                "engine: the warmed executables are specialized to the "
+                "served tree"
+            )
+        if use_bn and "batch_stats" not in variables:
+            variables = dict(variables)
+            variables["batch_stats"] = init_variables(
+                jax.random.PRNGKey(0), use_bn=True
+            )["batch_stats"]
+        served = (
+            {"params": variables["params"],
+             "batch_stats": variables["batch_stats"]}
+            if self.use_bn
+            else variables["params"]
+        )
+        new_leaves, new_def = jax.tree_util.tree_flatten(served)
+        cur_leaves, cur_def = jax.tree_util.tree_flatten(
+            self._variants[DEFAULT_DTYPE].variables
+        )
+        if new_def != cur_def or [
+            np.shape(a) for a in new_leaves
+        ] != [np.shape(a) for a in cur_leaves]:
+            raise ValueError(
+                "published variable tree does not match the served tree "
+                "(structure or leaf shapes differ); versions of one "
+                "model must share an architecture — register a new "
+                "model name for a new architecture instead"
+            )
+        digest = weights_digest(served)
+        placed = replicate_params(served, self.mesh)
+        return variables, digest, placed
+
+    def _variant_weights(self, name: str, variables, placed):
+        """The per-variant placed tree for a published checkpoint: int8
+        re-quantizes from host params (same construction as
+        _build_variant); f32 and bf16 share the placed f32 tree."""
+        if name.split(VERSION_SEP)[0] != "int8":
+            return placed
+        from ..models.quant import quantize_params
+
+        return replicate_params(
+            quantize_params(jax.device_get(variables["params"])),
+            self.mesh,
+        )
+
+    def publish_weights(
+        self, variables: dict[str, Any], version: str | None = None
+    ) -> str:
+        """Atomically republish the PRIMARY served weights in place —
+        the replica-tier half of a zero-downtime swap (docs/SERVING.md
+        swap state machine; the fleet tier rolls per backend).
+
+        Every primary variant's ``variables`` is reassigned (int8
+        re-quantized from the new host params); version-pinned canary
+        variants keep their own weights.  In-flight batches that read
+        the old tree complete on it; the next dispatch reads the new
+        one.  Returns the new weights digest — the caller (rollout
+        controller) bumps the response-cache generation with it so no
+        stale fill survives the cutover."""
+        variables, digest, placed = self._prepare_weights(variables)
+        cache: dict[str, Any] = {}
+        for key, v in self._variants.items():
+            if VERSION_SEP in key:
+                continue
+            base = key.split(VERSION_SEP)[0]
+            if base not in cache:
+                cache[base] = self._variant_weights(key, variables, placed)
+            v.variables = cache[base]
+        self._variables = placed
+        self.weights_digest = digest
+        if version is not None:
+            self.version = str(version)
+        return digest
+
+    def install_version(
+        self,
+        version: str,
+        variables: dict[str, Any],
+        verified: bool | None = None,
+    ) -> str:
+        """Install VERSION's weights as parallel variants beside the
+        primary — the canary mechanism (serving/rollout.py).
+
+        Each base dtype grows a ``{dtype}@{version}`` twin holding the
+        new weights but SHARING the base variant's sentinel and Program
+        grid (executables are shape-keyed and take weights per call), so
+        the install adds zero traces and canary traffic batches
+        separately from primary traffic — no batch ever mixes versions.
+        ``verified`` overrides the gate state (default: inherit the base
+        variant's — the registry manifest records the version's own
+        parity verdict and the rollout controller enforces it)."""
+        version = str(version)
+        if not version or VERSION_SEP in version:
+            raise ValueError(
+                f"bad version {version!r}: must be non-empty and free of "
+                f"{VERSION_SEP!r}"
+            )
+        variables, digest, placed = self._prepare_weights(variables)
+        for name, base in [
+            (n, v) for n, v in self._variants.items() if VERSION_SEP not in n
+        ]:
+            key = f"{name}{VERSION_SEP}{version}"
+            nv = _Variant(
+                key, base.jit_fn, base.predict,
+                self._variant_weights(name, variables, placed),
+                verified=base.verified if verified is None else verified,
+            )
+            nv.programs = base.programs  # shared shape-keyed grid
+            nv.aot = base.aot
+            self._variants[key] = nv
+        return digest
+
+    def remove_version(self, version: str) -> int:
+        """Drop VERSION's pinned variants (rollback, or post-promote
+        cleanup).  Shared Programs/sentinels stay with their base
+        variants; in-flight batches already dispatched on the removed
+        variants complete normally (the batcher holds its own
+        reference).  Returns the number of variants removed."""
+        suffix = VERSION_SEP + str(version)
+        removed = [k for k in self._variants if k.endswith(suffix)]
+        for key in removed:
+            del self._variants[key]
+        return len(removed)
+
+    def version_divergence(self, version: str) -> dict:
+        """Max |dlogit| + argmax agreement between the primary f32
+        forward and VERSION's pinned f32 variant on the fixed parity
+        slice — the rollout controller's canary parity-drift probe.
+        Zero new traces (warmed bucket shapes only)."""
+        key = f"{DEFAULT_DTYPE}{VERSION_SEP}{version}"
+        v = self._variants.get(key)
+        if v is None:
+            raise ValueError(
+                f"version {version!r} is not installed; have "
+                f"{[k for k in self._variants if VERSION_SEP in k]}"
+            )
+        x, bucket = self._parity_slice()
+        ref = np.asarray(self._run_variant(self._variants[DEFAULT_DTYPE], x))
+        out = np.asarray(self._run_variant(v, x))
+        return {
+            "version": version,
+            "rows": int(bucket),
+            "max_abs_logit_diff": float(np.abs(out - ref).max()),
+            "argmax_identical": bool(
+                (out.argmax(axis=1) == ref.argmax(axis=1)).all()
+            ),
+        }
 
     # -- serving --------------------------------------------------------------
 

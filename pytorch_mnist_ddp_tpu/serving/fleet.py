@@ -1150,6 +1150,50 @@ class Fleet:
                     return b
         raise KeyError(f"no backend named {name!r}")
 
+    def admin_rollout(
+        self, path: str, body: bytes, timeout_s: float = 30.0
+    ) -> tuple[int, dict]:
+        """Forward one rollout admin verb (``POST /admin/*``,
+        serving/server.py) to every ACTIVE backend, SEQUENTIALLY — the
+        fleet tier of a zero-downtime swap (docs/SERVING.md swap state
+        machine): each backend flips reference-atomically while its
+        peers keep serving, so the fleet never drops a request; the
+        deterministic canary split needs no coordination at all (every
+        backend hashes a payload to the same assignment).
+
+        After a successful mutation the FRONT response cache — keyed on
+        raw request bodies, blind to weights — is invalidated; each
+        backend already bumped its own cache generation.  A partial
+        failure returns 502 with per-backend detail and still
+        invalidates (some backends DID move); every verb is idempotent
+        at each backend, so the operator re-issues it to converge."""
+        results: dict = {}
+        ok = True
+        mutation = path != "/admin/rollout"
+        for b in self.active_backends():
+            try:
+                status, data, _ctype = b.request_full(
+                    "POST", path, body, timeout_s=timeout_s,
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    detail = json.loads(data)
+                except ValueError:
+                    detail = data.decode("utf-8", errors="replace")
+                results[b.name] = {"status": status, "body": detail}
+                ok = ok and status == 200
+            except (OSError, http.client.HTTPException) as e:
+                results[b.name] = {"error": f"{type(e).__name__}: {e}"}
+                ok = False
+        if mutation and self.response_cache is not None:
+            self.response_cache.invalidate()
+        if self.sink and mutation:
+            self.sink.emit(
+                "fleet_admin", path=path, ok=ok,
+                backends=sorted(results),
+            )
+        return (200 if ok else 502), {"ok": ok, "backends": results}
+
     def set_state(self, backend: Backend, state: str) -> None:
         if state not in BACKEND_STATES:
             raise ValueError(f"unknown backend state {state!r}")
@@ -1514,7 +1558,8 @@ class FleetHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802 - stdlib casing
         fleet: Fleet = self.server.fleet  # type: ignore[attr-defined]
-        if self.path != "/predict":
+        admin = self.path.startswith("/admin/")
+        if self.path != "/predict" and not admin:
             self._send_json(404, {"error": f"no such path {self.path!r}"})
             return
         try:
@@ -1532,6 +1577,12 @@ class FleetHandler(BaseHTTPRequestHandler):
             except OSError:
                 pass
             self.close_connection = True
+            return
+        if admin:
+            # Rolling per-backend forwarding (Fleet.admin_rollout): the
+            # fleet tier of swap/canary/rollback.
+            status, payload = fleet.admin_rollout(self.path, body)
+            self._send_json(status, payload)
             return
         # Pass-through proxy: the request's content type rides to the
         # backend and the backend's rides back — a binary-wire body
@@ -1893,6 +1944,16 @@ def subprocess_backend_spawner(
             sys.executable, "-m", "pytorch_mnist_ddp_tpu.serving",
             *backend_args, "--host", host, "--port", str(port),
         ]
+        if log_dir:
+            # Per-backend telemetry subdirectory: the front strips the
+            # operator's --telemetry-dir from backend argv (two rank-0
+            # backends sharing one dir would collide on the JSONL
+            # filename), so re-add it scoped by name — backend events
+            # (serving_request, model_swap, rollback, ...) land beside
+            # the front's events-fleet.jsonl instead of vanishing.  A
+            # replacement reuses its predecessor's subdir; the sink is
+            # append-mode, so the event trail survives respawns.
+            cmd += ["--telemetry-dir", os.path.join(log_dir, name)]
         env = dict(os.environ)
         if hb:
             env[ENV_FLEET_HEARTBEAT_FILE] = hb

@@ -127,6 +127,14 @@ class ServingMetrics:
         self._wire_requests: dict[str, object] = {}
         self._wire_bytes: dict[str, object] = {}
         self._cache: dict[str, object] = {}
+        # Registry/rollout surface (ISSUE 17, docs/SERVING.md model
+        # registry): request count + latency per served (model, version)
+        # so a canary's share and its latency are separable from the
+        # primary's on the same exposition.  The rollout controller
+        # pre-registers its routes (ensure_model) for the same
+        # scrapeable-from-first-exposition contract as ensure_qos.
+        self._model_count: dict[tuple[str, str], object] = {}
+        self._model_latency: dict[tuple[str, str], object] = {}
 
     # -- counter views (back-compat attribute surface) ------------------------
 
@@ -309,6 +317,46 @@ class ServingMetrics:
                     "coalesced = joined an identical in-flight request)",
                     outcome=outcome,
                 )
+
+    def ensure_model(self, model: str, version: str) -> None:
+        """Pre-register one (model, version) route's count/latency
+        families (the rollout controller calls this when a route becomes
+        servable: engine load, swap target, canary start) — same
+        scrapeable-from-first-exposition rationale as
+        :meth:`ensure_qos`: CI greps ``serving_model_requests_total``
+        out of a short smoke's dump before traffic may have split."""
+        key = (model, version)
+        if key in self._model_count:
+            return
+        # Both families land under the registry lock — a scrape racing
+        # the first registration must never see the counter without its
+        # latency twin (same invariant as record_completed's dtypes).
+        with self.registry.locked():
+            self._model_count[key] = self.registry.counter(
+                "serving_model_requests_total",
+                help="completed requests per served (model, version) "
+                "registry route",
+                model=model,
+                version=version,
+            )
+            self._model_latency[key] = self.registry.histogram(
+                "serving_model_latency_seconds",
+                help="request latency per served (model, version) "
+                "registry route (reservoir window)",
+                reservoir=self._reservoir,
+                model=model,
+                version=version,
+            )
+
+    def record_model_request(
+        self, model: str, version: str, latency_s: float
+    ) -> None:
+        """One request served by registry route (model, version)."""
+        key = (model, version)
+        if key not in self._model_count:
+            self.ensure_model(model, version)
+        self._model_count[key].inc()
+        self._model_latency[key].observe(latency_s)
 
     def record_wire(self, fmt: str, bytes_in: int = 0, bytes_out: int = 0) -> None:
         """One /predict exchange on wire format ``fmt`` moving

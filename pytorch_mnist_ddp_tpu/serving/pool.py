@@ -376,6 +376,7 @@ class EnginePool:
         conv_impl: str = "conv",
         device_stage: bool | None = None,
         compute_dtype=None,
+        version: str = "",
     ):
         assigned = replica_devices(replicas, devices)
         self.metrics = metrics if metrics is not None else ServingMetrics()
@@ -425,6 +426,7 @@ class EnginePool:
                     dtypes=dtypes,
                     aot_cache=self._store,
                     device_stage=device_stage,
+                    version=version,
                 )
             )
         self.devices = list(assigned)
@@ -441,7 +443,7 @@ class EnginePool:
         """Load the checkpoint ONCE, place it per replica."""
         from ..utils.checkpoint import load_inference_variables
 
-        return cls(load_inference_variables(path), **kwargs)
+        return cls(load_inference_variables(path), **kwargs)  # jaxlint: disable=JL022 -- pre-registry CLI surface (--checkpoint without --registry); digest ownership stays with the operator
 
     @classmethod
     def from_seed(cls, seed: int = 1, **kwargs) -> "EnginePool":
@@ -467,6 +469,10 @@ class EnginePool:
         response cache's model digest (serving/cache.py) is any
         replica's — they hash identically by construction."""
         return self.engines[0].weights_digest
+
+    @property
+    def version(self):
+        return self.engines[0].version
 
     @property
     def buckets(self):
@@ -499,6 +505,36 @@ class EnginePool:
         """Distinct traces across every replica and variant (the /metrics
         ``compiles`` field; 0 in AOT mode, where rungs deserialize)."""
         return sum(e.compile_count() for e in self.engines)
+
+    # -- registry/rollout surface (serving/rollout.py) -------------------------
+    # Each verb applies to EVERY replica, sequentially: a replica's swap
+    # is reference-atomic (engine.publish_weights), so mid-iteration the
+    # pool serves a mix of old and new WHOLE trees — each request still
+    # lands entirely on one version, never a torn tree; the response
+    # cache's generation bump (the controller's job) happens after all
+    # replicas flip.
+
+    def publish_weights(self, variables, version: str | None = None) -> str:
+        digest = ""
+        for engine in self.engines:
+            digest = engine.publish_weights(variables, version=version)
+        return digest
+
+    def install_version(
+        self, version: str, variables, verified: bool | None = None
+    ) -> str:
+        digest = ""
+        for engine in self.engines:
+            digest = engine.install_version(
+                version, variables, verified=verified
+            )
+        return digest
+
+    def remove_version(self, version: str) -> int:
+        return sum(e.remove_version(version) for e in self.engines)
+
+    def version_divergence(self, version: str) -> dict:
+        return self.engines[0].version_divergence(version)
 
     # -- lifecycle --------------------------------------------------------------
 

@@ -172,6 +172,11 @@ class ServingHandler(BaseHTTPRequestHandler):
                 health["replicas"] = {
                     name: s["state"] for name, s in stats().items()
                 }
+            # Registry mode: the active route + any live canary, so an
+            # operator reads "what is serving" from the same endpoint
+            # that says "is it serving".
+            if srv.rollout is not None:
+                health["rollout"] = srv.rollout.describe()
             self._send_json(200, health)
         elif url.path == "/readyz":
             # Readiness, split from liveness (docs/ROBUSTNESS.md):
@@ -229,8 +234,62 @@ class ServingHandler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": f"no such path {self.path!r}"})
 
+    def _handle_admin(self, srv) -> None:
+        """``POST /admin/{swap,canary,rollback,rollout}`` — the rollout
+        control surface (serving/rollout.py; fleet mode forwards these
+        per-backend, serving/fleet.py).  503 without a registry; rollout
+        state errors map to 400 like any other client error."""
+        if srv.rollout is None:
+            self._send_json(
+                503, {"error": "no model registry configured (--registry)"}
+            )
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("admin body must be a JSON object")
+        except ValueError as e:
+            self._send_json(400, {"error": str(e)})
+            return
+        try:
+            if self.path == "/admin/swap":
+                result = srv.rollout.swap(
+                    str(body["version"]), model=body.get("model")
+                )
+            elif self.path == "/admin/canary":
+                if "version" in body:
+                    result = srv.rollout.start_canary(
+                        str(body["version"]), float(body["pct"]),
+                        model=body.get("model"),
+                    )
+                else:
+                    result = srv.rollout.set_canary_pct(float(body["pct"]))
+            elif self.path == "/admin/rollback":
+                result = srv.rollout.rollback(
+                    reason=str(body.get("reason", "operator"))
+                )
+            elif self.path == "/admin/rollout":
+                result = srv.rollout.describe()
+            else:
+                self._send_json(
+                    404, {"error": f"no such admin path {self.path!r}"}
+                )
+                return
+        except KeyError as e:
+            self._send_json(400, {"error": f"missing admin field {e}"})
+            return
+        except (TypeError, ValueError) as e:
+            # RegistryError/RolloutError subclass ValueError.
+            self._send_json(400, {"error": str(e)})
+            return
+        self._send_json(200, result)
+
     def do_POST(self):  # noqa: N802 - stdlib casing
         srv: ServingHTTPServer = self.server  # type: ignore[assignment]
+        if self.path.startswith("/admin/"):
+            self._handle_admin(srv)
+            return
         if self.path != "/predict":
             self._send_json(404, {"error": f"no such path {self.path!r}"})
             return
@@ -280,6 +339,9 @@ class ServingHandler(BaseHTTPRequestHandler):
             return
         deadline_ms = None
         return_log_probs = False
+        model = version = None
+        route = None
+        t_req = time.perf_counter()
         try:
             if binary:
                 # Binary wire path (serving/wire.py): one zero-copy
@@ -292,6 +354,7 @@ class ServingHandler(BaseHTTPRequestHandler):
                 dtype = None if wreq.dtype == "f32" else wreq.dtype
                 qos = wreq.qos
                 deadline_ms = wreq.deadline_ms
+                model, version = wreq.model, wreq.version
             else:
                 if ctype not in ("", "application/json") and srv.sink:
                     # Fallback rule (docs/SERVING.md): any content type
@@ -304,17 +367,25 @@ class ServingHandler(BaseHTTPRequestHandler):
                 x = decode_instances(body)
                 dtype = body.get("dtype")
                 return_log_probs = bool(body.get("return_log_probs", False))
+                model, version = body.get("model"), body.get("version")
             # Variant selection (docs/SERVING.md): "dtype" picks a
             # reduced-precision serving path.  Unknown names are a
             # client error (400); a known-but-unverified variant is
             # rejected by the batcher below (503 — the parity-gate
             # refusal contract).
             if dtype is not None:
-                served = getattr(srv.engine, "dtypes", ("f32",))
+                served = [
+                    d
+                    for d in getattr(srv.engine, "dtypes", ("f32",))
+                    # Version-pinned canary keys ("f32@v2") are minted
+                    # by the rollout controller below, never accepted
+                    # from the wire — a client naming one directly
+                    # would bypass the canary split and its breaker.
+                    if "@" not in d
+                ]
                 if not isinstance(dtype, str) or dtype not in served:
                     raise ValueError(
-                        f"unknown dtype {dtype!r}; served dtypes: "
-                        f"{list(served)}"
+                        f"unknown dtype {dtype!r}; served dtypes: {served}"
                     )
             # QoS class (docs/SERVING.md tail latency): "qos" selects
             # the scheduling class the weighted admission queue orders
@@ -328,9 +399,41 @@ class ServingHandler(BaseHTTPRequestHandler):
                     raise ValueError(
                         f"unknown qos {qos!r}; classes: {list(classes)}"
                     )
+            # Registry routing (docs/SERVING.md model registry): the
+            # "model"/"version" fields resolve through the rollout
+            # controller — absent fields take the default route (and,
+            # when a canary is live, join its deterministic split);
+            # without a registry the fields are a client error, not
+            # silently ignored traffic misdirection.
+            for field, name in ((model, "model"), (version, "version")):
+                if field is not None and not isinstance(field, str):
+                    raise ValueError(f'"{name}" must be a string')
+            if srv.rollout is not None:
+                # Assignment hashes the MODEL-READY rows (the two wire
+                # formats normalize to bit-identical inputs), so the
+                # canary split is reproducible from the payload alone —
+                # across replicas, wire formats, and the loadgen's own
+                # offline audit (tools/serve_loadgen.py).
+                route = srv.rollout.route(
+                    model, version,
+                    payload=np.ascontiguousarray(x).data,
+                )
+            elif model is not None or version is not None:
+                raise ValueError(
+                    "no model registry is configured on this server; "
+                    'omit "model"/"version"'
+                )
         except ValueError as e:  # WireError subclasses ValueError
             reply_json(400, {"error": str(e)})
             return
+
+        # Per-route outcome feedback (metrics families + the canary
+        # breaker -> auto-rollback); no-op without a registry.
+        def observe(ok):
+            if route is not None:
+                srv.rollout.observe(
+                    route, ok, time.perf_counter() - t_req
+                )
         # Content-addressed response cache + single-flight
         # (serving/cache.py; off unless --response-cache).  The key
         # hashes the MODEL-READY rows, so identical pixels hit across
@@ -342,16 +445,27 @@ class ServingHandler(BaseHTTPRequestHandler):
             deadline_ms / 1e3 if deadline_ms
             else getattr(srv.batcher, "timeout_s", 30.0)
         )
+        # Canary routes dispatch on the version-pinned variant key
+        # ("f32@v2"): the batcher coalesces by key, so no batch mixes
+        # versions, and the key joins the cache key below, so a cached
+        # canary response can never serve a primary request.
+        submit_dtype = dtype
+        if route is not None and route.canary:
+            submit_dtype = route.dtype_key(
+                dtype or getattr(srv.engine, "default_dtype", "f32")
+            )
         if cache is not None:
             # memoryview, not tobytes(): blake2b hashes the contiguous
             # rows in place — no payload-sized copy on the path whose
             # whole point is deleting per-request host work.
             key = cache.key(
                 np.ascontiguousarray(x).data,
-                dtype=dtype or getattr(srv.engine, "default_dtype", "f32"),
+                dtype=submit_dtype
+                or getattr(srv.engine, "default_dtype", "f32"),
             )
             outcome, val = cache.claim(key)
             if outcome == HIT:
+                observe(True)
                 self._reply_logits(reply, reply_json, val,
                                    binary, return_log_probs)
                 return
@@ -362,9 +476,11 @@ class ServingHandler(BaseHTTPRequestHandler):
                 try:
                     logits = val.result(base_timeout_s + 1.0)
                 except RejectedError as e:
+                    observe(False)
                     reply_json(503, {"error": str(e)})
                     return
                 except (RequestTimeout, FlightTimeout) as e:
+                    observe(False)
                     reply_json(504, {"error": str(e)})
                     return
                 except BaseException as e:
@@ -372,10 +488,12 @@ class ServingHandler(BaseHTTPRequestHandler):
                     # CLAIMANT's, re-raised by the flight — whatever
                     # killed that thread, this joiner still owes its
                     # client one HTTP outcome, never a torn connection.
+                    observe(False)
                     reply_json(
                         500, {"error": f"{type(e).__name__}: {e}"}
                     )
                     return
+                observe(True)
                 self._reply_logits(reply, reply_json, logits,
                                    binary, return_log_probs)
                 return
@@ -414,7 +532,7 @@ class ServingHandler(BaseHTTPRequestHandler):
                     )
                 )
                 request = srv.batcher.submit(
-                    x, dtype=dtype, qos=qos, timeout_ms=remaining_ms
+                    x, dtype=submit_dtype, qos=qos, timeout_ms=remaining_ms
                 )
                 if attempt:
                     # The retry tally (serving_request_retries_total +
@@ -448,16 +566,19 @@ class ServingHandler(BaseHTTPRequestHandler):
         except RejectedError as e:
             if flight is not None:
                 cache.fail(key, flight, e)
+            observe(False)
             reply_json(503, {"error": str(e)})
             return
         except RequestTimeout as e:
             if flight is not None:
                 cache.fail(key, flight, e)
+            observe(False)
             reply_json(504, {"error": str(e)})
             return
         except Exception as e:  # engine failure propagated by the worker
             if flight is not None:
                 cache.fail(key, flight, e)
+            observe(False)
             reply_json(500, {"error": f"{type(e).__name__}: {e}"})
             return
         except BaseException as e:
@@ -470,6 +591,7 @@ class ServingHandler(BaseHTTPRequestHandler):
             raise
         if flight is not None:
             cache.complete(key, flight, np.asarray(logits))
+        observe(True)
         self._reply_logits(reply, reply_json, logits, binary, return_log_probs)
 
     @staticmethod
@@ -502,11 +624,16 @@ class ServingHTTPServer(ThreadingHTTPServer):
         request_timeout_s: float = 30.0,
         response_cache: ResponseCache | None = None,
         sink=None,
+        rollout=None,
     ):
         super().__init__(address, ServingHandler)
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
+        # Registry mode (serving/rollout.py): the route resolver + swap/
+        # canary/rollback control surface; None = no registry, and the
+        # request path is byte-identical to the pre-registry server.
+        self.rollout = rollout
         # Handler-connection socket timeout (ServingHandler.setup): an
         # idle or half-dead client frees its thread within this bound.
         self.request_timeout_s = request_timeout_s
@@ -552,6 +679,7 @@ def make_server(
     request_timeout_s: float = 30.0,
     response_cache: int | ResponseCache | None = None,
     sink=None,
+    rollout=None,
     **batcher_kwargs,
 ) -> ServingHTTPServer:
     """Wire engine + metrics + a started batcher into a ready-to-run
@@ -574,6 +702,11 @@ def make_server(
             model_digest=getattr(engine, "weights_digest", ""),
             metrics=metrics, sink=sink, scope="server",
         )
+    if rollout is not None and rollout.cache is None:
+        # The swap path owes the cache a generation bump; hand the
+        # controller the cache built here (None stays None: no cache,
+        # nothing to invalidate).
+        rollout.cache = response_cache
     if batcher is None:
         batcher = MicroBatcher(
             engine, metrics=metrics, sink=sink, **batcher_kwargs
@@ -586,5 +719,5 @@ def make_server(
     return ServingHTTPServer(
         (host, port), engine, batcher, metrics,
         request_timeout_s=request_timeout_s,
-        response_cache=response_cache, sink=sink,
+        response_cache=response_cache, sink=sink, rollout=rollout,
     )

@@ -32,6 +32,22 @@ Request layout (all integers little-endian)::
                                server's --timeout-ms default
     24      ...   payload      count x row_elems float32, row-major
 
+Registry extension (ISSUE 17): a request naming a registry ``model`` /
+``version`` (the JSON body fields of the same names) appends, AFTER
+offset 24 and BEFORE the payload::
+
+    24      2     model_len    UTF-8 bytes of the model name (0 = unset)
+    26      2     version_len  UTF-8 bytes of the version (0 = unset)
+    28      ...   model name bytes, then version bytes
+
+and sets ``header_size = 28 + model_len + version_len``.  Presence is
+keyed on ``header_size > 24`` — NOT on a flag bit, because this decoder
+(correctly) rejects unknown flag bits, while the versioning rule below
+makes longer headers skippable: a pre-registry reader serves such a
+request through its default route, exactly what absent fields mean.  A
+request with neither field keeps ``header_size = 24`` — byte-identical
+to the pre-registry wire.
+
 Response layout (``application/x-mnist-logits-f32``)::
 
     offset  size  field        meaning
@@ -73,6 +89,9 @@ RESPONSE_MAGIC = b"MNL1"
 _REQ_HEADER = struct.Struct("<4sHHIIBBHI")
 # magic, header_size, flags, count, classes — 16 bytes.
 _RESP_HEADER = struct.Struct("<4sHHII")
+# model_len, version_len — the registry extension's length prefix at
+# offset 24 (present iff header_size > 24; see the layout table).
+_REQ_EXT = struct.Struct("<HH")
 
 REQUEST_HEADER_SIZE = _REQ_HEADER.size
 RESPONSE_HEADER_SIZE = _RESP_HEADER.size
@@ -105,14 +124,18 @@ class WireRequest:
     """One decoded binary request: a zero-copy float32 row view plus the
     sideband fields the JSON surface carries as body keys."""
 
-    __slots__ = ("rows", "normalized", "dtype", "qos", "deadline_ms")
+    __slots__ = ("rows", "normalized", "dtype", "qos", "deadline_ms",
+                 "model", "version")
 
-    def __init__(self, rows, normalized, dtype, qos, deadline_ms):
+    def __init__(self, rows, normalized, dtype, qos, deadline_ms,
+                 model=None, version=None):
         self.rows = rows              # [n, 784] float32 view into the body
         self.normalized = normalized  # bool: skip the serving normalize
         self.dtype = dtype            # served variant name ("f32", ...)
         self.qos = qos                # scheduling class name or None
         self.deadline_ms = deadline_ms  # per-request override or None
+        self.model = model            # registry model name or None
+        self.version = version        # registry version or None
 
     @property
     def n(self) -> int:
@@ -142,8 +165,13 @@ def encode_request(
     qos: str | None = None,
     normalized: bool = False,
     deadline_ms: float | None = None,
+    model: str | None = None,
+    version: str | None = None,
 ) -> bytes:
-    """Rows + sideband fields -> one wire message (header ++ payload)."""
+    """Rows + sideband fields -> one wire message (header ++ payload).
+    ``model``/``version`` (registry routing, both optional) ride in the
+    header extension; omitting both emits the pre-registry 24-byte
+    header, bit for bit."""
     x = _rows_f32(rows, ROW_ELEMS, "request rows")
     if len(x) < 1:
         raise WireError("request must carry at least one row")
@@ -170,9 +198,16 @@ def encode_request(
         deadline_field = max(1, int(deadline_ms))
     else:
         deadline_field = 0
+    ext = b""
+    if model is not None or version is not None:
+        model_b = (model or "").encode("utf-8")
+        version_b = (version or "").encode("utf-8")
+        if max(len(model_b), len(version_b)) >= 1 << 16:
+            raise WireError("model/version names exceed the u16 length field")
+        ext = _REQ_EXT.pack(len(model_b), len(version_b)) + model_b + version_b
     header = _REQ_HEADER.pack(
         REQUEST_MAGIC,
-        REQUEST_HEADER_SIZE,
+        REQUEST_HEADER_SIZE + len(ext),
         FLAG_NORMALIZED if normalized else 0,
         len(x),
         ROW_ELEMS,
@@ -181,7 +216,7 @@ def encode_request(
         0,
         deadline_field,
     )
-    return header + x.tobytes()
+    return header + ext + x.tobytes()
 
 
 def decode_request(body: bytes) -> WireRequest:
@@ -231,6 +266,30 @@ def decode_request(body: bytes) -> WireRequest:
         )
     if qos_code not in QOS_NAMES:
         raise WireError(f"unknown qos code {qos_code}; have {QOS_NAMES}")
+    model = version = None
+    if header_size > REQUEST_HEADER_SIZE:
+        # Registry extension (or a future writer's longer header — the
+        # lengths still lead, extra tail bytes are skipped).
+        ext_end = REQUEST_HEADER_SIZE + _REQ_EXT.size
+        if header_size < ext_end:
+            raise WireError(
+                f"extended header_size {header_size} is shorter than the "
+                f"{ext_end}-byte model/version extension"
+            )
+        model_len, version_len = _REQ_EXT.unpack_from(
+            body, REQUEST_HEADER_SIZE
+        )
+        if ext_end + model_len + version_len > header_size:
+            raise WireError(
+                f"model/version lengths ({model_len}, {version_len}) "
+                f"overrun the {header_size}-byte header"
+            )
+        try:
+            names = body[ext_end:ext_end + model_len + version_len]
+            model = names[:model_len].decode("utf-8") or None
+            version = names[model_len:].decode("utf-8") or None
+        except UnicodeDecodeError as e:
+            raise WireError(f"model/version names are not UTF-8: {e}")
     rows = np.frombuffer(
         body, dtype="<f4", count=count * row_elems, offset=header_size
     ).reshape(count, row_elems)
@@ -240,6 +299,8 @@ def decode_request(body: bytes) -> WireRequest:
         dtype=dtype,
         qos=QOS_NAMES[qos_code],
         deadline_ms=float(deadline_ms) if deadline_ms else None,
+        model=model,
+        version=version,
     )
 
 

@@ -172,6 +172,80 @@ def _atomic_npz_write(flat: Mapping[str, np.ndarray], path: str) -> None:
     _atomic_write(path, write_npz)
 
 
+# ---------------------------------------------------------------------------
+# Model-registry manifest (serving/registry.py).
+#
+# The manifest is the registry's ONLY durable state: a JSON document in
+# the registry directory naming every (model, version) entry — relative
+# checkpoint path, weights digest, model family, parity record — plus
+# the default aliases request routing resolves through.  It is written
+# with the SAME crash-safety discipline as every checkpoint surface
+# (_atomic_write: mkstemp + fsync + atomic replace), so a reader only
+# ever sees an absent or COMPLETE manifest, never a torn one — the
+# property a serving fleet mid-rolling-swap leans on (two backends may
+# read while a publish replaces).
+
+REGISTRY_MANIFEST = "registry.json"
+REGISTRY_FORMAT = 1
+
+
+def registry_manifest_path(directory: str) -> str:
+    return os.path.join(directory, REGISTRY_MANIFEST)
+
+
+def save_registry_manifest(manifest: Mapping[str, Any], directory: str) -> str:
+    """Atomically publish the registry manifest into ``directory``.
+
+    The format tag is stamped here (one writer surface, like
+    ``save_params_tree``); sorted keys + a trailing newline keep the
+    bytes deterministic for a given manifest, so repeated publishes of
+    identical state are byte-identical on disk."""
+    import json
+
+    manifest = dict(manifest)
+    manifest["format"] = REGISTRY_FORMAT
+    path = registry_manifest_path(directory)
+    payload = (json.dumps(manifest, indent=2, sort_keys=True) + "\n").encode()
+
+    def write_json(tmp: str) -> None:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+
+    _atomic_write(path, write_json)
+    return path
+
+
+def load_registry_manifest(directory: str) -> dict[str, Any]:
+    """Read the registry manifest back; raises ``FileNotFoundError``
+    when the directory holds none (a fresh registry) and ``ValueError``
+    on a manifest this code cannot interpret — a FUTURE format must be
+    refused, not half-parsed into silently-wrong routing."""
+    import json
+
+    path = registry_manifest_path(directory)
+    with open(path, "rb") as f:
+        try:
+            manifest = json.load(f)
+        except json.JSONDecodeError as e:
+            raise CorruptCheckpointError(
+                f"{path!r} is not valid JSON ({e}); the registry writes "
+                "manifests atomically, so this file was likely produced "
+                "by a non-atomic writer or damaged in transit"
+            ) from e
+    if not isinstance(manifest, dict):
+        raise ValueError(f"{path!r} must hold a JSON object manifest")
+    fmt = int(manifest.get("format", 0))
+    if fmt != REGISTRY_FORMAT:
+        raise ValueError(
+            f"{path!r} is a format-{fmt} registry manifest; this build "
+            f"reads format {REGISTRY_FORMAT} — upgrade the reader or "
+            "re-publish the registry"
+        )
+    return manifest
+
+
 class CorruptCheckpointError(ValueError):
     """A checkpoint file that exists but will not parse (truncated/torn).
 

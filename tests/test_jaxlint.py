@@ -1866,6 +1866,91 @@ def test_jl018_waiver():
 
 
 # ---------------------------------------------------------------------------
+# JL022 — weights loaded or mutated behind the registry (serving modules)
+
+
+SERVING_FIXTURE_PATH = "pytorch_mnist_ddp_tpu/serving/fixture.py"
+
+
+def jl022_findings(source: str, path: str = SERVING_FIXTURE_PATH):
+    found, _ = ENGINE.check_source(source, path)
+    return [f for f in found if f.rule_id == "JL022"]
+
+
+JL022_BAD_DIRECT_LOAD = """\
+from ..utils.checkpoint import load_inference_variables
+
+def hot_reload(engine, path):
+    engine.variables = load_inference_variables(path)
+"""
+
+JL022_BAD_STATE_DICT = """\
+from ..utils import checkpoint
+
+def refresh(path):
+    return checkpoint.load_state_dict(path)
+"""
+
+JL022_BAD_DIGEST_WRITE = """\
+def cover_tracks(engine, digest):
+    engine.weights_digest = digest
+"""
+
+JL022_GOOD_REGISTRY_SURFACE = """\
+def swap(registry, rollout, model, version):
+    entry = registry.resolve(model, version)
+    return rollout.swap(entry.version)
+"""
+
+JL022_GOOD_SELF_STATE = """\
+class Engine:
+    def __init__(self, variables):
+        self.variables = variables
+        self.weights_digest = ""
+"""
+
+
+def test_jl022_fires_on_direct_load_and_weight_mutation():
+    # Direct checkpoint load AND the engine.variables write: two hits.
+    hits = jl022_findings(JL022_BAD_DIRECT_LOAD)
+    assert len(hits) == 2, [f.format() for f in hits]
+    assert jl022_findings(JL022_BAD_STATE_DICT)
+    assert jl022_findings(JL022_BAD_DIGEST_WRITE)
+
+
+def test_jl022_scoped_to_serving_outside_the_registry_surface():
+    # Out of serving/: the trainer resumes checkpoints legitimately.
+    assert not jl022_findings(
+        JL022_BAD_DIRECT_LOAD, "pytorch_mnist_ddp_tpu/trainer.py"
+    )
+    # The registry surface itself is the taught idiom, not a bypass.
+    for owner in ("registry.py", "rollout.py", "engine.py"):
+        assert not jl022_findings(
+            JL022_BAD_DIRECT_LOAD,
+            f"pytorch_mnist_ddp_tpu/serving/{owner}",
+        )
+    # A module merely NAMED serving.py (not under a serving/ directory)
+    # is out of scope — the gate is on the path component.
+    assert not jl022_findings(JL022_BAD_DIRECT_LOAD, "serving.py")
+
+
+def test_jl022_silent_on_registry_idiom_and_own_state():
+    assert not jl022_findings(JL022_GOOD_REGISTRY_SURFACE)
+    # self.variables in a constructor is that module's own state, not a
+    # foreign engine's weight surface.
+    assert not jl022_findings(JL022_GOOD_SELF_STATE)
+
+
+def test_jl022_waiver():
+    waived = JL022_BAD_STATE_DICT.replace(
+        "return checkpoint.load_state_dict(path)",
+        "return checkpoint.load_state_dict(path)"
+        "  # jaxlint: disable=JL022 -- pre-registry CLI surface",
+    )
+    assert not jl022_findings(waived)
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

@@ -190,8 +190,17 @@ def test_predict_config_composition_is_canonical():
     # cache; pin the exact key set.
     assert set(cfg) == {
         "program", "dtype", "bucket", "mesh", "devices", "use_bn",
-        "conv_impl", "device_stage", "prng_impl",
+        "conv_impl", "device_stage", "prng_impl", "version",
     }
+    # The unversioned surfaces (engine default, trainer handoff) must
+    # keep digest-matching: the default version is the empty string,
+    # and a registry version unshares the entry on purpose.
+    assert cfg["version"] == ""
+    versioned = predict_config(
+        mesh, "f32", 8, use_bn=False, conv_impl="conv", device_stage=True,
+        version="v2",
+    )
+    assert versioned["version"] == "v2" and versioned != cfg
 
 
 def test_predict_store_size_shared_formula():

@@ -1,0 +1,646 @@
+"""Model-registry subsystem tests (ISSUE 17): manifest round-trip and
+atomicity, alias resolution, zero-downtime swap bit-coherence under
+live traffic, deterministic canary split, auto-rollback on injected
+canary faults, cache-invalidation-on-swap, and the zero-new-traces
+warm-swap pin.
+
+Run alone with ``pytest -m registry`` (the CI registry job); everything
+here also rides the default smoke tier.  Pure manifest/routing
+mechanics use fakes (no jax dispatch); the swap/canary end-to-end
+tests compile ONE real engine per module (module-scoped stack) and
+pin its RecompileSentinel budget across every transition.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pytorch_mnist_ddp_tpu.models.net import NUM_CLASSES, init_params
+from pytorch_mnist_ddp_tpu.obs.export import render_prometheus
+from pytorch_mnist_ddp_tpu.serving import (
+    InferenceEngine,
+    ResponseCache,
+    ServingMetrics,
+)
+from pytorch_mnist_ddp_tpu.serving import faults, wire
+from pytorch_mnist_ddp_tpu.serving.pool import EnginePool
+from pytorch_mnist_ddp_tpu.serving.registry import (
+    ModelRegistry,
+    RegistryError,
+)
+from pytorch_mnist_ddp_tpu.serving.rollout import (
+    RolloutController,
+    RolloutError,
+    canary_assignment,
+)
+from pytorch_mnist_ddp_tpu.serving.server import make_server
+from pytorch_mnist_ddp_tpu.utils.checkpoint import (
+    REGISTRY_MANIFEST,
+    model_state_dict,
+    registry_manifest_path,
+    save_state_dict,
+)
+from pytorch_mnist_ddp_tpu.utils.rng import root_key, split_streams
+
+pytestmark = pytest.mark.registry
+
+
+# ---------------------------------------------------------------------------
+# Fixtures
+
+
+def _seed_checkpoint(path, seed):
+    params = init_params(split_streams(root_key(seed))["init"])
+    save_state_dict(model_state_dict(params), str(path), format="npz")
+    return str(path)
+
+
+def _make_registry(directory, seeds=(1, 2), sink=None):
+    """A registry with v1 (default) and v2 published from two seeds —
+    genuinely different weights, so swapped logits are distinguishable."""
+    reg = ModelRegistry(str(directory), sink=sink)
+    for i, seed in enumerate(seeds, start=1):
+        ckpt = _seed_checkpoint(
+            os.path.join(str(directory), f"v{i}.npz"), seed
+        )
+        reg.publish("mnist", f"v{i}", ckpt, make_default=(i == 1))
+    return reg
+
+
+class _Sink:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, **fields):
+        self.events.append((event, fields))
+
+    def names(self):
+        return [e for e, _ in self.events]
+
+    def __bool__(self):
+        return True
+
+
+def _post_json(url, obj, timeout=15.0):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            return e.code, json.loads(body)
+        except json.JSONDecodeError:
+            return e.code, {"raw": body.decode(errors="replace")}
+
+
+def _post_logits(base, raw, timeout=15.0, **extra):
+    """POST normalized rows, return (status, [n, 10] log-prob array or
+    the error body) — the bit-comparable serving surface."""
+    body = {
+        "instances": raw.tolist(), "normalized": True,
+        "return_log_probs": True, **extra,
+    }
+    status, payload = _post_json(f"{base}/predict", body, timeout=timeout)
+    if status != 200:
+        return status, payload
+    return status, np.asarray(payload["log_probs"], np.float32)
+
+
+class _Stack:
+    """One real engine + registry + rollout + server, shared per module
+    (ONE compile); tests restore primary=v1 / no-canary when done."""
+
+    def __init__(self, tmpdir):
+        self.sink = _Sink()
+        self.registry = _make_registry(tmpdir, sink=self.sink)
+        self.metrics = ServingMetrics()
+        entry = self.registry.resolve()
+        self.engine = InferenceEngine(
+            self.registry.load(entry),
+            buckets=(8,),
+            metrics=self.metrics,
+            version=entry.version,
+        )
+        self.rollout = RolloutController(
+            self.registry, self.engine,
+            metrics=self.metrics, sink=self.sink,
+        )
+        self.server = make_server(
+            self.engine, self.metrics,
+            port=0, linger_ms=1.0,
+            response_cache=64, sink=self.sink,
+            rollout=self.rollout,
+        )
+        threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        ).start()
+        self.base = f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def reset(self):
+        """Return to primary=v1, no canary (idempotent test epilogue)."""
+        try:
+            self.rollout.rollback(reason="test_reset")
+        except RolloutError:
+            pass
+        if self.rollout.describe()["version"] != "v1":
+            self.rollout.swap("v1")
+        self.sink.events.clear()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.batcher.stop(drain=False)
+        self.server.server_close()
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    s = _Stack(tmp_path_factory.mktemp("registry"))
+    yield s
+    s.close()
+
+
+def _rows(n, seed=0):
+    return np.random.RandomState(seed).randint(0, 256, (n, 784)).astype(
+        np.float32
+    )
+
+
+def _payload_bytes(flat_rows):
+    """The canary-assignment payload for one JSON request with
+    ``normalized: true`` — the MODEL-READY [n, 28, 28, 1] row bytes,
+    exactly what server.py hashes (and what the loadgen audits)."""
+    return (
+        np.ascontiguousarray(flat_rows.reshape(-1, 28, 28, 1))
+        .astype(np.float32)
+        .tobytes()
+    )
+
+
+# ---------------------------------------------------------------------------
+# Manifest: round-trip, relative paths, atomicity
+
+
+def test_manifest_roundtrip_and_relative_paths(tmp_path):
+    reg = _make_registry(tmp_path)
+    # Checkpoints inside the registry directory are stored RELATIVE, so
+    # the directory relocates as a unit.
+    e1 = reg.resolve("mnist", "v1")
+    assert e1.checkpoint == "v1.npz"
+    assert os.path.isabs(e1.path(reg.directory))
+    # A fresh instance over the same directory sees identical state.
+    reg2 = ModelRegistry(str(tmp_path))
+    assert reg2.models() == ["mnist"]
+    assert reg2.versions("mnist") == ["v1", "v2"]
+    d1, d2 = reg.describe(), reg2.describe()
+    assert d1 == d2
+    assert d2["default_model"] == "mnist"
+    assert d2["models"]["mnist"]["default_version"] == "v1"
+    # The on-disk manifest is format-stamped, sorted, newline-terminated
+    # (deterministic bytes for identical state).
+    with open(registry_manifest_path(str(tmp_path)), "rb") as f:
+        raw = f.read()
+    manifest = json.loads(raw)
+    assert manifest["format"] == 1
+    assert raw == (
+        json.dumps(manifest, indent=2, sort_keys=True) + "\n"
+    ).encode()
+    # Relocation: move the whole directory; everything still resolves
+    # and loads digest-verified.
+    moved = tmp_path.parent / (tmp_path.name + "_moved")
+    os.rename(str(tmp_path), str(moved))
+    reg3 = ModelRegistry(str(moved))
+    assert reg3.load(reg3.resolve())["params"]
+
+
+def test_manifest_write_is_atomic(tmp_path, monkeypatch):
+    reg = _make_registry(tmp_path)
+    before = open(registry_manifest_path(str(tmp_path)), "rb").read()
+    ckpt = _seed_checkpoint(tmp_path / "v3.npz", seed=3)
+
+    import pytorch_mnist_ddp_tpu.utils.checkpoint as ckpt_mod
+
+    def torn_replace(src, dst):
+        raise OSError("simulated crash inside the publish window")
+
+    monkeypatch.setattr(ckpt_mod.os, "replace", torn_replace)
+    with pytest.raises(OSError):
+        reg.publish("mnist", "v3", ckpt)
+    monkeypatch.undo()
+    # The previous manifest is byte-intact and the directory holds no
+    # temp debris a reader could mistake for a manifest.
+    assert open(registry_manifest_path(str(tmp_path)), "rb").read() == before
+    leftovers = [
+        f for f in os.listdir(str(tmp_path))
+        if f not in (REGISTRY_MANIFEST, "v1.npz", "v2.npz", "v3.npz")
+    ]
+    assert leftovers == []
+    # A fresh reader sees the pre-crash state: v3 never happened.
+    assert ModelRegistry(str(tmp_path)).versions("mnist") == ["v1", "v2"]
+
+
+def test_publish_validation_and_alias_resolution(tmp_path):
+    reg = _make_registry(tmp_path)
+    # Absent fields resolve through the default aliases.
+    assert reg.resolve().version == "v1"
+    assert reg.resolve("mnist").version == "v1"
+    assert reg.resolve(None, "v2").version == "v2"
+    reg.set_default("mnist", "v2")
+    assert reg.resolve().version == "v2"
+    assert ModelRegistry(str(tmp_path)).resolve().version == "v2"
+    # Unknown names are RegistryError (-> HTTP 400), never KeyError.
+    with pytest.raises(RegistryError, match="unknown model"):
+        reg.resolve("nope")
+    with pytest.raises(RegistryError, match="unknown version"):
+        reg.resolve("mnist", "v9")
+    with pytest.raises(RegistryError, match="unknown model"):
+        reg.versions("nope")
+    with pytest.raises(RegistryError, match="non-empty"):
+        reg.publish("", "v1", str(tmp_path / "v1.npz"))
+    # "@" is the engine's variant-key separator; a version carrying it
+    # would mint ambiguous canary keys.
+    with pytest.raises(RegistryError, match="must not contain"):
+        reg.publish("mnist", "v@3", str(tmp_path / "v1.npz"))
+    with pytest.raises(RegistryError, match="does not exist"):
+        reg.publish("mnist", "v3", str(tmp_path / "missing.npz"))
+    with pytest.raises(RegistryError, match="cannot default"):
+        reg.set_default("mnist", "v9")
+
+
+def test_load_refuses_digest_mismatch(tmp_path):
+    reg = _make_registry(tmp_path)
+    entry = reg.resolve("mnist", "v1")
+    # The file changes behind the manifest's back (partial copy,
+    # overwrite): load() must REFUSE, not silently serve unknown bytes.
+    _seed_checkpoint(tmp_path / "v1.npz", seed=9)
+    with pytest.raises(RegistryError, match="behind the manifest"):
+        reg.load(entry)
+
+
+# ---------------------------------------------------------------------------
+# Wire extension: model/version fields, baseline byte-identity
+
+
+def test_wire_version_extension_roundtrip_and_baseline_bytes():
+    x = _rows(3)
+    plain = wire.encode_request(x, normalized=True)
+    # No fields -> byte-identical to the PR-14 header (24 bytes), so a
+    # pre-registry peer is untouched.
+    assert wire.decode_request(plain).model is None
+    assert wire.decode_request(plain).version is None
+    tagged = wire.encode_request(
+        x, normalized=True, model="mnist", version="v2"
+    )
+    req = wire.decode_request(tagged)
+    assert (req.model, req.version) == ("mnist", "v2")
+    np.testing.assert_array_equal(req.rows, x)
+    # The extension strips back to the exact baseline bytes.
+    assert len(tagged) == len(plain) + 4 + len("mnist") + len("v2")
+    model_only = wire.decode_request(
+        wire.encode_request(x, normalized=True, model="mnist")
+    )
+    assert (model_only.model, model_only.version) == ("mnist", None)
+    with pytest.raises(wire.WireError, match="model"):
+        wire.encode_request(x, model="m" * 70000)
+    # A truncated extension (header_size promises names the body lacks)
+    # is a WireError, never an allocation or a hang.
+    broken = bytearray(tagged)
+    broken[4] = 200  # header_size < 28+lengths
+    broken[5] = 0
+    with pytest.raises(wire.WireError):
+        wire.decode_request(bytes(broken))
+
+
+# ---------------------------------------------------------------------------
+# Canary assignment + routing (fakes, no dispatch)
+
+
+def test_canary_assignment_deterministic_and_monotonic():
+    payloads = [bytes([i, i + 1, i + 2]) * 11 for i in range(200)]
+    a25 = [canary_assignment(p, 25.0) for p in payloads]
+    assert a25 == [canary_assignment(p, 25.0) for p in payloads]
+    # Raising pct only GROWS the slice: nobody assigned at 25% leaves
+    # at 50% (a ramp never flip-flops users).
+    a50 = [canary_assignment(p, 50.0) for p in payloads]
+    assert all(b or not a for a, b in zip(a25, a50))
+    assert all(canary_assignment(p, 100.0) for p in payloads)
+    assert not any(canary_assignment(p, 0.0) for p in payloads)
+    # Roughly proportional (seeded, so exact across runs).
+    assert 30 <= sum(a25) <= 70 and 70 <= sum(a50) <= 130
+    # A different seed is a different split.
+    assert a25 != [canary_assignment(p, 25.0, seed=7) for p in payloads]
+
+
+class _FakeEngine:
+    """Routing-only engine stand-in: the rollout controller touches the
+    engine solely in transitions, which these tests never take."""
+
+    weights_digest = "fake"
+    version = "v1"
+
+
+def test_route_pins_split_and_errors(tmp_path):
+    reg = _make_registry(tmp_path)
+    ctl = RolloutController(reg, _FakeEngine())
+    r = ctl.route()
+    assert (r.model, r.version, r.canary, r.pinned) == (
+        "mnist", "v1", False, False
+    )
+    assert r.dtype_key("f32") == "f32"
+    # Explicit pin to the primary.
+    rp = ctl.route(version="v1")
+    assert rp.pinned and not rp.canary
+    # Pin to a registered-but-not-serving version is a client error.
+    with pytest.raises(RolloutError, match="not serving"):
+        ctl.route(version="v2")
+    with pytest.raises(RegistryError, match="unknown model"):
+        ctl.route(model="nope")
+    # No canary live: payloads never split.
+    assert not ctl.route(payload=b"x" * 64).canary
+    with pytest.raises(RolloutError, match="no canary"):
+        ctl.rollback()
+    with pytest.raises(RolloutError, match="no canary"):
+        ctl.set_canary_pct(10)
+    with pytest.raises(RolloutError, match="pct"):
+        ctl.start_canary("v2", 0.0)
+
+
+def test_pool_rollout_passthroughs():
+    class _Eng:
+        def __init__(self):
+            self.calls = []
+
+        def publish_weights(self, variables, version=None):
+            self.calls.append(("publish", version))
+            return "d-new"
+
+        def install_version(self, version, variables, verified=None):
+            self.calls.append(("install", version))
+            return "d-canary"
+
+        def remove_version(self, version):
+            self.calls.append(("remove", version))
+            return 1
+
+        def version_divergence(self, version):
+            return {"version": version, "rows": 4}
+
+    class _Pool:
+        engines = [_Eng(), _Eng()]
+
+    pool = _Pool()
+    # Unbound pool methods over fakes: every replica sees every verb.
+    assert EnginePool.publish_weights(pool, {"params": {}}, version="v2") \
+        == "d-new"
+    assert EnginePool.install_version(pool, "v2", {"params": {}}) \
+        == "d-canary"
+    assert EnginePool.remove_version(pool, "v2") == 2
+    assert EnginePool.version_divergence(pool, "v2")["rows"] == 4
+    for eng in pool.engines:
+        assert eng.calls == [
+            ("publish", "v2"), ("install", "v2"), ("remove", "v2")
+        ]
+
+
+# ---------------------------------------------------------------------------
+# End-to-end over the real engine (module-scoped stack, one compile)
+
+
+def test_default_route_matches_preregistry_behavior(stack):
+    """A request with no model/version fields serves exactly what a
+    registry-less server would: the engine's own logits, bitwise."""
+    raw = _rows(4, seed=11)
+    expected = stack.engine.predict_logits(
+        raw.reshape(-1, 28, 28, 1)
+    )
+    status, got = _post_logits(stack.base, raw)
+    assert status == 200
+    np.testing.assert_array_equal(got, expected)
+    # Explicit pin to the primary serves identically.
+    status, pinned = _post_logits(
+        stack.base, raw, model="mnist", version="v1"
+    )
+    assert status == 200
+    np.testing.assert_array_equal(pinned, got)
+    # Pin to a registered-but-not-serving version: 400, not silence.
+    status, err = _post_logits(stack.base, raw, version="v2")
+    assert status == 400 and "not serving" in err["error"]
+    stack.reset()
+
+
+def test_swap_under_load_is_bit_coherent(stack):
+    """Hammer /predict from threads while swapping v1 -> v2: zero lost
+    requests, and every response equals FULL-old or FULL-new logits —
+    never a torn mix — with zero new traces."""
+    payloads = [_rows(8, seed=21 + k) for k in range(8)]
+    x4s = [p.reshape(-1, 28, 28, 1) for p in payloads]
+    old = [stack.engine.predict_logits(x).copy() for x in x4s]
+    compiles_before = stack.engine.compile_count()
+    results, errors = [], []
+    stop = threading.Event()
+
+    def hammer(offset):
+        i = offset
+        while not stop.is_set():
+            k = i % len(payloads)
+            i += 1
+            try:
+                status, got = _post_logits(stack.base, payloads[k])
+                if status != 200:
+                    errors.append(got)
+                else:
+                    results.append((k, got))
+            except Exception as e:  # transport error = lost request
+                errors.append(repr(e))
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,)) for t in range(4)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    swapped = stack.rollout.swap("v2")
+    assert swapped["version"] == "v2"
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    new = [stack.engine.predict_logits(x).copy() for x in x4s]
+    assert not errors, errors[:3]
+    assert results
+    # Seeds differ -> every payload's worlds are distinguishable.
+    assert all(not np.array_equal(o, n) for o, n in zip(old, new))
+    torn = [
+        (k, r) for k, r in results
+        if not (np.array_equal(r, old[k]) or np.array_equal(r, new[k]))
+    ]
+    assert torn == [], f"{len(torn)} torn responses"
+    # Both worlds actually served (the swap landed mid-stream).
+    assert any(np.array_equal(r, new[k]) for k, r in results)
+    assert any(np.array_equal(r, old[k]) for k, r in results)
+    # Weight republish is trace-free: executables are shape-keyed and
+    # take weights per call.
+    assert stack.engine.compile_count() == compiles_before
+    # Durable: the manifest's default alias moved atomically.
+    assert ModelRegistry(stack.registry.directory).resolve().version == "v2"
+    assert "model_swap" in stack.sink.names()
+    stack.reset()
+
+
+def test_cache_invalidation_on_swap(stack):
+    raw = _rows(2, seed=31)
+    _, first = _post_logits(stack.base, raw)
+    _, second = _post_logits(stack.base, raw)  # served from cache
+    np.testing.assert_array_equal(first, second)
+    gen_before = stack.server.response_cache.stats()["generation"]
+    stack.rollout.swap("v2")
+    # The swap bumped the cache generation (old entries unreachable).
+    assert stack.server.response_cache.stats()["generation"] > gen_before
+    _, after = _post_logits(stack.base, raw)
+    # New weights, not a stale cached answer.
+    assert not np.array_equal(after, first)
+    np.testing.assert_array_equal(
+        after,
+        stack.engine.predict_logits(raw.reshape(-1, 28, 28, 1)),
+    )
+    stack.reset()
+
+
+def test_canary_split_is_deterministic_and_trace_free(stack):
+    compiles_before = stack.engine.compile_count()
+    stack.rollout.start_canary("v2", 50.0)
+    assert stack.engine.compile_count() == compiles_before  # install: 0 traces
+    probe = stack.rollout.check_divergence()
+    assert probe["rows"] > 0 and not probe["drifted"]
+    x4_all = []
+    expected_canary = []
+    for i in range(40):
+        raw = _rows(2, seed=100 + i)
+        x4 = raw.reshape(-1, 28, 28, 1)
+        x4_all.append((raw, x4))
+        expected_canary.append(
+            canary_assignment(_payload_bytes(raw), 50.0)
+        )
+    assert 5 <= sum(expected_canary) <= 35  # both slices populated
+    for (raw, x4), is_canary in zip(x4_all, expected_canary):
+        status, got = _post_logits(stack.base, raw)
+        assert status == 200
+        want = stack.engine.predict_logits(
+            x4, dtype="f32@v2" if is_canary else None
+        )
+        np.testing.assert_array_equal(got, want)
+    # Zero new traces through the whole split.
+    assert stack.engine.compile_count() == compiles_before
+    # Per-version metric families are on the prom surface.
+    prom = render_prometheus(stack.metrics.registry)
+    assert 'serving_model_requests_total{model="mnist",version="v1"}' in prom
+    assert 'serving_model_requests_total{model="mnist",version="v2"}' in prom
+    assert "serving_model_latency_seconds" in prom
+    assert "canary_step" in stack.sink.names()
+    assert "canary_divergence" in stack.sink.names()
+    stack.rollout.rollback(reason="test_done")
+    # The pinned variants are gone; a canary pin now 400s.
+    assert all("@" not in d for d in stack.engine.dtypes)
+    stack.reset()
+
+
+def test_auto_rollback_on_injected_canary_faults(stack):
+    """pct=100 canary + injected launch failures (PR-8 grammar): the
+    canary breaker opens and the controller rolls back ON ITS OWN, with
+    the rollback event on record; traffic returns to the primary."""
+    stack.rollout.start_canary("v2", 100.0)
+    with faults.injected("fail:launch:count=inf"):
+        failures = 0
+        for i in range(12):
+            raw = _rows(1, seed=500 + i)
+            status, _ = _post_logits(stack.base, raw)
+            if status != 200:
+                failures += 1
+            if "rollback" in stack.sink.names():
+                break
+        assert failures >= stack.rollout.failure_threshold
+    events = dict(
+        (e, f) for e, f in stack.sink.events if e == "rollback"
+    )
+    assert events, "no rollback event emitted"
+    assert events["rollback"]["reason"] == "canary_error_budget"
+    assert stack.rollout.describe()["canary"] is None
+    # Post-rollback, the primary serves normally again.
+    raw = _rows(2, seed=600)
+    status, got = _post_logits(stack.base, raw)
+    assert status == 200
+    np.testing.assert_array_equal(
+        got, stack.engine.predict_logits(raw.reshape(-1, 28, 28, 1))
+    )
+    stack.reset()
+
+
+def test_admin_endpoints_drive_the_rollout(stack):
+    base = stack.base
+    status, desc = _post_json(f"{base}/admin/rollout", {})
+    assert status == 200 and desc["version"] == "v1"
+    status, desc = _post_json(f"{base}/admin/swap", {"version": "v2"})
+    assert status == 200 and desc["version"] == "v2"
+    status, desc = _post_json(
+        f"{base}/admin/canary", {"version": "v1", "pct": 25}
+    )
+    assert status == 200 and desc["canary"]["pct"] == 25.0
+    status, desc = _post_json(f"{base}/admin/canary", {"pct": 75})
+    assert status == 200 and desc["canary"]["pct"] == 75.0
+    status, desc = _post_json(
+        f"{base}/admin/rollback", {"reason": "operator_test"}
+    )
+    assert status == 200 and desc["canary"] is None
+    # healthz carries the rollout block.
+    with urllib.request.urlopen(f"{base}/healthz", timeout=10) as resp:
+        health = json.loads(resp.read())
+    assert health["rollout"]["version"] == "v2"
+    # Error contract: unknown version 400, missing field 400, bad path
+    # 404 — never a 500.
+    status, err = _post_json(f"{base}/admin/swap", {"version": "v9"})
+    assert status == 400 and "unknown version" in err["error"]
+    status, err = _post_json(f"{base}/admin/swap", {})
+    assert status == 400 and "missing admin field" in err["error"]
+    status, _ = _post_json(f"{base}/admin/nope", {})
+    assert status == 404
+    stack.reset()
+
+
+def test_admin_without_registry_is_503():
+    class _NoopEngine:
+        buckets = (8,)
+        metrics = None
+        weights_digest = "w"
+
+        def launch(self, staged, n):
+            return np.zeros((len(staged), NUM_CLASSES), np.float32)
+
+    m = ServingMetrics()
+    server = make_server(_NoopEngine(), m, port=0, linger_ms=1.0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        status, err = _post_json(f"{base}/admin/swap", {"version": "v2"})
+        assert status == 503 and "no model registry" in err["error"]
+        # model/version fields without a registry: a client error, not
+        # silently ignored traffic misdirection.
+        status, err = _post_json(
+            f"{base}/predict",
+            {"instances": _rows(1).tolist(), "normalized": True,
+             "model": "mnist"},
+        )
+        assert status == 400 and "no model registry" in err["error"]
+    finally:
+        server.shutdown()
+        server.batcher.stop(drain=False)
+        server.server_close()

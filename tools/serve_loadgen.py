@@ -1655,6 +1655,308 @@ def run_hostpath(args) -> int:
     return rc
 
 
+# ---------------------------------------------------------------------------
+# Model-registry drive modes (docs/SERVING.md model registry):
+# --swap-at-s T fires a live /admin/swap T seconds into the drive and
+# fails on any lost request, torn response (logits matching neither the
+# full-old nor the full-new weights), or post-warmup compile;
+# --canary-sweep P1,P2 climbs the canary rungs verifying the EXACT
+# deterministic split against the offline assignment recomputation.
+
+
+def _spin_registry_serve(args):
+    """Self-serve stack in registry mode: a temp registry directory with
+    v1 (seed) and v2 (seed+1) published, the engine serving v1, and the
+    rollout controller wired in.  The response cache stays OFF so every
+    outcome is a real dispatch the verdicts can count."""
+    import shutil
+    import tempfile
+
+    from pytorch_mnist_ddp_tpu.models.net import init_params
+    from pytorch_mnist_ddp_tpu.obs.events import open_sink
+    from pytorch_mnist_ddp_tpu.serving import InferenceEngine, ServingMetrics
+    from pytorch_mnist_ddp_tpu.serving.registry import ModelRegistry
+    from pytorch_mnist_ddp_tpu.serving.rollout import RolloutController
+    from pytorch_mnist_ddp_tpu.serving.server import make_server
+    from pytorch_mnist_ddp_tpu.utils.checkpoint import (
+        model_state_dict,
+        save_state_dict,
+    )
+    from pytorch_mnist_ddp_tpu.utils.rng import root_key, split_streams
+
+    metrics = ServingMetrics()
+    buckets = [int(b) for b in args.buckets.split(",")]
+    sink = open_sink(args.telemetry_dir)
+    regdir = tempfile.mkdtemp(prefix="loadgen_registry_")
+    registry = ModelRegistry(regdir, sink=sink)
+    base_seed = args.seed or 1
+    for i, seed in enumerate((base_seed, base_seed + 1), start=1):
+        params = init_params(split_streams(root_key(seed))["init"])
+        path = os.path.join(regdir, f"v{i}.npz")
+        save_state_dict(model_state_dict(params), path, format="npz")  # jaxlint: disable=JL014 -- bounded two-version publish, not a step loop
+        registry.publish("mnist", f"v{i}", path, make_default=(i == 1))
+    entry = registry.resolve()
+    engine = InferenceEngine(
+        registry.load(entry), buckets=buckets, metrics=metrics,
+        version=entry.version,
+    )
+    print(
+        f"registry self-serve: {regdir} (v1 seed {base_seed} default, "
+        f"v2 seed {base_seed + 1}); warming buckets {list(engine.buckets)}"
+    )
+    engine.warmup()
+    rollout = RolloutController(
+        registry, engine, metrics=metrics, sink=sink,
+    )
+    server = make_server(
+        engine, metrics, port=0, sink=sink, rollout=rollout,
+        linger_ms=args.linger_ms, queue_depth=args.queue_depth,
+        timeout_ms=args.timeout_ms, max_inflight=args.max_inflight,
+        adaptive_linger=not args.no_adaptive_linger,
+    )
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    print(f"registry self-serve: {url}")
+    cleanup = lambda: shutil.rmtree(regdir, ignore_errors=True)  # noqa: E731
+    return server, sink, url, engine, cleanup
+
+
+def _registry_payloads(args, count: int):
+    """Distinct seeded payloads: ``(raw_pixels, model_ready_rows)`` per
+    request, sizes cycling 1..max_request.  The model-ready bytes are
+    what the server hashes for the canary split, so the offline
+    assignment audit recomputes from ``x4.tobytes()`` exactly."""
+    import numpy as np
+
+    rng = np.random.RandomState(args.seed or 0)
+    payloads = []
+    for i in range(count):
+        n = 1 + i % max(1, args.max_request)
+        raw = rng.randint(0, 256, (n, 784)).astype(np.float32)
+        payloads.append((raw, raw.reshape(-1, 28, 28, 1)))
+    return payloads
+
+
+def _registry_predict(url, raw, timeout):
+    import numpy as np
+
+    status, body = fetch_json(
+        f"{url}/predict",
+        {"instances": raw.tolist(), "normalized": True,
+         "return_log_probs": True},
+        timeout=timeout,
+    )
+    if status != 200:
+        return status, None
+    return status, np.asarray(body.get("log_probs"), np.float32)
+
+
+def run_registry(args) -> int:
+    """The swap/canary drive: see the module docstring's registry
+    section.  Writes ``--registry-report`` and exits nonzero on any
+    lost/torn/misrouted outcome or post-warmup compile."""
+    import numpy as np
+
+    rc = 0
+    report: dict = {"mode": "registry"}
+    server, sink, url, engine, cleanup = _spin_registry_serve(args)
+    try:
+        compiles0 = engine.compile_count()
+        payloads = _registry_payloads(args, min(args.requests, 48))
+        expected_v1 = [
+            engine.predict_logits(x4).copy() for _raw, x4 in payloads
+        ]
+
+        # -- swap round -------------------------------------------------------
+        if args.swap_at_s is not None:
+            results: list[tuple[int, int, object]] = []
+            swap_result: dict = {}
+            stop = threading.Event()
+
+            def do_swap():
+                status, body = fetch_json(
+                    f"{url}/admin/swap", {"version": "v2"},
+                    timeout=args.timeout_s,
+                )
+                swap_result["status"] = status
+                swap_result["body"] = body
+
+            timer = threading.Timer(args.swap_at_s, do_swap)
+            timer.start()
+            deadline = time.perf_counter() + 2.0 * args.swap_at_s + 0.5
+
+            def hammer(wid, nworkers=4):
+                i = wid
+                while time.perf_counter() < deadline and not stop.is_set():
+                    k = i % len(payloads)
+                    i += nworkers
+                    status, logits = _registry_predict(
+                        url, payloads[k][0], args.timeout_s
+                    )
+                    results.append((k, status, logits))
+
+            workers = [
+                threading.Thread(target=hammer, args=(w,)) for w in range(4)
+            ]
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join(timeout=args.timeout_s + 2 * args.swap_at_s)
+            timer.join()
+            expected_v2 = [
+                engine.predict_logits(x4).copy() for _raw, x4 in payloads
+            ]
+            non_200 = sum(1 for _k, s, _l in results if s != 200)
+            torn = sum(
+                1 for k, s, logits in results
+                if s == 200 and not (
+                    np.array_equal(logits, expected_v1[k])
+                    or np.array_equal(logits, expected_v2[k])
+                )
+            )
+            served_new = sum(
+                1 for k, s, logits in results
+                if s == 200 and np.array_equal(logits, expected_v2[k])
+            )
+            added = engine.compile_count() - compiles0
+            swap_row = {
+                "swap_at_s": args.swap_at_s,
+                "requests": len(results),
+                "lost_or_failed": non_200,
+                "torn": torn,
+                "served_old": len(results) - non_200 - torn - served_new,
+                "served_new": served_new,
+                "swap_http_status": swap_result.get("status"),
+                "additional_compiles": added,
+            }
+            report["swap"] = swap_row
+            if swap_result.get("status") != 200:
+                print(f"REGISTRY FAIL [swap]: /admin/swap answered "
+                      f"{swap_result.get('status')} "
+                      f"({swap_result.get('body')})")
+                rc = 1
+            if non_200:
+                print(f"REGISTRY FAIL [swap]: {non_200} request(s) "
+                      "without a 200 outcome during the swap window")
+                rc = 1
+            if torn:
+                print(f"REGISTRY FAIL [swap]: {torn} TORN response(s) — "
+                      "logits match neither the old nor the new weights")
+                rc = 1
+            if not served_new:
+                print("REGISTRY FAIL [swap]: no request ever served the "
+                      "new weights — the swap never landed in the drive "
+                      "window")
+                rc = 1
+            if added:
+                print(f"REGISTRY FAIL [swap]: {added} post-warmup "
+                      "compile(s) — the weight republish re-traced")
+                rc = 1
+            if rc == 0:
+                print(
+                    f"swap: {len(results)} requests, "
+                    f"{swap_row['served_old']} old / {served_new} new, "
+                    "0 lost, 0 torn, 0 compiles"
+                )
+
+        # -- canary sweep ----------------------------------------------------
+        if args.canary_sweep:
+            from pytorch_mnist_ddp_tpu.serving.rollout import (
+                canary_assignment,
+            )
+
+            # After a swap round the primary is v2; canary the OTHER
+            # version so the split is between distinguishable weights.
+            _status, desc = fetch_json(f"{url}/admin/rollout", {})
+            primary = desc["version"]
+            canary_version = "v2" if primary == "v1" else "v1"
+            canary_rows = []
+            compiles_before = engine.compile_count()
+            for pct_s in str(args.canary_sweep).split(","):
+                pct = float(pct_s)
+                status, body = fetch_json(
+                    f"{url}/admin/canary",
+                    {"version": canary_version, "pct": pct},
+                    timeout=args.timeout_s,
+                )
+                if status != 200:
+                    print(f"REGISTRY FAIL [canary {pct:g}%]: /admin/canary "
+                          f"answered {status} ({body})")
+                    rc = 1
+                    break
+                expected_pin = [
+                    engine.predict_logits(
+                        x4, dtype=f"f32@{canary_version}"
+                    ).copy()
+                    for _raw, x4 in payloads
+                ]
+                expected_pri = [
+                    engine.predict_logits(x4).copy()
+                    for _raw, x4 in payloads
+                ]
+                misrouted = failed = canary_served = 0
+                for k, (raw, x4) in enumerate(payloads):
+                    assigned = canary_assignment(x4.tobytes(), pct)
+                    status, logits = _registry_predict(
+                        url, raw, args.timeout_s
+                    )
+                    if status != 200:
+                        failed += 1
+                        continue
+                    want = expected_pin[k] if assigned else expected_pri[k]
+                    if not np.array_equal(logits, want):
+                        misrouted += 1
+                    canary_served += bool(assigned)
+                row = {
+                    "pct": pct,
+                    "requests": len(payloads),
+                    "expected_canary": canary_served,
+                    "failed": failed,
+                    "misrouted": misrouted,
+                }
+                canary_rows.append(row)
+                if failed or misrouted:
+                    print(
+                        f"REGISTRY FAIL [canary {pct:g}%]: {failed} "
+                        f"failed, {misrouted} response(s) not matching "
+                        "the deterministic assignment"
+                    )
+                    rc = 1
+                else:
+                    print(
+                        f"canary {pct:g}%: {canary_served}/{len(payloads)}"
+                        " split to the canary, exact deterministic match"
+                    )
+            status, _body = fetch_json(
+                f"{url}/admin/rollback", {"reason": "sweep_done"},
+                timeout=args.timeout_s,
+            )
+            if status != 200:
+                print(f"REGISTRY FAIL [canary]: rollback answered {status}")
+                rc = 1
+            added = engine.compile_count() - compiles_before
+            if added:
+                print(f"REGISTRY FAIL [canary]: {added} post-warmup "
+                      "compile(s) across the sweep")
+                rc = 1
+            report["canary_sweep"] = {
+                "version": canary_version,
+                "rungs": canary_rows,
+                "additional_compiles": added,
+            }
+        _status, rollout_desc = fetch_json(f"{url}/admin/rollout", {})
+        report["final_rollout"] = rollout_desc
+        report["additional_compiles"] = engine.compile_count() - compiles0
+    finally:
+        _teardown_self_serve(server, sink)
+        cleanup()
+    with open(args.registry_report, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"registry report: {args.registry_report}")
+    print(f"REGISTRY {'PASS' if rc == 0 else 'FAIL'}")
+    return rc
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     parser.add_argument(
@@ -1930,6 +2232,22 @@ def main(argv: list[str] | None = None) -> int:
         "--no-check-compiles", action="store_true",
         help="don't fail when the run triggered additional compiles",
     )
+    parser.add_argument(
+        "--swap-at-s", type=float, default=None,
+        help="registry drive: fire a live /admin/swap to v2 this many "
+        "seconds into a closed-loop hammer; FAIL on any lost request, "
+        "torn response, or post-warmup compile",
+    )
+    parser.add_argument(
+        "--canary-sweep", default=None,
+        help="registry drive: comma-separated canary percentages (e.g. "
+        "25,50); each rung verifies the EXACT deterministic split "
+        "against the offline assignment recomputation, then rolls back",
+    )
+    parser.add_argument(
+        "--registry-report", default="BENCH_registry.json",
+        help="where the registry drive writes its verdict JSON",
+    )
     args = parser.parse_args(argv)
 
     if args.url and args.replicas is not None:
@@ -1964,6 +2282,21 @@ def main(argv: list[str] | None = None) -> int:
         # serving CLI's pre-flight rule).
         parser.error(f"--response-cache must be >= 1, got "
                      f"{args.response_cache}")
+    if args.swap_at_s is not None or args.canary_sweep:
+        if args.url or args.replicas is not None or args.replicas_sweep \
+                or args.chaos or args.ab_tail or args.fleet_sweep \
+                or args.hostpath_ab:
+            parser.error("--swap-at-s / --canary-sweep drive their own "
+                         "single-engine registry stack; drop --url / "
+                         "--replicas / --replicas-sweep / --chaos / "
+                         "--ab-tail / --fleet-sweep / --hostpath-ab")
+        if args.swap_at_s is not None and args.swap_at_s <= 0:
+            parser.error(f"--swap-at-s must be > 0, got {args.swap_at_s}")
+        if args.response_cache is not None:
+            parser.error("the registry drive keeps the response cache "
+                         "off so every outcome is a countable dispatch; "
+                         "drop --response-cache")
+        return run_registry(args)
     if args.hostpath_ab:
         if args.url or args.replicas_sweep or args.chaos or args.ab_tail \
                 or args.fleet_sweep:

@@ -25,6 +25,10 @@ zero post-warmup compiles   Prometheus dump ``jax_compiles_total`` ==
                             + the report's ``additional_compiles``
 recovery (mean s, count)    recovery-round telemetry ``replica_restart``
                             events under the committed chaos clause
+zero-downtime weight swap   swap-round registry report (loadgen
+                            ``--swap-at-s`` + ``--canary-sweep``): zero
+                            lost/torn responses, zero added compiles,
+                            the new weights actually served
 ==========================  =============================================
 
 Each run appends one row to the committed ``BENCH_slo.json`` trajectory
@@ -291,6 +295,76 @@ def run_gate(args) -> int:
         check(
             "recovery_loadgen_verdict", rec_rc == 0, f"rc {rec_rc} == 0"
         )
+
+    # -- round 3: zero-downtime weight swap + canary sweep ---------------------
+    # Registry drive: a live /admin/swap fired mid-trace plus the
+    # committed canary rungs, all on one engine (the drive owns its own
+    # registry stack).  The budget is absolute: zero lost requests, zero
+    # torn responses, zero post-warmup compiles — a weight swap that
+    # drops or re-traces is an outage, not a degradation.
+    if injected is None:
+        swap_report_path = os.path.join(workdir, "registry_report.json")
+        swap_rc = _run_loadgen(
+            "swap",
+            [
+                "--swap-at-s", str(protocol.get("swap_at_s", 1.0)),
+                "--canary-sweep", str(protocol.get("canary_pcts", "25,50")),
+                "--requests", str(protocol["requests"]),
+                "--max-request", str(protocol["max_request"]),
+                "--buckets", str(protocol["buckets"]),
+                "--seed", str(protocol["seed"]),
+                "--timeout-s", str(protocol.get("client_timeout_s", 30)),
+                "--registry-report", swap_report_path,
+            ],
+            devices=1,
+        )
+        try:
+            with open(swap_report_path) as f:
+                swap_report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"slo_gate: swap round produced no report ({e})")
+            return 2
+        swap = swap_report.get("swap", {})
+        sweep = swap_report.get("canary_sweep", {})
+        measured["swap_loadgen_rc"] = swap_rc
+        measured["swap_requests"] = swap.get("requests")
+        measured["swap_lost"] = swap.get("lost_or_failed")
+        measured["swap_torn"] = swap.get("torn")
+        measured["swap_served_new"] = swap.get("served_new")
+        measured["swap_added_compiles"] = swap_report.get(
+            "additional_compiles"
+        )
+        measured["canary_misrouted"] = sum(
+            r.get("misrouted", 0) + r.get("failed", 0)
+            for r in sweep.get("rungs", [])
+        )
+        check(
+            "swap_lost_requests",
+            measured["swap_lost"] == budgets["max_swap_lost"] == 0,
+            f"{measured['swap_lost']} == 0",
+        )
+        check(
+            "swap_torn_responses",
+            measured["swap_torn"] == budgets["max_swap_torn"] == 0,
+            f"{measured['swap_torn']} == 0",
+        )
+        check(
+            "swap_served_new_weights",
+            (measured["swap_served_new"] or 0) > 0,
+            f"{measured['swap_served_new']} > 0",
+        )
+        check(
+            "swap_added_compiles",
+            measured["swap_added_compiles"]
+            == budgets["max_swap_added_compiles"] == 0,
+            f"{measured['swap_added_compiles']} == 0",
+        )
+        check(
+            "canary_exact_split",
+            measured["canary_misrouted"] == 0,
+            f"{measured['canary_misrouted']} misrouted/failed == 0",
+        )
+        check("swap_loadgen_verdict", swap_rc == 0, f"rc {swap_rc} == 0")
 
     passed = not failures
     row = {
