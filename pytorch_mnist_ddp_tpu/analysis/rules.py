@@ -2447,6 +2447,115 @@ class RegistryBypassRule(Rule):
                     )
 
 
+# ---------------------------------------------------------------------------
+# JL023 — per-item pow2 padding inside a dispatch loop (packed batching)
+
+
+# The bucket-math helpers whose presence marks a pad as pow2-ladder
+# padding (serving/buckets.py owns all of them).  Matched by last
+# segment so `buckets.next_power_of_two(...)` and the bare from-import
+# both fire.
+_POW2_PAD_HELPERS = {
+    "pad_to_bucket", "next_power_of_two", "bucket_for", "pow2_buckets",
+}
+
+# Raw pad spellings that, fed a bucket-derived width, reimplement
+# pad_to_bucket inline.
+_RAW_PAD_CALLS = {"np.pad", "numpy.pad", "jnp.pad", "jax.numpy.pad"}
+
+
+class Pow2PadDispatchRule(Rule):
+    """JL023: per-item pow2/bucket padding inside an unbounded dispatch
+    loop outside the bucket helper module.
+
+    The device hot-path waste class packed batching retired (PR 19,
+    docs/SERVING.md): padding each request (or each forming batch) up to
+    its pow2 bucket inside the dispatch loop burns device rows on
+    padding — mean fill ~0.3 at MNIST request sizes — and re-grows the
+    per-bucket executable ladder the packed rows-capacity path
+    deliberately collapsed.  Padding is a *formation* decision, made
+    once, behind the serving surface: the bucketed path owns it in
+    ``serving/buckets.py`` (``StagingPool`` + ``pad_to_bucket``), and
+    the packed path replaces it with segment-id concatenation
+    (``segment_ids``) so the only padding left is the single buffer
+    tail.  A dispatch loop that calls ``pad_to_bucket`` — or
+    reimplements it inline as ``np.pad``/``jnp.pad`` fed
+    ``next_power_of_two``/``bucket_for`` widths — is hiding ladder
+    waste where the fill metrics and the SLO gate's ratcheted
+    ``min_mean_fill_ratio`` cannot see it coming.
+
+    Heuristics: fires inside unbounded loops (``while``/non-replay
+    ``for``, same boundedness test as JL013/JL018) on (a) any call
+    whose name's last segment is ``pad_to_bucket``, and (b) any
+    ``np.pad``/``jnp.pad`` call with a bucket-math helper call
+    (``next_power_of_two``/``bucket_for``/``pow2_buckets``) anywhere in
+    its arguments.  ``serving/buckets.py`` itself is exempt — it IS the
+    sanctioned home of the pow2 ladder.
+    """
+
+    rule_id = "JL023"
+    severity = Severity.WARNING
+    summary = (
+        "per-item pow2/bucket padding inside a dispatch loop; let the "
+        "batcher form batches (packed, or StagingPool-bucketed) instead"
+    )
+
+    @staticmethod
+    def _in_scope(ctx: ModuleContext) -> bool:
+        parts = ctx.path.replace("\\", "/").split("/")
+        return not (
+            parts[-1] == "buckets.py" and "serving" in parts[:-1]
+        )
+
+    @staticmethod
+    def _helper_call(node: ast.AST, names: set[str]) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = dotted_name(node.func)
+        return bool(name) and name.rsplit(".", 1)[-1] in names
+
+    @classmethod
+    def _pow2_pad(cls, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        if cls._helper_call(node, {"pad_to_bucket"}):
+            return True
+        if dotted_name(node.func) not in _RAW_PAD_CALLS:
+            return False
+        in_args = list(node.args) + [
+            kw.value for kw in node.keywords if kw.value is not None
+        ]
+        return any(
+            cls._helper_call(sub, _POW2_PAD_HELPERS)
+            for arg in in_args
+            for sub in ast.walk(arg)
+        )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not self._in_scope(ctx):
+            return
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            if SwallowedDispatchErrorRule._is_bounded_for(loop):
+                continue  # a bounded replay/report pass is not a serve loop
+            for node in iter_loop_body_nodes(loop):
+                if self._pow2_pad(node):
+                    yield self.finding(
+                        ctx, node,
+                        "pow2/bucket padding inside an unbounded dispatch "
+                        "loop: every iteration pays padding rows the "
+                        "device computes and throws away, and each "
+                        "distinct bucket shape grows the executable "
+                        "ladder — the waste packed batching deletes "
+                        "(serving/batcher.py packed mode: requests "
+                        "concatenate into one rows-capacity buffer + "
+                        "segment ids, padding only the single buffer "
+                        "tail); form batches behind the serving surface "
+                        "instead of padding per item here",
+                    )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -2467,6 +2576,7 @@ ALL_RULES: tuple[Rule, ...] = (
     BlockingNetReadLoopRule(),
     FloatListJSONLoopRule(),
     RegistryBypassRule(),
+    Pow2PadDispatchRule(),
 )
 
 

@@ -276,6 +276,8 @@ def predict_config(
     conv_impl: str,
     device_stage: bool,
     version: str = "",
+    packed: bool = False,
+    int8_impl: str = "dot",
 ) -> dict:
     """AOT key config for one serving-forward rung (dtype x bucket).
 
@@ -290,6 +292,14 @@ def predict_config(
     rungs.  The unversioned surfaces (single-checkpoint engine, trainer
     handoff) pass the default ``""`` and keep digest-matching each
     other.
+
+    ``packed`` marks the packed ragged-batching forward (segment-id arg,
+    ``bucket`` is the rows-capacity) — a packed and a bucketed
+    executable at the same shape have different calling conventions and
+    must never alias one entry.  ``int8_impl`` names the dense-head
+    implementation that ACTUALLY runs (``dot`` | ``pallas``); the engine
+    resolves Pallas availability before composing the key, so a
+    fallback run never poisons the kernel entry (docs/COMPILE.md).
     """
     import jax
 
@@ -304,6 +314,8 @@ def predict_config(
         "device_stage": bool(device_stage),
         "prng_impl": str(jax.config.jax_default_prng_impl),
         "version": str(version),
+        "packed": bool(packed),
+        "int8_impl": str(int8_impl),
     }
 
 

@@ -131,17 +131,52 @@ def _int8_dense(x: jax.Array, layer: dict) -> jax.Array:
     return acc.astype(jnp.float32) * (a_scale * layer["scale"]) + layer["bias"]
 
 
-def int8_forward(qparams: dict, x: jax.Array) -> jax.Array:
-    """Eval-mode quantized forward: ``[n, 28, 28, 1]`` f32 -> ``[n, 10]``
-    f32 log-probs.  Same topology as ``Net`` (models/net.py) with
-    dropout inert (eval) and the log_softmax tail f32."""
+def _conv_stack(qparams: dict, x: jax.Array) -> jax.Array:
+    """The shared front half: convs + pool + flatten, f32 throughout."""
     x = x.astype(jnp.float32)
     x = jax.nn.relu(_dequant_conv(x, qparams["conv1"]))
     x = jax.nn.relu(_dequant_conv(x, qparams["conv2"]))
     x = jax.lax.reduce_window(
         x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
     )
-    x = x.reshape(x.shape[0], -1)  # [n, 9216], H*W*C like Net's flatten
+    return x.reshape(x.shape[0], -1)  # [n, 9216], H*W*C like Net's flatten
+
+
+def int8_forward(qparams: dict, x: jax.Array) -> jax.Array:
+    """Eval-mode quantized forward: ``[n, 28, 28, 1]`` f32 -> ``[n, 10]``
+    f32 log-probs.  Same topology as ``Net`` (models/net.py) with
+    dropout inert (eval) and the log_softmax tail f32."""
+    x = _conv_stack(qparams, x)
     x = jax.nn.relu(_int8_dense(x, qparams["fc1"]))
     x = _int8_dense(x, qparams["fc2"])
     return jax.nn.log_softmax(x, axis=-1)
+
+
+def int8_forward_fused(qparams: dict, x: jax.Array) -> jax.Array:
+    """:func:`int8_forward` with the dense head as ONE Pallas kernel.
+
+    Same quantization scheme, same op order — the fused kernel
+    (ops/pallas_infer.py) replicates :func:`_int8_dense` arithmetic
+    op-for-op (integer core exact, f32 tail within compiler fusion
+    jitter), so the serving parity gate covers both with one budget.
+    Convs and
+    the log_softmax tail are unchanged (they are not where the FLOPs
+    are).  Runs in interpret mode automatically off-TPU; callers that
+    must not pay interpret-mode speed gate on
+    ``ops.pallas_infer.pallas_infer_active`` first (the engine does).
+    """
+    from ..ops.pallas_infer import fused_int8_head
+
+    x = _conv_stack(qparams, x)
+    x = fused_int8_head(qparams["fc1"], qparams["fc2"], x)
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def int8_forward_fn(int8_impl: str = "dot"):
+    """The int8 forward for an impl name: ``"dot"`` (reference
+    ``lax.dot_general`` head) or ``"pallas"`` (fused kernel head)."""
+    if int8_impl == "dot":
+        return int8_forward
+    if int8_impl == "pallas":
+        return int8_forward_fused
+    raise ValueError(f"unknown int8 impl {int8_impl!r} (want dot|pallas)")

@@ -1,0 +1,151 @@
+"""Pallas TPU kernel: the int8 dense head as ONE fused pass.
+
+models/quant.py's serving forward spends ~99% of its FLOPs in two dense
+GEMMs (fc1 9216->128, fc2 128->10), and the reference path round-trips
+through f32 between them: quantize activations, int8 GEMM, rescale to
+f32, bias, relu, then do it all again — each stage its own XLA op with
+an HBM-resident intermediate.  This kernel fuses the whole head
+
+    q1   <- clip(round(x / a_scale1), -127, 127)        per-row scale
+    h    <- relu(int32(q1 @ W1_q) * (a_scale1 * s1) + b1)
+    q2   <- clip(round(h / a_scale2), -127, 127)        per-row scale
+    y    <- int32(q2 @ W2_q) * (a_scale2 * s2) + b2
+
+into one VMEM-resident pass: activations never leave the core between
+fc1 and fc2, and the rank-1 rescales + bias + relu ride the MXU
+epilogue.  The arithmetic is OP-FOR-OP the reference
+``models/quant.py:_int8_dense`` (same jnp calls in the same order): the
+integer quantize/GEMM stages are exact, and the f32 rescale tail agrees
+to within compiler mul+add fusion (~1 ulp) — far inside the engine's
+parity gate (logit tolerance + argmax-identical), which covers the
+kernel with the same budget as the reference int8 variant.
+
+fc2's 10 output channels pad to the 128-lane tile with zero weights,
+unit scales, and zero biases — padded lanes compute exactly 0 and are
+sliced off on the way out, so the log_softmax tail (outside the kernel,
+f32, unchanged) sees the true ``[n, 10]`` logits.
+
+On non-TPU backends the kernel runs in Pallas interpret mode, which
+keeps CPU tests meaningful (gate: TPU_MNIST_PALLAS_INTERPRET=1, same
+contract as ops/pallas_adadelta.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_QMAX = 127.0  # symmetric int8, mirrors models/quant.py
+_BLOCK_ROWS = 128  # 128x9216 f32 x block + int8 copy + W1 ~ 7 MiB VMEM
+
+
+def pallas_infer_active(use_pallas: bool | None) -> bool:
+    """Would ``--int8-impl pallas`` actually run the kernel here?
+
+    Same gate as ``ops.pallas_adadelta.pallas_opt_active``: a real TPU
+    lowering, or the explicit interpret-mode test hook.  The serving
+    engine uses it to resolve the requested impl BEFORE composing AOT
+    config keys, so the persisted key always names the impl that ran.
+    """
+    return bool(use_pallas) and (
+        jax.default_backend() == "tpu"
+        or os.environ.get("TPU_MNIST_PALLAS_INTERPRET") == "1"
+    )
+
+
+def _head_kernel(x_ref, w1_ref, s1_ref, b1_ref, w2_ref, s2_ref, b2_ref, out):
+    def dense(x, w_ref, s_ref, b_ref):
+        # Op-for-op models/quant.py:_int8_dense — exact integer core,
+        # f32 tail within fusion jitter of the reference path.
+        a_max = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        a_scale = jnp.where(a_max > 0, a_max / _QMAX, 1.0)
+        x_q = jnp.clip(jnp.round(x / a_scale), -_QMAX, _QMAX).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            x_q, w_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        return acc.astype(jnp.float32) * (a_scale * s_ref[:]) + b_ref[:]
+
+    h = jnp.maximum(dense(x_ref[:], w1_ref, s1_ref, b1_ref), 0.0)
+    out[:] = dense(h, w2_ref, s2_ref, b2_ref)
+
+
+def _pad_axis(v: jax.Array, axis: int, to: int, value: float) -> jax.Array:
+    pad = to - v.shape[axis]
+    if pad == 0:
+        return v
+    widths = [(0, 0)] * v.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(v, widths, constant_values=value)
+
+
+def fused_int8_head(
+    fc1: dict, fc2: dict, x: jax.Array, interpret: bool | None = None
+) -> jax.Array:
+    """``relu(int8_dense(x, fc1))`` then ``int8_dense(., fc2)`` fused.
+
+    ``fc1``/``fc2`` are ``quantize_params`` layer dicts (``kernel_q``
+    int8 ``[in, out]``, ``scale`` f32 ``[out]``, ``bias`` f32 ``[out]``);
+    ``x`` is the f32 ``[n, 9216]`` flattened conv stack output.  Returns
+    f32 ``[n, out2]`` pre-softmax logits.  Rows pad to the f32 sublane
+    tile (and tile in ``_BLOCK_ROWS`` chunks past 128) — zero rows are
+    self-contained under per-row quantization, so padding never touches
+    real rows.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, d_in = x.shape
+    d_mid = fc1["kernel_q"].shape[1]
+    d_out = fc2["kernel_q"].shape[1]
+    if d_mid % _LANES:
+        raise ValueError(f"fc1 output width {d_mid} is not lane-aligned")
+
+    rows = -(-n // 8) * 8 if n <= _BLOCK_ROWS else -(-n // _BLOCK_ROWS) * _BLOCK_ROWS
+    block_rows = min(rows, _BLOCK_ROWS)
+    x2 = _pad_axis(x.astype(jnp.float32), 0, rows, 0.0)
+
+    # fc2's narrow output pads to one lane tile: zero weights keep the
+    # int32 accumulator at 0, unit scales keep the rescale finite, zero
+    # biases keep the padded lanes exactly 0.
+    w2 = _pad_axis(fc2["kernel_q"], 1, _LANES, 0)
+    s2 = _pad_axis(fc2["scale"], 0, _LANES, 1.0)
+    b2 = _pad_axis(fc2["bias"], 0, _LANES, 0.0)
+
+    row2d = lambda v: v.reshape(1, -1).astype(jnp.float32)
+    fixed = lambda shape: pl.BlockSpec(
+        shape, lambda i: (0, 0), memory_space=pltpu.VMEM
+    )
+    y = pl.pallas_call(
+        _head_kernel,
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec(
+                (block_rows, d_in), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            fixed((d_in, d_mid)),
+            fixed((1, d_mid)),
+            fixed((1, d_mid)),
+            fixed((d_mid, _LANES)),
+            fixed((1, _LANES)),
+            fixed((1, _LANES)),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_rows, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+        interpret=interpret,
+    )(
+        x2,
+        fc1["kernel_q"],
+        row2d(fc1["scale"]),
+        row2d(fc1["bias"]),
+        w2,
+        row2d(s2),
+        row2d(b2),
+    )
+    return y[:n, :d_out]

@@ -247,7 +247,7 @@ def make_predict_step(
     return jax.jit(sharded)
 
 
-def make_int8_predict_step(mesh: Mesh):
+def make_int8_predict_step(mesh: Mesh, int8_impl: str = "dot"):
     """Build the jitted int8 forward for the serving path.
 
     The quantized twin of :func:`make_predict_step`: ``predict_fn
@@ -256,13 +256,77 @@ def make_int8_predict_step(mesh: Mesh):
     (replicated).  Same one-trace-per-bucket contract, enforced by the
     engine's per-variant RecompileSentinel; parity with the f32 forward
     is gated at warmup (serving/engine.py verify_parity), never assumed.
-    """
-    from ..models.quant import int8_forward
 
+    ``int8_impl`` selects the dense-head implementation: ``"dot"`` is
+    the reference ``lax.dot_general`` path, ``"pallas"`` the fused
+    Pallas kernel (ops/pallas_infer.py) — same quantization scheme, so
+    the engine's parity gate covers both.
+    """
+    from ..models.quant import int8_forward_fn
+
+    fwd = int8_forward_fn(int8_impl)
     sharded = shard_map(
-        int8_forward,
+        fwd,
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def make_packed_predict_step(
+    mesh: Mesh, compute_dtype: jnp.dtype = jnp.float32, use_bn: bool = False,
+    conv_impl: str = "conv",
+):
+    """Packed twin of :func:`make_predict_step` for ragged batching.
+
+    ``predict_fn(params, x, seg_ids) -> log_probs`` where ``x`` is one
+    dense ``[capacity, ...]`` rows buffer holding several requests
+    back-to-back and ``seg_ids`` is the ``int32[capacity]`` segment-id
+    vector (serving/buckets.py ``segment_ids``): row -> owning request,
+    ``-1`` on padding rows.  Rows are per-sample independent through the
+    eval-mode forward, so live rows are bit-identical to the padded
+    path; padding rows are masked to exactly 0.0 on device (rather than
+    whatever log_softmax of a zero row gives) so the host-side unpacker
+    can assert on them cheaply.  Segment VALUES never affect compilation
+    — the trace is keyed by the capacity shape alone, preserving the
+    one-executable contract the packed ladder exists for.
+    """
+    model = Net(
+        compute_dtype=compute_dtype, use_bn=use_bn, conv_impl=conv_impl
+    )
+
+    def local_predict(params, x, seg_ids):
+        variables = params if use_bn else {"params": params}
+        logits = model.apply(variables, x, train=False)
+        return jnp.where(seg_ids[:, None] >= 0, logits, 0.0)
+
+    sharded = shard_map(
+        local_predict,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def make_packed_int8_predict_step(mesh: Mesh, int8_impl: str = "dot"):
+    """Packed twin of :func:`make_int8_predict_step` (see
+    :func:`make_packed_predict_step` for the segment contract)."""
+    from ..models.quant import int8_forward_fn
+
+    fwd = int8_forward_fn(int8_impl)
+
+    def local_predict(qparams, x, seg_ids):
+        logits = fwd(qparams, x)
+        return jnp.where(seg_ids[:, None] >= 0, logits, 0.0)
+
+    sharded = shard_map(
+        local_predict,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(DATA_AXIS),
         check_vma=False,
     )

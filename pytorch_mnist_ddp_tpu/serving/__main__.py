@@ -267,6 +267,29 @@ def build_parser() -> argparse.ArgumentParser:
         "CONV_IMPLS)",
     )
     parser.add_argument(
+        "--packed", action="store_true",
+        help="packed ragged batching (docs/SERVING.md): concatenate "
+        "requests into one rows-capacity buffer + segment-id vector "
+        "instead of padding each batch to its pow2 bucket — collapses "
+        "the executable ladder to the top capacity and drives fill "
+        "toward 1.0 (the PR-19 device hot-path floor)",
+    )
+    parser.add_argument(
+        "--fill-wait-ms", type=float, default=None,
+        help="packed mode only: how long a forming batch may wait for "
+        "more rows before dispatching part-full (replaces the linger "
+        "ceiling; the adaptive controller still shrinks it under deep "
+        "queue, where batches fill by splitting anyway)",
+    )
+    parser.add_argument(
+        "--int8-impl", default="dot", choices=("dot", "pallas"),
+        help="int8 dense-head lowering: 'dot' = reference "
+        "lax.dot_general GEMMs, 'pallas' = fused "
+        "dequant-matmul-bias-relu-matmul kernel (ops/pallas_infer.py); "
+        "'pallas' falls back to 'dot' with a warning off-TPU unless "
+        "TPU_MNIST_PALLAS_INTERPRET=1",
+    )
+    parser.add_argument(
         "--cache-dir", default=None,
         help="persistent XLA compile cache directory (default: the "
         "JAX_COMPILATION_CACHE_DIR env var, else the utils/cache_dir "
@@ -437,6 +460,8 @@ def main(argv: list[str] | None = None) -> int:
         dtypes=[d for d in dtypes if d != "f32"],
         aot_cache=args.aot_cache,
         device_stage=False if args.no_device_stage else None,
+        packed=args.packed,
+        int8_impl=args.int8_impl,
     )
     pool_mode = args.replicas is not None
     if pool_mode:
@@ -601,6 +626,7 @@ def main(argv: list[str] | None = None) -> int:
         deadline_aware=not args.no_deadline_close,
         qos_weights=qos_weights,
         heartbeat=hb.beat if hb is not None else None,
+        fill_wait_ms=args.fill_wait_ms,
     )
     rollout = None
     if registry is not None:

@@ -50,6 +50,23 @@ get 503) and, by default, lets the dispatch worker finish everything
 already admitted AND the completion worker read back everything already
 launched before joining — nothing in the queue or the in-flight window
 is lost.
+
+**Packed ragged batching** (PR 19, docs/SERVING.md): when the engine
+serves the packed path (``engine.packed``), batches are SEGMENT lists —
+``(request, start, rows)`` triples — instead of whole-request lists.
+Requests concatenate back-to-back into one rows-capacity buffer with a
+segment-id vector (serving/buckets.py), and a request that would
+overflow the forming batch is SPLIT: the head fills this batch exactly
+to capacity, the remainder carries to lead the next one — so every
+deep-queue batch dispatches 100% full, which is where the ratcheted
+``min_mean_fill_ratio`` budget comes from.  The completion worker
+reassembles split requests from per-request assembly buffers keyed by
+segment boundaries, bit-identical to the padded path (pinned in
+tests).  Under light load the **fill wait** (``fill_wait_ms``) replaces
+the millisecond linger as the adaptive controller's ceiling: packed
+mode trades a bounded wait for a full buffer, and the controller still
+collapses the wait toward 0 when the queue is deep (a deep queue fills
+the buffer instantly anyway).
 """
 
 from __future__ import annotations
@@ -293,15 +310,26 @@ class AdaptiveLinger:
 
 
 class _InFlight:
-    """One launched batch riding the dispatch→completion queue."""
+    """One launched batch riding the dispatch→completion queue.
+
+    ``batch`` is the unique member requests (the failure/abort paths'
+    unit of accounting — a request appears at most once per batch, even
+    split); ``segments`` is the row layout: ``(request, start, rows)``
+    per staged block, in staging order, where ``start`` is the block's
+    offset within the REQUEST (non-zero only for the carried remainder
+    of a packed split).  In bucketed mode segments are always whole
+    requests, so the completion slicing below reduces to the PR-4
+    ``host[offset : offset + req.n]`` exactly."""
 
     __slots__ = (
-        "batch", "logits", "staged", "bucket", "n", "stall_s", "dtype",
-        "t_launch",
+        "batch", "segments", "logits", "staged", "bucket", "n", "stall_s",
+        "dtype", "t_launch",
     )
 
-    def __init__(self, batch, logits, staged, bucket, n, stall_s, dtype):
+    def __init__(self, batch, segments, logits, staged, bucket, n, stall_s,
+                 dtype):
         self.batch = batch
+        self.segments = segments
         self.logits = logits
         self.staged = staged
         self.bucket = bucket
@@ -332,6 +360,7 @@ class MicroBatcher:
         timeout_ms: float = 1000.0,
         max_inflight: int = 2,
         adaptive_linger: bool = True,
+        fill_wait_ms: float | None = None,
         sink=None,
         replica: str | None = None,
         deadline_aware: bool = True,
@@ -364,7 +393,22 @@ class MicroBatcher:
         self.on_expire = None
         self.metrics = metrics if metrics is not None else engine.metrics
         self.max_batch = min(max_batch or top, top)
+        # Packed ragged batching rides the ENGINE's mode (module
+        # docstring): segment staging, request splitting at the capacity
+        # boundary, and the fill-wait close ceiling all key off it, so a
+        # batcher can never disagree with its engine about the layout.
+        self.packed = bool(getattr(engine, "packed", False))
         self.linger_s = linger_ms / 1e3
+        # The packed close ceiling: waiting to FILL the rows buffer is
+        # the whole fill-ratio win under light load, and is worth more
+        # than a millisecond linger (the capacity only pads one buffer,
+        # not one per rung).  None keeps the plain linger — bucketed
+        # mode ignores the flag entirely.
+        self.fill_wait_s = (
+            fill_wait_ms / 1e3
+            if (self.packed and fill_wait_ms is not None)
+            else None
+        )
         self.timeout_s = timeout_ms / 1e3
         self.max_inflight = max_inflight
         # Variant routing: engines expose their served dtype names (the
@@ -374,7 +418,8 @@ class MicroBatcher:
         self._registry = self.metrics.registry if self.metrics is not None else None
         self._sink = sink
         self._linger = AdaptiveLinger(
-            self.linger_s, enabled=adaptive_linger, registry=self._registry,
+            self.fill_wait_s if self.fill_wait_s is not None else self.linger_s,
+            enabled=adaptive_linger, registry=self._registry,
             replica=self.replica,
         )
         # Deadline-aware batch close (docs/SERVING.md tail latency): the
@@ -402,6 +447,13 @@ class MicroBatcher:
         # One spare staging slot beyond the window so batch N+1 pads
         # while the window is still full with batches N-k..N.
         self._staging: StagingPool | None = None
+        # Packed-split reassembly (completion worker only — single
+        # thread, no lock): id(request) -> [request, out_buffer,
+        # rows_filled].  A split request completes when its last part
+        # lands; entries whose request settled elsewhere (hedge twin,
+        # launch failure on the sibling batch) are swept on the
+        # completion cadence.
+        self._assembly: dict[int, list] = {}
         self._inflight_lock = make_lock("batcher.inflight")
         self._inflight = 0
         self.peak_inflight = 0
@@ -603,7 +655,9 @@ class MicroBatcher:
     def current_linger_ms(self) -> float:
         """What the adaptive controller is currently waiting (ms)."""
         return 1e3 * (
-            self._linger.current_s if self._linger.enabled else self.linger_s
+            self._linger.current_s
+            if self._linger.enabled
+            else self._linger.ceiling_s
         )
 
     # -- admission (any thread) ----------------------------------------------
@@ -841,12 +895,16 @@ class MicroBatcher:
         return close
 
     def _run(self) -> None:
-        carry: PendingRequest | None = None
+        # The carried leader of the next batch: (request, start-row).
+        # start > 0 only in packed mode, where a request split at the
+        # capacity boundary carries its REMAINDER forward; bucketed mode
+        # always carries whole requests (start 0).
+        carry: tuple[PendingRequest, int] | None = None
         while True:
             if self._heartbeat is not None:
                 self._heartbeat()
             if carry is not None:
-                first, carry = carry, None
+                (first, first_start), carry = carry, None
             else:
                 try:
                     first = self._queue.get(timeout=0.05)
@@ -861,13 +919,14 @@ class MicroBatcher:
                     self._linger.update(0)
                     self.sweep_expired()
                     continue
+                first_start = 0
             if first.done():
                 continue  # settled elsewhere (hedge twin won); free slot
             if first.expired():
                 self._expire(first)
                 continue
-            batch = [first]
-            total = first.n
+            segs = [(first, first_start, first.n - first_start)]
+            total = first.n - first_start
             oldest_deadline = first.deadline
             # Linger: coalesce until the batch is full or the close
             # deadline passes.  A draining batcher skips the linger —
@@ -876,7 +935,10 @@ class MicroBatcher:
             # the CURRENT queue depth: deep queue -> the next batch is
             # already here, lingering is pure latency.  Deadline-aware
             # close additionally dispatches early when the oldest
-            # member's budget is nearly spent (_close_at).
+            # member's budget is nearly spent (_close_at).  In packed
+            # mode the controller's ceiling is the FILL WAIT (module
+            # docstring): worth paying under light load, collapsed by
+            # the controller when the queue is deep.
             linger = (
                 0.0 if self._closed.is_set()
                 else self._linger.update(self._queue.qsize())
@@ -897,16 +959,31 @@ class MicroBatcher:
                 if nxt.expired():
                     self._expire(nxt)
                     continue
-                if total + nxt.n > self.max_batch:
-                    carry = nxt  # doesn't fit; leads the next batch
-                    break
                 if nxt.dtype != first.dtype:
                     # Variants dispatch on different executables; a
                     # mixed batch cannot coalesce.  The stranger leads
-                    # the next batch instead.
-                    carry = nxt
+                    # the next batch instead.  (Checked BEFORE the size
+                    # split: a packed split across dtypes would stage
+                    # rows on the wrong executable.)
+                    carry = (nxt, 0)
                     break
-                batch.append(nxt)
+                if total + nxt.n > self.max_batch:
+                    if self.packed:
+                        # Packed split: the head fills THIS buffer to
+                        # exactly its capacity, the remainder leads the
+                        # next batch.  This is what keeps deep-queue
+                        # batches at 100% fill instead of fragmenting at
+                        # every carry boundary.
+                        head = self.max_batch - total
+                        segs.append((nxt, 0, head))
+                        total = self.max_batch
+                        carry = (nxt, head)
+                        if nxt.deadline < oldest_deadline:
+                            oldest_deadline = nxt.deadline
+                    else:
+                        carry = (nxt, 0)  # doesn't fit; leads the next batch
+                    break
+                segs.append((nxt, 0, nxt.n))
                 total += nxt.n
                 if nxt.deadline < oldest_deadline:
                     # QoS-weighted dequeue can hand us a member with an
@@ -919,22 +996,27 @@ class MicroBatcher:
                             time.perf_counter(), linger, oldest_deadline
                         ),
                     )
-            self._dispatch(batch)
+            self._dispatch(segs)
 
-    def _dispatch(self, batch: list[PendingRequest]) -> None:
+    def _dispatch(
+        self, segs: list[tuple[PendingRequest, int, int]]
+    ) -> None:
         """Pad into staging, launch async, hand off to completion.
 
-        Runs entirely on the dispatch worker; never blocks on device
-        compute — only (briefly) on a full in-flight window, which is
-        recorded as pipeline stall.
+        ``segs`` is the formed batch as ``(request, start, rows)``
+        segments (whole requests in bucketed mode; possibly a split head
+        or carried remainder in packed mode).  Runs entirely on the
+        dispatch worker; never blocks on device compute — only (briefly)
+        on a full in-flight window, which is recorded as pipeline stall.
         """
         # A member can settle between its dequeue and here (a hedge twin
         # completing on the other replica): dispatching it would burn
         # bucket rows on an answer nobody is waiting for.
-        batch = [r for r in batch if not r.done()]
-        if not batch:
+        segs = [s for s in segs if not s[0].done()]
+        if not segs:
             return
-        parts = [r.x for r in batch]
+        batch = [s[0] for s in segs]  # unique: one segment per request
+        parts = [r.x[start : start + rows] for r, start, rows in segs]
         total = sum(len(p) for p in parts)
         if self._staging is None:
             # Sized lazily from the first request's row shape so fakes
@@ -948,6 +1030,10 @@ class MicroBatcher:
             )
         with span("serving_pad", sink=self._sink, registry=self._registry):
             staged, bucket = self._staging.stage(parts)
+        if self.packed:
+            from .buckets import segment_ids
+
+            seg_vec = segment_ids([len(p) for p in parts], bucket)
         if self._window.acquire(blocking=False):
             stall_s = 0.0  # free slot: the common, fully overlapped case
         else:
@@ -966,7 +1052,11 @@ class MicroBatcher:
                 fault_point("launch", self.replica)
                 # Default-dtype dispatch keeps the bare two-arg call so
                 # fake engines (tests) need not grow a dtype kwarg.
-                if dtype == self._default_dtype:
+                if self.packed:
+                    logits = self.engine.launch(
+                        staged, total, dtype=dtype, seg_ids=seg_vec
+                    )
+                elif dtype == self._default_dtype:
                     logits = self.engine.launch(staged, total)
                 else:
                     logits = self.engine.launch(staged, total, dtype=dtype)
@@ -1008,7 +1098,9 @@ class MicroBatcher:
                     pass  # a hook failure must never kill the worker
             return
         self.consecutive_launch_failures = 0
-        item = _InFlight(batch, logits, staged, bucket, total, stall_s, dtype)
+        item = _InFlight(
+            batch, segs, logits, staged, bucket, total, stall_s, dtype
+        )
         aborted = False
         with self._inflight_lock:
             aborted = self._aborted.is_set()
@@ -1115,17 +1207,43 @@ class MicroBatcher:
                 # already-errored waiters.
                 aborted = self._aborted.is_set()
                 offset = 0
-                for req in item.batch:
-                    # First-wins gate doubles as the hedge cancellation
-                    # accounting (docs/SERVING.md): the losing replica's
-                    # read must not re-count the request on completed/
-                    # latency families nor feed on_complete -> the
-                    # breaker's success side — exactly one client
-                    # outcome, counted exactly once.
-                    won = req.set_result(
-                        host[offset : offset + req.n], by=self.replica
-                    )
-                    offset += req.n
+                for req, start, rows in item.segments:
+                    part = host[offset : offset + rows]
+                    offset += rows
+                    if rows == req.n:
+                        # Whole-request segment: the PR-4 fast path.
+                        # First-wins gate doubles as the hedge
+                        # cancellation accounting (docs/SERVING.md): the
+                        # losing replica's read must not re-count the
+                        # request on completed/latency families nor feed
+                        # on_complete -> the breaker's success side —
+                        # exactly one client outcome, counted exactly
+                        # once.
+                        won = req.set_result(part, by=self.replica)
+                    else:
+                        # Packed split: copy this part into the
+                        # request's assembly buffer; only the LAST part
+                        # completes the waiter (bit-identical rows — the
+                        # device computed each row independently of its
+                        # batch-mates, pinned in tests).
+                        if req.done():
+                            continue  # settled elsewhere; swept below
+                        entry = self._assembly.get(id(req))
+                        if entry is None:
+                            entry = [
+                                req,
+                                np.empty(
+                                    (req.n, *part.shape[1:]), part.dtype
+                                ),
+                                0,
+                            ]
+                            self._assembly[id(req)] = entry
+                        entry[1][start : start + rows] = part
+                        entry[2] += rows
+                        if entry[2] < req.n:
+                            continue  # the remainder is still in flight
+                        del self._assembly[id(req)]
+                        won = req.set_result(entry[1], by=self.replica)
                     latency_s = done - req.t_submit
                     if not won:
                         continue
@@ -1173,7 +1291,19 @@ class MicroBatcher:
                     fill_ratio=item.n / item.bucket, stall_s=item.stall_s,
                     dtype=item.dtype,
                     **({"replica": self.replica} if self.replica else {}),
+                    # Tagged only in packed mode so pre-PR-19 bucketed
+                    # JSONL stays byte-stable (the qos schema note above).
+                    **({"packed": True} if self.packed else {}),
                 )
+            # Drop assembly buffers whose request settled elsewhere (a
+            # hedge twin answered, or the sibling batch's failure path
+            # errored it) — a dead split must not pin its buffer until
+            # shutdown.
+            if self._assembly:
+                for key in [
+                    k for k, e in self._assembly.items() if e[0].done()
+                ]:
+                    del self._assembly[key]
             # Eager expiry on the completion cadence too: when the
             # dispatch worker is parked on a full in-flight window, this
             # is the thread that still runs — queued requests whose

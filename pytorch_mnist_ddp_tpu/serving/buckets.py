@@ -77,6 +77,64 @@ def validate_buckets(buckets: Sequence[int], n_shards: int = 1) -> tuple[int, ..
     return tuple(out)
 
 
+def packed_capacities(
+    max_bucket: int, n_shards: int = 1, rungs: int = 1
+) -> tuple[int, ...]:
+    """The rows-capacity ladder for packed batch formation.
+
+    Packed mode replaces the full pow2 ladder with one (default) or two
+    rows-capacities: requests are concatenated into a single dense rows
+    buffer, so per-batch shape variety — the reason the ladder had a rung
+    per pow2 — disappears, and with it all but one (or two) executables,
+    warmup traces, and AOT entries.  ``rungs=2`` adds a half-capacity
+    rung for deployments where a lone small batch at the top capacity
+    would be worse than a second executable.
+
+    The top capacity is ``max_bucket`` rounded UP to a power of two (and
+    to shard divisibility), so a packed engine accepts exactly the
+    request sizes its bucketed twin did.  Idempotent: feeding a ladder
+    that is already packed returns the same capacities.
+    """
+    if max_bucket < 1:
+        raise ValueError(f"need max_bucket >= 1, got {max_bucket}")
+    if rungs not in (1, 2):
+        raise ValueError(f"packed ladders have 1 or 2 rungs, got {rungs}")
+    top = 1
+    while top < max(max_bucket, n_shards):
+        top *= 2
+    if top % n_shards:
+        raise ValueError(
+            f"capacity {top} not divisible by the {n_shards}-way data axis"
+        )
+    if rungs == 2 and top // 2 >= max(1, n_shards) and top // 2 % n_shards == 0:
+        return (top // 2, top)
+    return (top,)
+
+
+def segment_ids(lengths: Sequence[int], capacity: int) -> np.ndarray:
+    """The segment-id vector for one packed rows buffer.
+
+    ``int32[capacity]`` mapping each row to the index of the request
+    (segment) that owns it, in staging order; padding rows in the tail
+    get ``-1`` so the device-side mask can zero them deterministically.
+    Host numpy only — this is the single source of truth for the packed
+    layout, shared by the batcher (staging), the engine (warmup example
+    args), and the tests that pin unpacking bit-identity.
+    """
+    total = 0
+    ids = np.full(capacity, -1, np.int32)
+    for seg, n in enumerate(lengths):
+        if n < 1:
+            raise ValueError(f"segment {seg} has non-positive length {n}")
+        if total + n > capacity:
+            raise ValueError(
+                f"segments total {total + n} overflow capacity {capacity}"
+            )
+        ids[total : total + n] = seg
+        total += n
+    return ids
+
+
 def bucket_for(n: int, buckets: Sequence[int]) -> int:
     """The smallest bucket >= n (the shape actually dispatched).
 

@@ -53,11 +53,18 @@ from ..analysis.sentinel import RecompileError, RecompileSentinel
 from ..models.net import INPUT_SHAPE, NUM_CLASSES, init_params, init_variables
 from ..parallel.ddp import (
     make_int8_predict_step,
+    make_packed_int8_predict_step,
+    make_packed_predict_step,
     make_predict_step,
     replicate_params,
 )
 from ..parallel.mesh import DATA_AXIS, make_mesh
-from .buckets import StagingPool, pow2_buckets, validate_buckets
+from .buckets import (
+    StagingPool,
+    packed_capacities,
+    pow2_buckets,
+    validate_buckets,
+)
 from .metrics import ServingMetrics
 
 # The default (reference-precision) variant every engine serves.
@@ -171,6 +178,21 @@ class InferenceEngine:
     metrics:
         Optional :class:`ServingMetrics`; per-dispatch occupancy is
         recorded when present.
+    packed:
+        Packed ragged batching (docs/SERVING.md): collapse the pow2
+        ladder to the rows-capacity ladder (serving/buckets.py
+        ``packed_capacities``) and serve the segment-aware forward —
+        requests concatenate into one dense rows buffer plus a
+        segment-id vector instead of padding each batch to its own
+        rung.  ``self.buckets`` then IS the capacity ladder, so
+        staging, sentinel budgets, AOT store sizing, and metrics all
+        see the collapsed grid through the existing surface.
+    int8_impl:
+        Dense-head implementation for the int8 variant: ``"dot"``
+        (reference) or ``"pallas"`` (ops/pallas_infer.py fused kernel).
+        Pallas on a backend without a real lowering falls back to
+        ``"dot"`` with a warning BEFORE any AOT key is composed, so
+        the persisted config always names the impl that ran.
     """
 
     def __init__(
@@ -186,6 +208,8 @@ class InferenceEngine:
         aot_cache: str | None = None,
         device_stage: bool | None = None,
         version: str = "",
+        packed: bool = False,
+        int8_impl: str = "dot",
     ):
         # The model-registry version identity of the served weights
         # ("" = the unversioned single-checkpoint path, which keeps the
@@ -201,6 +225,32 @@ class InferenceEngine:
         elif max_bucket is not None:
             raise ValueError("pass buckets or max_bucket, not both")
         self.buckets = validate_buckets(buckets, n_shards)
+        self.packed = bool(packed)
+        if self.packed:
+            # The packed grid: one (or two) rows-capacities instead of a
+            # rung per pow2.  Idempotent, so the pool can pre-resolve
+            # capacities for store sizing and pass them back in here.
+            self.buckets = packed_capacities(self.buckets[-1], n_shards)
+        if int8_impl not in ("dot", "pallas"):
+            raise ValueError(
+                f"unknown int8 impl {int8_impl!r} (want dot|pallas)"
+            )
+        if int8_impl == "pallas":
+            from ..ops.pallas_infer import pallas_infer_active
+
+            if not pallas_infer_active(True):
+                import warnings
+
+                warnings.warn(
+                    "--int8-impl pallas requested on backend "
+                    f"{jax.default_backend()!r}, which has no real Pallas "
+                    "lowering; serving the reference dot-general head "
+                    "instead (set TPU_MNIST_PALLAS_INTERPRET=1 to force "
+                    "interpret mode for testing)",
+                    stacklevel=2,
+                )
+                int8_impl = "dot"
+        self.int8_impl = int8_impl
         self.use_bn = "bn1" in variables.get("params", {})
         if self.use_bn and "batch_stats" not in variables:
             # A BN model without running averages would eval-normalize by
@@ -252,7 +302,10 @@ class InferenceEngine:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         self._input_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
-        fn = make_predict_step(
+        make_default = (
+            make_packed_predict_step if self.packed else make_predict_step
+        )
+        fn = make_default(
             self.mesh,
             compute_dtype=compute_dtype or jax.numpy.float32,
             use_bn=self.use_bn,
@@ -316,7 +369,10 @@ class InferenceEngine:
 
     def _build_variant(self, name: str, variables, registry) -> _Variant:
         if name == "bf16":
-            fn = make_predict_step(
+            make_fn = (
+                make_packed_predict_step if self.packed else make_predict_step
+            )
+            fn = make_fn(
                 self.mesh,
                 compute_dtype=jax.numpy.bfloat16,
                 use_bn=self.use_bn,
@@ -331,7 +387,12 @@ class InferenceEngine:
                     "int8 variant does not support BatchNorm checkpoints; "
                     "serve BN checkpoints at f32 or bf16"
                 )
-            fn = make_int8_predict_step(self.mesh)
+            make_fn = (
+                make_packed_int8_predict_step
+                if self.packed
+                else make_int8_predict_step
+            )
+            fn = make_fn(self.mesh, int8_impl=self.int8_impl)
             placed = replicate_params(
                 quantize_params(jax.device_get(variables["params"])),
                 self.mesh,
@@ -429,13 +490,35 @@ class InferenceEngine:
             return staged
         return jax.device_put(staged, self._input_sharding)
 
-    def _run_variant(self, v: _Variant, staged):
+    def _stage_seg(self, seg):
+        """The segment-id leg of :meth:`_stage` (packed mode): commit
+        the int32 vector to the same data-axis sharding as the rows
+        buffer, so seg values shard row-aligned with their rows."""
+        if not self.device_stage or not isinstance(seg, np.ndarray):
+            return seg
+        return jax.device_put(seg, self._input_sharding)
+
+    def _run_variant(self, v: _Variant, staged, seg=None):
         """Dispatch one bucket-shaped batch on a variant, bypassing the
         verified gate (warmup and the parity gate itself come through
         here; request traffic goes through :meth:`launch`).  Steady
         state is ``Program.call`` — the executable fast path in AOT
-        mode, the sentinel-guarded jit wrapper otherwise."""
+        mode, the sentinel-guarded jit wrapper otherwise.
+
+        Packed mode takes the segment-id vector as a third arg;
+        ``seg=None`` (warmup sweeps, parity slices, direct calls)
+        synthesizes the all-live vector — every row segment 0 — which
+        masks nothing, so those paths see exactly the bucketed
+        semantics."""
         staged = self._stage(staged)
+        if self.packed:
+            if seg is None:
+                seg = np.zeros(len(staged), np.int32)
+            seg = self._stage_seg(seg)
+            prog = v.programs.get(len(staged))
+            if prog is not None:
+                return prog.call(v.variables, staged, seg)
+            return v.predict(v.variables, staged, seg)
         prog = v.programs.get(len(staged))
         if prog is not None:
             return prog.call(v.variables, staged)
@@ -452,6 +535,7 @@ class InferenceEngine:
         if prog is None:
             from ..compile import Program, predict_config
 
+            base_dtype = v.name.split(VERSION_SEP)[0]
             prog = Program(
                 f"predict_step[{v.name}][{b}]",
                 v.jit_fn,
@@ -459,12 +543,24 @@ class InferenceEngine:
                 example_args=lambda: (
                     v.variables,
                     self._stage(np.zeros((b, *INPUT_SHAPE), np.float32)),
+                    *(
+                        (self._stage_seg(np.zeros(b, np.int32)),)
+                        if self.packed
+                        else ()
+                    ),
                 ),
                 config=predict_config(
-                    self.mesh, v.name.split(VERSION_SEP)[0], b,
+                    self.mesh, base_dtype, b,
                     use_bn=self.use_bn,
                     conv_impl=self._conv_impl,
                     device_stage=self.device_stage,
+                    packed=self.packed,
+                    # Only the int8 forward has a head impl choice; f32/
+                    # bf16 keep the default key so their digests are
+                    # impl-independent.
+                    int8_impl=(
+                        self.int8_impl if base_dtype == "int8" else "dot"
+                    ),
                     # A version-pinned variant ("f32@v2") keys the store
                     # under ITS version; the primary keys under the
                     # engine's ("" on the unversioned path — digest
@@ -533,7 +629,12 @@ class InferenceEngine:
         jobs = [
             (vname, b) for vname in self._variants for b in self.buckets
         ]
-        if parallel and len(jobs) > 1:
+        # Even a single job rides the service (a packed engine's
+        # collapsed ladder is exactly one rung per variant): the service
+        # is where compile spans and the compile_seconds counters are
+        # emitted, and a spanless warmup would make the packed rung
+        # invisible to perf_report's device-path section.
+        if parallel and jobs:
             from ..compile import CompileService
 
             with CompileService(
@@ -842,7 +943,13 @@ class InferenceEngine:
 
     # -- serving --------------------------------------------------------------
 
-    def launch(self, staged: np.ndarray, n: int, dtype: str | None = None):
+    def launch(
+        self,
+        staged: np.ndarray,
+        n: int,
+        dtype: str | None = None,
+        seg_ids: np.ndarray | None = None,
+    ):
         """Dispatch one already-bucket-shaped batch WITHOUT reading back.
 
         ``staged`` must be exactly a warmed bucket shape (the batcher and
@@ -854,9 +961,28 @@ class InferenceEngine:
         dispatch means this does NOT wait for the compute, so the caller
         can overlap host work (padding the next batch) with device
         execution and read the result later with ``np.asarray``.
+
+        Packed mode additionally takes ``seg_ids`` — the int32
+        ``[capacity]`` segment-id vector (serving/buckets.py
+        ``segment_ids``) mapping each live row to its request and
+        padding rows to ``-1``; omitted, the whole buffer dispatches as
+        one all-live segment.  ``n`` stays the LIVE row count, so
+        ``serving_batch_fill_ratio`` measures real rows over
+        rows-capacity in both modes (satellite accounting contract,
+        serving/metrics.py).
         """
         v = self._variant_for(dtype)
         bucket = len(staged)
+        if seg_ids is not None and not self.packed:
+            raise ValueError(
+                "seg_ids passed to a bucketed engine; packed=True is the "
+                "segment-aware path"
+            )
+        if seg_ids is not None and len(seg_ids) != bucket:
+            raise ValueError(
+                f"seg_ids length {len(seg_ids)} does not match the "
+                f"{bucket}-row staged buffer"
+            )
         if bucket not in self.buckets:
             raise ValueError(
                 f"staged batch of {bucket} rows is not a warmed bucket "
@@ -869,7 +995,7 @@ class InferenceEngine:
                 f"variant {v.name!r} has not passed its parity gate "
                 "(engine.verify_parity); refusing to serve it"
             )
-        logits = self._run_variant(v, staged)
+        logits = self._run_variant(v, staged, seg=seg_ids)
         if self.metrics is not None:
             self.metrics.record_batch(n, bucket)
         return logits

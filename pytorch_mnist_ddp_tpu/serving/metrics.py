@@ -75,12 +75,17 @@ class ServingMetrics:
         # stall the dispatch thread pays waiting for an in-flight slot.
         self._fill = self.registry.histogram(
             "serving_batch_fill_ratio",
-            help="live rows / bucket slots per dispatch (1.0 = no padding)",
+            help="live rows / dispatched rows per dispatch (1.0 = no "
+            "padding).  The denominator is what the DEVICE computed: the "
+            "pow2 bucket in padded mode, the rows-capacity in packed mode "
+            "— a packed batch with a padded tail must NOT read as 100% "
+            "fill (PR-19 accounting contract, pinned in tests)",
             reservoir=reservoir,
         )
         self._padding_rows = self.registry.histogram(
             "serving_padding_waste_rows",
-            help="padding rows per dispatch (bucket - live)",
+            help="padding rows per dispatch (bucket slots or packed "
+            "rows-capacity, minus live rows)",
             reservoir=reservoir,
         )
         self._stall = self.registry.histogram(
@@ -192,7 +197,14 @@ class ServingMetrics:
         self._retries.inc(n)
 
     def record_batch(self, real: int, bucket: int) -> None:
-        """One engine dispatch: ``real`` live samples padded to ``bucket``."""
+        """One engine dispatch: ``real`` live samples padded to ``bucket``.
+
+        ``real`` is LIVE rows — client rows, never staging copies —
+        and ``bucket`` is the rows the device computed (the pow2 rung,
+        or the packed rows-capacity).  The engine passes exactly these
+        (engine.launch), so fill/waste stay honest in both modes: a
+        packed buffer whose tail is padding reports its true fill, not
+        100% (the formula a buffer-length caller would corrupt)."""
         self._batches.inc()
         self._samples_real.inc(real)
         self._samples_padded.inc(bucket)

@@ -48,6 +48,7 @@ and eight.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Sequence
@@ -55,7 +56,7 @@ from typing import Any, Sequence
 from ..analysis.lockwatch import make_lock
 from ..liveness import BackoffLadder
 from ..parallel.mesh import replica_devices, single_device_mesh
-from .buckets import DEFAULT_MAX_BUCKET, pow2_buckets
+from .buckets import DEFAULT_MAX_BUCKET, packed_capacities, pow2_buckets
 from .engine import InferenceEngine
 from .faults import fault_point
 from .metrics import ServingMetrics
@@ -377,6 +378,8 @@ class EnginePool:
         device_stage: bool | None = None,
         compute_dtype=None,
         version: str = "",
+        packed: bool = False,
+        int8_impl: str = "dot",
     ):
         assigned = replica_devices(replicas, devices)
         self.metrics = metrics if metrics is not None else ServingMetrics()
@@ -390,6 +393,18 @@ class EnginePool:
             # 1's just-written entries).  Min bucket 1 = n_shards on the
             # single-device meshes every replica runs on.
             buckets = pow2_buckets(1, max_bucket or DEFAULT_MAX_BUCKET)
+            max_bucket = None
+        self.packed = bool(packed)
+        if self.packed:
+            # Collapse to the rows-capacity ladder HERE, not per engine:
+            # the store sizing below must see the PACKED grid.  Sizing
+            # from the pow2 ladder while the engines warm the collapsed
+            # one would let the grids drift apart — the exact bug class
+            # the post-warmup assert in :meth:`warmup` pins shut.
+            # (packed_capacities is idempotent, so the engines' own
+            # collapse of this list is a no-op; n_shards=1 matches the
+            # single-device meshes every replica runs on.)
+            buckets = packed_capacities(max(buckets), 1)
             max_bucket = None
         self._store = None
         if aot_cache:
@@ -427,6 +442,8 @@ class EnginePool:
                     aot_cache=self._store,
                     device_stage=device_stage,
                     version=version,
+                    packed=packed,
+                    int8_impl=int8_impl,
                 )
             )
         self.devices = list(assigned)
@@ -553,6 +570,7 @@ class EnginePool:
         if len(self.engines) == 1 or not parallel:
             for i, engine in enumerate(self.engines):
                 self._warm_one(i, engine, parallel, sink, on_rung)
+            self._check_store_sizing()
             return
         from concurrent.futures import ThreadPoolExecutor
 
@@ -563,6 +581,43 @@ class EnginePool:
             ]
             for f in futures:
                 f.result()  # surface the first warmup failure, not hang
+        self._check_store_sizing()
+
+    def _check_store_sizing(self) -> None:
+        """Post-warmup invariant (PR-19 satellite): the shared store was
+        sized from the SAME rung ladder the engines actually warmed.
+
+        ``predict_store_size`` is computed in ``__init__`` from
+        ``len(buckets)`` — if that list were the pre-collapse pow2
+        ladder while packed engines warm the collapsed capacity ladder
+        (or vice versa), the cap and the grid drift: an under-sized cap
+        means replica N's warmup silently pruned replica 1's
+        just-written entries, and every warm start after that re-misses.
+        Warmup is the one moment the whole grid is provably on disk, so
+        check it here, loudly, instead of debugging ghost recompiles
+        later.
+        """
+        if self._store is None:
+            return
+        grid = len(self.engines) * (1 + len(self.dtypes)) * len(self.buckets)
+        if grid > self._store.MAX_ENTRIES:
+            raise RuntimeError(
+                f"AOT store sized for {self._store.MAX_ENTRIES} entries but "
+                f"the warmed grid needs {grid} "
+                f"({len(self.engines)} replicas x {1 + len(self.dtypes)} "
+                f"variants x {len(self.buckets)} rungs) — store sizing and "
+                f"engine rung ladder disagree (packed collapse drift?)"
+            )
+        on_disk = sum(
+            1 for f in os.listdir(self._store.directory)
+            if f.endswith(".jexec")
+        )
+        if on_disk > self._store.MAX_ENTRIES:
+            raise RuntimeError(
+                f"AOT store holds {on_disk} entries over its cap "
+                f"{self._store.MAX_ENTRIES} — pruning failed to keep the "
+                f"warmed grid bounded"
+            )
 
     def _warm_one(self, i, engine, parallel, sink, on_rung) -> None:
         name = _replica_name(i)

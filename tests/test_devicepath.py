@@ -1,0 +1,414 @@
+"""Device hot-path tests (ISSUE 19): packed ragged batching, the fused
+int8 Pallas inference head, corrected fill accounting, and the collapsed
+executable ladder.
+
+Run alone with ``pytest -m devicepath`` (the CI ``devicepath`` job);
+everything here also rides the default smoke tier.  The pins that
+matter:
+
+- **bit-identity** — every packed formation (single request, exact
+  capacity, split across batches, router-sharded oversize, mixed-dtype
+  coalescing) must return byte-for-byte what ``predict_logits`` returns
+  on the same rows; packing is a layout change, never a numerics change.
+- **ladder collapse** — a packed engine warms ONE capacity where its
+  bucketed twin warms the whole pow2 ladder, and the pool's shared AOT
+  store is sized from the collapsed grid (the satellite bugfix).
+- **fill accounting** — ``serving_batch_fill_ratio`` divides live rows
+  by DISPATCHED rows in both modes; a packed buffer with a padded tail
+  must not read as 100% fill.
+- **Pallas parity** — the fused int8 head clears the same tolerance +
+  argmax-identical gate as the reference dot-general head, at every
+  row count, and falls back to the reference head (with a warning) when
+  Pallas cannot run.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from pytorch_mnist_ddp_tpu.models.net import NUM_CLASSES, init_params
+from pytorch_mnist_ddp_tpu.models.quant import (
+    int8_forward,
+    int8_forward_fn,
+    int8_forward_fused,
+    quantize_params,
+)
+from pytorch_mnist_ddp_tpu.serving import (
+    EnginePool,
+    InferenceEngine,
+    MicroBatcher,
+    ServingMetrics,
+)
+from pytorch_mnist_ddp_tpu.serving.buckets import (
+    packed_capacities,
+    segment_ids,
+)
+from pytorch_mnist_ddp_tpu.utils.rng import root_key, split_streams
+
+pytestmark = pytest.mark.devicepath
+
+RNG = np.random.RandomState(20260806)
+
+
+def _rows(n: int) -> np.ndarray:
+    return RNG.rand(n, 28, 28, 1).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# packed_capacities / segment_ids (pure host policy, no device)
+
+
+def test_packed_capacities_collapse_and_rounding():
+    assert packed_capacities(8) == (8,)
+    assert packed_capacities(5) == (8,)   # rounds UP to pow2
+    assert packed_capacities(1) == (1,)
+    assert packed_capacities(2, n_shards=4) == (4,)  # shard divisibility
+
+
+def test_packed_capacities_two_rung_ladder():
+    assert packed_capacities(8, rungs=2) == (4, 8)
+    # Half-capacity rung dropped when it cannot honor the data axis.
+    assert packed_capacities(4, n_shards=4, rungs=2) == (4,)
+
+
+def test_packed_capacities_idempotent():
+    for ladder in (packed_capacities(6), packed_capacities(16, rungs=2)):
+        assert packed_capacities(max(ladder), rungs=len(ladder)) == ladder
+
+
+def test_packed_capacities_validation():
+    with pytest.raises(ValueError):
+        packed_capacities(0)
+    with pytest.raises(ValueError):
+        packed_capacities(8, rungs=3)
+    with pytest.raises(ValueError):
+        packed_capacities(8, n_shards=3)  # 8 % 3 != 0
+
+
+def test_segment_ids_layout():
+    ids = segment_ids([3, 2], 8)
+    assert ids.dtype == np.int32
+    assert ids.tolist() == [0, 0, 0, 1, 1, -1, -1, -1]
+    # Exact fill: no padding tail at all.
+    assert segment_ids([4, 4], 8).tolist() == [0] * 4 + [1] * 4
+    assert segment_ids([1], 1).tolist() == [0]
+
+
+def test_segment_ids_validation():
+    with pytest.raises(ValueError):
+        segment_ids([0], 4)
+    with pytest.raises(ValueError):
+        segment_ids([3, 2], 4)  # overflow
+
+
+# ---------------------------------------------------------------------------
+# Packed engine: collapsed ladder + segment-aware launch
+
+
+@pytest.fixture(scope="module")
+def packed_engine():
+    m = ServingMetrics()
+    engine = InferenceEngine.from_seed(
+        buckets=(8, 16), packed=True, metrics=m
+    )
+    engine.warmup()
+    return engine
+
+
+def test_packed_engine_collapses_the_ladder(packed_engine):
+    # The pow2 ladder (8, 16) collapsed to the single top capacity: one
+    # executable instead of two, and the whole engine surface (staging,
+    # sentinel budget, AOT sizing) sees the collapsed grid.
+    assert packed_engine.buckets == (16,)
+    assert packed_engine.packed
+    assert packed_engine.compile_count() == 1
+
+
+def test_packed_launch_is_bit_identical_and_masks_padding(packed_engine):
+    parts = [_rows(3), _rows(2)]
+    staged, bucket = packed_engine._staging.stage(parts)
+    try:
+        seg = segment_ids([len(p) for p in parts], bucket)
+        out = np.asarray(
+            packed_engine.launch(staged, 5, seg_ids=seg)
+        )
+    finally:
+        packed_engine._staging.release(staged, bucket)
+    direct = packed_engine.predict_logits(np.concatenate(parts))
+    np.testing.assert_array_equal(out[:5], direct)
+    # Padding rows are masked to exactly zero, deterministically.
+    assert np.all(out[5:] == 0.0)
+
+
+def test_packed_launch_validates_seg_ids(packed_engine):
+    staged, bucket = packed_engine._staging.stage([_rows(2)])
+    try:
+        with pytest.raises(ValueError, match="seg_ids length"):
+            packed_engine.launch(
+                staged, 2, seg_ids=np.zeros(3, np.int32)
+            )
+    finally:
+        packed_engine._staging.release(staged, bucket)
+
+
+def test_bucketed_engine_refuses_seg_ids():
+    engine = InferenceEngine.from_seed(buckets=(8,))
+    engine.warmup()
+    staged, bucket = engine._staging.stage([_rows(2)])
+    try:
+        with pytest.raises(ValueError, match="bucketed engine"):
+            engine.launch(
+                staged, 2, seg_ids=np.zeros(8, np.int32)
+            )
+    finally:
+        engine._staging.release(staged, bucket)
+
+
+def test_fill_accounting_divides_by_dispatched_rows_in_both_modes(
+    packed_engine,
+):
+    # Packed: 5 live rows in the 16-row capacity buffer must read as
+    # 5/16 fill, NOT 100% — the satellite accounting contract.
+    m = packed_engine.metrics
+    before = m.snapshot()
+    staged, bucket = packed_engine._staging.stage([_rows(5)])
+    try:
+        packed_engine.launch(
+            staged, 5, seg_ids=segment_ids([5], bucket)
+        )
+    finally:
+        packed_engine._staging.release(staged, bucket)
+    after = m.snapshot()
+    real = after["samples"]["real"] - before["samples"]["real"]
+    dispatched = (
+        after["samples"]["dispatched"] - before["samples"]["dispatched"]
+    )
+    assert (real, dispatched) == (5, 16)
+
+    # Bucketed: 3 live rows padded to the 8-bucket read as 3/8.
+    m2 = ServingMetrics()
+    bucketed = InferenceEngine.from_seed(buckets=(8, 16), metrics=m2)
+    bucketed.warmup()
+    bucketed.predict_logits(_rows(3))
+    snap = m2.snapshot()
+    assert snap["samples"]["real"] == 3
+    assert snap["samples"]["dispatched"] == 8
+    assert snap["batch_occupancy_pct"] == pytest.approx(37.5)
+
+
+# ---------------------------------------------------------------------------
+# Packed batch formation end-to-end (MicroBatcher -> engine -> unpack)
+
+
+def _drain_batcher(batcher):
+    batcher.stop(drain=True)
+
+
+def test_packed_single_request_batch_is_bit_identical(packed_engine):
+    batcher = MicroBatcher(packed_engine, fill_wait_ms=30.0).start()
+    try:
+        x = _rows(3)
+        got = batcher.submit(x).result()
+        np.testing.assert_array_equal(
+            got, packed_engine.predict_logits(x)
+        )
+    finally:
+        _drain_batcher(batcher)
+
+
+def test_packed_batch_at_exact_capacity(packed_engine):
+    batcher = MicroBatcher(packed_engine, fill_wait_ms=200.0).start()
+    try:
+        x = _rows(16)  # exactly the rows-capacity: zero padding tail
+        got = batcher.submit(x).result()
+        np.testing.assert_array_equal(
+            got, packed_engine.predict_logits(x)
+        )
+    finally:
+        _drain_batcher(batcher)
+
+
+def test_packed_split_across_batches_is_bit_identical(packed_engine):
+    # 10 + 10 rows into capacity 16: the second request SPLITS — 6
+    # rows ride the first buffer, 4 lead the next — and the completion
+    # worker must reassemble the second answer from both batches.
+    before = packed_engine.metrics.snapshot()["batches"]
+    batcher = MicroBatcher(
+        packed_engine, fill_wait_ms=300.0, linger_ms=50.0
+    ).start()
+    try:
+        xs = [_rows(10), _rows(10)]
+        reqs = [batcher.submit(x) for x in xs]
+        for x, req in zip(xs, reqs):
+            np.testing.assert_array_equal(
+                req.result(), packed_engine.predict_logits(x)
+            )
+    finally:
+        _drain_batcher(batcher)
+    after = packed_engine.metrics.snapshot()["batches"]
+    assert after - before >= 2
+
+
+@pytest.fixture(scope="module")
+def packed_int8_engine():
+    engine = InferenceEngine.from_seed(
+        buckets=(8, 16), packed=True, dtypes=("int8",)
+    )
+    engine.warmup()
+    engine.verify_parity(raise_on_failure=True)
+    return engine
+
+
+def test_packed_mixed_dtype_coalescing_keeps_batches_pure(
+    packed_int8_engine,
+):
+    # Interleaved f32 / int8 submissions: packed coalescing must stay
+    # dtype-pure (the dtype boundary closes the forming batch BEFORE
+    # any size split), and every answer must match the engine's own
+    # per-dtype direct path bit-for-bit.
+    engine = packed_int8_engine
+    batcher = MicroBatcher(engine, fill_wait_ms=100.0).start()
+    try:
+        xs = [_rows(3), _rows(2), _rows(4), _rows(1)]
+        dtypes = [None, "int8", None, "int8"]
+        reqs = [
+            batcher.submit(x, dtype=d) for x, d in zip(xs, dtypes)
+        ]
+        for x, d, req in zip(xs, dtypes, reqs):
+            np.testing.assert_array_equal(
+                req.result(), engine.predict_logits(x, dtype=d)
+            )
+    finally:
+        _drain_batcher(batcher)
+
+
+# ---------------------------------------------------------------------------
+# Pool: packed store sizing + router-sharded oversize
+
+
+@pytest.fixture(scope="module")
+def packed_pool(tmp_path_factory):
+    cache = tmp_path_factory.mktemp("packed_aot")
+    pool = EnginePool.from_seed(
+        replicas=2, buckets=(4, 8), packed=True,
+        aot_cache=str(cache),
+    )
+    pool.warmup()
+    return pool
+
+
+def test_pool_store_sized_from_the_packed_grid(packed_pool):
+    from pytorch_mnist_ddp_tpu.compile import predict_store_size
+
+    # The satellite bugfix: sizing must see the COLLAPSED capacity
+    # ladder (1 rung), not the pre-collapse pow2 ladder (2 rungs).
+    assert packed_pool.buckets == (8,)
+    assert packed_pool._store.MAX_ENTRIES == predict_store_size(2, 1, 1)
+    # Warmup persisted exactly the packed grid: 2 replicas x 1 variant
+    # x 1 capacity.
+    entries = [
+        f for f in os.listdir(packed_pool._store.directory)
+        if f.endswith(".jexec")
+    ]
+    assert len(entries) == 2
+
+
+def test_pool_store_sizing_drift_is_loud(packed_pool):
+    # A store cap below the warmed grid (the symptom of sizing from the
+    # wrong ladder) must fail the post-warmup check, not silently prune.
+    original = packed_pool._store.MAX_ENTRIES
+    packed_pool._store.MAX_ENTRIES = 1
+    try:
+        with pytest.raises(RuntimeError, match="sized for 1"):
+            packed_pool._check_store_sizing()
+    finally:
+        packed_pool._store.MAX_ENTRIES = original
+    packed_pool._check_store_sizing()  # restored: healthy again
+
+
+def test_router_sharded_oversize_through_packed_replicas(packed_pool):
+    # A request larger than one replica's capacity rides the PR-7
+    # sharded path: chunked near-equally, each chunk packed on its
+    # replica, reassembled in arrival order — bit-identical end to end.
+    router = packed_pool.start(fill_wait_ms=50.0)
+    try:
+        x = _rows(12)  # > capacity 8 -> 2 chunks of 6
+        got = router.submit(x).result()
+        np.testing.assert_array_equal(
+            got, packed_pool.engines[0].predict_logits(x)
+        )
+    finally:
+        packed_pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# Pallas fused int8 head: parity + fallback
+
+
+@pytest.fixture(scope="module")
+def qparams():
+    key = split_streams(root_key(3))["init"]
+    return quantize_params(init_params(key))
+
+
+@pytest.mark.parametrize("n", [1, 3, 8, 130])
+def test_fused_head_parity_at_every_row_count(qparams, n):
+    # Interpret mode engages automatically off-TPU; the integer core is
+    # exact and the f32 rescale tail agrees within compiler fusion
+    # jitter — far inside the serving parity tolerance, argmax
+    # identical (the same contract the engine gate enforces).
+    x = _rows(n)
+    ref = np.asarray(int8_forward(qparams, x))
+    fused = np.asarray(int8_forward_fused(qparams, x))
+    assert fused.shape == (n, NUM_CLASSES)
+    np.testing.assert_allclose(fused, ref, atol=1e-5)
+    np.testing.assert_array_equal(
+        fused.argmax(axis=-1), ref.argmax(axis=-1)
+    )
+
+
+def test_int8_forward_fn_dispatch():
+    assert int8_forward_fn("dot") is int8_forward
+    assert int8_forward_fn("pallas") is int8_forward_fused
+    with pytest.raises(ValueError, match="unknown int8 impl"):
+        int8_forward_fn("einsum")
+
+
+def test_pallas_engine_passes_the_parity_gate(monkeypatch):
+    # Opt-in interpret mode (the off-TPU harness): the pallas-headed
+    # int8 variant must clear the SAME gate as the dot-general head on
+    # every warmed capacity, through the real engine surface.
+    monkeypatch.setenv("TPU_MNIST_PALLAS_INTERPRET", "1")
+    engine = InferenceEngine.from_seed(
+        buckets=(8, 16), packed=True, dtypes=("int8",),
+        int8_impl="pallas",
+    )
+    assert engine.int8_impl == "pallas"
+    engine.warmup()
+    report = engine.verify_parity(raise_on_failure=True)
+    assert report["int8"]["passed"]
+    x = _rows(5)
+    got = engine.predict_logits(x, dtype="int8")
+    assert got.shape == (5, NUM_CLASSES)
+
+
+def test_pallas_engine_falls_back_off_tpu(monkeypatch):
+    # Without the interpret opt-in on a non-TPU backend, requesting the
+    # pallas head must warn and serve the reference head — never crash,
+    # never silently serve an ungated kernel.
+    monkeypatch.delenv("TPU_MNIST_PALLAS_INTERPRET", raising=False)
+    if jax.default_backend() == "tpu":
+        pytest.skip("fallback path is for non-TPU backends")
+    with pytest.warns(UserWarning, match="pallas"):
+        engine = InferenceEngine.from_seed(
+            buckets=(8,), dtypes=("int8",), int8_impl="pallas"
+        )
+    assert engine.int8_impl == "dot"
+
+
+def test_engine_rejects_unknown_int8_impl():
+    with pytest.raises(ValueError, match="unknown int8 impl"):
+        InferenceEngine.from_seed(buckets=(8,), int8_impl="einsum")

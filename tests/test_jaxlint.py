@@ -1951,6 +1951,104 @@ def test_jl022_waiver():
 
 
 # ---------------------------------------------------------------------------
+# JL023 — per-item pow2 padding inside a dispatch loop (packed batching)
+
+
+JL023_BAD_PAD_TO_BUCKET = """\
+from pytorch_mnist_ddp_tpu.serving.buckets import pad_to_bucket
+
+def serve(queue, predict, params):
+    while True:
+        x = queue.get()
+        padded = pad_to_bucket(x, 8)
+        predict(params, padded)
+"""
+
+JL023_BAD_INLINE_POW2 = """\
+import numpy as np
+from pytorch_mnist_ddp_tpu.serving.buckets import next_power_of_two
+
+def serve(queue, predict, params):
+    while True:
+        x = queue.get()
+        padded = np.pad(x, ((0, next_power_of_two(len(x)) - len(x)), (0, 0)))
+        predict(params, padded)
+"""
+
+JL023_BAD_KWARG_BUCKET_FOR = """\
+import jax.numpy as jnp
+from pytorch_mnist_ddp_tpu.serving.buckets import bucket_for
+
+def serve(requests, predict, params, buckets):
+    for x in requests:
+        padded = jnp.pad(
+            x, pad_width=((0, bucket_for(len(x), buckets) - len(x)), (0, 0))
+        )
+        predict(params, padded)
+"""
+
+JL023_GOOD_CONSTANT_PAD = """\
+import numpy as np
+
+def serve(queue, predict, params):
+    while True:
+        x = queue.get()
+        predict(params, np.pad(x, ((1, 1), (0, 0))))
+"""
+
+JL023_GOOD_BOUNDED_REPLAY = """\
+from pytorch_mnist_ddp_tpu.serving.buckets import pad_to_bucket
+
+def replay(trace, predict, params):
+    for i in range(64):
+        predict(params, pad_to_bucket(trace[i], 8))
+"""
+
+JL023_GOOD_OUTSIDE_LOOP = """\
+from pytorch_mnist_ddp_tpu.serving.buckets import pad_to_bucket
+
+def warm(predict, params, probe):
+    return predict(params, pad_to_bucket(probe, 8))
+"""
+
+
+def test_jl023_fires_on_pow2_padding_in_dispatch_loops():
+    assert_fires(JL023_BAD_PAD_TO_BUCKET, "JL023", line=6)
+    assert_fires(JL023_BAD_INLINE_POW2, "JL023", line=7)
+    assert_fires(JL023_BAD_KWARG_BUCKET_FOR, "JL023", line=6)
+
+
+def test_jl023_silent_on_sanctioned_shapes():
+    # A constant-width pad is geometry, not bucket laddering.
+    assert_silent(JL023_GOOD_CONSTANT_PAD, "JL023")
+    # Bounded replay/report passes are not serve loops.
+    assert_silent(JL023_GOOD_BOUNDED_REPLAY, "JL023")
+    # One-shot padding outside any loop (warmup probes) is fine.
+    assert_silent(JL023_GOOD_OUTSIDE_LOOP, "JL023")
+
+
+def test_jl023_exempts_the_bucket_helper_module():
+    # serving/buckets.py IS the sanctioned home of the pow2 ladder.
+    found, _ = ENGINE.check_source(
+        JL023_BAD_PAD_TO_BUCKET,
+        "pytorch_mnist_ddp_tpu/serving/buckets.py",
+    )
+    assert not [f for f in found if f.rule_id == "JL023"]
+    # A module merely named buckets.py outside serving/ stays in scope.
+    found, _ = ENGINE.check_source(JL023_BAD_PAD_TO_BUCKET, "buckets.py")
+    assert [f for f in found if f.rule_id == "JL023"]
+
+
+def test_jl023_waiver():
+    waived = JL023_BAD_PAD_TO_BUCKET.replace(
+        "padded = pad_to_bucket(x, 8)",
+        "padded = pad_to_bucket(x, 8)  # jaxlint: disable=JL023 -- "
+        "legacy compat shim, packed path lands next",
+    )
+    assert_silent(waived, "JL023")
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

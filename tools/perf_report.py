@@ -866,6 +866,45 @@ def summarize_telemetry(directory: str) -> str | None:
             if fills else
             f"  serving batches: {len(sbatches)}"
         )
+    # Device path section (PR 19, docs/SERVING.md packed batching): the
+    # packed-vs-bucketed split of what the DEVICE was fed.  Packed
+    # dispatches are tagged on the serving_batch event; fill here is
+    # live rows over the rows-capacity the device computed, and the
+    # warmup-executable tally per mode comes from the compile spans of
+    # the runs that produced each mode's batches — the two numbers the
+    # packed ladder collapse exists to move (fill up, executables down).
+    packed_batches = [e for e in sbatches if e.get("packed")]
+    if packed_batches:
+        def _mode_line(label, evs):
+            fills = [e["fill_ratio"] for e in evs if "fill_ratio" in e]
+            pad = sum(
+                e["bucket"] - e["real"] for e in evs
+                if "bucket" in e and "real" in e
+            )
+            caps = sorted({e["bucket"] for e in evs if e.get("bucket")})
+            rids = {e.get("run_id") for e in evs}
+            execs = sum(
+                1 for e in events
+                if e.get("event") == "span_end"
+                and e.get("span") == "compile"
+                and e.get("run_id") in rids
+            )
+            return (
+                f"    {label}: {len(evs)} dispatch(es), mean fill "
+                f"{100.0 * sum(fills) / len(fills):.1f}%, "
+                f"{pad} padding row(s), "
+                f"capacities {'/'.join(str(c) for c in caps) or '?'}"
+                + (f", {execs} warmup executable(s)" if execs else "")
+            )
+
+        bucketed_batches = [e for e in sbatches if not e.get("packed")]
+        lines.append(
+            f"  device path: {len(packed_batches)} packed of "
+            f"{len(sbatches)} dispatch(es)"
+        )
+        lines.append(_mode_line("packed", packed_batches))
+        if bucketed_batches:
+            lines.append(_mode_line("bucketed", bucketed_batches))
     runs = [e for e in events if e.get("event") == "run_complete"]
     if runs:
         # Correctly-labeled seconds — the telemetry surface does NOT

@@ -590,11 +590,14 @@ def _spin_self_serve(args, replicas: int | None):
     metrics = ServingMetrics()
     buckets = [int(b) for b in args.buckets.split(",")]
     dtypes = [args.dtype] if args.dtype != "f32" else None
+    packed = bool(getattr(args, "packed", False))
+    int8_impl = getattr(args, "int8_impl", None) or "dot"
     batcher_kwargs = dict(
         linger_ms=args.linger_ms, queue_depth=args.queue_depth,
         timeout_ms=args.timeout_ms, max_inflight=args.max_inflight,
         adaptive_linger=not args.no_adaptive_linger,
         deadline_aware=not getattr(args, "no_deadline_close", False),
+        fill_wait_ms=getattr(args, "fill_wait_ms", None),
     )
     hedge = bool(
         getattr(args, "hedge", False)
@@ -609,6 +612,7 @@ def _spin_self_serve(args, replicas: int | None):
         pool = EnginePool.from_seed(
             replicas=replicas or None, buckets=buckets, metrics=metrics,
             dtypes=dtypes, aot_cache=args.aot_cache,
+            packed=packed, int8_impl=int8_impl,
         )
         print(
             f"self-serve pool: warming buckets {list(pool.buckets)} x "
@@ -656,6 +660,7 @@ def _spin_self_serve(args, replicas: int | None):
     engine = InferenceEngine.from_seed(
         buckets=buckets, metrics=metrics, dtypes=dtypes,
         aot_cache=args.aot_cache,
+        packed=packed, int8_impl=int8_impl,
     )
     print(
         f"self-serve: warming buckets {list(engine.buckets)} x dtypes "
@@ -1655,6 +1660,160 @@ def run_hostpath(args) -> int:
     return rc
 
 
+def run_devicepath(args) -> int:
+    """The device hot-path A/B (docs/SERVING.md; the PR-19 twin of
+    --hostpath-ab): the SAME open-loop trace against a fresh self-serve
+    stack twice, once bucketed (pow2 padding ladder) and once packed
+    (ragged rows-capacity buffer + segment ids), at equal offered rate.
+
+    What packing must show, and what this round enforces:
+
+    - **fewer warmup executables** — the packed capacity ladder
+      collapses the pow2 rung grid, so the packed rung's warmup trace
+      count must be strictly below the bucketed rung's;
+    - **better fill** — mean fill ratio (live rows / dispatched rows,
+      the corrected accounting) must improve, optionally above a hard
+      floor (``--devicepath-min-fill``);
+    - **equal-or-better client p99** within ``--devicepath-p99-slack``
+      (default 1.0 = literally equal-or-better; smokes on noisy CI
+      hosts may loosen it);
+    - the standing hostpath invariants: zero lost responses, zero
+      transport errors, zero duplicated outcomes, zero post-warmup
+      compiles — splitting a request across two packed batches must
+      never lose or double-answer it.
+
+    The section merges into ``--hostpath-report`` (BENCH_hostpath.json)
+    under ``"device_ab"`` so one file carries both hot-path ledgers.
+    """
+    if not args.open_loop:
+        raise SystemExit(
+            "--devicepath-ab is an open-loop A/B (fill and padding waste "
+            "only mean something at a FIXED offered rate; a closed loop "
+            "would re-close around the faster path); add --open-loop "
+            "--rate R"
+        )
+    rc = 0
+    rungs: dict[str, dict] = {}
+    for mode in ("bucketed", "packed"):
+        rung_args = argparse.Namespace(**{
+            **vars(args),
+            "packed": mode == "packed",
+            "fill_wait_ms": (
+                args.fill_wait_ms if mode == "packed" else None
+            ),
+            "repeat_dist": None, "response_cache": None,
+        })
+        print(f"--- devicepath rung: {mode} ---")
+        server, sink, url = _spin_self_serve(rung_args, replicas=args.replicas)
+        try:
+            _status, before = fetch_json(f"{url}/metrics")
+            raw = _drive(rung_args, url)
+            _status, after = fetch_json(f"{url}/metrics")
+            if mode == "packed" and args.prom_dump:
+                with open(args.prom_dump, "w") as f:
+                    f.write(fetch_text(f"{url}/metrics?format=prom"))
+                print(f"prometheus exposition (packed rung): {args.prom_dump}")
+        finally:
+            _teardown_self_serve(server, sink)
+        report = summarize(raw, before, after)
+        row, rung_rc = _rung_verdict(args, raw, before, after, report, mode)
+        rc = rc or rung_rc
+        # Warmup executable count: the compiles gauge right after warmup
+        # IS the rung grid (variants x rungs x replicas traces) — the
+        # ladder-collapse win the packed rung must show.
+        row["warmup_executables"] = before.get("compiles")
+        row["fill_ratio_mean"] = (
+            (after.get("pipeline") or {}).get("fill_ratio_mean")
+        )
+        row["batch_occupancy_pct"] = after.get("batch_occupancy_pct")
+        rungs[mode] = row
+    b, p = rungs["bucketed"], rungs["packed"]
+    if (
+        b["warmup_executables"] is not None
+        and p["warmup_executables"] is not None
+        and p["warmup_executables"] >= b["warmup_executables"]
+    ):
+        print(
+            f"DEVICEPATH FAIL: packed warmed {p['warmup_executables']} "
+            f"executable(s), not fewer than bucketed's "
+            f"{b['warmup_executables']} — the capacity ladder did not "
+            "collapse"
+        )
+        rc = 1
+    if (
+        b["fill_ratio_mean"] is not None
+        and p["fill_ratio_mean"] is not None
+        and p["fill_ratio_mean"] <= b["fill_ratio_mean"]
+    ):
+        print(
+            f"DEVICEPATH FAIL: packed mean fill "
+            f"{p['fill_ratio_mean']:.3f} did not improve on bucketed's "
+            f"{b['fill_ratio_mean']:.3f}"
+        )
+        rc = 1
+    if (
+        args.devicepath_min_fill is not None
+        and (p["fill_ratio_mean"] or 0.0) < args.devicepath_min_fill
+    ):
+        print(
+            f"DEVICEPATH FAIL: packed mean fill "
+            f"{p['fill_ratio_mean']:.3f} under the --devicepath-min-fill "
+            f"floor {args.devicepath_min_fill:g}"
+        )
+        rc = 1
+    p99_b = b["latency_ms"]["p99"]
+    p99_p = p["latency_ms"]["p99"]
+    if p99_b and p99_p and p99_p > p99_b * args.devicepath_p99_slack:
+        print(
+            f"DEVICEPATH FAIL: packed client p99 {p99_p:.2f} ms worse "
+            f"than bucketed {p99_b:.2f} ms x slack "
+            f"{args.devicepath_p99_slack:g}"
+        )
+        rc = 1
+    device_ab = {
+        "offered_rate_rps": args.rate,
+        "requests": args.requests,
+        "max_request": args.max_request,
+        "buckets": [int(x) for x in args.buckets.split(",")],
+        "replicas": args.replicas,
+        "fill_wait_ms": args.fill_wait_ms,
+        "rungs": rungs,
+        "warmup_executables_bucketed": b["warmup_executables"],
+        "warmup_executables_packed": p["warmup_executables"],
+        "fill_ratio_mean_bucketed": b["fill_ratio_mean"],
+        "fill_ratio_mean_packed": p["fill_ratio_mean"],
+        "p99_ratio_packed_vs_bucketed": (
+            p99_p / p99_b if p99_b else None
+        ),
+        "passed": rc == 0,
+    }
+    # One hot-path ledger: merge into the hostpath report rather than
+    # scattering a second bench file (the host A/B's sections survive).
+    doc = {"mode": "hostpath-ab"}
+    if os.path.exists(args.hostpath_report):
+        try:
+            with open(args.hostpath_report) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            pass
+    doc["device_ab"] = device_ab
+    with open(args.hostpath_report, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(f"devicepath report: {args.hostpath_report} (device_ab section)")
+    for mode in ("bucketed", "packed"):
+        r = rungs[mode]
+        fill = r["fill_ratio_mean"]
+        print(
+            f"  {mode}: {r['warmup_executables']} warmup executable(s), "
+            "mean fill "
+            + (f"{100.0 * fill:.1f}%" if fill is not None else "n/a")
+            + f", p50 {r['latency_ms']['p50']:.2f} ms / "
+            f"p99 {r['latency_ms']['p99']:.2f} ms, "
+            f"{r['rejected']} rejected, {r['timed_out']} timed out"
+        )
+    return rc
+
+
 # ---------------------------------------------------------------------------
 # Model-registry drive modes (docs/SERVING.md model registry):
 # --swap-at-s T fires a live /admin/swap T seconds into the drive and
@@ -2041,6 +2200,44 @@ def main(argv: list[str] | None = None) -> int:
         "hit/miss latency split measures the cache, not client-side "
         "queueing",
     )
+    parser.add_argument(
+        "--packed", action="store_true",
+        help="--self-serve mode: packed ragged batching (requests "
+        "concatenated into one rows-capacity buffer + segment ids "
+        "instead of pow2 padding; docs/SERVING.md)",
+    )
+    parser.add_argument(
+        "--fill-wait-ms", type=float, default=None,
+        help="packed mode: how long a forming batch may wait for more "
+        "rows before dispatching part-full (the linger ceiling in "
+        "packed mode)",
+    )
+    parser.add_argument(
+        "--int8-impl", default="dot", choices=("dot", "pallas"),
+        help="--self-serve int8 dense-head lowering (dot = reference "
+        "GEMMs, pallas = fused kernel with off-TPU fallback)",
+    )
+    parser.add_argument(
+        "--devicepath-ab", action="store_true",
+        help="device hot-path A/B (docs/SERVING.md; PR-19): the SAME "
+        "open-loop trace bucketed then packed at equal offered rate; "
+        "merge the rung table into --hostpath-report under 'device_ab' "
+        "and FAIL unless packed warms strictly fewer executables, "
+        "improves mean fill, holds client p99 within "
+        "--devicepath-p99-slack, and loses/duplicates nothing",
+    )
+    parser.add_argument(
+        "--devicepath-p99-slack", type=float, default=1.0,
+        help="multiplier on the bucketed rung's client p99 the packed "
+        "rung must stay within (1.0 = literally equal-or-better; CI "
+        "smokes on noisy shared hosts may loosen)",
+    )
+    parser.add_argument(
+        "--devicepath-min-fill", type=float, default=None,
+        help="optional hard floor on the packed rung's mean fill ratio "
+        "(the SLO gate ratchets this permanently; here it guards ad-hoc "
+        "A/Bs)",
+    )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--timeout-s", type=float, default=30.0)
     parser.add_argument(
@@ -2285,11 +2482,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.swap_at_s is not None or args.canary_sweep:
         if args.url or args.replicas is not None or args.replicas_sweep \
                 or args.chaos or args.ab_tail or args.fleet_sweep \
-                or args.hostpath_ab:
+                or args.hostpath_ab or args.devicepath_ab:
             parser.error("--swap-at-s / --canary-sweep drive their own "
                          "single-engine registry stack; drop --url / "
                          "--replicas / --replicas-sweep / --chaos / "
-                         "--ab-tail / --fleet-sweep / --hostpath-ab")
+                         "--ab-tail / --fleet-sweep / --hostpath-ab / "
+                         "--devicepath-ab")
         if args.swap_at_s is not None and args.swap_at_s <= 0:
             parser.error(f"--swap-at-s must be > 0, got {args.swap_at_s}")
         if args.response_cache is not None:
@@ -2304,6 +2502,16 @@ def main(argv: list[str] | None = None) -> int:
                          "stacks; drop --url / --replicas-sweep / "
                          "--chaos / --ab-tail / --fleet-sweep")
         return run_hostpath(args)
+    if args.devicepath_ab:
+        if args.url or args.replicas_sweep or args.chaos or args.ab_tail \
+                or args.fleet_sweep:
+            parser.error("--devicepath-ab drives its own self-serve "
+                         "stacks; drop --url / --replicas-sweep / "
+                         "--chaos / --ab-tail / --fleet-sweep")
+        if args.packed:
+            parser.error("--devicepath-ab toggles packing itself; drop "
+                         "--packed")
+        return run_devicepath(args)
     if args.fleet_sweep:
         if args.url or args.replicas_sweep or args.chaos or args.ab_tail:
             parser.error("--fleet-sweep drives its own fleets; drop "

@@ -21,7 +21,10 @@ batch fill ratio (mean)     Prometheus dump ``serving_batch_fill_ratio``
 pipeline stall (total s)    Prometheus dump ``serving_pipeline_stall_
                             seconds_sum``
 zero post-warmup compiles   Prometheus dump ``jax_compiles_total`` ==
-                            replicas x buckets (the warmup grid, exactly)
+                            replicas x rungs (the warmup grid, exactly;
+                            rungs = the pow2 ladder, or the collapsed
+                            packed capacity ladder when the protocol
+                            sets ``"packed": true``)
                             + the report's ``additional_compiles``
 recovery (mean s, count)    recovery-round telemetry ``replica_restart``
                             events under the committed chaos clause
@@ -135,6 +138,18 @@ def run_gate(args) -> int:
     devices = int(protocol["virtual_devices"])
     replicas = int(protocol["replicas"])
     buckets = [int(b) for b in str(protocol["buckets"]).split(",")]
+    packed = bool(protocol.get("packed"))
+    if packed:
+        # The engines collapse the pow2 ladder to the packed
+        # rows-capacity ladder (serving/buckets.packed_capacities), so
+        # the warmup-grid arithmetic below must count CAPACITIES — an
+        # expected-compiles figure computed from the pre-collapse ladder
+        # would flag the collapse itself as a breach.
+        from pytorch_mnist_ddp_tpu.serving.buckets import packed_capacities
+
+        rungs = list(packed_capacities(max(buckets), 1))
+    else:
+        rungs = buckets
 
     common = [
         "--open-loop",
@@ -146,6 +161,10 @@ def run_gate(args) -> int:
         "--seed", str(protocol["seed"]),
         "--timeout-s", str(protocol.get("client_timeout_s", 30)),
     ]
+    if packed:
+        common += ["--packed"]
+        if protocol.get("fill_wait_ms") is not None:
+            common += ["--fill-wait-ms", str(protocol["fill_wait_ms"])]
 
     # -- round 1: the steady-state trace --------------------------------------
     steady_report = os.path.join(workdir, "steady_report.json")
@@ -205,10 +224,11 @@ def run_gate(args) -> int:
     )
 
     # Zero post-warmup compiles: the sentinel counter must hold EXACTLY
-    # the warmup grid (replicas x buckets, f32 only in this protocol),
-    # and the report's delta must be zero.
+    # the warmup grid (replicas x rungs, f32 only in this protocol;
+    # rungs = pow2 buckets, or the collapsed capacity ladder when the
+    # protocol runs packed), and the report's delta must be zero.
     measured["jax_compiles_total"] = _prom_sum(prom, "jax_compiles_total")
-    measured["expected_warmup_compiles"] = replicas * len(buckets)
+    measured["expected_warmup_compiles"] = replicas * len(rungs)
     measured["additional_compiles"] = report.get("additional_compiles")
 
     def check(name: str, ok: bool, detail: str) -> None:
