@@ -2556,6 +2556,120 @@ class Pow2PadDispatchRule(Rule):
                     )
 
 
+# ---------------------------------------------------------------------------
+# JL024 — sharded predict-step built over an inline mesh inside a loop
+
+
+# Mesh constructors (parallel/mesh.py owns all but `Mesh` itself).
+# Matched by last segment so both `mesh.replica_mesh(...)` and the bare
+# from-import spelling fire.
+_MESH_BUILDER_CALLS = {
+    "Mesh", "make_mesh", "make_2d_mesh", "make_nd_mesh",
+    "single_device_mesh", "replica_mesh",
+}
+
+
+class ShardedStepMeshLoopRule(Rule):
+    """JL024: sharded predict-step construction closing over a mesh
+    built inside the same dispatch/warmup loop.
+
+    The predict-step builders (``make_tp_predict_step``,
+    ``make_ep_predict_step``, ``make_pp_predict_step``, ...) close over
+    a concrete ``Mesh``: the mesh's device tuple is part of the trace
+    and of every AOT cache key (compile/program.py ``predict_config``).
+    Building a *fresh* mesh each loop iteration — even over the same
+    devices — hands the builder a new closure identity per pass, so
+    every iteration re-traces, the ExecutableStore never hits, and the
+    RecompileSentinel budget burns down on shapes that were already
+    compiled.  The sanctioned pattern threads ONE mesh in from outside
+    the loop (serving/pool.py plans replica meshes once, at
+    construction) or uses a module-level mesh.
+
+    Heuristics: fires on any call whose name's last segment looks like
+    ``make_*predict_step`` inside any loop body when its mesh argument
+    (first positional, or ``mesh=``) is (a) an inline mesh-builder call
+    (``Mesh``/``make_mesh``/``make_2d_mesh``/``make_nd_mesh``/
+    ``single_device_mesh``/``replica_mesh``), or (b) a name assigned
+    from one of those inside the same loop body.  A mesh threaded in as
+    a parameter or built at module level is exempt — that is the fix.
+    Bounded loops are NOT exempt here (unlike JL013/JL018/JL023): a
+    per-iteration mesh re-traces in a bounded warmup sweep exactly as
+    it does in a serve loop.
+    """
+
+    rule_id = "JL024"
+    severity = Severity.WARNING
+    summary = (
+        "sharded predict-step built over a mesh created inside the "
+        "loop; build the mesh once outside and thread it in"
+    )
+
+    @staticmethod
+    def _mesh_builder_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = dotted_name(node.func)
+        return bool(name) and name.rsplit(".", 1)[-1] in _MESH_BUILDER_CALLS
+
+    @staticmethod
+    def _step_builder_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = dotted_name(node.func)
+        if not name:
+            return False
+        last = name.rsplit(".", 1)[-1]
+        return last.startswith("make_") and last.endswith("predict_step")
+
+    @staticmethod
+    def _mesh_arg(call: ast.Call) -> ast.AST | None:
+        for kw in call.keywords:
+            if kw.arg == "mesh":
+                return kw.value
+        return call.args[0] if call.args else None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            body = list(iter_loop_body_nodes(loop))
+            # Names bound to a fresh mesh within THIS loop body: their
+            # use as a mesh arg is the two-line spelling of the inline
+            # builder call.
+            loop_meshes: set[str] = set()
+            for node in body:
+                targets: list[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets, value = list(node.targets), node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                if self._mesh_builder_call(value):
+                    loop_meshes.update(
+                        t.id for t in targets if isinstance(t, ast.Name)
+                    )
+            for node in body:
+                if not self._step_builder_call(node):
+                    continue
+                mesh = self._mesh_arg(node)
+                if mesh is None:
+                    continue
+                if self._mesh_builder_call(mesh) or (
+                    isinstance(mesh, ast.Name) and mesh.id in loop_meshes
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        "predict-step builder closing over a mesh created "
+                        "inside the loop: the mesh is part of the trace "
+                        "and AOT cache identity, so every iteration "
+                        "re-traces and the executable store never hits — "
+                        "build the replica mesh ONCE outside the loop "
+                        "(serving/pool.py plans meshes at construction) "
+                        "and thread it in via mesh=",
+                    )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     KeyReuseRule(),
     HostSyncRule(),
@@ -2577,6 +2691,7 @@ ALL_RULES: tuple[Rule, ...] = (
     FloatListJSONLoopRule(),
     RegistryBypassRule(),
     Pow2PadDispatchRule(),
+    ShardedStepMeshLoopRule(),
 )
 
 

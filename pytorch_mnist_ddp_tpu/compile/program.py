@@ -278,6 +278,7 @@ def predict_config(
     version: str = "",
     packed: bool = False,
     int8_impl: str = "dot",
+    shard_kind: str = "dp",
 ) -> dict:
     """AOT key config for one serving-forward rung (dtype x bucket).
 
@@ -300,6 +301,16 @@ def predict_config(
     implementation that ACTUALLY runs (``dot`` | ``pallas``); the engine
     resolves Pallas availability before composing the key, so a
     fallback run never poisons the kernel entry (docs/COMPILE.md).
+
+    ``shard_kind`` names the replica's shard topology
+    (parallel/mesh.SHARD_KINDS: ``dp`` | ``tp`` | ``vtp`` | ``ep`` |
+    ``pp``).  Together with the ``mesh`` shape field it keys sharded
+    predict programs so they NEVER alias a DP entry: a 4-device TP rung
+    and four 1-device DP rungs at the same bucket are different
+    executables with different collectives.  The default ``"dp"`` keeps
+    every pre-existing digest byte-identical in meaning (the field is
+    part of the dict either way; all legacy surfaces compose it as
+    ``dp``), so trainer-handoff reuse is unchanged.
     """
     import jax
 
@@ -307,6 +318,7 @@ def predict_config(
         "program": "predict_step",
         "dtype": dtype,
         "bucket": int(bucket),
+        "shard_kind": str(shard_kind),
         "mesh": {str(k): int(s) for k, s in mesh.shape.items()},
         "devices": [int(d.id) for d in mesh.devices.flat],
         "use_bn": bool(use_bn),

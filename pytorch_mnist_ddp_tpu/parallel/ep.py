@@ -72,10 +72,28 @@ def moe_mlp_ep(
     models/moe.py's scatter form (same route / scatter_to_slots /
     gather_from_slots / expert_ffn); only the two all_to_all hops are new.
     """
+    out, _ = _moe_mlp_ep_with_load(mp, x, cfg, axis_name)
+    return out
+
+
+def _moe_mlp_ep_with_load(
+    mp: dict, x: jax.Array, cfg: ViTConfig, axis_name: str = DATA_AXIS
+) -> tuple[MoeOut, jax.Array]:
+    """:func:`moe_mlp_ep` plus the per-expert KEPT-token counts of this
+    shard's routing group, ``f32[E]`` — the raw material of the serving
+    layer's expert load-balance metrics (``serving_expert_load``).
+    Counts are local (callers psum over ``axis_name``); dropped tokens
+    (over capacity) land in the dummy slot and count for no expert, so
+    the counts measure tokens actually SERVED by each expert."""
     b, t, d = x.shape
     flat = x.reshape(b * t, d)
     cap = capacity_for(b * t, cfg)
     slot, kept, gate_prob, aux = route(mp["gate"], flat, cfg, cap)
+    # kept slots are e*cap + pos; the dummy drop slot E*cap maps to index
+    # E, which one_hot zeroes — exactly the "dropped counts nowhere" rule.
+    load = jax.nn.one_hot(
+        slot // cap, cfg.num_experts, dtype=jnp.float32
+    ).sum(axis=0)
 
     # Pack per-expert inputs (scatter form — no [G, E, C] tensor), device-
     # major over the E dim (the global expert order IS device-major
@@ -95,7 +113,7 @@ def moe_mlp_ep(
     # every device carries the same scalar (and the grad contribution is
     # the global mean's, matching the dense oracle's single-group form).
     aux = jax.lax.pmean(aux, axis_name)
-    return MoeOut(y.reshape(b, t, d).astype(x.dtype), aux)
+    return MoeOut(y.reshape(b, t, d).astype(x.dtype), aux), load
 
 
 def ep_param_specs(cfg: ViTConfig) -> dict:
@@ -199,6 +217,59 @@ def make_ep_train_step(
         out_specs=(state_specs, P(DATA_AXIS)),
     )
     return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_ep_predict_step(mesh: Mesh, cfg: ViTConfig, use_flash: bool = False):
+    """Build the jitted expert-parallel forward for the serving path.
+
+    ``predict_fn(params, x) -> (log_probs, expert_load)``: ``params``
+    sharded per ``ep_param_specs`` (expert stacks split over ``data``),
+    ``x``/``log_probs`` sharded by rows over ``data`` (the serving batch
+    rides the same axis the experts do — "EP rides DP"), and
+    ``expert_load`` a replicated ``f32[E]`` of kept-token counts per
+    expert summed over every block and every shard — the expert
+    imbalance signal the serving metrics export
+    (``serving_expert_load{expert=}``).
+
+    Capacity is per routing group (each device's row shard), so the
+    drop pattern differs from the single-device dense forward's one big
+    group: with headroom (``cfg.capacity_factor`` >= ~2 at serving
+    loads) no token drops and parity is tight; at the capacity edge a
+    token kept by one grouping may drop in the other — the documented
+    EP parity tolerance (docs/SERVING.md)."""
+    _check_expert_divisibility(cfg, mesh)
+    if cfg.remat:
+        # The load taps below are collected across block_fn calls; under
+        # jax.checkpoint those values are region-local tracers and may
+        # not escape.  Forward-only serving gains nothing from remat.
+        raise ValueError("the EP serving forward does not support cfg.remat")
+    from ..ops.pallas_attention import select_attention
+
+    attention_fn = select_attention(use_flash)
+
+    def local_predict(params, x):
+        loads: list[jax.Array] = []
+
+        def moe_fn(mp, h):
+            out, load = _moe_mlp_ep_with_load(mp, h, cfg)
+            loads.append(load)
+            return out
+
+        logp, _ = vit_moe_forward(
+            params, x, cfg, attention_fn=attention_fn, moe_fn=moe_fn
+        )
+        # One [E] count vector per block (the trace calls moe_fn once per
+        # block); the serving signal is the total over blocks and shards.
+        load = jax.lax.psum(sum(loads), DATA_AXIS)
+        return logp, load
+
+    sharded = shard_map(
+        local_predict,
+        mesh=mesh,
+        in_specs=(ep_param_specs(cfg), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P()),
+    )
+    return jax.jit(sharded)
 
 
 def make_ep_eval_step(mesh: Mesh, cfg: ViTConfig, use_flash: bool = False):

@@ -157,6 +157,145 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+# ---------------------------------------------------------------------------
+# Sharded serving replicas: one logical replica spanning a k-device mesh.
+#
+# The serving pool (serving/pool.py) historically placed one whole-model
+# replica per 1x1 mesh ("dp").  A replica SHAPE names how one replica
+# spans k devices instead:
+#
+#   "dp"     — 1 device, whole model (the classic pool replica)
+#   "tpK"    — K-way tensor parallel CNN head (parallel/tp.py)
+#   "vtpK"   — K-way tensor parallel ViT (parallel/tp_vit.py)
+#   "epK"    — K-way expert parallel MoE-ViT (parallel/ep.py; EP rides
+#              the data axis, so the replica mesh is (K, 1))
+#   "ppK"    — K-stage pipeline CNN (parallel/pp.py; K must equal
+#              pipeline.NUM_STAGES)
+#
+# A spec string like "tp4,dp,dp,dp,dp" describes a heterogeneous pool:
+# one 4-device TP replica plus four 1-device DP replicas.
+
+SHARD_KINDS = ("dp", "tp", "vtp", "ep", "pp")
+
+
+def parse_shard_kind(spec: str) -> tuple[str, int]:
+    """``"tp4"`` -> ``("tp", 4)``; bare ``"dp"`` -> ``("dp", 1)``.
+
+    Every non-DP kind must name its device count explicitly (a 1-device
+    "tp" replica is just dp with extra collectives — refuse the silent
+    misconfiguration)."""
+    s = str(spec).strip().lower()
+    for kind in sorted(SHARD_KINDS, key=len, reverse=True):
+        if s.startswith(kind):
+            digits = s[len(kind):]
+            if not digits:
+                if kind == "dp":
+                    return ("dp", 1)
+                raise ValueError(
+                    f"shard kind {spec!r} needs a device count (e.g. "
+                    f"'{kind}4')"
+                )
+            if not digits.isdigit():
+                break
+            k = int(digits)
+            if kind == "dp" and k != 1:
+                raise ValueError(
+                    f"a dp replica is 1 device by definition, got {spec!r}"
+                    " (scale dp by adding replicas, not devices)"
+                )
+            if k < 1:
+                raise ValueError(f"bad device count in {spec!r}")
+            return (kind, k)
+    raise ValueError(
+        f"unknown replica shape {spec!r}; want one of "
+        f"{', '.join(SHARD_KINDS)} with a device-count suffix"
+    )
+
+
+def parse_replica_shapes(spec) -> list[tuple[str, int]]:
+    """A replica-shape plan from a comma-joined string or a sequence of
+    per-replica specs: ``"tp4,dp,dp"`` -> ``[("tp", 4), ("dp", 1),
+    ("dp", 1)]``."""
+    if isinstance(spec, str):
+        parts = [p for p in spec.split(",") if p.strip()]
+    else:
+        parts = list(spec)
+    if not parts:
+        raise ValueError("empty replica-shape spec")
+    return [parse_shard_kind(p) for p in parts]
+
+
+def replica_mesh(
+    kind: str, k: int, devices: Sequence[jax.Device]
+) -> Mesh:
+    """The ``(data, model)`` mesh one replica of shape ``(kind, k)``
+    dispatches on, over exactly ``k`` of ``devices``.
+
+    TP/pipeline shards ride the ``model`` axis (a ``(1, k)`` mesh:
+    the full batch is visible to every shard, which is what the
+    column/row-parallel layers and the stage ring want); EP rides the
+    existing ``data`` axis (a ``(k, 1)`` mesh — the standard "EP rides
+    DP" deployment of parallel/ep.py), so serving batches additionally
+    shard by rows across the expert devices."""
+    if len(devices) < k:
+        raise ValueError(
+            f"replica shape {kind}{k} needs {k} devices, got {len(devices)}"
+        )
+    devs = list(devices[:k])
+    if kind == "dp":
+        return single_device_mesh(devs[0])
+    if kind in ("tp", "vtp"):
+        return make_mesh(num_data=1, num_model=k, devices=devs)
+    if kind == "ep":
+        return make_mesh(num_data=k, num_model=1, devices=devs)
+    if kind == "pp":
+        from .pipeline import NUM_STAGES
+
+        if k != NUM_STAGES:
+            raise ValueError(
+                f"pipeline replicas are {NUM_STAGES}-stage, got pp{k}"
+            )
+        return make_mesh(num_data=1, num_model=k, devices=devs)
+    raise ValueError(f"unknown shard kind {kind!r}")
+
+
+def plan_replica_meshes(
+    shapes: Sequence[tuple[str, int]],
+    devices: Sequence[jax.Device] | None = None,
+) -> list[tuple[str, int, Mesh]]:
+    """Assign consecutive device blocks to a replica-shape plan and
+    build each replica's mesh: ``[(kind, k, mesh), ...]``.
+
+    Multi-device shapes take strictly disjoint consecutive blocks (a
+    TP replica sharing chips with another replica would serialize its
+    collectives — refuse it).  An all-1-device plan keeps the classic
+    round-robin wrap of :func:`replica_devices`, so oversubscribed
+    single-host test pools keep working."""
+    pool = list(devices if devices is not None else local_devices())
+    if not pool:
+        raise ValueError("no devices visible to this process")
+    if all(k == 1 for _, k in shapes):
+        assigned = replica_devices(len(shapes), pool)
+        return [
+            (kind, 1, replica_mesh(kind, 1, [dev]))
+            for (kind, _), dev in zip(shapes, assigned)
+        ]
+    need = sum(k for _, k in shapes)
+    if need > len(pool):
+        raise ValueError(
+            f"replica plan {[f'{kind}{k}' for kind, k in shapes]} needs "
+            f"{need} devices but only {len(pool)} are visible; "
+            "multi-device replicas never share chips"
+        )
+    out: list[tuple[str, int, Mesh]] = []
+    cursor = 0
+    for kind, k in shapes:
+        block = pool[cursor : cursor + k]
+        out.append((kind, k, replica_mesh(kind, k, block)))
+        cursor += k
+    return out
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully-replicated sharding (params/opt state under pure DP)."""
     return NamedSharding(mesh, P())

@@ -74,11 +74,109 @@ def _stage1_loss_sum(
     return nll_loss(logp, y, w, reduction="sum")
 
 
+def _stage1_logp(
+    params: dict, act: jax.Array, compute_dtype: jnp.dtype = jnp.float32,
+) -> jax.Array:
+    """The dense head as the serving stage body: fc1 -> relu -> fc2 ->
+    log_softmax, per-row log-probs instead of the training stage's
+    weighted NLL sum.  Same op sequence (and therefore the same numerics)
+    as the eval-mode DP forward's tail."""
+    h = jax.nn.relu(
+        act @ params["fc1"]["kernel"].astype(compute_dtype)
+        + params["fc1"]["bias"].astype(compute_dtype)
+    )
+    logits = h @ params["fc2"]["kernel"].astype(compute_dtype) \
+        + params["fc2"]["bias"].astype(compute_dtype)
+    return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+
 def _mb_keys(key: jax.Array, j: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Per-microbatch dropout keys, identical in forward and backward so
     rematerialized masks replay exactly."""
     kmb = jax.random.fold_in(key, j)
     return jax.random.fold_in(kmb, 1), jax.random.fold_in(kmb, 2)
+
+
+def make_pp_predict_step(
+    mesh: Mesh,
+    num_micro: int = 2,
+    compute_dtype: jnp.dtype = jnp.float32,
+):
+    """Build the jitted forward-only pipeline step for the serving path.
+
+    ``predict_fn(params, x) -> log_probs`` with ``params`` replicated and
+    ``x``/the output sharded over ``data`` (size 1 on a pure-pipeline
+    serving replica).  The schedule is the forward half of
+    parallel/pipeline.py: ``num_micro`` microbatches flow through the
+    2-stage ring over ``num_micro + 1`` ticks, each device running only
+    its own stage's FLOPs (``lax.cond`` activity predicate around a
+    ``lax.switch`` on the stage index, one ``ppermute`` hop per tick).
+    Microbatch ``j``'s rows materialize on the stage-1 device at tick
+    ``j + 1``; the idle stage contributes zeros, so ONE stage-axis psum
+    of the collected per-tick rows hands every device the full
+    ``[n, 10]`` — no backward, no stash, no custom_vjp.
+
+    Batch sizes must divide by ``num_micro`` (the serving bucket ladder
+    is pow2, so any pow2 ``num_micro`` composes)."""
+    if mesh.shape[STAGE_AXIS] != NUM_STAGES:
+        raise ValueError(
+            f"pipeline needs a {NUM_STAGES}-wide '{STAGE_AXIS}' axis, got "
+            f"{mesh.shape[STAGE_AXIS]}"
+        )
+    if num_micro < 1:
+        raise ValueError(f"num_micro must be >= 1, got {num_micro}")
+
+    def local_predict(params, x):
+        n = x.shape[0]
+        if n % num_micro:
+            raise ValueError(
+                f"batch {n} not divisible by {num_micro} microbatches"
+            )
+        mb = n // num_micro
+        x_mbs = x.reshape(num_micro, mb, *x.shape[1:])
+        stage = jax.lax.axis_index(STAGE_AXIS)
+        key = jax.random.PRNGKey(0)  # train=False: never consumed
+        zero_act = jnp.zeros((mb, _FLAT), jnp.dtype(compute_dtype))
+        zero_logp = jnp.zeros((mb, 10), jnp.float32)
+        ring = [(i, (i + 1) % NUM_STAGES) for i in range(NUM_STAGES)]
+        ticks = num_micro + NUM_STAGES - 1
+
+        def tick(carry, t):
+            in_flight = carry
+            j = t - stage
+            active = jnp.logical_and(j >= 0, j < num_micro)
+            jc = jnp.clip(j, 0, num_micro - 1)
+            x_mb = jax.lax.dynamic_index_in_dim(x_mbs, jc, keepdims=False)
+
+            def run_stage0():
+                act = _stage0_fwd(params, x_mb, key, False, compute_dtype)
+                return act, zero_logp
+
+            def run_stage1():
+                return zero_act, _stage1_logp(params, in_flight, compute_dtype)
+
+            out, logp = jax.lax.cond(
+                active,
+                lambda: jax.lax.switch(stage, [run_stage0, run_stage1]),
+                lambda: (zero_act, zero_logp),
+            )
+            moved = jax.lax.ppermute(out, STAGE_AXIS, ring)
+            return moved, logp
+
+        _, logps = jax.lax.scan(tick, zero_act, jnp.arange(ticks))
+        # Stage 1 emits microbatch j's rows at tick j+1; every other
+        # tick/stage contributed zeros, so the stage psum IS the gather.
+        rows = jax.lax.psum(logps[1:], STAGE_AXIS)
+        return rows.reshape(n, 10)
+
+    sharded = shard_map(
+        local_predict,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
 
 
 def make_pp_train_step(

@@ -142,6 +142,34 @@ def make_tp_eval_step(mesh: Mesh, compute_dtype: jnp.dtype = jnp.float32):
     return jax.jit(sharded)
 
 
+def make_tp_predict_step(
+    mesh: Mesh, compute_dtype: jnp.dtype = jnp.float32
+):
+    """Build the jitted TP forward for the serving path: the model-sharded
+    twin of ``ddp.make_predict_step``.
+
+    ``predict_fn(params, x) -> log_probs`` with ``params`` sharded per
+    ``param_specs()`` and ``x``/the output sharded over ``data`` (size 1
+    on a pure-TP serving replica mesh, so every model shard sees the full
+    batch).  Same math as the eval step's forward — the fc2 psum is the
+    only collective — so parity with the single-device reference is the
+    same pin tests/test_tp.py holds for training."""
+
+    def local_predict(params, x):
+        return _tp_forward(
+            params, x, train=False, key=jax.random.PRNGKey(0),
+            compute_dtype=compute_dtype,
+        )
+
+    sharded = shard_map(
+        local_predict,
+        mesh=mesh,
+        in_specs=(param_specs(), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+    )
+    return jax.jit(sharded)
+
+
 def make_tp_train_step(
     mesh: Mesh,
     rho: float = 0.9,

@@ -201,6 +201,30 @@ def make_vit_tp_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
+def make_vit_tp_predict_step(
+    mesh: Mesh, cfg: ViTConfig, use_flash: bool = False
+):
+    """Build the jitted ViT-TP forward for the serving path.
+
+    ``predict_fn(params, x) -> log_probs`` with ``params`` sharded per
+    ``vit_tp_param_specs`` and ``x``/the output sharded over ``data``
+    (size 1 on a pure-TP serving replica, so every model shard holds the
+    full batch and contributes its heads/MLP features through the two
+    per-block psums)."""
+    _check_head_divisibility(cfg, mesh)
+
+    def local_predict(params, x):
+        return _tp_vit_forward(params, x, cfg, use_flash=use_flash)
+
+    sharded = shard_map(
+        local_predict,
+        mesh=mesh,
+        in_specs=(vit_tp_param_specs(cfg), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+    )
+    return jax.jit(sharded)
+
+
 def make_vit_tp_eval_step(mesh: Mesh, cfg: ViTConfig, use_flash: bool = False):
     """Jitted (data x model) eval step: TP forward + the psum'd
     (loss_sum, correct) totals every eval path in the framework shares —

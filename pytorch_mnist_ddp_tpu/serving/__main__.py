@@ -165,6 +165,14 @@ def build_parser() -> argparse.ArgumentParser:
         "(docs/SERVING.md scale-out); omitted = the single-engine path",
     )
     parser.add_argument(
+        "--replica-shapes", default=None, metavar="SPEC",
+        help="with --replicas: comma-separated per-replica shard shape, "
+        "e.g. 'tp4,dp,dp,dp,dp' — tp/vtp/ep/pp replicas span disjoint "
+        "k-device blocks of the visible mesh and are parity-gated "
+        "against the single-device reference at warmup; count must "
+        "match the replica count (docs/SERVING.md sharded replicas)",
+    )
+    parser.add_argument(
         "--router-policy", default="cost",
         choices=("roundrobin", "least-loaded", "cost"),
         help="replica placement policy with --replicas: roundrobin "
@@ -471,8 +479,14 @@ def main(argv: list[str] | None = None) -> int:
 
         factory = EnginePool
         engine_kwargs["replicas"] = args.replicas or None
+        if args.replica_shapes:
+            engine_kwargs["replica_shapes"] = args.replica_shapes
     else:
         factory = InferenceEngine
+        if args.replica_shapes:
+            print("error: --replica-shapes needs --replicas (a sharded "
+                  "replica is a pool member; docs/SERVING.md)")
+            return 2
     registry = entry = canary_version = None
     if args.registry:
         # Registry mode (docs/SERVING.md model registry): the manifest's

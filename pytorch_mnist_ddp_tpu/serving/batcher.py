@@ -374,10 +374,11 @@ class MicroBatcher:
         self.engine = engine
         # Pool mode (serving/router.py): ``replica`` names this batcher
         # on the per-replica metric families and telemetry events, and
-        # the pool assigns ``on_complete(latency_s)`` after construction
-        # to feed the router's per-replica EWMA from the completion
-        # worker.  Both are None in single-engine use, where the
-        # unlabeled PR-4 surface is unchanged.
+        # the pool assigns ``on_complete(latency_s, rows)`` after
+        # construction to feed the router's per-replica (and per-shape-
+        # class — ``rows`` is the completed request's row count) EWMAs
+        # from the completion worker.  Both are None in single-engine
+        # use, where the unlabeled PR-4 surface is unchanged.
         self.replica = replica
         self.on_complete = None
         # Failure hook (pool mode): called with the failed-request count
@@ -1253,7 +1254,7 @@ class MicroBatcher:
                         )
                     if self.on_complete is not None and not aborted:
                         try:
-                            self.on_complete(latency_s)
+                            self.on_complete(latency_s, req.n)
                         except Exception:
                             # A hook failure must never kill the
                             # completion worker: later batches would

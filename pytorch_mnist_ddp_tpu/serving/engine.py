@@ -58,7 +58,7 @@ from ..parallel.ddp import (
     make_predict_step,
     replicate_params,
 )
-from ..parallel.mesh import DATA_AXIS, make_mesh
+from ..parallel.mesh import DATA_AXIS, SHARD_KINDS, make_mesh
 from .buckets import (
     StagingPool,
     packed_capacities,
@@ -193,6 +193,25 @@ class InferenceEngine:
         Pallas on a backend without a real lowering falls back to
         ``"dot"`` with a warning BEFORE any AOT key is composed, so
         the persisted config always names the impl that ran.
+    shard_kind:
+        Replica shard topology (parallel/mesh.SHARD_KINDS).  The default
+        ``"dp"`` is the classic 1-device-per-replica data-parallel
+        engine, byte-for-byte unchanged.  ``"tp"``/``"vtp"``/``"ep"``/
+        ``"pp"`` make THIS engine one logical replica spanning a
+        k-device mesh (serving/sharded.py): tensor-parallel CNN,
+        tensor-parallel ViT, expert-parallel MoE, 2-stage pipeline.
+        Sharded kinds require an explicit ``mesh`` (replica_mesh),
+        serve f32 only (``dtypes`` must be empty — the parity anchor is
+        the SINGLE-DEVICE f32 forward), refuse BN trees and non-default
+        conv impls, and start UNVERIFIED: :meth:`verify_sharded_parity`
+        must pass before :meth:`launch` will serve a request.
+    vit_cfg:
+        Model config for the ``vtp``/``ep`` families (defaults per
+        serving/sharded.py — note EP's serving default holds
+        capacity-factor headroom so routing never drops tokens).
+    pp_microbatches:
+        Pipeline microbatch count (``pp`` only); every bucket must
+        divide by it.
     """
 
     def __init__(
@@ -210,12 +229,28 @@ class InferenceEngine:
         version: str = "",
         packed: bool = False,
         int8_impl: str = "dot",
+        shard_kind: str = "dp",
+        vit_cfg=None,
+        pp_microbatches: int = 2,
     ):
         # The model-registry version identity of the served weights
         # ("" = the unversioned single-checkpoint path, which keeps the
         # canonical predict_config digest — and therefore cross-surface
         # AOT reuse with the trainer handoff — exactly as before).
         self.version = str(version)
+        self.shard_kind = str(shard_kind)
+        if self.shard_kind not in SHARD_KINDS:
+            raise ValueError(
+                f"unknown shard kind {self.shard_kind!r}; have {SHARD_KINDS}"
+            )
+        is_sharded = self.shard_kind != "dp"
+        if is_sharded and mesh is None:
+            raise ValueError(
+                f"shard kind {self.shard_kind!r} needs an explicit replica "
+                "mesh (parallel.mesh.replica_mesh); defaulting to the "
+                "every-device DP mesh would silently serve the wrong "
+                "topology"
+            )
         self.mesh = mesh if mesh is not None else make_mesh()
         n_shards = self.mesh.shape[DATA_AXIS]
         if buckets is None:
@@ -231,6 +266,19 @@ class InferenceEngine:
             # rung per pow2.  Idempotent, so the pool can pre-resolve
             # capacities for store sizing and pass them back in here.
             self.buckets = packed_capacities(self.buckets[-1], n_shards)
+        self.pp_microbatches = int(pp_microbatches)
+        if self.shard_kind == "pp":
+            if self.pp_microbatches < 1:
+                raise ValueError(
+                    f"pp_microbatches must be >= 1, got {self.pp_microbatches}"
+                )
+            bad = [b for b in self.buckets if b % self.pp_microbatches]
+            if bad:
+                raise ValueError(
+                    f"buckets {bad} do not divide by {self.pp_microbatches} "
+                    "pipeline microbatches; every warmed rung must split "
+                    "evenly into the microbatch schedule"
+                )
         if int8_impl not in ("dot", "pallas"):
             raise ValueError(
                 f"unknown int8 impl {int8_impl!r} (want dot|pallas)"
@@ -252,6 +300,41 @@ class InferenceEngine:
                 int8_impl = "dot"
         self.int8_impl = int8_impl
         self.use_bn = "bn1" in variables.get("params", {})
+        self._vit_cfg = None
+        if is_sharded:
+            from . import sharded as shardlib
+
+            if dtypes:
+                raise ValueError(
+                    f"sharded replicas serve f32 only; dtypes="
+                    f"{tuple(dtypes)} cannot ride shard kind "
+                    f"{self.shard_kind!r} (the parity anchor is the "
+                    "single-device f32 forward; mix precisions at the "
+                    "POOL level with heterogeneous replicas instead)"
+                )
+            if self.use_bn:
+                raise ValueError(
+                    f"shard kind {self.shard_kind!r} has no BN-aware "
+                    "sharded forward; serve BN checkpoints on DP replicas"
+                )
+            if conv_impl != "conv":
+                raise ValueError(
+                    f"shard kind {self.shard_kind!r} serves the reference "
+                    f"conv impl only; got conv_impl={conv_impl!r}"
+                )
+            if compute_dtype is not None and (
+                jax.numpy.dtype(compute_dtype)
+                != jax.numpy.dtype(jax.numpy.float32)
+            ):
+                raise ValueError(
+                    "sharded replicas serve f32 only; drop compute_dtype"
+                )
+            shardlib.validate_family(self.shard_kind, variables["params"])
+            if self.shard_kind in ("vtp", "ep"):
+                self._vit_cfg = (
+                    vit_cfg if vit_cfg is not None
+                    else shardlib.default_vit_cfg(self.shard_kind)
+                )
         if self.use_bn and "batch_stats" not in variables:
             # A BN model without running averages would eval-normalize by
             # garbage; init defaults (mean 0 / var 1) are torch's
@@ -288,7 +371,16 @@ class InferenceEngine:
         # retrained weights — necessarily changes it, making every old
         # cache entry unreachable without an explicit invalidation hook.
         self.weights_digest = weights_digest(served)
-        self._variables = replicate_params(served, self.mesh)
+        # Host-side copy of the served tree: the sharded parity gate's
+        # single-device reference forward reads it (the placed tree's
+        # leaves live sharded across the replica mesh).
+        self._host_served = served
+        if is_sharded:
+            self._variables = shardlib.place_params(
+                self.shard_kind, served, self.mesh, self._vit_cfg
+            )
+        else:
+            self._variables = replicate_params(served, self.mesh)
         self.metrics = metrics
         registry = metrics.registry if metrics is not None else None
         if device_stage is None:
@@ -302,15 +394,27 @@ class InferenceEngine:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         self._input_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
-        make_default = (
-            make_packed_predict_step if self.packed else make_predict_step
-        )
-        fn = make_default(
-            self.mesh,
-            compute_dtype=compute_dtype or jax.numpy.float32,
-            use_bn=self.use_bn,
-            conv_impl=conv_impl,
-        )
+        if is_sharded:
+            # The kind's shard_map forward (serving/sharded.py): inputs
+            # ride the data axis (size 1 on tp/vtp/pp replicas, k on
+            # ep), params are placed per the kind's specs above.
+            fn = shardlib.build_predict_fn(
+                self.shard_kind,
+                self.mesh,
+                vit_cfg=self._vit_cfg,
+                pp_microbatches=self.pp_microbatches,
+                packed=self.packed,
+            )
+        else:
+            make_default = (
+                make_packed_predict_step if self.packed else make_predict_step
+            )
+            fn = make_default(
+                self.mesh,
+                compute_dtype=compute_dtype or jax.numpy.float32,
+                use_bn=self.use_bn,
+                conv_impl=conv_impl,
+            )
         # One trace per bucket per variant, ever.  A post-warmup retrace
         # means a request shape escaped the bucket policy.  Compile
         # events land on the shared registry (jax_compiles_total{fn=
@@ -322,14 +426,24 @@ class InferenceEngine:
             name="predict_step",
             registry=registry,
         )
-        # The default (reference-precision) variant serves unverified by
-        # definition: it IS the parity reference.
+        # The DP default (reference-precision) variant serves unverified
+        # by definition: it IS the parity reference.  A SHARDED default
+        # is the opposite — it starts refused, and only
+        # verify_sharded_parity (vs the single-device forward) may flip
+        # it servable: the same gate discipline the dtype variants get,
+        # applied to the shard topology.
         self._variants: dict[str, _Variant] = {
             DEFAULT_DTYPE: _Variant(
                 DEFAULT_DTYPE, fn, self._predict, self._variables,
-                verified=True,
+                verified=not is_sharded,
             )
         }
+        # EP expert-load plumbing: each dispatch returns (logp, load);
+        # the load is stashed and the PREVIOUS one is read back on the
+        # next dispatch (one-batch lag — an immediate np.asarray would
+        # sync the dispatch thread against its own batch).
+        self._pending_expert_load = None
+        self._reference_fn = None
         for name in dtypes or ():
             if name == DEFAULT_DTYPE or name in self._variants:
                 continue
@@ -424,10 +538,20 @@ class InferenceEngine:
     def from_seed(cls, seed: int = 1, **kwargs) -> "InferenceEngine":
         """Fresh reference-init params (utils/rng stream layout) — the
         no-checkpoint path used by ``--warmup-only`` smoke runs and load
-        tests, where serving mechanics matter and weights don't."""
+        tests, where serving mechanics matter and weights don't.
+        Family-aware: a sharded ``shard_kind`` seeds the model family it
+        serves (ViT for vtp, MoE-ViT for ep, the CNN otherwise)."""
         from ..utils.rng import root_key, split_streams
 
         key = split_streams(root_key(seed))["init"]
+        kind = kwargs.get("shard_kind", "dp")
+        if kind != "dp":
+            from . import sharded as shardlib
+
+            if kind in ("vtp", "ep") and kwargs.get("vit_cfg") is None:
+                kwargs["vit_cfg"] = shardlib.default_vit_cfg(kind)
+            params = shardlib.seed_params(kind, key, kwargs.get("vit_cfg"))
+            return cls({"params": params}, **kwargs)
         return cls({"params": init_params(key)}, **kwargs)
 
     # -- variant surface ------------------------------------------------------
@@ -509,7 +633,12 @@ class InferenceEngine:
         ``seg=None`` (warmup sweeps, parity slices, direct calls)
         synthesizes the all-live vector — every row segment 0 — which
         masks nothing, so those paths see exactly the bucketed
-        semantics."""
+        semantics.
+
+        EP dispatches return ``(logp, expert_load)``; the load array is
+        stashed on-device and the PREVIOUS dispatch's (already
+        materialized by then) is read into the expert-load gauges — the
+        one-batch lag keeps ``np.asarray`` off the dispatch hot path."""
         staged = self._stage(staged)
         if self.packed:
             if seg is None:
@@ -517,12 +646,29 @@ class InferenceEngine:
             seg = self._stage_seg(seg)
             prog = v.programs.get(len(staged))
             if prog is not None:
-                return prog.call(v.variables, staged, seg)
-            return v.predict(v.variables, staged, seg)
-        prog = v.programs.get(len(staged))
-        if prog is not None:
-            return prog.call(v.variables, staged)
-        return v.predict(v.variables, staged)
+                out = prog.call(v.variables, staged, seg)
+            else:
+                out = v.predict(v.variables, staged, seg)
+        else:
+            prog = v.programs.get(len(staged))
+            if prog is not None:
+                out = prog.call(v.variables, staged)
+            else:
+                out = v.predict(v.variables, staged)
+        if self.shard_kind == "ep":
+            out, load = out
+            prev, self._pending_expert_load = self._pending_expert_load, load
+            if prev is not None and self.metrics is not None:
+                self.metrics.record_expert_load(np.asarray(prev))
+        return out
+
+    def flush_expert_load(self) -> None:
+        """Materialize the stashed (one-batch-lagged) EP expert-load
+        array into the gauges — drain/shutdown hook so the LAST batch's
+        routing isn't lost to the lag."""
+        prev, self._pending_expert_load = self._pending_expert_load, None
+        if prev is not None and self.metrics is not None:
+            self.metrics.record_expert_load(np.asarray(prev))
 
     def _program_for(self, v: _Variant, b: int):
         """The (variant, bucket) rung as a :class:`~..compile.Program`:
@@ -555,6 +701,10 @@ class InferenceEngine:
                     conv_impl=self._conv_impl,
                     device_stage=self.device_stage,
                     packed=self.packed,
+                    # Keys a sharded rung's executable apart from every
+                    # DP rung (with the mesh-shape/device fields) so a
+                    # warm start never deserializes the wrong topology.
+                    shard_kind=self.shard_kind,
                     # Only the int8 forward has a head impl choice; f32/
                     # bf16 keep the default key so their digests are
                     # impl-independent.
@@ -677,6 +827,10 @@ class InferenceEngine:
                     f"variant {v.name!r}; the bucket ladder does not map "
                     "1:1 onto compiled programs"
                 )
+        # The verification sweep's all-zero batches routed SOMEWHERE;
+        # don't let that synthetic load leak into the gauges on the
+        # first real dispatch (the one-batch lag would surface it).
+        self._pending_expert_load = None
         self.warmed = True
         return report
 
@@ -774,6 +928,84 @@ class InferenceEngine:
         ).astype(np.uint8)
         return normalize(raw), bucket
 
+    def verify_sharded_parity(
+        self,
+        tol: float | None = None,
+        raise_on_failure: bool = False,
+        sink=None,
+    ) -> dict:
+        """Gate a sharded replica's forward against the SINGLE-DEVICE
+        reference forward of its model family — the topology twin of
+        :meth:`verify_parity`, and the gate a sharded default variant
+        must pass before :meth:`launch` will serve it.
+
+        The fixed parity slice is dispatched through the sharded
+        forward at an already-warmed bucket (zero new traces) and
+        through a jitted single-device reference on the HOST param
+        tree; the replica passes iff
+
+        - ``max |logp_sharded - logp_reference| <= tol`` (defaults per
+          kind, serving/sharded.SHARDED_PARITY_TOL — pp is gated at
+          exactly 0.0, bit-identity), AND
+        - argmax is identical on EVERY row.
+
+        EP note: the default serving MoE config carries capacity-factor
+        headroom, so routing keeps every token and the gate sees
+        bit-identical outputs; a config at the capacity edge whose
+        groups drop different tokens than the dense reference FAILS
+        here, and that refusal is the gate working (docs/SERVING.md).
+
+        No-op ``{}`` on a DP engine.  Returns (and records on
+        :attr:`parity_report` under the default variant's name, with
+        ``shard_kind`` in the row) the result dict; ``raise_on_failure``
+        raises :class:`ParityError` naming the numbers."""
+        if self.shard_kind == "dp":
+            return {}
+        from . import sharded as shardlib
+
+        v = self._variants[DEFAULT_DTYPE]
+        x, bucket = self._parity_slice()
+        out = np.asarray(self._run_variant(v, x))
+        if self._reference_fn is None:
+            self._reference_fn = shardlib.reference_fn(
+                self.shard_kind, self._vit_cfg
+            )
+        ref = np.asarray(self._reference_fn(self._host_served, x))
+        max_diff = float(np.abs(out - ref).max())
+        argmax_ok = bool((out.argmax(axis=1) == ref.argmax(axis=1)).all())
+        tolerance = float(
+            shardlib.SHARDED_PARITY_TOL[self.shard_kind]
+            if tol is None else tol
+        )
+        passed = argmax_ok and max_diff <= tolerance
+        v.verified = passed
+        v.parity = {
+            "dtype": v.name,
+            "shard_kind": self.shard_kind,
+            "devices": len(list(self.mesh.devices.flat)),
+            "rows": int(bucket),
+            "max_abs_logit_diff": max_diff,
+            "tolerance": tolerance,
+            "argmax_identical": argmax_ok,
+            "passed": passed,
+        }
+        if self.metrics is not None:
+            self.metrics.registry.gauge(
+                "serving_variant_verified",
+                help="1 = the dtype variant passed its parity gate "
+                "and may serve; 0 = refused",
+                dtype=f"{v.name}/{self.shard_kind}",
+            ).set(1.0 if passed else 0.0)
+        if sink:
+            sink.emit("parity_gate", **v.parity)
+        if raise_on_failure and not passed:
+            raise ParityError(
+                f"sharded parity gate failed: {self.shard_kind} "
+                f"max|dlogp|={max_diff:.4g} (tol {tolerance:g}), "
+                f"argmax_identical={argmax_ok}"
+            )
+        return v.parity
+
     # -- the registry swap surface (serving/registry.py, rollout.py) ----------
     #
     # Weight mutation enters the engine ONLY through these methods (the
@@ -789,6 +1021,14 @@ class InferenceEngine:
         tree: same BN-ness, same structure, same leaf shapes — the
         compiled executables are specialized to those avals, and a
         mismatched tree must be refused here, not crash a dispatch."""
+        if self.shard_kind != "dp":
+            raise ValueError(
+                f"weight publish into a sharded ({self.shard_kind}) "
+                "replica is not supported: a swap would have to re-place "
+                "the tree under the kind's partition specs and re-gate "
+                "parity mid-serve; drain the replica and rebuild it on "
+                "the new checkpoint instead (docs/SERVING.md)"
+            )
         use_bn = "bn1" in variables.get("params", {})
         if use_bn != self.use_bn:
             raise ValueError(

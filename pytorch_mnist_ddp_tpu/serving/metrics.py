@@ -140,6 +140,13 @@ class ServingMetrics:
         # scrapeable-from-first-exposition contract as ensure_qos.
         self._model_count: dict[tuple[str, str], object] = {}
         self._model_latency: dict[tuple[str, str], object] = {}
+        # Sharded-replica surface (ISSUE 20, docs/SERVING.md sharded
+        # replicas): per-replica mesh width and per-expert routed-token
+        # load for EP replicas.  Registered by record_shard_devices
+        # (the pool, at construction) / ensure_expert_load (the EP
+        # engine's first recorded dispatch, or the pool pre-registering
+        # so CI greps a short smoke's dump).
+        self._expert_load: dict[str, object] = {}
 
     # -- counter views (back-compat attribute surface) ------------------------
 
@@ -359,6 +366,52 @@ class ServingMetrics:
                 model=model,
                 version=version,
             )
+
+    def record_shard_devices(self, replica: str, devices: int) -> None:
+        """Devices in REPLICA's mesh (1 = plain DP, k = a TP/EP/PP
+        replica spanning k devices) — the pool sets these once at
+        construction, so the topology is scrapeable from the first
+        exposition."""
+        self.registry.gauge(
+            "serving_shard_devices",
+            help="devices in each replica's mesh (1 = plain DP, k = a "
+            "sharded TP/EP/PP replica spanning k devices)",
+            replica=replica,
+        ).set(devices)
+
+    def ensure_expert_load(self, num_experts: int) -> None:
+        """Pre-register the per-expert load gauges so an EP pool's
+        exposition carries the family before the first recorded
+        dispatch — same scrapeable-from-first-exposition rationale as
+        :meth:`ensure_qos`."""
+        if len(self._expert_load) >= num_experts:
+            return
+        with self.registry.locked():
+            for e in range(num_experts):
+                key = str(e)
+                if key not in self._expert_load:
+                    self._expert_load[key] = self.registry.gauge(
+                        "serving_expert_load",
+                        help="tokens routed to (and kept by) each expert "
+                        "in the most recent EP dispatch; max/mean across "
+                        "experts is the imbalance factor",
+                        expert=key,
+                    )
+
+    def record_expert_load(self, loads) -> None:
+        """Per-expert kept-token counts from one EP dispatch (the
+        engine's one-batch-lagged readback)."""
+        loads = [float(v) for v in loads]
+        self.ensure_expert_load(len(loads))
+        for e, val in enumerate(loads):
+            self._expert_load[str(e)].set(val)
+
+    def expert_load_snapshot(self) -> dict[str, float]:
+        """Current per-expert load gauge values ({} when the pool has no
+        EP replica) — the pool's shutdown telemetry reads this so the
+        imbalance factor lands in the JSONL stream, not only in a
+        Prometheus scrape."""
+        return {k: g.value for k, g in sorted(self._expert_load.items())}
 
     def record_model_request(
         self, model: str, version: str, latency_s: float

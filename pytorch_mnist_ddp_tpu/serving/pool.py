@@ -55,7 +55,12 @@ from typing import Any, Sequence
 
 from ..analysis.lockwatch import make_lock
 from ..liveness import BackoffLadder
-from ..parallel.mesh import replica_devices, single_device_mesh
+from ..parallel.mesh import (
+    parse_replica_shapes,
+    plan_replica_meshes,
+    replica_devices,
+    single_device_mesh,
+)
 from .buckets import DEFAULT_MAX_BUCKET, packed_capacities, pow2_buckets
 from .engine import InferenceEngine
 from .faults import fault_point
@@ -362,6 +367,16 @@ class EnginePool:
     Parameters mirror :class:`~.engine.InferenceEngine` where they mean
     the same thing; ``replicas`` picks the pool size (default: one per
     local device), ``devices`` overrides the assignment explicitly.
+
+    ``replica_shapes`` (``"tp4,dp,dp,dp,dp"`` or a parsed list) builds a
+    HETEROGENEOUS pool instead: each entry is one replica's shard
+    topology (parallel/mesh.SHARD_KINDS), multi-device shapes take
+    strictly disjoint consecutive device blocks, and every sharded
+    replica is parity-gated against the single-device forward at the end
+    of :meth:`warmup` — it cannot serve a request before that gate
+    passes.  The ViT families (``vtp``/``ep``) cannot mix with the CNN
+    kinds in one pool (one checkpoint, one architecture).  Sharded
+    pools serve f32 only (``dtypes`` must stay empty).
     """
 
     def __init__(
@@ -380,19 +395,63 @@ class EnginePool:
         version: str = "",
         packed: bool = False,
         int8_impl: str = "dot",
+        replica_shapes=None,
+        vit_cfg=None,
+        pp_microbatches: int = 2,
     ):
-        assigned = replica_devices(replicas, devices)
+        plans = None
+        if replica_shapes is not None:
+            shapes = parse_replica_shapes(replica_shapes)
+            if replicas is not None and replicas != len(shapes):
+                raise ValueError(
+                    f"replicas={replicas} disagrees with the "
+                    f"{len(shapes)}-entry replica_shapes plan; pass one "
+                    "or the other"
+                )
+            kinds = {kind for kind, _ in shapes}
+            vit_kinds = kinds & {"vtp", "ep"}
+            if vit_kinds and kinds - vit_kinds:
+                raise ValueError(
+                    f"replica plan mixes the ViT families {sorted(vit_kinds)} "
+                    f"with CNN kinds {sorted(kinds - vit_kinds)}; one pool "
+                    "serves one checkpoint, so every replica must serve "
+                    "the same model family"
+                )
+            if len(vit_kinds) > 1:
+                raise ValueError(
+                    "replica plan mixes 'vtp' (dense ViT) and 'ep' "
+                    "(MoE-ViT); those are different param trees"
+                )
+            if kinds != {"dp"} and dtypes:
+                raise ValueError(
+                    f"sharded replica shapes serve f32 only; drop dtypes="
+                    f"{tuple(dtypes)} (the parity anchor is the single-"
+                    "device f32 forward)"
+                )
+            plans = plan_replica_meshes(shapes, devices)
+            assigned = [plan[2].devices.flat[0] for plan in plans]
+        else:
+            assigned = replica_devices(replicas, devices)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         registry = self.metrics.registry
         dtypes = tuple(dtypes or ())
+        # The ladder's floor: 1 on the classic single-device meshes, but
+        # a heterogeneous plan can raise it — an EP replica shards rows
+        # over its k-wide data axis (every bucket must divide), and a
+        # pipeline replica splits every bucket into its microbatches.
+        n_min = 1
+        if plans is not None:
+            for kind, _k, plan_mesh in plans:
+                n_min = max(n_min, plan_mesh.shape["data"])
+                if kind == "pp":
+                    n_min = max(n_min, int(pp_microbatches))
         if buckets is None:
             # Resolve the default ladder ONCE and hand every engine the
             # explicit result: the store sizing below and the engines'
             # rung grids must agree exactly (a drift under-sizes the
             # shared store, and replica N's warmup would prune replica
-            # 1's just-written entries).  Min bucket 1 = n_shards on the
-            # single-device meshes every replica runs on.
-            buckets = pow2_buckets(1, max_bucket or DEFAULT_MAX_BUCKET)
+            # 1's just-written entries).
+            buckets = pow2_buckets(n_min, max_bucket or DEFAULT_MAX_BUCKET)
             max_bucket = None
         self.packed = bool(packed)
         if self.packed:
@@ -402,9 +461,9 @@ class EnginePool:
             # one would let the grids drift apart — the exact bug class
             # the post-warmup assert in :meth:`warmup` pins shut.
             # (packed_capacities is idempotent, so the engines' own
-            # collapse of this list is a no-op; n_shards=1 matches the
-            # single-device meshes every replica runs on.)
-            buckets = packed_capacities(max(buckets), 1)
+            # collapse of this list is a no-op; n_min matches the widest
+            # data axis any replica in the plan runs on.)
+            buckets = packed_capacities(max(buckets), n_min)
             max_bucket = None
         self._store = None
         if aot_cache:
@@ -424,15 +483,26 @@ class EnginePool:
                 ),
             )
         self.engines: list[InferenceEngine] = []
-        for device in assigned:
-            # Per-replica engine construction carries BOTH pool
-            # disciplines jaxlint JL012 checks for: an explicit mesh pin
-            # (no replica ends up wherever jax defaults) and the shared
-            # AOT store (no replica re-compiles what another persisted).
+        # Per-replica engine construction carries BOTH pool disciplines
+        # jaxlint JL012 checks for: an explicit mesh pin (no replica
+        # ends up wherever jax defaults) and the shared AOT store (no
+        # replica re-compiles what another persisted).  Under a
+        # replica-shape plan the mesh is the replica's k-device block
+        # (parallel/mesh.plan_replica_meshes); classically it is the
+        # 1x1 mesh over the replica's one device.
+        if plans is not None:
+            replica_meshes = [
+                (kind, plan_mesh) for kind, _k, plan_mesh in plans
+            ]
+        else:
+            replica_meshes = [
+                ("dp", single_device_mesh(device)) for device in assigned
+            ]
+        for kind, replica_mesh_ in replica_meshes:
             self.engines.append(
                 InferenceEngine(
                     variables,
-                    mesh=single_device_mesh(device),
+                    mesh=replica_mesh_,
                     buckets=buckets,
                     max_bucket=max_bucket,
                     compute_dtype=compute_dtype,
@@ -444,9 +514,24 @@ class EnginePool:
                     version=version,
                     packed=packed,
                     int8_impl=int8_impl,
+                    shard_kind=kind,
+                    vit_cfg=vit_cfg,
+                    pp_microbatches=pp_microbatches,
                 )
             )
         self.devices = list(assigned)
+        # Topology is scrapeable from the first exposition: one
+        # serving_shard_devices{replica=} gauge per replica, plus the
+        # expert-load family pre-registered for EP pools (CI greps a
+        # short smoke's dump).
+        for i, engine in enumerate(self.engines):
+            self.metrics.record_shard_devices(
+                _replica_name(i), len(list(engine.mesh.devices.flat))
+            )
+            if engine.shard_kind == "ep" and engine._vit_cfg is not None:
+                self.metrics.ensure_expert_load(
+                    engine._vit_cfg.num_experts
+                )
         self.router: Router | None = None
         self.supervisor: ReplicaSupervisor | None = None
         self._batcher_kwargs: dict = {}
@@ -464,11 +549,31 @@ class EnginePool:
 
     @classmethod
     def from_seed(cls, seed: int = 1, **kwargs) -> "EnginePool":
-        from ..models.net import init_params
+        """Seed a pool for the FAMILY the replica shapes imply: dp/tp/pp
+        shapes share one CNN checkpoint, vtp shapes a dense ViT, ep
+        shapes a MoE ViT (one checkpoint, one architecture — mixing
+        families is refused by the constructor, so the seed only has to
+        look at which ViT kind, if any, appears)."""
         from ..utils.rng import root_key, split_streams
 
         key = split_streams(root_key(seed))["init"]
-        return cls({"params": init_params(key)}, **kwargs)
+        raw_shapes = kwargs.get("replica_shapes")
+        shapes = parse_replica_shapes(raw_shapes) if raw_shapes else []
+        kinds = {kind for kind, _ in shapes}
+        if kinds & {"vtp", "ep"}:
+            from . import sharded as shardlib
+
+            family = "ep" if "ep" in kinds else "vtp"
+            if kwargs.get("vit_cfg") is None:
+                kwargs["vit_cfg"] = shardlib.default_vit_cfg(family)
+            variables = {
+                "params": shardlib.seed_params(family, key, kwargs["vit_cfg"])
+            }
+        else:
+            from ..models.net import init_params
+
+            variables = {"params": init_params(key)}
+        return cls(variables, **kwargs)
 
     # -- single-engine-compatible surface --------------------------------------
 
@@ -571,6 +676,7 @@ class EnginePool:
             for i, engine in enumerate(self.engines):
                 self._warm_one(i, engine, parallel, sink, on_rung)
             self._check_store_sizing()
+            self._gate_sharded(sink)
             return
         from concurrent.futures import ThreadPoolExecutor
 
@@ -582,6 +688,19 @@ class EnginePool:
             for f in futures:
                 f.result()  # surface the first warmup failure, not hang
         self._check_store_sizing()
+        self._gate_sharded(sink)
+
+    def _gate_sharded(self, sink) -> None:
+        """Parity-gate every SHARDED replica against the single-device
+        reference forward of its family, immediately after warmup — a
+        sharded replica cannot take a request before this passes
+        (engine.launch refuses unverified variants), and a failing gate
+        fails the pool start loudly rather than serving wrong logits
+        fast (docs/SERVING.md sharded replicas)."""
+        for i, engine in enumerate(self.engines):
+            if engine.shard_kind == "dp":
+                continue
+            engine.verify_sharded_parity(raise_on_failure=True, sink=sink)
 
     def _check_store_sizing(self) -> None:
         """Post-warmup invariant (PR-19 satellite): the shared store was
@@ -719,6 +838,14 @@ class EnginePool:
                 sink=self._sink,
                 **(supervisor_kwargs or {}),
             ).start()
+        if self._sink is not None:
+            self._sink.emit("pool_topology", replicas={
+                _replica_name(i): {
+                    "shard_kind": engine.shard_kind,
+                    "devices": len(list(engine.mesh.devices.flat)),
+                }
+                for i, engine in enumerate(self.engines)
+            })
         return self.router
 
     @staticmethod
@@ -813,3 +940,19 @@ class EnginePool:
             self.supervisor = None
         if self.router is not None:
             self.router.stop(drain=drain)
+        # EP expert-load readback lags one dispatch (the engine stashes
+        # the device array and materializes it on the NEXT launch, so a
+        # readback never blocks the hot path); flush the stash now that
+        # the batchers are quiet, then put the final per-expert picture
+        # on the JSONL stream for perf_report's sharded-serving section.
+        ep_engines = [e for e in self.engines if e.shard_kind == "ep"]
+        for engine in ep_engines:
+            engine.flush_expert_load()
+        if ep_engines and self._sink is not None:
+            loads = self.metrics.expert_load_snapshot()
+            vals = list(loads.values())
+            mean = sum(vals) / len(vals) if vals else 0.0
+            self._sink.emit(
+                "expert_load", loads=loads,
+                imbalance=(max(vals) / mean) if mean else None,
+            )

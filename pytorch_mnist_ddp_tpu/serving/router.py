@@ -89,6 +89,22 @@ POLICIES = ("roundrobin", "least-loaded", "cost")
 EWMA_ALPHA = 0.2
 
 
+def shape_class(rows: int | None) -> str:
+    """Pow2-ceiling shape-class label (``"b8"`` holds 5..8 rows).
+
+    The cost policy's latency samples are bucketed the way the engine
+    pads, so one class aggregates requests that cost the SAME device
+    work — in a heterogeneous pool (a 4-device TP replica beside
+    1-device DP replicas) a replica's big-batch speedup must not be
+    credited to its small-batch requests, or vice versa."""
+    if not rows or rows < 1:
+        return "b1"
+    b = 1
+    while b < rows:
+        b *= 2
+    return f"b{b}"
+
+
 class Replica:
     """One routable replica: a name, its (started) batcher, optionally
     the engine behind it, and the router-side load state.
@@ -109,20 +125,39 @@ class Replica:
         # Replica objects in tests stay breaker-less and unrestricted.
         self.breaker: CircuitBreaker | None = None
         self._ewma_s: float | None = None
+        # Per-shape-class EWMAs (cost policy): {"b8": seconds, ...}.
+        self._class_ewma_s: dict[str, float] = {}
 
     # -- load signals --------------------------------------------------------
 
-    def observe_latency(self, latency_s: float) -> None:
+    def observe_latency(self, latency_s: float, rows: int | None = None) -> None:
         """Completion-worker hook (MicroBatcher ``on_complete``): feed
-        the per-replica EWMA the cost policy scores with, and count the
-        success toward the circuit breaker."""
+        the per-replica EWMAs the cost policy scores with, and count the
+        success toward the circuit breaker.  ``rows`` (the completed
+        request's row count) additionally lands the sample on its
+        shape class, so a heterogeneous replica's per-shape profile —
+        a TP replica that is fast at b64 but ordinary at b1 — is scored
+        per class, not smeared into one number.  ``rows=None`` (legacy
+        callers) keeps only the global EWMA."""
         prev = self._ewma_s
         self._ewma_s = (
             latency_s if prev is None
             else EWMA_ALPHA * latency_s + (1.0 - EWMA_ALPHA) * prev
         )
+        if rows is not None:
+            cls = shape_class(rows)
+            prev_c = self._class_ewma_s.get(cls)
+            self._class_ewma_s[cls] = (
+                latency_s if prev_c is None
+                else EWMA_ALPHA * latency_s + (1.0 - EWMA_ALPHA) * prev_c
+            )
         if self.breaker is not None:
             self.breaker.record_success()
+
+    def class_latency_s(self, cls: str) -> float | None:
+        """This replica's EWMA latency for one shape class (None until
+        a request of that class completes here)."""
+        return self._class_ewma_s.get(cls)
 
     def observe_failure(self, count: int = 1) -> None:
         """Worker failure hook (MicroBatcher ``on_failure``): one failed
@@ -165,6 +200,7 @@ class Replica:
             )
         self.batcher = batcher
         self._ewma_s = None  # stale latency must not bias placement
+        self._class_ewma_s = {}
         self.state = "active"
 
 
@@ -555,6 +591,10 @@ class Router:
                     1e3 * r.ewma_latency_s
                     if r.ewma_latency_s is not None else None
                 ),
+                "class_ewma_ms": {
+                    cls: 1e3 * s
+                    for cls, s in sorted(r._class_ewma_s.items())
+                },
             }
             for r in self.replicas
         }
@@ -583,7 +623,9 @@ class Router:
             return order
         return trials + [r for r in order if r not in trials]
 
-    def _order(self, active: list[Replica]) -> list[Replica]:
+    def _order(
+        self, active: list[Replica], rows: int | None = None
+    ) -> list[Replica]:
         """Active replicas, best placement first, under the lock."""
         with self._lock:
             rotation = self._rr
@@ -595,30 +637,56 @@ class Router:
             key = lambda r: r.load()  # noqa: E731 - local sort key
         else:
             # cost: expected time-to-answer = (backlog + this request) x
-            # EWMA latency.  A replica without samples yet (fresh, or
-            # just re-added) scores with the pool-mean EWMA as its prior
-            # — NOT last place, which would starve it of the very
-            # traffic that builds its estimate; with no samples anywhere
-            # the policy degrades to least-loaded (the documented
-            # fallback).
-            ewmas = [
-                r.ewma_latency_s for r in active
-                if r.ewma_latency_s is not None
-            ]
-            if not ewmas:
-                key = lambda r: r.load()  # noqa: E731 - local sort key
-            else:
-                prior = sum(ewmas) / len(ewmas)
+            # EWMA latency for THIS request's shape class.  Per-class
+            # scoring is what makes heterogeneous pools routable: a
+            # 4-device TP replica is several times faster at the top
+            # bucket but ordinary at b1, and one smeared EWMA would
+            # either hide the big-batch win or falsely promote it for
+            # small requests.  A replica without samples in the class
+            # scores with the CLASS's pool-mean as its prior — not the
+            # replica's other-shape samples (a fresh TP replica's b1
+            # latency says nothing about its b64), and not last place,
+            # which would starve it of the very traffic that builds its
+            # estimate.  No samples in the class anywhere -> the legacy
+            # global-EWMA score; no samples at all -> least-loaded (the
+            # documented fallback).
+            cls = shape_class(rows) if rows is not None else None
+            class_ewmas = (
+                [
+                    e for e in
+                    (r.class_latency_s(cls) for r in active)
+                    if e is not None
+                ]
+                if cls is not None else []
+            )
+            if class_ewmas:
+                prior = sum(class_ewmas) / len(class_ewmas)
 
                 def key(r: Replica):
-                    ewma = r.ewma_latency_s
+                    ewma = r.class_latency_s(cls)
                     return (r.load() + 1) * (prior if ewma is None else ewma)
+            else:
+                ewmas = [
+                    r.ewma_latency_s for r in active
+                    if r.ewma_latency_s is not None
+                ]
+                if not ewmas:
+                    key = lambda r: r.load()  # noqa: E731 - local sort key
+                else:
+                    prior = sum(ewmas) / len(ewmas)
+
+                    def key(r: Replica):
+                        ewma = r.ewma_latency_s
+                        return (r.load() + 1) * (
+                            prior if ewma is None else ewma
+                        )
         # Rotate before the stable sort so exact ties spread over
         # replicas instead of always landing on the first name.
         k = rotation % len(active)
         return self._trials_first(sorted(active[k:] + active[:k], key=key))
 
     def _note(self, replica: Replica, rows: int) -> None:
+        cls = shape_class(rows)
         if self._registry is not None:
             self._registry.counter(
                 "serving_router_decisions_total",
@@ -626,10 +694,22 @@ class Router:
                 policy=self.policy,
                 replica=replica.name,
             ).inc()
+            # A separate family, NOT an extra label on the one above:
+            # the per-replica family's label schema is pinned by CI
+            # greps and dashboards, and the shape tally answers a
+            # different question (which classes the cost model routed,
+            # perf_report's sharded-serving section).
+            self._registry.counter(
+                "serving_router_shape_decisions_total",
+                help="request placements by policy and request shape "
+                "class (pow2-ceiling rows bucket)",
+                policy=self.policy,
+                shape_class=cls,
+            ).inc()
         if self._sink:
             self._sink.emit(
                 "router_decision", policy=self.policy,
-                replica=replica.name, rows=rows,
+                replica=replica.name, rows=rows, shape_class=cls,
             )
 
     def submit(
@@ -685,7 +765,7 @@ class Router:
         # placement outright (docs/ROBUSTNESS.md); a half-open one
         # admits at most its trial quota, so a freshly restarted replica
         # proves itself on a trickle, not the full stream.
-        order = self._order(active)
+        order = self._order(active, len(x))
         saw_error: RejectedError | None = None
         for r in order:
             if r.breaker is not None and not r.breaker.try_acquire():

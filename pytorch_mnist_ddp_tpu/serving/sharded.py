@@ -1,0 +1,226 @@
+"""Sharded serving replicas: the shard-kind registry.
+
+One logical serving replica can span a k-device mesh (parallel/mesh.py
+``replica_mesh``): tensor parallel for the CNN and ViT families, expert
+parallel for MoE, pipeline parallel for depth.  This module is the single
+table the engine (serving/engine.py) consults per ``shard_kind`` — which
+predict-step builder to jit, how to place the host params onto the
+replica mesh, which single-device forward anchors the parity gate, and
+how tight that gate is.  Keeping the table OUT of the engine keeps the
+engine's variant/sentinel/Program machinery shard-agnostic: a sharded
+engine differs from a DP engine only in its mesh, its placed tree, and
+its default forward.
+
+Parity expectations (measured on this repo's models, pinned by
+tests/test_sharded.py):
+
+- **tp / vtp**: the row-parallel psum re-associates the reduction over
+  the sharded contraction dim, so outputs are ~1e-7 from the
+  single-device forward — gated at 1e-5 + argmax-identical.
+- **pp**: the pipeline runs the exact same op sequence per microbatch
+  (conv stack then dense head), so outputs are bit-identical — gated at
+  0.0.
+- **ep**: per-token expert math is slot-order independent, so with no
+  capacity drops outputs are bit-identical; capacity is per routing
+  GROUP (each device's row shard) versus the dense forward's one global
+  group, so at the capacity edge the two may drop different tokens and
+  the gate legitimately refuses — serve EP with capacity-factor headroom
+  (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..models.vit import ViTConfig
+from ..parallel.mesh import SHARD_KINDS, replica_mesh  # noqa: F401 (re-export)
+
+# Parity-gate tolerance (max |logp_sharded - logp_reference|) per kind,
+# plus argmax-identity on every row — the verify_parity discipline
+# applied to the shard topology instead of a dtype.
+SHARDED_PARITY_TOL = {"tp": 1e-5, "vtp": 1e-5, "pp": 0.0, "ep": 1e-5}
+
+# The ViT/MoE configs a sharded engine serves when the caller doesn't
+# pin one (from_seed smoke paths).  EP's capacity factor is 4.0, NOT the
+# training default 2.0: serving routes untrained-to-lightly-trained
+# distributions whose gate imbalance would drop tokens at 2.0, and a
+# dropped token is a parity failure by design (see module docstring).
+DEFAULT_VIT_CFG = ViTConfig()
+DEFAULT_MOE_CFG = ViTConfig(num_experts=4, capacity_factor=4.0)
+
+
+def default_vit_cfg(kind: str) -> ViTConfig:
+    return DEFAULT_MOE_CFG if kind == "ep" else DEFAULT_VIT_CFG
+
+
+def validate_family(kind: str, params: dict) -> None:
+    """Refuse a param tree from the wrong model family LOUDLY at
+    construction — the alternative is a shape error deep inside a
+    shard_map trace."""
+    is_vit = "blocks" in params
+    if kind in ("tp", "pp"):
+        if is_vit or "fc1" not in params:
+            raise ValueError(
+                f"shard kind {kind!r} serves the CNN family "
+                "(conv1/conv2/fc1/fc2 params); got a "
+                f"{'ViT' if is_vit else 'foreign'} tree"
+            )
+    elif kind in ("vtp", "ep"):
+        if not is_vit:
+            raise ValueError(
+                f"shard kind {kind!r} serves the ViT family "
+                "(blocks/<i> params); got a foreign tree"
+            )
+        if kind == "ep" and "moe" not in params["blocks"]["0"]:
+            raise ValueError(
+                "shard kind 'ep' serves the MoE-ViT family; the given "
+                "ViT tree has dense MLP blocks (use 'vtp')"
+            )
+        if kind == "vtp" and "moe" in params["blocks"]["0"]:
+            raise ValueError(
+                "shard kind 'vtp' serves the dense ViT family; the "
+                "given tree has MoE blocks (use 'ep')"
+            )
+
+
+def seed_params(kind: str, key, vit_cfg: ViTConfig | None = None) -> dict:
+    """Fresh reference-init params of the family ``kind`` serves — the
+    no-checkpoint smoke path (engine.from_seed / pool.from_seed)."""
+    if kind in ("vtp", "ep"):
+        from ..models.vit import init_vit_params
+
+        return init_vit_params(key, vit_cfg or default_vit_cfg(kind))
+    from ..models.net import init_params
+
+    return init_params(key)
+
+
+def place_params(kind: str, params: dict, mesh, vit_cfg: ViTConfig | None):
+    """Place host params onto the replica mesh with the kind's specs."""
+    from ..parallel.mesh import place_tree
+
+    if kind == "tp":
+        from ..parallel.tp import param_specs
+
+        return place_tree(params, param_specs(), mesh)
+    if kind == "vtp":
+        from ..parallel.tp_vit import vit_tp_param_specs
+
+        return place_tree(params, vit_tp_param_specs(vit_cfg), mesh)
+    if kind == "ep":
+        from ..parallel.ep import ep_param_specs
+
+        return place_tree(params, ep_param_specs(vit_cfg), mesh)
+    if kind == "pp":
+        from ..parallel.ddp import replicate_params
+
+        return replicate_params(params, mesh)
+    raise ValueError(f"unknown shard kind {kind!r}")
+
+
+def build_predict_fn(
+    kind: str,
+    mesh,
+    *,
+    vit_cfg: ViTConfig | None = None,
+    pp_microbatches: int = 2,
+    packed: bool = False,
+):
+    """The kind's jitted serving forward.
+
+    Unpacked: ``fn(params, x) -> logp`` (``(logp, expert_load)`` for
+    ``ep``).  Packed adds the segment-id vector and masks padding rows
+    to exactly 0.0, the ``make_packed_predict_step`` contract — the mask
+    composes OUTSIDE the shard_map (on the already-gathered logp, with
+    ``seg_ids`` placed against the sharded data axis), so one wrapper
+    serves every kind."""
+    import jax.numpy as jnp
+
+    if kind == "tp":
+        from ..parallel.tp import make_tp_predict_step
+
+        base = make_tp_predict_step(mesh)
+    elif kind == "vtp":
+        from ..parallel.tp_vit import make_vit_tp_predict_step
+
+        base = make_vit_tp_predict_step(mesh, vit_cfg)
+    elif kind == "ep":
+        from ..parallel.ep import make_ep_predict_step
+
+        base = make_ep_predict_step(mesh, vit_cfg)
+    elif kind == "pp":
+        from ..parallel.pp import make_pp_predict_step
+
+        base = make_pp_predict_step(mesh, num_micro=pp_microbatches)
+    else:
+        raise ValueError(f"unknown shard kind {kind!r}")
+    if not packed:
+        return base
+    if kind == "ep":
+
+        def packed_fn(params, x, seg_ids):
+            logp, load = base(params, x)
+            return jnp.where(seg_ids[:, None] >= 0, logp, 0.0), load
+
+    else:
+
+        def packed_fn(params, x, seg_ids):
+            logp = base(params, x)
+            return jnp.where(seg_ids[:, None] >= 0, logp, 0.0)
+
+    return jax.jit(packed_fn)
+
+
+def reference_fn(kind: str, vit_cfg: ViTConfig | None):
+    """The single-device forward the sharded parity gate compares
+    against: ``ref(host_params, x) -> logp`` — the same functions the
+    DP engine / single-device eval paths serve, jitted on the default
+    device.  Gate-time only (one extra compile per gated engine), never
+    on the dispatch path."""
+    if kind in ("tp", "pp"):
+        from ..models.net import Net
+
+        model = Net()
+
+        def fwd(params, x):
+            return model.apply({"params": params}, x, train=False)
+
+    elif kind == "vtp":
+        from ..models.vit import vit_forward
+
+        cfg = vit_cfg
+
+        def fwd(params, x):
+            return vit_forward(params, x, cfg)
+
+    elif kind == "ep":
+        from ..models.vit import vit_moe_forward
+
+        cfg = vit_cfg
+
+        def fwd(params, x):
+            return vit_moe_forward(params, x, cfg)[0]
+
+    else:
+        raise ValueError(f"unknown shard kind {kind!r}")
+    return jax.jit(fwd)
+
+
+def expert_imbalance(load: np.ndarray) -> float:
+    """max/mean of the per-expert kept-token counts — 1.0 is perfectly
+    balanced, E is total collapse onto one expert.  The scalar
+    perf_report and the SLO narrative quote."""
+    load = np.asarray(load, np.float64)
+    mean = float(load.mean())
+    if mean <= 0.0:
+        return 0.0
+    return float(load.max() / mean)
+
+
+def shard_devices(mesh) -> list[Any]:
+    """The replica's device list in mesh order (the
+    ``serving_shard_devices`` gauge value is its length)."""
+    return list(mesh.devices.flat)

@@ -2049,6 +2049,101 @@ def test_jl023_waiver():
 
 
 # ---------------------------------------------------------------------------
+# JL024 — sharded predict-step built over an inline mesh inside a loop
+
+
+JL024_BAD_INLINE_MESH = """\
+from pytorch_mnist_ddp_tpu.parallel.mesh import replica_mesh
+from pytorch_mnist_ddp_tpu.parallel.tp import make_tp_predict_step
+
+def warm(devices, buckets, probe, params):
+    for bucket in buckets:
+        step = make_tp_predict_step(replica_mesh("tp", 4, devices))
+        step(params, probe[:bucket])
+"""
+
+JL024_BAD_LOOP_ASSIGNED_MESH = """\
+from pytorch_mnist_ddp_tpu.parallel.mesh import single_device_mesh
+from pytorch_mnist_ddp_tpu.parallel.ddp import make_predict_step
+
+def serve(queue, devices, params):
+    while True:
+        x = queue.get()
+        mesh = single_device_mesh(devices[0])
+        step = make_predict_step(mesh)
+        step(params, x)
+"""
+
+JL024_BAD_MESH_KWARG = """\
+from pytorch_mnist_ddp_tpu.parallel import mesh as M
+from pytorch_mnist_ddp_tpu.parallel.ep import make_ep_predict_step
+
+def warm(devices, cfg, buckets, probe, params):
+    for bucket in buckets:
+        step = make_ep_predict_step(
+            cfg=cfg, mesh=M.replica_mesh("ep", 2, devices)
+        )
+        step(params, probe[:bucket])
+"""
+
+JL024_GOOD_THREADED_MESH = """\
+from pytorch_mnist_ddp_tpu.parallel.tp import make_tp_predict_step
+
+def warm(mesh, buckets, probe, params):
+    for bucket in buckets:
+        step = make_tp_predict_step(mesh)
+        step(params, probe[:bucket])
+"""
+
+JL024_GOOD_MESH_OUTSIDE_LOOP = """\
+from pytorch_mnist_ddp_tpu.parallel.mesh import replica_mesh
+from pytorch_mnist_ddp_tpu.parallel.pp import make_pp_predict_step
+
+def warm(devices, buckets, probe, params):
+    mesh = replica_mesh("pp", 2, devices)
+    for bucket in buckets:
+        step = make_pp_predict_step(mesh, num_micro=2)
+        step(params, probe[:bucket])
+"""
+
+JL024_GOOD_MODULE_MESH = """\
+from pytorch_mnist_ddp_tpu.parallel.mesh import make_mesh
+from pytorch_mnist_ddp_tpu.parallel.tp import make_tp_predict_step
+
+MESH = make_mesh()
+
+def warm(buckets, probe, params):
+    for bucket in buckets:
+        step = make_tp_predict_step(MESH)
+        step(params, probe[:bucket])
+"""
+
+
+def test_jl024_fires_on_in_loop_mesh_construction():
+    assert_fires(JL024_BAD_INLINE_MESH, "JL024", line=6)
+    # Bounded warmup sweeps are NOT exempt: a per-iteration mesh
+    # re-traces there exactly as in a serve loop.
+    assert_fires(JL024_BAD_LOOP_ASSIGNED_MESH, "JL024", line=8)
+    assert_fires(JL024_BAD_MESH_KWARG, "JL024", line=6)
+
+
+def test_jl024_silent_on_threaded_or_module_mesh():
+    assert_silent(JL024_GOOD_THREADED_MESH, "JL024")
+    assert_silent(JL024_GOOD_MESH_OUTSIDE_LOOP, "JL024")
+    assert_silent(JL024_GOOD_MODULE_MESH, "JL024")
+
+
+def test_jl024_waiver():
+    waived = JL024_BAD_INLINE_MESH.replace(
+        'step = make_tp_predict_step(replica_mesh("tp", 4, devices))',
+        'step = make_tp_predict_step(replica_mesh("tp", 4, devices))'
+        "  # jaxlint: disable=JL024 -- one-shot topology probe, not a "
+        "serve loop",
+    )
+    assert_silent(waived, "JL024")
+
+
+# ---------------------------------------------------------------------------
 # Suppressions + engine behavior
 
 

@@ -191,15 +191,17 @@ def test_predict_config_composition_is_canonical():
     assert set(cfg) == {
         "program", "dtype", "bucket", "mesh", "devices", "use_bn",
         "conv_impl", "device_stage", "prng_impl", "version",
-        "packed", "int8_impl",
+        "packed", "int8_impl", "shard_kind",
     }
     # The unversioned surfaces (engine default, trainer handoff) must
     # keep digest-matching: the default version is the empty string,
     # and a registry version unshares the entry on purpose.  Likewise
-    # the packed/int8_impl defaults (False/"dot") keep every pre-packed
-    # surface composing the same digest as each other.
+    # the packed/int8_impl/shard_kind defaults (False/"dot"/"dp") keep
+    # every pre-packed, unsharded surface composing the same digest as
+    # each other.
     assert cfg["version"] == ""
     assert cfg["packed"] is False and cfg["int8_impl"] == "dot"
+    assert cfg["shard_kind"] == "dp"
     packed = predict_config(
         mesh, "f32", 8, use_bn=False, conv_impl="conv", device_stage=True,
         packed=True,

@@ -646,6 +646,90 @@ def summarize_telemetry(directory: str) -> str | None:
             lines.append(
                 f"  router decisions [{policy}]: {rendered}{suffix}"
             )
+    # Sharded serving (serving/pool.py + engine.py): pool topology by
+    # replica shard shape, request share per shape, the warmup parity
+    # gates, EP expert-load imbalance, and the cost policy's decision
+    # tallies by request shape class — the operator's view of whether
+    # heterogeneous replicas (tp4 next to dp) are earning their devices.
+    topologies = [e for e in events if e.get("event") == "pool_topology"]
+    sharded_topos = [
+        e for e in topologies
+        if any(r.get("shard_kind", "dp") != "dp"
+               for r in e.get("replicas", {}).values())
+    ]
+    multi_topo = len({e.get("run_id") for e in sharded_topos}) > 1
+    for topo in sharded_topos:
+        rid = topo.get("run_id")
+        replicas = topo.get("replicas", {})
+        shape_of = {
+            name: f"{r.get('shard_kind', 'dp')}x{r.get('devices', 1)}"
+            for name, r in replicas.items()
+        }
+        rendered = ", ".join(
+            f"{name} {shape}" for name, shape in sorted(shape_of.items())
+        )
+        suffix = f" [run {str(rid)[-6:]}]" if multi_topo else ""
+        lines.append(
+            f"  sharded pool: {len(replicas)} replica(s) over "
+            f"{sum(r.get('devices', 1) for r in replicas.values())} "
+            f"device(s): {rendered}{suffix}"
+        )
+        # Request share folded by SHAPE, not by replica: a tp4 replica
+        # holding 4 devices should be judged against the dp replicas'
+        # combined share, and the per-replica line above already exists.
+        by_replica = share_runs.get(rid, {})
+        if by_replica:
+            by_shape: dict[str, int] = {}
+            for name, n in by_replica.items():
+                by_shape[shape_of.get(name, "dpx1")] = (
+                    by_shape.get(shape_of.get(name, "dpx1"), 0) + n
+                )
+            total = sum(by_shape.values())
+            shares = ", ".join(
+                f"{shape} {100.0 * n / total:.1f}% ({n})"
+                for shape, n in sorted(by_shape.items())
+            )
+            lines.append(
+                f"    requests by replica shape: {shares}{suffix}"
+            )
+    for e in events:
+        if e.get("event") != "expert_load":
+            continue
+        loads = e.get("loads", {})
+        imbalance = e.get("imbalance")
+        rendered = ", ".join(
+            f"e{k} {v:.0f}" for k, v in sorted(loads.items())
+        )
+        lines.append(
+            "  expert load (final EP dispatch): " + rendered
+            + (f"; imbalance (max/mean) {imbalance:.2f}"
+               if imbalance is not None else "")
+        )
+    shaped = [
+        e for e in decisions if e.get("shape_class")
+    ]
+    if shaped:
+        shape_runs: dict[tuple, dict[str, int]] = {}
+        for e in shaped:
+            tally = shape_runs.setdefault(
+                (e.get("run_id"), e.get("policy", "?")), {}
+            )
+            cls = e.get("shape_class", "?")
+            tally[cls] = tally.get(cls, 0) + 1
+        multi = len({rid for rid, _ in shape_runs}) > 1
+        for (rid, policy), tally in shape_runs.items():
+            rendered = ", ".join(
+                f"{cls} {n}" for cls, n in sorted(
+                    tally.items(),
+                    key=lambda kv: int(kv[0][1:])
+                    if kv[0][1:].isdigit() else 0,
+                )
+            )
+            suffix = f" [run {str(rid)[-6:]}]" if multi else ""
+            lines.append(
+                f"  shape-class decisions [{policy}]: {rendered}{suffix}"
+            )
+
     def _elastic_lines(kind: str, label: str) -> None:
         # Same per-run grouping as the share/decision lines above.
         ev_runs: dict[object, list] = {}
@@ -846,8 +930,13 @@ def summarize_telemetry(directory: str) -> str | None:
     gates = [e for e in events if e.get("event") == "parity_gate"]
     if gates:
         for e in gates:
+            # Sharded warmup gates (engine.verify_sharded_parity) carry
+            # the replica's shard shape next to the dtype variant label.
+            label = str(e.get("dtype", "?"))
+            if e.get("shard_kind") and e.get("shard_kind") != "dp":
+                label += f" {e['shard_kind']}x{e.get('devices', '?')}"
             lines.append(
-                f"  parity gate [{e.get('dtype', '?')}]: "
+                f"  parity gate [{label}]: "
                 + ("PASS" if e.get("passed") else "FAIL")
                 + f" (max|dlogit| {e.get('max_abs_logit_diff', 0.0):.2e}"
                 f" <= {e.get('tolerance', 0.0):g}, argmax_identical="

@@ -613,6 +613,7 @@ def _spin_self_serve(args, replicas: int | None):
             replicas=replicas or None, buckets=buckets, metrics=metrics,
             dtypes=dtypes, aot_cache=args.aot_cache,
             packed=packed, int8_impl=int8_impl,
+            replica_shapes=getattr(args, "replica_shapes", None),
         )
         print(
             f"self-serve pool: warming buckets {list(pool.buckets)} x "
@@ -2319,6 +2320,14 @@ def main(argv: list[str] | None = None) -> int:
         "pool behind the queue-aware router instead of one engine "
         "(0 = one per visible device, as in the serving CLI; "
         "docs/SERVING.md scale-out)",
+    )
+    parser.add_argument(
+        "--replica-shapes", default=None, metavar="SPEC",
+        help="--self-serve pool mode: comma-separated per-replica shard "
+        "shape, e.g. 'tp4,dp,dp,dp,dp' — tp/vtp/ep/pp replicas span "
+        "disjoint device blocks and are parity-gated against the "
+        "single-device reference at warmup; count must match --replicas "
+        "(docs/SERVING.md sharded replicas)",
     )
     parser.add_argument(
         "--router-policy", default="cost",

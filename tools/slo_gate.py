@@ -165,6 +165,11 @@ def run_gate(args) -> int:
         common += ["--packed"]
         if protocol.get("fill_wait_ms") is not None:
             common += ["--fill-wait-ms", str(protocol["fill_wait_ms"])]
+    if protocol.get("replica_shapes"):
+        # Heterogeneous pool: sharded replicas (tp/ep/pp) span device
+        # blocks and are parity-gated at warmup; the budgets must hold
+        # with them in the pool, not only for per-device dp replicas.
+        common += ["--replica-shapes", str(protocol["replica_shapes"])]
 
     # -- round 1: the steady-state trace --------------------------------------
     steady_report = os.path.join(workdir, "steady_report.json")
